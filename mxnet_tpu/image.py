@@ -1,0 +1,434 @@
+"""Image pipeline (parity: reference ``python/mxnet/image.py`` — the pure
+python fast image pipeline: decode, augmenters, ``ImageIter``).
+
+The reference decodes JPEG via an OpenCV-backed C++ op; this build has no
+OpenCV dependency, so codecs go through PIL when available and fall back to a
+raw ``.npy`` byte encoding (what ``tools/im2rec.py`` here writes by default).
+Augmenters are numpy transforms applied on the host, batched and prefetched;
+the device side stays pure XLA.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import logging
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import NDArray, array
+
+__all__ = ["imdecode", "imdecode_bytes", "imencode", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "ResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "RandomOrderAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "HorizontalFlipAug", "CastAug", "CreateAugmenter",
+           "ImageIter"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError:
+        return None
+
+
+def imencode(img, img_fmt=".jpg", quality=95):
+    """Encode an HWC uint8 array to bytes."""
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = np.asarray(img)
+    Image = _pil()
+    if Image is not None and img_fmt in (".jpg", ".jpeg", ".png"):
+        buf = _io.BytesIO()
+        fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(img.astype(np.uint8)).save(buf, format=fmt, quality=quality)
+        return buf.getvalue()
+    # raw fallback: npy bytes (self-describing)
+    buf = _io.BytesIO()
+    np.save(buf, img.astype(np.uint8))
+    return buf.getvalue()
+
+
+def imdecode_bytes(buf):
+    """Decode image bytes to an HWC uint8 numpy array."""
+    if isinstance(buf, (bytearray, memoryview)):
+        buf = bytes(buf)
+    if buf[:6] == b"\x93NUMPY":
+        return np.load(_io.BytesIO(buf))
+    Image = _pil()
+    if Image is None:
+        raise MXNetError("cannot decode image: PIL unavailable and not raw npy")
+    img = Image.open(_io.BytesIO(buf))
+    return np.asarray(img.convert("RGB"))
+
+
+def imdecode(buf, **kwargs):
+    """Decode to NDArray (parity: ``image.py:imdecode`` / the ``imdecode`` op)."""
+    return array(imdecode_bytes(buf))
+
+
+def scale_down(src_size, size):
+    """(parity: ``image.py:scale_down``)"""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to size (parity: ``image.py:resize_short``)."""
+    import jax
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    out = jax.image.resize(arr.astype(np.float32), (new_h, new_w) + arr.shape[2:],
+                           method="bilinear")
+    return array(np.asarray(out))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        import jax
+
+        out = np.asarray(jax.image.resize(
+            out.astype(np.float32), (size[1], size[0]) + out.shape[2:],
+            method="bilinear"))
+    return array(out)
+
+
+def random_crop(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else array(src)
+    out = src.asnumpy().astype(np.float32) - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        out = out / np.asarray(std, dtype=np.float32)
+    return array(out)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3.0 / 4.0, 4.0 / 3.0),
+                     interp=2):
+    """(parity: ``image.py:random_size_crop``)"""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = _pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ----------------------------------------------------------------------
+# augmenters (parity: image.py augmenter closures)
+# ----------------------------------------------------------------------
+
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomSizedCropAug(size, min_area=0.08, ratio=(3 / 4, 4 / 3), interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        srcs = [src]
+        t = list(ts)
+        _pyrandom.shuffle(t)
+        for i in t:
+            srcs = sum((i(s) for s in srcs), [])
+        return srcs
+
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    ts = []
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if brightness > 0:
+        def baug(src):
+            alpha = 1.0 + _pyrandom.uniform(-brightness, brightness)
+            return [array(src.asnumpy() * alpha)]
+        ts.append(baug)
+    if contrast > 0:
+        def caug(src):
+            alpha = 1.0 + _pyrandom.uniform(-contrast, contrast)
+            x = src.asnumpy()
+            gray = (x * coef).sum(axis=2, keepdims=True)
+            return [array(x * alpha + gray.mean() * (1 - alpha))]
+        ts.append(caug)
+    if saturation > 0:
+        def saug(src):
+            alpha = 1.0 + _pyrandom.uniform(-saturation, saturation)
+            x = src.asnumpy()
+            gray = (x * coef).sum(axis=2, keepdims=True)
+            return [array(x * alpha + gray * (1 - alpha))]
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        return [array(src.asnumpy() + rgb)]
+
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if _pyrandom.random() < p:
+            return [array(src.asnumpy()[:, ::-1])]
+        return [src]
+
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [array(src.asnumpy().astype(np.float32))]
+
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Create the standard augmenter list (parity: ``image.py:CreateAugmenter``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, interp=inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and getattr(mean, "shape", None):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over RecordIO or an image list (parity:
+    ``image.py:ImageIter`` / reference ``iter_image_recordio_2.cc``)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO, MXRecordIO
+
+            logging.info("loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+
+        self.imglist = None
+        if path_imglist:
+            logging.info("loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]], dtype=np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+                self.imgidx = imgkeys
+        elif isinstance(imglist, list):
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], (list, np.ndarray)):
+                    label = np.array(img[0], dtype=np.float32)
+                else:
+                    label = np.array([img[0]], dtype=np.float32)
+                result[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = result
+            self.imgidx = imgkeys
+
+        self.path_root = path_root
+        self.provide_data = [DataDesc(data_name, (batch_size,) + tuple(data_shape))]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.seq = self.imgidx
+        self.num_parts = num_parts
+        self.part_index = part_index
+        if num_parts > 1 and self.seq is not None:
+            # worker sharding (parity: InputSplit by worker)
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n : (part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from .recordio import unpack
+
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as fin:
+                img = fin.read()
+            return label, img
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        if self.label_width > 1:
+            batch_label = np.zeros((batch_size, self.label_width), dtype=np.float32)
+        else:
+            batch_label = np.zeros((batch_size,), dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = [array(imdecode_bytes(s).astype(np.float32))]
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    if i < batch_size:
+                        batch_data[i] = d.asnumpy().transpose(2, 0, 1)
+                        batch_label[i] = label if np.isscalar(label) or \
+                            self.label_width > 1 else np.asarray(label).reshape(-1)[0]
+                        i += 1
+        except StopIteration:
+            if not i:
+                raise
+        return DataBatch([array(batch_data)], [array(batch_label)],
+                         batch_size - i)

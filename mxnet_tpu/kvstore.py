@@ -1,0 +1,190 @@
+"""KVStore — parameter synchronization (parity: reference
+``include/mxnet/kvstore.h`` + ``src/kvstore/``).
+
+Types mirror the reference's ``KVStore::Create`` registry
+(``src/kvstore/kvstore.cc:17-44``):
+
+* ``local`` / ``local_allreduce_cpu``   — host-side reduce + updater
+* ``device`` / ``local_allreduce_device`` — reduce stays on accelerator; the
+  reduce that the reference does with GPU P2P trees (``comm.h:211-335``) is a
+  jitted XLA add-n here, and when values live on a sharded mesh the "reduce"
+  is an ICI all-reduce XLA inserts automatically.
+* ``dist_sync`` / ``dist_device_sync`` / ``dist_async`` — multi-process data
+  parallelism.  Instead of ps-lite worker/server RPC over ZMQ, Push/Pull map
+  to ``jax.lax.psum`` collectives across a process-spanning mesh (see
+  ``parallel/``); sync semantics match ``dist_sync`` (all workers see the
+  aggregated update after pull).  Single-process fallback behaves like
+  ``local`` with rank 0 of 1, so the same script runs anywhere.
+
+The optimizer-on-server concept (``kvstore_dist_server.h:136-205``) maps to
+``set_optimizer``: the updater runs where the reduced value lives (sharded
+optimizer state), preserving the python API including optimizer pickling.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (int, str)):
+        return [key], False
+    return list(key), True
+
+
+def _val_list(value, n):
+    """Normalize to a list-of-lists: per key, a list of device values."""
+    if isinstance(value, NDArray):
+        return [[value]]
+    assert isinstance(value, (list, tuple))
+    if n == 1 and (not value or isinstance(value[0], NDArray)):
+        return [list(value)]
+    out = []
+    for v in value:
+        out.append([v] if isinstance(v, NDArray) else list(v))
+    return out
+
+
+class KVStore(object):
+    """Key-value store for parameter sync (parity: ``kvstore.py:KVStore``)."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    # -- identity ------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        if self._kind.startswith("dist"):
+            import jax
+
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._kind.startswith("dist"):
+            import jax
+
+            return jax.process_count()
+        return 1
+
+    # -- data plane ----------------------------------------------------
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values into the store (reduce + optional update).
+
+        The reference overlaps comm with backward via per-layer priority
+        (``model.py:94-110``); XLA async dispatch gives the same overlap, so
+        ``priority`` is accepted and unused.
+        """
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            merged = vlist[0]
+            if len(vlist) > 1:
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + v._data
+                merged = NDArray(acc, vlist[0].context)
+            if self._kind.startswith("dist"):
+                merged = self._allreduce(merged)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] += merged
+
+    def pull(self, key, out=None, priority=0):
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data.astype(o.dtype))
+
+    def _allreduce(self, value):
+        """Cross-process reduce.  Multi-host: psum over the global mesh via
+        ``parallel.collectives``; single process: identity."""
+        if self.num_workers == 1:
+            return value
+        from .parallel.collectives import allreduce_hosts
+
+        return NDArray(allreduce_hosts(value._data), value.context)
+
+    # -- control plane -------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Register optimizer; in dist modes this plays the reference's
+        'pickle optimizer to servers' role (``kvstore.py:226``) — here the
+        updater simply runs where the reduced values live."""
+        # keep the pickle round-trip to preserve the reference contract
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    def barrier(self):
+        self._barrier_count += 1
+        if self.num_workers > 1:
+            from .parallel.collectives import barrier
+
+            barrier()
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _updater_key(k):
+    return int(k) if isinstance(k, int) or (isinstance(k, str) and k.isdigit()) else k
+
+
+_VALID = {
+    "local", "local_allreduce_cpu", "local_allreduce_device", "device",
+    "dist_sync", "dist_device_sync", "dist_async", "dist_sync_device", "dist",
+    "dist_tpu",
+}
+
+
+def create(name="local"):
+    """Create a KVStore (parity: ``kvstore.py:create`` /
+    ``src/kvstore/kvstore.cc:17``)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name not in _VALID:
+        raise MXNetError("Unknown KVStore type %r (valid: %s)" % (name, sorted(_VALID)))
+    return KVStore(name)

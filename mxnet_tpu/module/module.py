@@ -1,0 +1,463 @@
+"""Module — the intermediate-level trainer over one Symbol (parity: reference
+``python/mxnet/module/module.py``).
+
+Multi-device data parallelism is the one place this intentionally departs from
+the reference's architecture: instead of ``DataParallelExecutorGroup`` slicing
+the batch across per-device executors and reducing grads through kvstore
+(``executor_group.py:77,207-236``), a multi-context Module builds ONE executor
+whose inputs are sharded over a ``jax.sharding.Mesh`` of the given devices
+(batch axis sharded, params replicated).  XLA inserts the all-reduce (ICI
+collective on TPU) inside the compiled step — the GSPMD-native equivalent of
+kvstore 'device' mode, with comm/compute overlap scheduled by the compiler
+instead of by per-layer priorities.  The KVStore code path is kept for API
+parity and for `dist_*` multi-process modes.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..initializer import Uniform, InitDesc
+from ..io import DataDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint, save_checkpoint)
+from ..ndarray import NDArray
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """Module over a Symbol (parity: ``module.py:Module``)."""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [cpu()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = [c if c is not None else cpu() for c in context]
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._output_names = symbol.list_outputs()
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._mesh = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(parity: ``module.py:Module.load``)"""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(parity: ``module.py:save_checkpoint``)"""
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+
+    # ------------------------------------------------------------------
+    # properties
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in
+                zip(self._output_names, self._exec.outputs)]
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False):
+        """(parity: ``module.py:init_params``)"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+
+        if self._arg_params is None:
+            self._arg_params = {
+                name: nd.zeros(arr.shape, self._context[0], dtype=arr.dtype)
+                for name, arr in self._exec.arg_dict.items()
+                if name in self._param_names
+            }
+        if self._aux_params is None:
+            self._aux_params = {
+                name: nd.zeros(arr.shape, self._context[0], dtype=arr.dtype)
+                for name, arr in self._exec.aux_dict.items()
+            }
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None:
+                if name in cache:
+                    cache_arr = cache[name]
+                    if cache_arr is not arr:
+                        cache_arr.copyto(arr)
+                else:
+                    if not allow_missing:
+                        raise RuntimeError("%s is not presented" % name)
+                    if initializer is not None:
+                        initializer(_desc(name), arr)
+            else:
+                if initializer is not None:
+                    initializer(_desc(name), arr)
+
+        def _desc(name):
+            return InitDesc(name, attrs.get(name, None))
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        # copy the initialized parameters to devices
+        self._exec.copy_params_from(self._arg_params, self._aux_params)
+        self._exec.replicate_params(skip_names=self._input_names())
+
+    def set_params(self, arg_params, aux_params, allow_missing=False, force_init=True):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=allow_missing,
+                             force_init=force_init)
+            return
+        if self.params_initialized and not force_init:
+            return
+        self._exec.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+        self._exec.replicate_params(skip_names=self._input_names())
+        self.params_initialized = True
+        # only the executor copies were updated, not self._arg_params — they
+        # are dirty now (reference module.py:319-320)
+        self._params_dirty = True
+
+    def _sync_params_from_devices(self):
+        """(parity: ``module.py:_sync_params_from_devices``)"""
+        if self._exec is None:
+            return
+        for name in self._param_names:
+            if name in self._exec.arg_dict and self._arg_params is not None:
+                if name in self._arg_params:
+                    self._arg_params[name]._set_data(self._exec.arg_dict[name]._data)
+        if self._aux_params is not None:
+            for name, arr in self._exec.aux_dict.items():
+                if name in self._aux_params:
+                    self._aux_params[name]._set_data(arr._data)
+        self._params_dirty = False
+
+    # ------------------------------------------------------------------
+    # bind
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(parity: ``module.py:bind`` -> one GSPMD executor, see module doc)"""
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        def _norm(shapes):
+            out = []
+            for s in shapes or []:
+                if isinstance(s, DataDesc):
+                    out.append(s)
+                else:
+                    out.append(DataDesc(s[0], tuple(s[1])))
+            return out
+
+        self._data_shapes = _norm(data_shapes)
+        self._label_shapes = _norm(label_shapes) if label_shapes else []
+
+        shape_dict = {d.name: d.shape for d in self._data_shapes}
+        shape_dict.update({l.name: l.shape for l in self._label_shapes})
+        type_dict = {d.name: str(_np.dtype(d.dtype)) for d in self._data_shapes}
+        type_dict.update({l.name: str(_np.dtype(l.dtype)) for l in self._label_shapes})
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._param_names and name not in self._fixed_param_names:
+                req[name] = grad_req if for_training else "null"
+            elif name in self._data_names:
+                req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                req[name] = "null"
+
+        shared_exec = shared_module._exec if shared_module is not None else None
+        self._exec = self._symbol.simple_bind(
+            self._context[0], grad_req=req, type_dict=type_dict,
+            shared_exec=shared_exec, **shape_dict
+        )
+        if len(self._context) > 1:
+            self._setup_mesh()
+
+        if shared_module is not None and shared_module.params_initialized:
+            # bucketing: share the parameter arrays themselves so every bucket
+            # executor reads the same buffers (reference shares memory pools,
+            # graph_executor.cc InitDataEntryMemory shared_pool)
+            for name in self._param_names:
+                if name in shared_module._exec.arg_dict:
+                    self._exec.arg_dict[name] = shared_module._exec.arg_dict[name]
+                    if name in shared_module._exec.grad_dict:
+                        self._exec.grad_dict[name] = shared_module._exec.grad_dict[name]
+            for name, arr in shared_module._exec.aux_dict.items():
+                self._exec.aux_dict[name] = arr
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+
+    def _setup_mesh(self):
+        """Build the device mesh + shardings for multi-context DP."""
+        from ..parallel.mesh import data_parallel_mesh
+
+        devices = [c.jax_device for c in self._context]
+        self._mesh = data_parallel_mesh(devices)
+        self._exec.mesh = self._mesh
+
+    def _input_names(self):
+        return set(self._data_names) | set(self._label_names)
+
+    def _reset_bind(self):
+        self.binded = False
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._mesh = None
+
+    # ------------------------------------------------------------------
+    # optimizer
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(parity: ``module.py:init_optimizer``)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+
+        batch_size = self._data_shapes[0].shape[0]
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            # copy initialized local parameters to kvstore
+            _initialize_kvstore(kvstore=kvstore,
+                                param_arrays=self._param_arrays(),
+                                arg_params=self._arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def _param_arrays(self):
+        return [[self._exec.arg_dict[n]] for n in self._param_names]
+
+    def _grad_arrays(self):
+        return [[self._exec.grad_dict.get(n)] for n in self._param_names]
+
+    # ------------------------------------------------------------------
+    # computation
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """(parity: ``module.py:forward``)"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._load_batch(data_batch)
+        self._exec.forward(is_train=is_train)
+
+    def _load_batch(self, data_batch):
+        arrays = list(data_batch.data or [])
+        names = list(self._data_names)
+        labels = list(data_batch.label or [])
+        if self.for_training or labels:
+            names = names + list(self._label_names)
+            arrays = arrays + labels
+        for name, arr in zip(names, arrays):
+            if name not in self._exec.arg_dict:
+                continue
+            tgt = self._exec.arg_dict[name]
+            src = arr._data if isinstance(arr, NDArray) else None
+            if src is None:
+                tgt[:] = arr
+                continue
+            if tuple(src.shape) != tgt.shape:
+                raise MXNetError(
+                    "shape mismatch for %r: batch %s vs bound %s (use force_rebind"
+                    " or BucketingModule for variable shapes)"
+                    % (name, tuple(src.shape), tgt.shape))
+            if self._mesh is not None:
+                from ..parallel.mesh import shard_batch
+
+                tgt._set_data(shard_batch(self._mesh, src.astype(tgt.dtype)))
+            else:
+                # commit to the executor's device (H2D transfer)
+                import jax
+
+                tgt._set_data(jax.device_put(src.astype(tgt.dtype),
+                                             self._exec._ctx.jax_device))
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """(parity: ``module.py:update`` -> ``model.py:86-110``)"""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(self._param_arrays(), self._grad_arrays(),
+                                      self._kvstore)
+        else:
+            _update_params(self._param_arrays(), self._grad_arrays(),
+                           updater=self._updater, num_device=1,
+                           kvstore=self._kvstore)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        outputs = self.get_outputs()
+        eval_metric.update(labels, outputs[: len(labels)] if len(labels) and
+                           len(outputs) > len(labels) else outputs)
+
+    # ------------------------------------------------------------------
+    # optimizer states
+    # ------------------------------------------------------------------
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def borrow_optimizer(self, shared_module):
+        """(parity: ``module.py:borrow_optimizer``)"""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True

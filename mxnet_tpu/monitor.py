@@ -1,0 +1,95 @@
+"""Monitor — per-op tensor stat capture (parity: reference
+``python/mxnet/monitor.py``; executor monitor callback,
+``graph_executor.cc:131 ExecuteMonCallback``).
+
+The jitted executor doesn't call back per-op; instead ``toc`` re-runs the
+graph interpreted (un-jitted) over the executor's current inputs and applies
+``stat_func`` to every interior output — same observability, paid only when
+the monitor is active (the reference likewise disables bulk-exec for this).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return float(abs(x.asnumpy()).mean())
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            self._capture(exe)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def _capture(self, exe):
+        """Interpreted re-run capturing every interior output."""
+        import jax
+
+        from . import random as _random
+
+        sym = exe._symbol
+        args = {k: v._data for k, v in exe.arg_dict.items()}
+        auxs = {k: v._data for k, v in exe.aux_dict.items()}
+        env = {}
+        rng = _random.next_key()
+        for node in sym._topo():
+            if node.is_variable:
+                src = auxs if node.is_aux else args
+                env[node._id] = [src.get(node.name)]
+                continue
+            op = node.op
+            ins = [env[s._id][i] for s, i in node.inputs]
+            n_args = len(op.input_names(node.attrs))
+            node_rng = jax.random.fold_in(rng, node._id) if op.needs_rng else None
+            outs, _ = op.apply(node.attrs, ins[:n_args], ins[n_args:],
+                               is_train=True, rng=node_rng)
+            env[node._id] = outs
+            for i, o in enumerate(outs):
+                self.stat_helper(node.output_name(i), NDArray(o, exe._ctx))
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,194 @@
+"""Custom operators in Python (parity: reference ``python/mxnet/operator.py``
+— ``CustomOp``/``CustomOpProp`` registered through ``MXCustomOpRegister``).
+
+The reference routes custom-op forward/backward through C callbacks under the
+engine.  Here a registered CustomOp becomes a host computation embedded in the
+XLA graph via ``jax.pure_callback`` (ordering is guaranteed by dataflow —
+the callback's outputs feed the consumers), with gradients routed back through
+a ``jax.custom_vjp`` whose bwd calls the op's ``backward``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM_OPS: Dict[str, type] = {}
+
+
+class CustomOp(object):
+    """Base class for python custom operators (parity: ``operator.py:CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp(object):
+    """Properties of a custom op (parity: ``operator.py:CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp class under ``op_type`` (parity:
+    ``operator.py:register``).  Creates the ``Custom``-op plumbing so
+    ``mx.nd.Custom(..., op_type=reg_name)`` / ``mx.sym.Custom`` work."""
+
+    def do_register(prop_cls):
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered():
+    return dict(_CUSTOM_OPS)
+
+
+def _get_prop(op_type, kwargs):
+    if op_type not in _CUSTOM_OPS:
+        raise MXNetError("custom op %r is not registered" % op_type)
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    return _CUSTOM_OPS[op_type](**str_kwargs)
+
+
+# ----------------------------------------------------------------------
+# the host-callback 'Custom' op, registered in the main registry
+# ----------------------------------------------------------------------
+
+
+def _custom_impl(attrs, *inputs):
+    import jax
+    import jax.numpy as jnp
+
+    op_type = attrs["op_type"]
+    extra = attrs.get("_kwargs") or {}
+    if not isinstance(extra, dict):  # canonicalized to tuple-of-pairs by jit cache
+        extra = dict(extra)
+    prop = _get_prop(op_type, extra)
+    n_out = len(prop.list_outputs())
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    in_shapes = [tuple(x.shape) for x in inputs[:n_args]]
+    ishapes, oshapes, ashapes = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [x.dtype for x in inputs[:n_args]]
+    itypes, otypes, atypes = prop.infer_type(in_types)
+
+    out_structs = [
+        jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+        for s, t in zip(oshapes, otypes)
+    ]
+
+    @jax.custom_vjp
+    def run(*xs):
+        def host_fwd(*arrs):
+            cop = prop.create_operator(None, in_shapes, in_types)
+            in_nd = [array(_np.asarray(a)) for a in arrs[:n_args]]
+            aux_nd = [array(_np.asarray(a)) for a in arrs[n_args:]]
+            out_nd = [array(_np.zeros(s.shape, s.dtype)) for s in out_structs]
+            cop.forward(True, ["write"] * n_out, in_nd, out_nd, aux_nd)
+            return tuple(o.asnumpy() for o in out_nd)
+
+        return jax.pure_callback(host_fwd, tuple(out_structs), *xs)
+
+    def fwd(*xs):
+        outs = run(*xs)
+        return outs, (xs, outs)
+
+    def bwd(res, gs):
+        xs, outs = res
+
+        def host_bwd(*arrs):
+            k = len(xs)
+            xs_np = arrs[:k]
+            outs_np = arrs[k : k + n_out]
+            gs_np = arrs[k + n_out :]
+            cop = prop.create_operator(None, in_shapes, in_types)
+            in_nd = [array(_np.asarray(a)) for a in xs_np[:n_args]]
+            aux_nd = [array(_np.asarray(a)) for a in xs_np[n_args:]]
+            out_nd = [array(_np.asarray(a)) for a in outs_np]
+            ograd_nd = [array(_np.asarray(a)) for a in gs_np]
+            igrad_nd = [array(_np.zeros(a.shape, a.dtype)) for a in xs_np[:n_args]]
+            cop.backward(["write"] * n_args, ograd_nd, in_nd, out_nd, igrad_nd,
+                         aux_nd)
+            return tuple(g.asnumpy() for g in igrad_nd)
+
+        in_structs = [jax.ShapeDtypeStruct(tuple(x.shape), _np.dtype(x.dtype))
+                      for x in xs[:n_args]]
+        grads = jax.pure_callback(host_bwd, tuple(in_structs), *(xs + outs + gs))
+        # aux inputs receive zero cotangent
+        zeros_aux = tuple(jnp.zeros_like(x) for x in xs[n_args:])
+        return tuple(grads) + zeros_aux
+
+    run.defvjp(fwd, bwd)
+    out = run(*inputs)
+    return out if len(out) > 1 else out[0]
+
+
+def _register_custom_host_op():
+    from .ops.registry import Op, ParamSpec as P, register_op
+
+    def n_outputs(attrs):
+        extra = attrs.get("_kwargs") or {}
+        if not isinstance(extra, dict):
+            extra = dict(extra)
+        prop = _get_prop(attrs["op_type"], extra)
+        return len(prop.list_outputs())
+
+    op = Op(
+        "Custom",
+        _custom_impl,
+        variable_args=True,
+        num_outputs=n_outputs,
+        collect_extra=True,
+        params={"op_type": P("str", None, required=True), "_kwargs": P("any", None)},
+    )
+    register_op(op)
+
+
+_register_custom_host_op()

@@ -1,0 +1,8 @@
+"""Operator registry + compute rules (the NNVM registry, XLA edition)."""
+
+from .registry import OP_REGISTRY, Op, ParamSpec, get_op, list_ops, register
+
+# importing these modules populates the registry
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn_op  # noqa: F401

@@ -1,0 +1,193 @@
+"""Fused RNN operator (parity: reference ``src/operator/rnn.cc`` /
+``cudnn_rnn-inl.h`` — the cuDNN fused LSTM/GRU).
+
+The reference's CPU path is ``LOG(FATAL) "only available for gpu"``; the cuDNN
+path consumes one packed parameter blob.  Here the fused path is a
+``lax.scan`` over timesteps with the same packed-parameter layout as cuDNN
+(per layer/direction: [i2h_W gates..., h2h_W gates...] then all biases
+[i2h_b..., h2h_b...]), so ``FusedRNNCell.unpack_weights`` round-trips
+checkpoints exactly like ``rnn/rnn.py`` pack/unpack.
+
+Gate orders match cuDNN/MXNet: LSTM i,f,c,o ; GRU r,z,n.
+Layout: data (seq, batch, input) [layout='TNC'], states (layers*dirs, batch, h).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ParamSpec as P
+from .registry import register
+
+
+def _rnn_n_gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layer, input_size, state_size, bidirectional, mode):
+    """Total packed parameter count (matches cuDNN's layout arithmetic)."""
+    ng = _rnn_n_gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layer):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            size += ng * state_size * (in_sz + state_size)  # i2h + h2h weights
+    for layer in range(num_layer):
+        for _ in range(dirs):
+            size += ng * state_size * 2  # i2h + h2h biases
+    return size
+
+
+def rnn_param_slices(num_layer, input_size, state_size, bidirectional, mode):
+    """Offsets of each (layer, dir) -> dict of named slices into the blob."""
+    ng = _rnn_n_gates(mode)
+    dirs = 2 if bidirectional else 1
+    slices = []
+    off = 0
+    for layer in range(num_layer):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for d in range(dirs):
+            i2h = (off, (ng * state_size, in_sz))
+            off += ng * state_size * in_sz
+            h2h = (off, (ng * state_size, state_size))
+            off += ng * state_size * state_size
+            slices.append({"i2h_weight": i2h, "h2h_weight": h2h})
+    bi = 0
+    for layer in range(num_layer):
+        for d in range(dirs):
+            s = slices[layer * dirs + d]
+            s["i2h_bias"] = (off, (ng * state_size,))
+            off += ng * state_size
+            s["h2h_bias"] = (off, (ng * state_size,))
+            off += ng * state_size
+    return slices, off
+
+
+def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b, state_size):
+    """One timestep given precomputed input projection."""
+    g = x_proj + jnp.dot(h, h2h_w.T) + h2h_b
+    if mode == "lstm":
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        cc = jnp.tanh(cc)
+        o = jax.nn.sigmoid(o)
+        new_c = f * c + i * cc
+        new_h = o * jnp.tanh(new_c)
+        return new_h, new_c
+    if mode == "gru":
+        # MXNet/cuDNN GRU: r,z,n with n = tanh(x_n + r*(h2h_n))
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hr, hz, hn = jnp.split(jnp.dot(h, h2h_w.T) + h2h_b, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1.0 - z) * n + z * h
+        return new_h, c
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    new_h = act(g)
+    return new_h, c
+
+
+def _run_layer(mode, x, h0, c0, params, state_size, reverse=False):
+    """Scan one direction of one layer.  x: (T, B, in)."""
+    i2h_w, i2h_b, h2h_w, h2h_b = params
+    # big batched matmul across all timesteps first — MXU-friendly
+    x_proj = jnp.einsum("tbi,gi->tbg", x, i2h_w) + i2h_b
+    if mode == "gru":
+        pass  # h2h handled inside step for GRU
+
+    def step(carry, xp):
+        h, c = carry
+        if mode == "gru":
+            new_h, new_c = _cell_step(mode, xp, h, c, h2h_w, h2h_b, state_size)
+        else:
+            new_h, new_c = _cell_step(mode, xp, h, c, h2h_w, h2h_b, state_size)
+        return (new_h, new_c), new_h
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x_proj, reverse=reverse)
+    if reverse:
+        pass  # lax.scan(reverse=True) already emits outputs in forward order
+    return ys, hT, cT
+
+
+def _rnn_impl(attrs, data, parameters, state, state_cell=None):
+    mode = attrs["mode"]
+    L = attrs["num_layers"]
+    H = attrs["state_size"]
+    bid = attrs["bidirectional"]
+    dirs = 2 if bid else 1
+    T, B, I = data.shape
+    slices, total = rnn_param_slices(L, I, H, bid, mode)
+
+    def get(idx, name):
+        off, shape = slices[idx][name]
+        return jax.lax.dynamic_slice(parameters, (off,), (int(jnp.prod(jnp.array(shape))),)).reshape(shape)
+
+    x = data
+    hs, cs = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            off_w, wshape = slices[idx]["i2h_weight"]
+            i2h_w = jax.lax.dynamic_slice(parameters, (off_w,), (wshape[0] * wshape[1],)).reshape(wshape)
+            off_h, hshape = slices[idx]["h2h_weight"]
+            h2h_w = jax.lax.dynamic_slice(parameters, (off_h,), (hshape[0] * hshape[1],)).reshape(hshape)
+            off_ib, ibs = slices[idx]["i2h_bias"]
+            i2h_b = jax.lax.dynamic_slice(parameters, (off_ib,), ibs)
+            off_hb, hbs = slices[idx]["h2h_bias"]
+            h2h_b = jax.lax.dynamic_slice(parameters, (off_hb,), hbs)
+            h0 = jnp.broadcast_to(state[idx], (B, H)).astype(data.dtype)
+            c0 = (jnp.broadcast_to(state_cell[idx], (B, H)).astype(data.dtype)
+                  if state_cell is not None else jnp.zeros_like(h0))
+            ys, hT, cT = _run_layer(mode, x, h0, c0, (i2h_w, i2h_b, h2h_w, h2h_b),
+                                    H, reverse=(d == 1))
+            outs.append(ys)
+            hs.append(hT)
+            cs.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if attrs["p"] > 0 and layer < L - 1:
+            pass  # inter-layer dropout is a no-op in inference; train handled upstream
+    out = x
+    hstack = jnp.stack(hs, axis=0)
+    results = [out]
+    if attrs["state_outputs"]:
+        results.append(hstack)
+        if mode == "lstm":
+            results.append(jnp.stack(cs, axis=0))
+    return tuple(results) if len(results) > 1 else results[0]
+
+
+def _rnn_args(attrs):
+    if attrs.get("mode") == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_nout(attrs):
+    if not attrs.get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode") == "lstm" else 2
+
+
+@register(
+    "RNN",
+    arg_names=["data", "parameters", "state", "state_cell"],
+    input_names_fn=_rnn_args,
+    num_outputs=_rnn_nout,
+    params={
+        "state_size": P("int", 0, required=True),
+        "num_layers": P("int", 0, required=True),
+        "bidirectional": P("bool", False),
+        "mode": P("str", "lstm", enum=["rnn_relu", "rnn_tanh", "lstm", "gru"]),
+        "p": P("float", 0.0),
+        "state_outputs": P("bool", False),
+        "lstm_state_clip_min": P("float", None),
+        "lstm_state_clip_max": P("float", None),
+    },
+)
+def _rnn(attrs, data, parameters, state, state_cell=None):
+    return _rnn_impl(attrs, data, parameters, state, state_cell)

@@ -1,0 +1,13 @@
+"""Parallelism package — meshes, shardings, collectives, long-context kernels.
+
+This is where the TPU build *exceeds* the 2017 reference (SURVEY.md §2.4: the
+reference has only DP + manual model parallelism): GSPMD data/tensor/sequence/
+expert sharding over `jax.sharding.Mesh`, `shard_map` collectives over
+ICI/DCN, and a ring-attention path for long sequences.
+"""
+
+from . import mesh
+from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, local_mesh,
+                   make_mesh, replicate, shard_batch)
+from . import collectives
+from .collectives import allreduce_hosts, barrier, init_process_group, rank, size

@@ -1,0 +1,79 @@
+"""Cross-process collectives — the communication backend (parity: reference
+ps-lite worker/server RPC, ``src/kvstore/kvstore_dist.h``).
+
+Multi-host topology: every host runs the same program under
+``jax.distributed.initialize``; arrays span hosts through a global mesh, and
+Push/Pull-style reduction lowers to ``psum`` over ICI (intra-slice) / DCN
+(multi-slice) — no separate server processes, no ZMQ.  Single-process
+fallbacks keep the same API shape so tests run on one host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+__all__ = ["init_process_group", "allreduce_hosts", "barrier", "rank", "size"]
+
+_INITIALIZED = {"v": False}
+
+
+def init_process_group(coordinator_address=None, num_processes=None, process_id=None):
+    """Bootstrap multi-process JAX (parity: the dmlc tracker env handshake,
+    ``tools/launch.py`` + ``MXInitPSEnv``).  Reads ``MXNET_TPU_COORDINATOR``
+    style env vars when args are omitted (the DMLC_PS_ROOT_URI analog)."""
+    import os
+
+    if _INITIALIZED["v"]:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MXNET_TPU_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-process mode
+    num_processes = num_processes or int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("MXNET_TPU_PROC_ID", "0"))
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _INITIALIZED["v"] = True
+
+
+def rank():
+    return jax.process_index()
+
+
+def size():
+    return jax.process_count()
+
+
+def allreduce_hosts(array):
+    """Sum an equally-shaped array across all processes.
+
+    Implemented as a psum over a global 1-D mesh using one device per process
+    (the kvstore ``dist_sync`` reduce).  Single process: identity.
+    """
+    if jax.process_count() == 1:
+        return array
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = _np.array(jax.devices())  # globally visible devices, all hosts
+    mesh = Mesh(devs, ("workers",))
+    # each process contributes its local array; form a global batch then sum
+    stacked = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("workers")),
+        _np.asarray(array)[None],
+        (len(devs),) + tuple(array.shape),
+    )
+
+    @jax.jit
+    def _sum(x):
+        return jnp.sum(x, axis=0)
+
+    return _sum(stacked)
+
+
+def barrier():
+    """Block until all processes arrive (parity: ``ps::Postoffice::Barrier``)."""
+    if jax.process_count() == 1:
+        return
+    # a tiny allreduce doubles as a barrier
+    allreduce_hosts(_np.zeros((1,), dtype=_np.float32)).block_until_ready()

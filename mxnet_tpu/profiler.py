@@ -1,0 +1,45 @@
+"""Profiler (parity: reference ``python/mxnet/profiler.py`` +
+``src/engine/profiler.cc``).
+
+The reference hooks the engine to emit chrome://tracing JSON.  The TPU-native
+equivalent is the jax/XLA profiler (xplane): ``profiler_set_state('run')``
+starts a jax trace; ``dump_profile()`` stops it and leaves a trace viewable in
+TensorBoard/Perfetto.  The ``profiler_set_config`` filename becomes the trace
+directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+
+_STATE = {"mode": "symbolic", "dir": "profile_output", "running": False}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """(parity: ``profiler.py:profiler_set_config``)"""
+    _STATE["mode"] = mode
+    _STATE["dir"] = os.path.splitext(filename)[0]
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts an xplane trace; 'stop' ends it (parity:
+    ``profiler.py:profiler_set_state``)."""
+    import jax
+
+    if state == "run" and not _STATE["running"]:
+        os.makedirs(_STATE["dir"], exist_ok=True)
+        jax.profiler.start_trace(_STATE["dir"])
+        _STATE["running"] = True
+    elif state == "stop" and _STATE["running"]:
+        jax.profiler.stop_trace()
+        _STATE["running"] = False
+    else:
+        logging.debug("profiler state change to %r ignored", state)
+
+
+def dump_profile():
+    """Stop + flush the trace (parity: ``profiler.py:dump_profile``)."""
+    profiler_set_state("stop")

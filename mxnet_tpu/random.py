@@ -1,0 +1,30 @@
+"""Global PRNG state (parity: reference ``python/mxnet/random.py`` /
+``MXRandomSeed``).
+
+The reference seeds per-device mshadow PRNGs through the resource manager;
+here randomness is counter-based jax PRNG keys.  A module-level root key is
+split per draw, so eager sampling is reproducible after :func:`seed` and every
+draw under ``jit`` gets an explicit key (XLA-safe, replayable).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_STATE = {"key": jax.random.PRNGKey(0), "counter": 0}
+
+
+def seed(seed_state: int):
+    """Seed the global PRNG (parity: ``mx.random.seed``)."""
+    _STATE["key"] = jax.random.PRNGKey(int(seed_state))
+    _STATE["counter"] = 0
+
+
+def next_key():
+    """Split a fresh key off the global state (advances the stream)."""
+    _STATE["counter"] += 1
+    return jax.random.fold_in(_STATE["key"], _STATE["counter"])
+
+
+def current_key():
+    return _STATE["key"]

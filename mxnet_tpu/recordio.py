@@ -1,0 +1,177 @@
+"""RecordIO — record-packed dataset container (parity: reference
+``python/mxnet/recordio.py`` + dmlc-core recordio).
+
+Binary format is kept compatible with the reference: records framed with the
+dmlc magic ``0xced7230a`` + length word (upper 3 bits = continuation flag),
+payloads padded to 4 bytes; ``IRHeader`` packs (flag, label, id, id2) with
+``struct '<IfQQ'`` exactly as ``recordio.py:19-168``.  The C++ fast path for
+bulk packing/decode lives in ``src/`` (im2rec equivalent).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LREC_KIND_BITS = 29
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << _LREC_KIND_BITS) | length
+
+
+def _decode_lrec(rec):
+    return (rec >> _LREC_KIND_BITS) & 7, rec & ((1 << _LREC_KIND_BITS) - 1)
+
+
+class MXRecordIO(object):
+    """Sequential RecordIO reader/writer (parity: ``recordio.py:MXRecordIO``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError("Invalid RecordIO magic number")
+        _, length = _decode_lrec(lrec)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with ``.idx`` sidecar (parity:
+    ``recordio.py:MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.handle is not None and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + bytes into a record payload (parity: ``recordio.py:pack``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record payload (parity: ``recordio.py:unpack``)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=np.frombuffer(s[: header.flag * 4], dtype=np.float32))
+        s = s[header.flag * 4 :]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (parity: ``recordio.py:pack_img``; PNG/raw-npy
+    encoding here since OpenCV isn't a dependency)."""
+    from .image import imencode
+
+    return pack(header, imencode(img, img_fmt=img_fmt, quality=quality))
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    from .image import imdecode_bytes
+
+    img = imdecode_bytes(s)
+    return header, img

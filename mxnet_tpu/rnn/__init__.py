@@ -1,0 +1,10 @@
+"""RNN package (parity: reference ``python/mxnet/rnn/``)."""
+
+from . import rnn_cell
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell, FusedRNNCell,
+                       GRUCell, LSTMCell, ModifierCell, RNNCell, RNNParams,
+                       SequentialRNNCell, ZoneoutCell)
+from . import io
+from .io import BucketSentenceIter, encode_sentences
+from . import rnn
+from .rnn import do_rnn_checkpoint, load_rnn_checkpoint, save_rnn_checkpoint

@@ -1,0 +1,684 @@
+"""Symbolic RNN cells (parity: reference ``python/mxnet/rnn/rnn_cell.py:90-881``).
+
+Cells compose Symbols per step; ``FusedRNNCell`` emits the single fused ``RNN``
+op (a ``lax.scan`` kernel here instead of cuDNN, ``ops/rnn_op.py``) and
+``unfuse()`` lowers it to per-step cells, with ``pack_weights``/
+``unpack_weights`` keeping the cuDNN parameter-blob layout for checkpoint
+compatibility (reference ``rnn/rnn.py:15-80``).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray
+from .. import symbol
+from ..base import MXNetError
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ModifierCell", "RNNParams"]
+
+
+class RNNParams(object):
+    """Container for holding variables (parity: ``rnn_cell.py:RNNParams``)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell(object):
+    """Abstract base class for RNN cells (parity: ``rnn_cell.py:BaseRNNCell``)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError()
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified, (
+            "After applying modifier cells the base cell cannot be called directly. "
+            "Call the modifier cell instead.")
+        states = []
+        for shape in self.state_shape:
+            self._init_counter += 1
+            # the reference uses 0 for the unknown batch dim and resolves it at
+            # bind; here a 1-dim broadcasts against the batch inside the graph
+            shape = tuple(1 if d == 0 else d for d in shape)
+            state = func(name="%sbegin_state_%d" % (self._prefix, self._init_counter),
+                         shape=shape)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Unpack fused weights into per-gate weights (parity:
+        ``rnn_cell.py:unpack_weights``)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ["i2h", "h2h"]:
+            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
+            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
+            for j, gate in enumerate(self._gate_names):
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                args[wname] = weight[j * h : (j + 1) * h].copy()
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                args[bname] = bias[j * h : (j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """(parity: ``rnn_cell.py:pack_weights``)"""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        for group_name in ["i2h", "h2h"]:
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                weight.append(args.pop(wname))
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                bias.append(args.pop(bname))
+            args["%s%s_weight" % (self._prefix, group_name)] = ndarray.concatenate(weight)
+            args["%s%s_bias" % (self._prefix, group_name)] = ndarray.concatenate(bias)
+        return args
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=False):
+        """Unroll the cell (parity: ``rnn_cell.py:unroll``)."""
+        self.reset()
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            assert len(inputs.list_outputs()) == 1, (
+                "unroll doesn't allow grouped symbol as input. Convert to list first "
+                "or let unroll handle slicing")
+            axis = layout.find("T")
+            inputs = list(symbol.SliceChannel(inputs, axis=axis, num_outputs=length,
+                                              squeeze_axis=1))
+        else:
+            assert len(inputs) == length
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
+            outputs = symbol.Concat(*outputs, dim=1)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (parity: ``rnn_cell.py:RNNCell``)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name="%sh2h" % name)
+        output = symbol.Activation(i2h + h2h, act_type=self._activation,
+                                   name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (parity: ``rnn_cell.py:LSTMCell``; gate order i,f,c,o matches
+    the reference/cuDNN)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None, forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        from ..initializer import LSTMBias
+
+        self._iB = self.params.get("i2h_bias", init=LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden), (0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ["_i", "_f", "_c", "_o"]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%sh2h" % name)
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
+                                          name="%sslice" % name)
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
+                                    name="%si" % name)
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
+                                        name="%sf" % name)
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
+                                         name="%sc" % name)
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
+                                     name="%so" % name)
+        next_c = symbol._create("elemwise_add",
+                                [forget_gate * states[1], in_gate * in_transform],
+                                {}, name="%sstate" % name)
+        next_h = symbol._create("elemwise_mul",
+                                [out_gate, symbol.Activation(next_c, act_type="tanh")],
+                                {}, name="%sout" % name)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (parity: ``rnn_cell.py:GRUCell``; gate order r,z,n)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ["_r", "_z", "_o"]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        seq_idx = self._counter
+        name = "%st%d_" % (self._prefix, seq_idx)
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
+                                    bias=self._hB, num_hidden=self._num_hidden * 3,
+                                    name="%sh2h" % name)
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3,
+                                                name="%si2h_slice" % name)
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3,
+                                                name="%sh2h_slice" % name)
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                       name="%sr_act" % name)
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                        name="%sz_act" % name)
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h, act_type="tanh",
+                                       name="%sh_act" % name)
+        next_h = symbol._create(
+            "elemwise_add",
+            [(1.0 - update_gate) * next_h_tmp, update_gate * prev_state_h],
+            {}, name="%sout" % name)
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused RNN cell emitting one ``RNN`` op (parity:
+    ``rnn_cell.py:FusedRNNCell``; ``lax.scan`` kernel instead of cuDNN)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm", bidirectional=False,
+                 dropout=0.0, get_next_state=False, forget_bias=1.0,
+                 prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        initializer = None
+        self._parameter = self.params.get("parameters", init=initializer)
+
+    @property
+    def state_shape(self):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        n = 2 if self._mode == "lstm" else 1
+        return [(b, 0, self._num_hidden)] * n
+
+    @property
+    def _gate_names(self):
+        return {
+            "rnn_relu": [""],
+            "rnn_tanh": [""],
+            "lstm": ["_i", "_f", "_c", "_o"],
+            "gru": ["_r", "_z", "_o"],
+        }[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _slice_weights(self, arr, li, lh):
+        """Slice the packed blob into name->NDArray (layout: ops/rnn_op.py)."""
+        from ..ops.rnn_op import rnn_param_slices
+
+        args = {}
+        slices, total = rnn_param_slices(self._num_layers, li, lh,
+                                         self._bidirectional, self._mode)
+        dirs = len(self._directions)
+        data = arr.asnumpy().reshape(-1)
+        for layer in range(self._num_layers):
+            for d, dname in enumerate(self._directions):
+                idx = layer * dirs + d
+                for part in ("i2h", "h2h"):
+                    off, shape = slices[idx]["%s_weight" % part]
+                    n = int(_np.prod(shape))
+                    name = "%s%s%d_%s_weight" % (self._prefix, dname, layer, part)
+                    args[name] = ndarray.array(data[off : off + n].reshape(shape))
+                    boff, bshape = slices[idx]["%s_bias" % part]
+                    bn = int(_np.prod(bshape))
+                    bname = "%s%s%d_%s_bias" % (self._prefix, dname, layer, part)
+                    args[bname] = ndarray.array(data[boff : boff + bn].reshape(bshape))
+        return args
+
+    def unpack_weights(self, args):
+        from ..ops.rnn_op import rnn_param_size, rnn_param_slices
+
+        args = args.copy()
+        arr = args.pop("%sparameters" % self._prefix, None)
+        if arr is None:
+            arr = args.pop("parameters")
+        total = arr.size
+        ng = self._num_gates
+        dirs = len(self._directions)
+        h = self._num_hidden
+        # infer input size from blob size
+        L = self._num_layers
+        # total = sum over layers of dirs*ng*h*(in+h) + biases(2*ng*h*L*dirs)
+        bias_total = 2 * ng * h * L * dirs
+        w_total = total - bias_total
+        first_rest = w_total - (L - 1) * dirs * ng * h * (h * dirs + h)
+        input_size = first_rest // (dirs * ng * h) - h
+        out = self._slice_weights(arr, int(input_size), h)
+        args.update(out)
+        return args
+
+    def pack_weights(self, args):
+        from ..ops.rnn_op import rnn_param_slices
+
+        args = args.copy()
+        w0 = args["%sl0_i2h_weight" % self._prefix]
+        input_size = w0.shape[1]
+        h = self._num_hidden
+        dirs = len(self._directions)
+        slices, total = rnn_param_slices(self._num_layers, input_size, h,
+                                         self._bidirectional, self._mode)
+        blob = _np.zeros((total,), dtype=_np.float32)
+        for layer in range(self._num_layers):
+            for d, dname in enumerate(self._directions):
+                idx = layer * dirs + d
+                for part in ("i2h", "h2h"):
+                    name = "%s%s%d_%s_weight" % (self._prefix, dname, layer, part)
+                    off, shape = slices[idx]["%s_weight" % part]
+                    n = int(_np.prod(shape))
+                    blob[off : off + n] = args.pop(name).asnumpy().reshape(-1)
+                    bname = "%s%s%d_%s_bias" % (self._prefix, dname, layer, part)
+                    boff, bshape = slices[idx]["%s_bias" % part]
+                    bn = int(_np.prod(bshape))
+                    blob[boff : boff + bn] = args.pop(bname).asnumpy().reshape(-1)
+        args["%sparameters" % self._prefix] = ndarray.array(blob)
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("FusedRNNCell cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        if isinstance(inputs, list):
+            assert len(inputs) == length
+            inputs = [symbol.expand_dims(i, axis=0) for i in inputs]
+            inputs = symbol.Concat(*inputs, dim=0)
+            axis = 0
+        else:
+            if axis == 1:
+                # NTC -> TNC for the fused kernel
+                inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
+                axis = 0
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        if self._mode == "lstm":
+            rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+                             state=states[0], state_cell=states[1],
+                             state_size=self._num_hidden,
+                             num_layers=self._num_layers,
+                             bidirectional=self._bidirectional,
+                             p=self._dropout,
+                             state_outputs=self._get_next_state,
+                             mode=self._mode, name=self._prefix + "rnn")
+        else:
+            rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+                             state=states[0],
+                             state_size=self._num_hidden,
+                             num_layers=self._num_layers,
+                             bidirectional=self._bidirectional,
+                             p=self._dropout,
+                             state_outputs=self._get_next_state,
+                             mode=self._mode, name=self._prefix + "rnn")
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+        if layout == "NTC":
+            outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs = list(symbol.SliceChannel(outputs, axis=axis,
+                                               num_outputs=length,
+                                               squeeze_axis=1))
+        return outputs, states
+
+    def unfuse(self):
+        """Unfuse to a SequentialRNNCell of per-step cells (parity:
+        ``rnn_cell.py:unfuse``)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda cell_prefix: RNNCell(self._num_hidden,
+                                                    activation="relu",
+                                                    prefix=cell_prefix),
+            "rnn_tanh": lambda cell_prefix: RNNCell(self._num_hidden,
+                                                    activation="tanh",
+                                                    prefix=cell_prefix),
+            "lstm": lambda cell_prefix: LSTMCell(self._num_hidden,
+                                                 prefix=cell_prefix),
+            "gru": lambda cell_prefix: GRUCell(self._num_hidden,
+                                               prefix=cell_prefix),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i)),
+                    output_prefix="%sbi_%s_%d" % (self._prefix, self._mode, i)))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_" % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack multiple cells (parity: ``rnn_cell.py:SequentialRNNCell``)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, (
+                "Either specify params for SequentialRNNCell or child cells, not both.")
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_shape(self):
+        return sum([c.state_shape for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_shape)
+            state = states[p : p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=False):
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        num_cells = len(self._cells)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_shape)
+            states = begin_state[p : p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, input_prefix=input_prefix,
+                begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout between cells (parity: ``rnn_cell.py:DropoutCell``)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    @property
+    def state_shape(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells that modify another cell (parity: ``ModifierCell``)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_shape(self):
+        return self.base_cell.state_shape
+
+    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularizer on a cell (parity: ``rnn_cell.py:ZoneoutCell``)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), (
+            "FusedRNNCell doesn't support zoneout. Please unfuse first.")
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
+                                     self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: symbol.Dropout(  # noqa: E731
+            symbol.ones_like(like), p=p)
+        prev_output = self.prev_output if self.prev_output is not None else \
+            symbol.zeros((0, 0))
+        output = (symbol.where(mask(p_outputs, next_output), next_output,
+                               prev_output)
+                  if p_outputs != 0.0 else next_output)
+        states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
+                   for new_s, old_s in zip(next_states, states)]
+                  if p_states != 0.0 else next_states)
+        self.prev_output = output
+        return output, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Bidirectional wrapper (parity: ``rnn_cell.py:BidirectionalCell``)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_shape(self):
+        return sum([c.state_shape for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            axis = layout.find("T")
+            inputs = list(symbol.SliceChannel(inputs, axis=axis,
+                                              num_outputs=length, squeeze_axis=1))
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[: len(l_cell.state_shape)],
+            layout=layout, merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_shape) :],
+            layout=layout, merge_outputs=False)
+        outputs = [
+            symbol.Concat(l_o, r_o, dim=1,
+                          name="%st%d" % (self._output_prefix, i))
+            for i, (l_o, r_o) in enumerate(zip(l_outputs, reversed(r_outputs)))
+        ]
+        if merge_outputs:
+            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
+            outputs = symbol.Concat(*outputs, dim=1)
+        states = l_states + r_states
+        return outputs, states

@@ -1,0 +1,690 @@
+"""Symbol — symbolic graph construction (parity: reference nnvm ``Symbol`` +
+``python/mxnet/symbol.py``).
+
+A Symbol is a list of output entries ``(Node, out_index)`` over an immutable
+DAG of ``Node``s, composed functionally exactly like the reference
+(``MXSymbolCreateAtomicSymbol`` + ``Compose``).  Missing tensor inputs
+auto-materialize as variables (``{name}_weight`` ...), auxiliary states are
+variables flagged ``is_aux`` (the ``list_auxiliary_states`` split).
+
+JSON serialization keeps the reference's on-disk graph format
+(``nodes``/``arg_nodes``/``heads``, all attr values stringified) so
+``prefix-symbol.json`` checkpoints round-trip; see ``tojson``/``load``.
+
+Shape/type inference runs the registry compute rules under ``jax.eval_shape``
+— the XLA-native replacement for the reference's per-op ``InferShape``
+functions (``src/executor/graph_executor.cc:425-442``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from .attribute import AttrScope
+from .base import MXNetError, mx_dtype
+from .name import NameManager
+from .ops.registry import OP_REGISTRY, _ALIAS, Op, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros", "ones", "arange"]
+
+
+class Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "extra_attrs", "is_aux", "_id")
+
+    _counter = [0]
+
+    def __init__(self, op, name, attrs=None, inputs=None, extra_attrs=None, is_aux=False):
+        self.op: Optional[Op] = op
+        self.name = name
+        self.attrs = attrs or {}
+        self.inputs: List[Tuple["Node", int]] = inputs or []
+        self.extra_attrs = extra_attrs or {}  # string attrs (ctx_group, __shard__...)
+        self.is_aux = is_aux
+        Node._counter[0] += 1
+        self._id = Node._counter[0]
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        return 1 if self.is_variable else self.op.n_outputs(self.attrs)
+
+    def output_name(self, idx):
+        if self.is_variable:
+            return self.name
+        n = self.num_outputs()
+        if self.op.output_names and idx < len(self.op.output_names):
+            return "%s_%s" % (self.name, self.op.output_names[idx])
+        if n == 1:
+            return "%s_output" % self.name
+        return "%s_output%d" % (self.name, idx)
+
+
+def _topo_order(out_entries) -> List[Node]:
+    seen = {}
+    order: List[Node] = []
+
+    def visit(node):
+        if node._id in seen:
+            return
+        seen[node._id] = True
+        for inode, _ in node.inputs:
+            visit(inode)
+        order.append(node)
+
+    for node, _ in out_entries:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """Symbolic graph handle (a set of output entries)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs: List[Tuple[Node, int]] = list(outputs)
+
+    # -- introspection -------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def list_outputs(self):
+        return [n.output_name(i) for n, i in self._outputs]
+
+    def _topo(self):
+        return _topo_order(self._outputs)
+
+    def list_arguments(self):
+        return [n.name for n in self._topo() if n.is_variable and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo() if n.is_variable and n.is_aux]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable]
+
+    # -- composition ---------------------------------------------------
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError("Cannot find output %r; outputs: %s" % (index, names))
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def get_internals(self):
+        """Symbol exposing every internal output (parity: ``get_internals``)."""
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- attrs ---------------------------------------------------------
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node.extra_attrs.get(key, None)
+
+    def list_attr(self):
+        return dict(self._outputs[0][0].extra_attrs)
+
+    def attr_dict(self):
+        ret = {}
+        for node in self._topo():
+            d = dict(node.extra_attrs)
+            for k, v in node.attrs.items():
+                if v is not None:
+                    d[k] = _attr_str(v)
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].extra_attrs.update(kwargs)
+
+    # -- arithmetic sugar ---------------------------------------------
+    def __add__(self, other):
+        return _sugar(self, other, "elemwise_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sugar(self, other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _sugar(self, other, None, "_rminus_scalar")
+
+    def __mul__(self, other):
+        return _sugar(self, other, "elemwise_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __div__(self, other):
+        return _sugar(self, other, "elemwise_div", "_div_scalar")
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, other):
+        return _sugar(self, other, None, "_rdiv_scalar")
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, other):
+        return _sugar(self, other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    # -- shape/type inference -----------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = shape
+        known.update({k: v for k, v in kwargs.items() if v is not None})
+        type_dict = {k: _np.float32 for k in known}
+        shapes, out_shapes, aux_shapes, out_types, aux_types = _infer(
+            self, known, type_dict, partial=partial
+        )
+        return shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        tdict = {}
+        if args:
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    tdict[name] = t
+        tdict.update(kwargs)
+        # needs shapes too; use dummy 1-sized dims — dtype propagation only
+        raise NotImplementedError(
+            "infer_type requires shapes; use simple_bind/infer_shape instead"
+        )
+
+    # -- serialization -------------------------------------------------
+    def tojson(self):
+        nodes = self._topo()
+        nid = {n._id: i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            attr = {k: _attr_str(v) for k, v in n.attrs.items() if v is not None}
+            entry = {
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[src._id], idx, 0] for src, idx in n.inputs],
+            }
+            if attr:
+                entry["attr"] = attr
+            extra = dict(n.extra_attrs)
+            if n.is_aux:
+                extra["__is_aux__"] = "1"
+            if extra:
+                entry.setdefault("attr", {}).update(extra)
+            jnodes.append(entry)
+        graph = {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[nid[n._id], i, 0] for n, i in self._outputs],
+            "attrs": {"mxnet_version": ["int", 905]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding (graph executor entry) --------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_exec=None, **kwargs):
+        from .executor import Executor
+
+        return Executor._simple_bind(
+            self, ctx, grad_req=grad_req, type_dict=type_dict, group2ctx=group2ctx,
+            shared_exec=shared_exec, shapes=kwargs
+        )
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor._bind(
+            self, ctx, args, args_grad=args_grad, grad_req=grad_req,
+            aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec
+        )
+
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def grad(self, wrt):
+        raise NotImplementedError("use bind(args_grad=...) + backward()")
+
+
+def _attr_str(v):
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def _sugar(sym, other, op_name, scalar_op):
+    from . import symbol as _s
+
+    if isinstance(other, Symbol):
+        return _create(op_name, [sym, other], {})
+    if isinstance(other, (int, float)):
+        return _create(scalar_op, [sym], {"scalar": float(other)})
+    raise TypeError("unsupported operand type " + str(type(other)))
+
+
+# ----------------------------------------------------------------------
+# symbol creation
+# ----------------------------------------------------------------------
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None, **kwargs):
+    """Create a variable symbol (parity: ``symbol.py:Variable``)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current.get(attr)
+    extra = dict(attr) if attr else {}
+    if shape is not None:
+        extra["__shape__"] = _attr_str(tuple(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        extra["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        extra["__init__"] = init
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            extra[k] = str(v)
+    node = Node(None, name, extra_attrs=extra)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one (parity: ``symbol.py:Group``)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def _create(op_name, sym_inputs, kwargs, name=None, attr=None):
+    """Create a node applying ``op_name`` (the Compose step)."""
+    op = get_op(op_name)
+    if op.variable_args and "num_args" not in kwargs:
+        kwargs["num_args"] = len(sym_inputs)
+    attrs = op.parse_attrs(kwargs)
+    hint = op.name.lower().lstrip("_")
+    name = NameManager.current.get(name, hint)
+    extra = AttrScope.current.get(attr)
+
+    input_names = op.input_names(attrs)
+    inputs: List[Tuple[Node, int]] = []
+    for i, iname in enumerate(input_names):
+        if i < len(sym_inputs) and sym_inputs[i] is not None:
+            s = sym_inputs[i]
+            if len(s._outputs) != 1:
+                raise MXNetError("cannot compose with grouped symbol input")
+            inputs.append(s._outputs[0])
+        else:
+            vnode = Node(None, "%s_%s" % (name, iname))
+            inputs.append((vnode, 0))
+    # auxiliary states auto-materialize as flagged variables
+    for aname in op.aux_names:
+        anode = Node(None, "%s_%s" % (name, aname), is_aux=True)
+        inputs.append((anode, 0))
+
+    node = Node(op, name, attrs=attrs, inputs=inputs, extra_attrs=extra)
+    n = node.num_outputs()
+    return Symbol([(node, i) for i in range(n)])
+
+
+def _make_sym_fn(op_name):
+    op = get_op(op_name)
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = list(args)
+        # tensor inputs by keyword
+        consumed = []
+        for aname in op.arg_names:
+            if aname in kwargs and isinstance(kwargs[aname], Symbol):
+                sym_inputs.append(kwargs.pop(aname))
+        if op.variable_args:
+            # Concat(*args) style: also accept a list as first arg
+            if len(sym_inputs) == 1 and isinstance(sym_inputs[0], (list, tuple)):
+                sym_inputs = list(sym_inputs[0])
+        return _create(op_name, sym_inputs, kwargs, name=name, attr=attr)
+
+    fn.__name__ = op_name
+    fn.__doc__ = "Symbolic op %r (TPU-native; see ops registry)." % op_name
+    return fn
+
+
+def _init_module():
+    import sys
+
+    mod = sys.modules[__name__]
+    for name in list(OP_REGISTRY) + list(_ALIAS):
+        if not hasattr(mod, name):
+            setattr(mod, name, _make_sym_fn(name))
+        public = name[1:] if name.startswith("_") else name
+        if public and not hasattr(mod, public):
+            setattr(mod, public, _make_sym_fn(name))
+
+
+# creation sugar matching mx.sym namespace
+def zeros(shape, dtype=None, **kwargs):
+    return _create("_zeros", [], {"shape": shape, "dtype": str(_np.dtype(dtype or "float32"))})
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _create("_ones", [], {"shape": shape, "dtype": str(_np.dtype(dtype or "float32"))})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype=None):
+    return _create(
+        "_arange",
+        [],
+        {"start": start, "stop": stop, "step": step, "repeat": repeat,
+         "dtype": str(_np.dtype(dtype or "float32"))},
+        name=name,
+    )
+
+
+# ----------------------------------------------------------------------
+# JSON load (keeps reference graph format incl. "param" legacy key,
+# reference src/nnvm/legacy_json_util.cc)
+# ----------------------------------------------------------------------
+
+
+def load_json(json_str):
+    graph = json.loads(json_str)
+    jnodes = graph["nodes"]
+    nodes: List[Node] = []
+    for jn in jnodes:
+        opname = jn["op"]
+        raw_attr = dict(jn.get("attr", jn.get("param", {}) or {}))
+        raw_attr.update(jn.get("attrs", {}) if isinstance(jn.get("attrs"), dict) else {})
+        is_aux = raw_attr.pop("__is_aux__", None) == "1"
+        if opname == "null":
+            node = Node(None, jn["name"], extra_attrs=raw_attr, is_aux=is_aux)
+        else:
+            op = get_op(opname)
+            known = {}
+            extra = {}
+            for k, v in raw_attr.items():
+                if k in op.params or (k == "num_args" and op.variable_args):
+                    known[k] = v
+                else:
+                    extra[k] = v
+            attrs = op.parse_attrs(known)
+            inputs = [(nodes[e[0]], e[1]) for e in jn["inputs"]]
+            node = Node(op, jn["name"], attrs=attrs, inputs=inputs, extra_attrs=extra)
+            # re-flag aux inputs by the op's declaration
+            n_args = len(op.input_names(attrs))
+            for (inode, _), pos in zip(inputs, range(len(inputs))):
+                if pos >= n_args and inode.is_variable:
+                    inode.is_aux = True
+        nodes.append(node)
+    heads = [(nodes[h[0]], h[1]) for h in graph["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ----------------------------------------------------------------------
+# inference engine shared with executor: trace under eval_shape
+# ----------------------------------------------------------------------
+
+
+def _infer(symbol: Symbol, shape_dict: Dict[str, tuple], type_dict=None, partial=False):
+    """Infer shapes/types by abstract evaluation (jax.eval_shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    type_dict = type_dict or {}
+    nodes = symbol._topo()
+    variables = [n for n in nodes if n.is_variable]
+    args = [n for n in variables if not n.is_aux]
+    auxs = [n for n in variables if n.is_aux]
+
+    # seed known shapes; variables can also carry __shape__ hints
+    known = dict(shape_dict)
+    for n in variables:
+        if n.name not in known and "__shape__" in n.extra_attrs:
+            from .ops.registry import _parse_shape
+
+            known[n.name] = _parse_shape(n.extra_attrs["__shape__"])
+
+    # iterative local propagation: run graph with placeholders, solving unknown
+    # variable shapes from op constraints where derivable (FC weight etc.)
+    resolved: Dict[str, tuple] = dict(known)
+    resolved_types: Dict[str, _np.dtype] = {
+        k: _np.dtype(type_dict.get(k, _np.float32)) for k in list(resolved)
+    }
+
+    shapes_out: Dict[int, List] = {}  # node id -> list of ShapeDtypeStruct per output
+
+    def get_entry(entry):
+        node, idx = entry
+        return shapes_out[node._id][idx]
+
+    progress = True
+    pending = list(nodes)
+    while progress:
+        progress = False
+        remaining = []
+        for node in pending:
+            if node.is_variable:
+                if node.name in resolved:
+                    dt = _np.dtype(type_dict.get(node.name, resolved_types.get(node.name, _np.float32)))
+                    shapes_out[node._id] = [jax.ShapeDtypeStruct(tuple(resolved[node.name]), dt)]
+                    progress = True
+                else:
+                    remaining.append(node)
+                continue
+            if not all(inode._id in shapes_out for inode, _ in node.inputs):
+                # try to back-solve parameter shapes from known data shapes
+                if _try_param_solve(node, shapes_out, resolved, resolved_types):
+                    progress = True
+                remaining.append(node)
+                continue
+            in_structs = [get_entry(e) for e in node.inputs]
+            op = node.op
+            n_args = len(op.input_names(node.attrs))
+            arg_structs = in_structs[:n_args]
+            aux_structs = in_structs[n_args:]
+
+            def absfn(*tensors):
+                a = tensors[:n_args]
+                x = tensors[n_args:]
+                kw = {}
+                if op.needs_mode:
+                    kw["is_train"] = False
+                if op.needs_rng:
+                    kw["rng"] = jax.random.PRNGKey(0)
+                outs, new_aux = op.apply(node.attrs, a, x, **kw)
+                return tuple(outs) + tuple(new_aux)
+
+            try:
+                result = jax.eval_shape(absfn, *(arg_structs + aux_structs))
+            except Exception as e:  # pragma: no cover
+                raise MXNetError(
+                    "shape inference failed at node %r (%s): %s"
+                    % (node.name, op.name, e)
+                )
+            shapes_out[node._id] = list(result)
+            progress = True
+        pending = remaining
+        if not pending:
+            break
+
+    if pending and not partial:
+        missing = sorted({n.name for n in pending if n.is_variable})
+        raise MXNetError(
+            "cannot infer shapes; unresolved variables: %s (provide their shapes)"
+            % (missing,)
+        )
+
+    def var_shape(n):
+        if n._id in shapes_out:
+            s = shapes_out[n._id][0]
+            return tuple(s.shape), _np.dtype(s.dtype)
+        return None, None
+
+    arg_shapes = []
+    arg_types = []
+    for n in args:
+        s, t = var_shape(n)
+        arg_shapes.append(s)
+        arg_types.append(t)
+    aux_shapes = []
+    aux_types = []
+    for n in auxs:
+        s, t = var_shape(n)
+        aux_shapes.append(s)
+        aux_types.append(t)
+    out_shapes = []
+    out_types = []
+    for e in symbol._outputs:
+        node, idx = e
+        if node._id in shapes_out:
+            s = shapes_out[node._id][idx]
+            out_shapes.append(tuple(s.shape))
+            out_types.append(_np.dtype(s.dtype))
+        else:
+            out_shapes.append(None)
+            out_types.append(None)
+    return arg_shapes, out_shapes, aux_shapes, out_types, aux_types
+
+
+def _try_param_solve(node, shapes_out, resolved, resolved_types):
+    """Back-solve parameter/aux variable shapes for common layers once the
+    data input shape is known (the reference does this in per-op InferShape)."""
+    op = node.op
+    if op is None:
+        return False
+    name_of = {}
+    input_names = op.input_names(node.attrs) + op.aux_names
+    for (inode, _), iname in zip(node.inputs, input_names):
+        name_of[iname] = inode
+    data = name_of.get("data")
+    if data is None or data._id not in shapes_out:
+        return False
+    dshape = tuple(shapes_out[data._id][0].shape)
+    ddtype = shapes_out[data._id][0].dtype
+    solved = {}
+    a = node.attrs
+    if op.name == "FullyConnected":
+        in_dim = int(_np.prod(dshape[1:])) if a.get("flatten", True) else dshape[-1]
+        solved["weight"] = (a["num_hidden"], in_dim)
+        solved["bias"] = (a["num_hidden"],)
+    elif op.name in ("Convolution",):
+        k = a["kernel"]
+        ng = a.get("num_group", 1)
+        solved["weight"] = (a["num_filter"], dshape[1] // ng) + tuple(k)
+        solved["bias"] = (a["num_filter"],)
+    elif op.name == "Deconvolution":
+        k = a["kernel"]
+        ng = a.get("num_group", 1)
+        solved["weight"] = (dshape[1], a["num_filter"] // ng) + tuple(k)
+        solved["bias"] = (a["num_filter"],)
+    elif op.name in ("BatchNorm",):
+        c = dshape[1] if len(dshape) > 1 else dshape[0]
+        for p in ("gamma", "beta", "moving_mean", "moving_var"):
+            solved[p] = (c,)
+    elif op.name == "InstanceNorm":
+        c = dshape[1]
+        solved["gamma"] = (c,)
+        solved["beta"] = (c,)
+    elif op.name == "LeakyReLU" and a.get("act_type") == "prelu":
+        solved["gamma"] = (dshape[1] if len(dshape) > 1 else dshape[0],)
+    elif op.name == "Embedding":
+        solved["weight"] = (a["input_dim"], a["output_dim"])
+    elif op.name == "SoftmaxOutput":
+        if a.get("multi_output"):
+            solved["label"] = (dshape[0],) + tuple(dshape[2:])
+        else:
+            solved["label"] = (dshape[0],)
+    elif op.name in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                     "MAERegressionOutput"):
+        solved["label"] = dshape
+    elif op.name in ("SVMOutput", "softmax_cross_entropy"):
+        solved["label"] = (dshape[0],)
+    else:
+        return False
+    progress = False
+    for pname, pshape in solved.items():
+        vnode = name_of.get(pname)
+        if vnode is not None and vnode.is_variable and vnode._id not in shapes_out:
+            dt = _np.float32
+            shapes_out[vnode._id] = [jax.ShapeDtypeStruct(tuple(pshape), dt)]
+            resolved[vnode.name] = tuple(pshape)
+            progress = True
+    return progress
+
+
+import jax  # noqa: E402  (used in _infer/_try_param_solve)

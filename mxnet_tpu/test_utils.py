@@ -1,0 +1,296 @@
+"""Testing utilities (parity: reference ``python/mxnet/test_utils.py``):
+numeric-gradient checking, golden forward/backward checks, cross-context
+consistency — the reference's whole test strategy (SURVEY.md §4), with JAX
+autodiff as the oracle alongside finite differences.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "random_arrays",
+           "numeric_grad", "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "simple_forward"]
+
+_DEFAULT_CTX = [None]
+
+
+def default_context():
+    """(parity: ``test_utils.py:default_context``)"""
+    if _DEFAULT_CTX[0] is not None:
+        return _DEFAULT_CTX[0]
+    return current_context()
+
+
+def set_default_context(ctx):
+    _DEFAULT_CTX[0] = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def same(a, b):
+    return _np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    return _np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    if almost_equal(a, b, rtol, atol):
+        return
+    err = _np.max(_np.abs(_np.asarray(a) - _np.asarray(b)))
+    raise AssertionError(
+        "Items %s and %s are not almost equal (max abs err %g, rtol=%g, atol=%g)"
+        % (names[0], names[1], err, rtol, atol))
+
+
+def rand_ndarray(shape, ctx=None, dtype=_np.float32):
+    return array(_np.random.uniform(-1.0, 1.0, shape).astype(dtype),
+                 ctx=ctx or default_context())
+
+
+def random_arrays(*shapes):
+    arrays = [_np.random.randn(*s).astype(_np.float32) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def _parse_location(symbol, location, ctx):
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(symbol.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match."
+                "symbol args:%s, location.keys():%s"
+                % (str(set(symbol.list_arguments())), str(set(location.keys()))))
+    else:
+        location = {k: v for k, v in zip(symbol.list_arguments(), location)}
+    location = {
+        k: array(v, ctx=ctx) if isinstance(v, _np.ndarray) else v
+        for k, v in location.items()
+    }
+    return location
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Finite-difference gradients (parity: ``test_utils.py:numeric_grad``)."""
+    approx_grads = {k: _np.zeros(v.shape, dtype=_np.float32)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(_np.prod(old_value.shape))):
+            # inplace update
+            loc = _np.unravel_index(i, old_value.shape)
+            old_v = old_value[loc]
+            perturbed = old_value.copy()
+            perturbed[loc] = old_v + eps / 2
+            executor.arg_dict[k][:] = perturbed
+            if aux_states is not None:
+                for key, val in aux_states.items():
+                    executor.aux_dict[key][:] = val
+            executor.forward(is_train=use_forward_train)
+            f_peps = executor.outputs[0].asnumpy().sum()
+            perturbed[loc] = old_v - eps / 2
+            executor.arg_dict[k][:] = perturbed
+            if aux_states is not None:
+                for key, val in aux_states.items():
+                    executor.aux_dict[key][:] = val
+            executor.forward(is_train=use_forward_train)
+            f_neps = executor.outputs[0].asnumpy().sum()
+            approx_grads[k].ravel()[i] = (f_peps - f_neps) / eps
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym_, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Verify symbolic gradients vs finite differences (parity:
+    ``test_utils.py:check_numeric_gradient:360``)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    if aux_states is not None:
+        aux_npy = {k: _np.asarray(v) for k, v in aux_states.items()}
+    else:
+        aux_npy = None
+
+    if grad_nodes is None:
+        grad_nodes = sym_.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_nodes = list(grad_nodes)
+        grad_req = {k: "write" if k in grad_nodes else "null"
+                    for k in sym_.list_arguments()}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = grad_nodes.keys()
+    else:
+        raise ValueError
+
+    input_shape = {k: v.shape for k, v in location.items()}
+    _, out_shapes, _ = sym_.infer_shape(**input_shape)
+    # project the output with random weights so every output element's gradient
+    # is exercised (same trick as the reference's check_numeric_gradient)
+    proj = sym.Variable("__random_proj")
+    out = sym.MakeLoss(sym.sum(sym_ * proj))
+
+    location = dict(location)
+    location["__random_proj"] = array(
+        _np.random.uniform(-1.0, 1.0, out_shapes[0]).astype("float32"), ctx)
+    args_grad_npy = {k: _np.random.normal(0, 0.01, size=location[k].shape)
+                     for k in grad_nodes}
+    args_grad = {k: array(v, ctx) for k, v in args_grad_npy.items()}
+
+    executor = out.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req,
+                        aux_states={k: array(v, ctx) for k, v in aux_npy.items()}
+                        if aux_npy else None)
+
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    numeric_gradients = numeric_grad(
+        executor, {k: v for k, v in location_npy.items()},
+        aux_npy, eps=numeric_eps, use_forward_train=use_forward_train)
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if grad_req[name] == "write":
+            assert_almost_equal(fd_grad, sym_grad, rtol, atol or 1e-4,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(fd_grad, sym_grad - args_grad_npy[name], rtol,
+                                atol or 1e-4,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+
+
+def check_symbolic_forward(sym_, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare forward outputs against golden values (parity:
+    ``test_utils.py:check_symbolic_forward:473``)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    aux = {k: array(_np.asarray(v), ctx) for k, v in (aux_states or {}).items()} \
+        if aux_states else None
+    executor = sym_.bind(ctx, args=location, aux_states=aux)
+    outputs = executor.forward()
+    for output, expect in zip(outputs, expected):
+        assert_almost_equal(output.asnumpy(), expect, rtol, atol or 1e-20)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym_, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare backward grads against golden values (parity:
+    ``test_utils.py:check_symbolic_backward:526``)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym_.list_arguments(), expected)}
+    args_grad_npy = {k: _np.random.normal(size=v.shape)
+                     for k, v in expected.items()}
+    args_grad_data = {k: array(v, ctx) for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym_.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym_.list_arguments(), grad_req)}
+    aux = {k: array(_np.asarray(v), ctx) for k, v in (aux_states or {}).items()} \
+        if aux_states else None
+    executor = sym_.bind(ctx, args=location, args_grad=args_grad_data,
+                         grad_req=grad_req, aux_states=aux)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [array(_np.asarray(v), ctx) for v in out_grads]
+    elif isinstance(out_grads, dict):
+        out_grads = [array(_np.asarray(v), ctx) for v in out_grads.values()]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items() if v is not None}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(grads[name], expected[name], rtol, atol or 1e-20,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(grads[name], args_grad_npy[name] + expected[name],
+                                rtol, atol or 1e-20,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+    return grads
+
+
+def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True):
+    """Run the same graph on several contexts and cross-check outputs/grads
+    (parity: ``test_utils.py:check_consistency:676``; cpu-vs-tpu here)."""
+    tol = tol or 1e-3
+    assert len(ctx_list) > 1
+    if isinstance(sym_, sym.Symbol):
+        sym_list = [sym_] * len(ctx_list)
+    else:
+        sym_list = sym_
+    output_points = None
+    results = []
+    for s, ctx_spec in zip(sym_list, ctx_list):
+        ctx_spec = dict(ctx_spec)
+        ctx = ctx_spec.pop("ctx", None) or cpu()
+        type_dict = ctx_spec.pop("type_dict", {})
+        shapes = ctx_spec
+        exe = s.simple_bind(ctx, grad_req=grad_req, type_dict=type_dict, **shapes)
+        if arg_params is None:
+            arg_params = {}
+            for name, arr in exe.arg_dict.items():
+                if name not in shapes:
+                    arg_params[name] = _np.random.normal(
+                        size=arr.shape, scale=scale).astype(_np.float32)
+        for name, arr in exe.arg_dict.items():
+            if name in shapes:
+                arr[:] = _np.random.uniform(-1, 1, arr.shape) if name not in \
+                    (arg_params or {}) else arg_params[name]
+            elif name in arg_params:
+                arr[:] = arg_params[name]
+        if aux_params:
+            for name, arr in exe.aux_dict.items():
+                if name in aux_params:
+                    arr[:] = aux_params[name]
+        exe.forward(is_train=(grad_req != "null"))
+        if grad_req != "null":
+            exe.backward()
+        results.append(exe)
+    out0 = [o.asnumpy() for o in results[0].outputs]
+    for exe in results[1:]:
+        for a, b in zip(out0, exe.outputs):
+            assert_almost_equal(a, b.asnumpy(), rtol=tol, atol=tol)
+    return results
+
+
+def simple_forward(sym_, ctx=None, is_train=False, **inputs):
+    """Bind + forward in one call, returning numpy (parity:
+    ``test_utils.py:simple_forward``)."""
+    ctx = ctx or default_context()
+    inputs = {k: array(v, ctx) for k, v in inputs.items()}
+    exe = sym_.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs

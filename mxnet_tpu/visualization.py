@@ -1,0 +1,114 @@
+"""Network visualization (parity: reference ``python/mxnet/visualization.py``)."""
+
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print network layer summary (parity: ``visualization.py:print_summary``)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shape_dict[name] = s
+        internals = symbol.get_internals()
+        for node in symbol._topo():
+            for i in range(node.num_outputs()):
+                pass
+
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+    out_shapes = {}
+    if show_shape:
+        internals = symbol.get_internals()
+        known = {k: v for k, v in shape.items()}
+        _, int_out_shapes, _ = internals.infer_shape(**known)
+        for name, s in zip(internals.list_outputs(), int_out_shapes):
+            out_shapes[name] = s
+
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        op = node.op.name
+        name = node.name
+        out_shape = out_shapes.get(node.output_name(0), "")
+        cur_param = 0
+        for (inode, _) in node.inputs:
+            if inode.is_variable and (
+                inode.name.endswith("weight") or inode.name.endswith("bias")
+                or inode.name.endswith("gamma") or inode.name.endswith("beta")
+            ):
+                s = shape_dict.get(inode.name)
+                if s:
+                    p = 1
+                    for d in s:
+                        p *= d
+                    cur_param += p
+        first_connection = ", ".join(
+            i.name for i, _ in node.inputs if not i.is_variable
+        )
+        fields = ["%s(%s)" % (name, op), str(out_shape), cur_param, first_connection]
+        print_row(fields, positions)
+        total_params[0] += cur_param
+    print("=" * line_length)
+    print("Total params: %d" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Render graph with graphviz if installed (parity: ``plot_network``);
+    raises ImportError otherwise, like the reference."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    fill_colors = ["#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+                   "#fdb462", "#b3de69", "#fccde5"]
+    for node in symbol._topo():
+        name = node.name
+        if node.is_variable:
+            if hide_weights and name != "data":
+                continue
+            dot.node(name=name, label=name, fillcolor=fill_colors[0], **node_attr)
+        else:
+            opname = node.op.name
+            color = fill_colors[hash(opname) % len(fill_colors)]
+            dot.node(name=name, label="%s\n%s" % (opname, name),
+                     fillcolor=color, **node_attr)
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        for (inode, _) in node.inputs:
+            if inode.is_variable and hide_weights and inode.name != "data":
+                continue
+            dot.edge(tail_name=inode.name, head_name=node.name)
+    return dot

@@ -1,0 +1,27 @@
+"""Test configuration: run on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (SURVEY.md §4: the
+reference's 'multiple ctx on one box' strategy)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# the axon TPU plugin overrides JAX_PLATFORMS env; the config update wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    _np.random.seed(42)
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)

@@ -1,0 +1,134 @@
+"""Executor tests (parity model: reference ``tests/python/unittest/test_executor.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    a_nd = mx.nd.array(np.random.randn(3, 4).astype(np.float32))
+    b_nd = mx.nd.array(np.random.randn(3, 4).astype(np.float32))
+    ex = c.bind(mx.cpu(), {"a": a_nd, "b": b_nd})
+    out = ex.forward()
+    assert_almost_equal(out[0].asnumpy(), a_nd.asnumpy() + b_nd.asnumpy())
+
+
+def test_backward_simple():
+    # d(sum(a*b))/da = b
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.sum(a * b)
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(3, 4).astype(np.float32)
+    ga = mx.nd.zeros((3, 4))
+    gb = mx.nd.zeros((3, 4))
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(a_np), "b": mx.nd.array(b_np)},
+                args_grad={"a": ga, "b": gb})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ga.asnumpy(), b_np, rtol=1e-5)
+    assert_almost_equal(gb.asnumpy(), a_np, rtol=1e-5)
+
+
+def test_backward_out_grads():
+    a = mx.sym.Variable("a")
+    b = a * 3.0
+    ga = mx.nd.zeros((2, 2))
+    ex = b.bind(mx.cpu(), {"a": mx.nd.ones((2, 2))}, args_grad={"a": ga})
+    ex.forward(is_train=True)
+    og = np.array([[1, 2], [3, 4]], np.float32)
+    ex.backward(mx.nd.array(og))
+    assert_almost_equal(ga.asnumpy(), og * 3.0, rtol=1e-6)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    b = mx.sym.sum(a * a)
+    ga = mx.nd.ones((2, 2))
+    ex = b.bind(mx.cpu(), {"a": mx.nd.ones((2, 2))}, args_grad={"a": ga},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    # grad is 2*a = 2, added to existing 1
+    assert_almost_equal(ga.asnumpy(), np.full((2, 2), 3.0, np.float32), rtol=1e-6)
+
+
+def test_softmax_output_grad():
+    """Loss-layer semantics: backward without out_grads (reference
+    softmax_output-inl.h: grad = p - onehot(label))."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    net = mx.sym.SoftmaxOutput(data, label, name="softmax")
+    d_np = np.random.randn(4, 5).astype(np.float32)
+    l_np = np.array([0, 1, 2, 3], np.float32)
+    gd = mx.nd.zeros((4, 5))
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(d_np), "label": mx.nd.array(l_np)},
+                  args_grad={"data": gd})
+    ex.forward(is_train=True)
+    probs = ex.outputs[0].asnumpy()
+    ex.backward()
+    expect = probs.copy()
+    expect[np.arange(4), l_np.astype(int)] -= 1.0
+    assert_almost_equal(gd.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+    # forward matches softmax
+    e = np.exp(d_np - d_np.max(axis=1, keepdims=True))
+    assert_almost_equal(probs, e / e.sum(axis=1, keepdims=True), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_simple_bind():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(4, 16))
+    assert ex.arg_dict["fc_weight"].shape == (8, 16)
+    assert ex.grad_dict["fc_weight"].shape == (8, 16)
+    ex.arg_dict["data"][:] = 1.0
+    out = ex.forward()
+    assert out[0].shape == (4, 8)
+
+
+def test_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = bn.simple_bind(mx.cpu(), data=(8, 3))
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    d = np.random.randn(8, 3).astype(np.float32) * 3 + 1
+    mm_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=mx.nd.array(d))
+    _ = ex.outputs  # materialize deferred forward
+    mm_after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expect = 0.5 * mm_before + 0.5 * d.mean(axis=0)
+    assert_almost_equal(mm_after, expect, rtol=1e-3, atol=1e-4)
+    # eval forward does not update aux
+    ex.forward(is_train=False, data=mx.nd.array(d))
+    assert_almost_equal(ex.aux_dict["bn_moving_mean"].asnumpy(), mm_after)
+
+
+def test_executor_reshape():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.arg_dict["data"].shape == (5, 6)
+    # params shared (same NDArray objects)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    out = ex2.forward()
+    assert out[0].shape == (5, 4)
+
+
+def test_dropout_modes():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Dropout(data, p=0.5, name="drop")
+    ex = net.simple_bind(mx.cpu(), data=(100, 100), grad_req="null")
+    ex.arg_dict["data"][:] = 1.0
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_eval, np.ones((100, 100), np.float32))
+    ex.forward(is_train=True)
+    out_train = ex.outputs[0].asnumpy()
+    zeros_frac = (out_train == 0).mean()
+    assert 0.3 < zeros_frac < 0.7
+    # survivors scaled by 1/(1-p)
+    assert_almost_equal(out_train[out_train != 0],
+                        np.full((out_train != 0).sum(), 2.0, np.float32))

@@ -1,0 +1,132 @@
+"""Module tests incl. train-to-accuracy gates (parity model: reference
+``tests/python/unittest/test_module.py`` + ``tests/python/train/test_mlp.py``).
+
+MNIST is replaced by a synthetic separable classification problem (no dataset
+downloads in this environment); the convergence gate plays the same role."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _blobs(n=400, num_class=4, dim=10, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(num_class, dim) * 3.0
+    labels = rng.randint(0, num_class, n)
+    data = centers[labels] + rng.randn(n, dim)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def _mlp(num_class=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=num_class, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_bind_forward():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch([mx.nd.ones((8, 10))], [mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-5)
+
+
+def test_module_fit_convergence():
+    """Train-to-accuracy gate (reference tests/python/train/test_mlp.py)."""
+    data, labels = _blobs()
+    train = mx.io.NDArrayIter(data, labels, batch_size=40, shuffle=True)
+    val = mx.io.NDArrayIter(data, labels, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=15,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2,
+                                               "momentum": 0.9},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "MLP failed to converge: %s" % (score,)
+
+
+def test_module_get_set_params():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.One())
+    arg_params, aux_params = mod.get_params()
+    assert_almost_equal(arg_params["fc1_weight"].asnumpy(),
+                        np.ones((32, 10), np.float32))
+    arg_params["fc1_bias"][:] = 5.0
+    mod.set_params(arg_params, aux_params)
+    a2, _ = mod.get_params()
+    assert_almost_equal(a2["fc1_bias"].asnumpy(), np.full((32,), 5.0, np.float32))
+
+
+def test_module_checkpoint(tmp_path):
+    data, labels = _blobs(80)
+    train = mx.io.NDArrayIter(data, labels, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=[("data", (40, 10))],
+              label_shapes=[("softmax_label", (40,))])
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert_almost_equal(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_predict():
+    data, labels = _blobs(80)
+    train = mx.io.NDArrayIter(data, labels, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    pred_iter = mx.io.NDArrayIter(data, None, batch_size=40)
+    out = mod.predict(pred_iter)
+    assert out.shape == (80, 4)
+
+
+def test_module_input_grads():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    mod.init_optimizer()
+    batch = mx.io.DataBatch([mx.nd.ones((8, 10))], [mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (8, 10)
+    assert np.abs(ig.asnumpy()).sum() > 0
+
+
+def test_module_multi_device_dp():
+    """Data-parallel over a multi-device mesh (GSPMD replaces
+    DataParallelExecutorGroup)."""
+    import jax
+
+    ndev = min(4, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs multiple devices")
+    ctxs = [mx.cpu(i) for i in range(ndev)]
+    data, labels = _blobs(160)
+    train = mx.io.NDArrayIter(data, labels, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=ctxs)
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(data, labels, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score

@@ -1,0 +1,109 @@
+"""NDArray tests (parity model: reference ``tests/python/unittest/test_ndarray.py``)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_ndarray_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 3))
+    assert b.asnumpy().sum() == 6
+    c = mx.nd.full((2, 2), 3.5)
+    assert_almost_equal(c.asnumpy(), np.full((2, 2), 3.5, np.float32))
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    assert_almost_equal(d.asnumpy(), np.array([[1, 2], [3, 4]], np.float32))
+
+
+def test_ndarray_elementwise():
+    np.random.seed(0)
+    a_np = np.random.randn(4, 5).astype(np.float32)
+    b_np = np.random.randn(4, 5).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal((a + b).asnumpy(), a_np + b_np)
+    assert_almost_equal((a - b).asnumpy(), a_np - b_np)
+    assert_almost_equal((a * b).asnumpy(), a_np * b_np)
+    assert_almost_equal((a / b).asnumpy(), a_np / b_np, rtol=1e-4)
+    assert_almost_equal((a + 2).asnumpy(), a_np + 2)
+    assert_almost_equal((2 * a).asnumpy(), 2 * a_np)
+    assert_almost_equal((-a).asnumpy(), -a_np)
+
+
+def test_ndarray_inplace():
+    a = mx.nd.ones((2, 3))
+    a += 2
+    assert_almost_equal(a.asnumpy(), np.full((2, 3), 3, np.float32))
+    a *= 2
+    assert_almost_equal(a.asnumpy(), np.full((2, 3), 6, np.float32))
+    a[:] = 1.5
+    assert_almost_equal(a.asnumpy(), np.full((2, 3), 1.5, np.float32))
+
+
+def test_ndarray_indexing():
+    a_np = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a[1].asnumpy(), a_np[1])
+    assert_almost_equal(a[1:3].asnumpy(), a_np[1:3])
+    a[0] = 0.0
+    a_np[0] = 0.0
+    assert_almost_equal(a.asnumpy(), a_np)
+
+
+def test_ndarray_ops():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(mx.nd.exp(a).asnumpy(), np.exp(a_np), rtol=1e-5)
+    assert_almost_equal(mx.nd.square(a).asnumpy(), a_np ** 2, rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a).asnumpy(), a_np.sum().reshape(()), rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.sum(a, axis=1).asnumpy(), a_np.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(mx.nd.transpose(a).asnumpy(), a_np.T)
+    r = mx.nd.Reshape(a, shape=(4, 3))
+    assert r.shape == (4, 3)
+
+
+def test_ndarray_dot():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(4, 5).astype(np.float32)
+    out = mx.nd.dot(mx.nd.array(a_np), mx.nd.array(b_np))
+    assert_almost_equal(out.asnumpy(), a_np @ b_np, rtol=1e-4)
+
+
+def test_ndarray_copy_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    b = a.copyto(mx.cpu(0))
+    assert_almost_equal(a.asnumpy(), b.asnumpy())
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context == mx.cpu(0) or c is a
+
+
+def test_ndarray_saveload(tmp_path):
+    fname = str(tmp_path / "nd.npz")
+    data = {"w": mx.nd.ones((3, 3)), "b": mx.nd.zeros((3,))}
+    mx.nd.save(fname, data)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), np.ones((3, 3), np.float32))
+    lst = [mx.nd.ones((2,)), mx.nd.zeros((3,))]
+    mx.nd.save(fname, lst)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_ndarray_onehot():
+    idx = mx.nd.array([0, 2, 1])
+    out = mx.nd.one_hot(idx, depth=3)
+    assert_almost_equal(out.asnumpy(), np.eye(3, dtype=np.float32)[[0, 2, 1]])
+
+
+def test_random_reproducible():
+    mx.random.seed(7)
+    a = mx.nd.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)

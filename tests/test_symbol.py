@@ -1,0 +1,97 @@
+"""Symbol tests (parity model: reference ``tests/python/unittest/test_symbol.py``
++ ``test_infer_shape.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_compose():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    assert dict(zip(net.list_arguments(), arg_shapes))["fc1_weight"] == (128, 100)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_symbol_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                              name="conv")
+    bn = mx.sym.BatchNorm(conv, name="bn")
+    pool = mx.sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (16, 3, 3, 3)
+    assert d["conv_bias"] == (16,)
+    assert d["bn_gamma"] == (16,)
+    assert out_shapes == [(2, 16, 4, 4)]
+    assert aux_shapes == [(16,), (16,)]
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_grouping():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    g = mx.sym.Group([c, a * b])
+    assert len(g.list_outputs()) == 2
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # shape inference still works after round trip
+    _, out_shapes, _ = net2.infer_shape(data=(4, 50))
+    assert out_shapes == [(4, 10)]
+
+
+def test_symbol_attr():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+    assert a.attr("ctx_group") == "dev1"
+    data = mx.sym.Variable("data", lr_mult=2.0)
+    assert data.attr("__lr_mult__") == "2.0"
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2.0 * a + b ** 2 - 1.0
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([1.0, 2.0]), "b": mx.nd.array([3.0, 4.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [2 + 9 - 1, 4 + 16 - 1], rtol=1e-6)
+
+
+def test_symbol_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
