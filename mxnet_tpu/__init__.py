@@ -48,6 +48,7 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel
 from . import contrib
+from . import models
 from . import test_utils
 
 __version__ = "0.1.0"

@@ -1,0 +1,61 @@
+"""Model zoo — Symbol-composition network definitions (behavioral parity:
+reference ``example/image-classification/symbols/*.py``).
+
+Each module exposes ``get_symbol(num_classes, ...)`` returning a Symbol whose
+single output is a ``SoftmaxOutput`` named ``softmax`` with data input
+``data`` and label ``softmax_label`` — the contract the Module/fit harness
+and checkpoint format assume.
+
+``get_symbol(network, **kwargs)`` dispatches by name like the reference's
+``importlib.import_module('symbols.' + args.network)`` in
+``example/image-classification/common/fit.py``.
+
+TPU notes: the definitions are dtype-polymorphic — pass ``dtype='bfloat16'``
+to run activations in bf16 (MXU-native) with fp32 accumulation handled inside
+the Convolution/FullyConnected ops (the fp16-variant symbols of the reference,
+``resnet_fp16.py``/``alexnet_fp16.py``, collapse into this one flag).
+"""
+
+from . import mlp, lenet, alexnet, vgg, googlenet, inception_bn, inception_v3, resnet
+from . import lstm
+
+_REGISTRY = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "alexnet": alexnet,
+    "vgg": vgg,
+    "vgg16": vgg,
+    "googlenet": googlenet,
+    "inception-bn": inception_bn,
+    "inception_bn": inception_bn,
+    "inception-v3": inception_v3,
+    "inception_v3": inception_v3,
+    "resnet": resnet,
+    "resnet-18": resnet,
+    "resnet-34": resnet,
+    "resnet-50": resnet,
+    "resnet-101": resnet,
+    "resnet-152": resnet,
+    "resnext": resnet,
+}
+
+_DEPTH = {"resnet-18": 18, "resnet-34": 34, "resnet-50": 50,
+          "resnet-101": 101, "resnet-152": 152}
+
+
+def get_symbol(network, num_classes=1000, **kwargs):
+    """Build a model symbol by name (``fit.py`` network dispatch parity)."""
+    if network not in _REGISTRY:
+        raise ValueError(
+            "unknown network %r; available: %s" % (network, sorted(_REGISTRY)))
+    mod = _REGISTRY[network]
+    if network in _DEPTH:
+        kwargs.setdefault("num_layers", _DEPTH[network])
+    if network == "resnext":
+        kwargs.setdefault("num_group", 32)
+        kwargs.setdefault("num_layers", 50)
+    return mod.get_symbol(num_classes=num_classes, **kwargs)
+
+
+def list_models():
+    return sorted(_REGISTRY)

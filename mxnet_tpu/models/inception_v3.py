@@ -1,0 +1,159 @@
+"""Inception-v3 (parity: reference
+``example/image-classification/symbols/inception-v3.py`` — BASELINE training
+config, 129.98 img/s batch-32 on 1×P100)."""
+
+from .. import symbol as sym
+
+
+def conv(data, num_filter, kernel=(1, 1), stride=(1, 1), pad=(0, 0), name=None,
+         suffix=""):
+    c = sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True,
+                        name="%s%s_conv2d" % (name, suffix))
+    bn = sym.BatchNorm(data=c, eps=0.001, fix_gamma=True,
+                       name="%s%s_batchnorm" % (name, suffix))
+    act = sym.Activation(data=bn, act_type="relu",
+                         name="%s%s_relu" % (name, suffix))
+    return act
+
+
+def inception7a(data, num_1x1, num_5x5_red, num_5x5, num_3x3_red, num_3x3,
+                pool, proj, name):
+    tower_1x1 = conv(data, num_1x1, name=("%s_conv" % name))
+    tower_5x5 = conv(data, num_5x5_red, name=("%s_tower" % name), suffix="_conv")
+    tower_5x5 = conv(tower_5x5, num_5x5, kernel=(5, 5), pad=(2, 2),
+                     name=("%s_tower" % name), suffix="_conv_1")
+    tower_3x3 = conv(data, num_3x3_red, name=("%s_tower_1" % name), suffix="_conv")
+    tower_3x3 = conv(tower_3x3, num_3x3, kernel=(3, 3), pad=(1, 1),
+                     name=("%s_tower_1" % name), suffix="_conv_1")
+    tower_3x3 = conv(tower_3x3, num_3x3, kernel=(3, 3), pad=(1, 1),
+                     name=("%s_tower_1" % name), suffix="_conv_2")
+    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                          pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
+    cproj = conv(pooling, proj, name=("%s_tower_2" % name), suffix="_conv")
+    return sym.Concat(tower_1x1, tower_5x5, tower_3x3, cproj,
+                      name="ch_concat_%s_chconcat" % name)
+
+
+def inception7b(data, num_3x3, num_d3x3_red, num_d3x3, pool, name):
+    tower_3x3 = conv(data, num_3x3, kernel=(3, 3), pad=(0, 0), stride=(2, 2),
+                     name=("%s_conv" % name))
+    tower_d3x3 = conv(data, num_d3x3_red, name=("%s_tower" % name), suffix="_conv")
+    tower_d3x3 = conv(tower_d3x3, num_d3x3, kernel=(3, 3), pad=(1, 1),
+                      name=("%s_tower" % name), suffix="_conv_1")
+    tower_d3x3 = conv(tower_d3x3, num_d3x3, kernel=(3, 3), pad=(0, 0),
+                      stride=(2, 2), name=("%s_tower" % name), suffix="_conv_2")
+    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(2, 2), pad=(0, 0),
+                          pool_type="max", name=("max_pool_%s_pool" % name))
+    return sym.Concat(tower_3x3, tower_d3x3, pooling,
+                      name="ch_concat_%s_chconcat" % name)
+
+
+def inception7c(data, num_1x1, num_d7_red, num_d7_1, num_d7_2, num_q7_red,
+                num_q7_1, num_q7_2, pool, proj, name):
+    tower_1x1 = conv(data, num_1x1, name=("%s_conv" % name))
+    tower_d7 = conv(data, num_d7_red, name=("%s_tower" % name), suffix="_conv")
+    tower_d7 = conv(tower_d7, num_d7_1, kernel=(1, 7), pad=(0, 3),
+                    name=("%s_tower" % name), suffix="_conv_1")
+    tower_d7 = conv(tower_d7, num_d7_2, kernel=(7, 1), pad=(3, 0),
+                    name=("%s_tower" % name), suffix="_conv_2")
+    tower_q7 = conv(data, num_q7_red, name=("%s_tower_1" % name), suffix="_conv")
+    tower_q7 = conv(tower_q7, num_q7_1, kernel=(7, 1), pad=(3, 0),
+                    name=("%s_tower_1" % name), suffix="_conv_1")
+    tower_q7 = conv(tower_q7, num_q7_1, kernel=(1, 7), pad=(0, 3),
+                    name=("%s_tower_1" % name), suffix="_conv_2")
+    tower_q7 = conv(tower_q7, num_q7_2, kernel=(7, 1), pad=(3, 0),
+                    name=("%s_tower_1" % name), suffix="_conv_3")
+    tower_q7 = conv(tower_q7, num_q7_2, kernel=(1, 7), pad=(0, 3),
+                    name=("%s_tower_1" % name), suffix="_conv_4")
+    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                          pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
+    cproj = conv(pooling, proj, name=("%s_tower_2" % name), suffix="_conv")
+    return sym.Concat(tower_1x1, tower_d7, tower_q7, cproj,
+                      name="ch_concat_%s_chconcat" % name)
+
+
+def inception7d(data, num_3x3_red, num_3x3, num_d7_3x3_red, num_d7_1, num_d7_2,
+                num_d7_3x3, pool, name):
+    tower_3x3 = conv(data, num_3x3_red, name=("%s_tower" % name), suffix="_conv")
+    tower_3x3 = conv(tower_3x3, num_3x3, kernel=(3, 3), pad=(0, 0),
+                     stride=(2, 2), name=("%s_tower" % name), suffix="_conv_1")
+    tower_d7_3x3 = conv(data, num_d7_3x3_red, name=("%s_tower_1" % name),
+                        suffix="_conv")
+    tower_d7_3x3 = conv(tower_d7_3x3, num_d7_1, kernel=(1, 7), pad=(0, 3),
+                        name=("%s_tower_1" % name), suffix="_conv_1")
+    tower_d7_3x3 = conv(tower_d7_3x3, num_d7_2, kernel=(7, 1), pad=(3, 0),
+                        name=("%s_tower_1" % name), suffix="_conv_2")
+    tower_d7_3x3 = conv(tower_d7_3x3, num_d7_3x3, kernel=(3, 3), stride=(2, 2),
+                        name=("%s_tower_1" % name), suffix="_conv_3")
+    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(2, 2),
+                          pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
+    return sym.Concat(tower_3x3, tower_d7_3x3, pooling,
+                      name="ch_concat_%s_chconcat" % name)
+
+
+def inception7e(data, num_1x1, num_d3_red, num_d3_1, num_d3_2, num_3x3_d3_red,
+                num_3x3, num_3x3_d3_1, num_3x3_d3_2, pool, proj, name):
+    tower_1x1 = conv(data, num_1x1, name=("%s_conv" % name))
+    tower_d3 = conv(data, num_d3_red, name=("%s_tower" % name), suffix="_conv")
+    tower_d3_a = conv(tower_d3, num_d3_1, kernel=(1, 3), pad=(0, 1),
+                      name=("%s_tower" % name), suffix="_mixed_conv")
+    tower_d3_b = conv(tower_d3, num_d3_2, kernel=(3, 1), pad=(1, 0),
+                      name=("%s_tower" % name), suffix="_mixed_conv_1")
+    tower_3x3_d3 = conv(data, num_3x3_d3_red, name=("%s_tower_1" % name),
+                        suffix="_conv")
+    tower_3x3_d3 = conv(tower_3x3_d3, num_3x3, kernel=(3, 3), pad=(1, 1),
+                        name=("%s_tower_1" % name), suffix="_conv_1")
+    tower_3x3_d3_a = conv(tower_3x3_d3, num_3x3_d3_1, kernel=(1, 3), pad=(0, 1),
+                          name=("%s_tower_1" % name), suffix="_mixed_conv")
+    tower_3x3_d3_b = conv(tower_3x3_d3, num_3x3_d3_2, kernel=(3, 1), pad=(1, 0),
+                          name=("%s_tower_1" % name), suffix="_mixed_conv_1")
+    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                          pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
+    cproj = conv(pooling, proj, name=("%s_tower_2" % name), suffix="_conv")
+    return sym.Concat(tower_1x1, tower_d3_a, tower_d3_b, tower_3x3_d3_a,
+                      tower_3x3_d3_b, cproj, name="ch_concat_%s_chconcat" % name)
+
+
+def get_symbol(num_classes=1000, dtype="float32", **kwargs):
+    data = sym.Variable(name="data")
+    if dtype != "float32":
+        data = sym.Cast(data=data, dtype=dtype)
+    # stage 1
+    conv1 = conv(data, 32, kernel=(3, 3), stride=(2, 2), name="conv")
+    conv_1 = conv(conv1, 32, kernel=(3, 3), name="conv_1")
+    conv_2 = conv(conv_1, 64, kernel=(3, 3), pad=(1, 1), name="conv_2")
+    pool = sym.Pooling(data=conv_2, kernel=(3, 3), stride=(2, 2),
+                       pool_type="max", name="pool")
+    # stage 2
+    conv_3 = conv(pool, 80, kernel=(1, 1), name="conv_3")
+    conv_4 = conv(conv_3, 192, kernel=(3, 3), name="conv_4")
+    pool1 = sym.Pooling(data=conv_4, kernel=(3, 3), stride=(2, 2),
+                        pool_type="max", name="pool1")
+    # stage 3
+    in3a = inception7a(pool1, 64, 48, 64, 64, 96, "avg", 32, "mixed")
+    in3b = inception7a(in3a, 64, 48, 64, 64, 96, "avg", 64, "mixed_1")
+    in3c = inception7a(in3b, 64, 48, 64, 64, 96, "avg", 64, "mixed_2")
+    in3d = inception7b(in3c, 384, 64, 96, "max", "mixed_3")
+    # stage 4
+    in4a = inception7c(in3d, 192, 128, 128, 192, 128, 128, 192, "avg", 192,
+                       "mixed_4")
+    in4b = inception7c(in4a, 192, 160, 160, 192, 160, 160, 192, "avg", 192,
+                       "mixed_5")
+    in4c = inception7c(in4b, 192, 160, 160, 192, 160, 160, 192, "avg", 192,
+                       "mixed_6")
+    in4d = inception7c(in4c, 192, 192, 192, 192, 192, 192, 192, "avg", 192,
+                       "mixed_7")
+    in4e = inception7d(in4d, 192, 320, 192, 192, 192, 192, "max", "mixed_8")
+    # stage 5
+    in5a = inception7e(in4e, 320, 384, 384, 384, 448, 384, 384, 384, "avg", 192,
+                       "mixed_9")
+    in5b = inception7e(in5a, 320, 384, 384, 384, 448, 384, 384, 384, "max", 192,
+                       "mixed_10")
+    pool2 = sym.Pooling(data=in5b, kernel=(8, 8), stride=(1, 1),
+                        global_pool=True, pool_type="avg", name="global_pool")
+    flatten = sym.Flatten(data=pool2, name="flatten")
+    fc1 = sym.FullyConnected(data=flatten, num_hidden=num_classes, name="fc1")
+    if dtype != "float32":
+        fc1 = sym.Cast(data=fc1, dtype="float32")
+    return sym.SoftmaxOutput(data=fc1, name="softmax")

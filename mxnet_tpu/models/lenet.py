@@ -1,0 +1,33 @@
+"""LeNet-5 style convnet (parity: reference
+``example/image-classification/symbols/lenet.py``)."""
+
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=10, add_stn=False, **kwargs):
+    data = sym.Variable("data")
+    if add_stn:
+        data = sym.SpatialTransformer(
+            data=data, loc=get_loc(data), target_shape=(28, 28),
+            transform_type="affine", sampler_type="bilinear")
+    conv1 = sym.Convolution(data=data, kernel=(5, 5), num_filter=20, name="conv1")
+    tanh1 = sym.Activation(data=conv1, act_type="tanh")
+    pool1 = sym.Pooling(data=tanh1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    conv2 = sym.Convolution(data=pool1, kernel=(5, 5), num_filter=50, name="conv2")
+    tanh2 = sym.Activation(data=conv2, act_type="tanh")
+    pool2 = sym.Pooling(data=tanh2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flatten = sym.Flatten(data=pool2)
+    fc1 = sym.FullyConnected(data=flatten, num_hidden=500, name="fc1")
+    tanh3 = sym.Activation(data=fc1, act_type="tanh")
+    fc2 = sym.FullyConnected(data=tanh3, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def get_loc(data, attr=None):
+    """Localisation network for the STN variant (6-param affine init)."""
+    loc = sym.Convolution(data=data, num_filter=30, kernel=(5, 5), stride=(2, 2))
+    loc = sym.Activation(data=loc, act_type="relu")
+    loc = sym.Pooling(data=loc, global_pool=True, kernel=(2, 2), pool_type="avg")
+    loc = sym.Flatten(data=loc)
+    loc = sym.FullyConnected(data=loc, num_hidden=6, name="stn_loc")
+    return loc

@@ -1,0 +1,44 @@
+"""Stacked-LSTM language model symbol (parity: the ``sym_gen`` of reference
+``example/rnn/lstm_bucketing.py``: Embedding → stacked LSTMCell unrolled →
+FC → SoftmaxOutput over every time step)."""
+
+from .. import symbol as sym
+from ..rnn import rnn_cell
+
+
+def get_symbol(num_classes=10000, seq_len=35, num_embed=200, num_hidden=200,
+               num_layers=2, dropout=0.0, **kwargs):
+    """Build the unrolled LM symbol for one bucket length ``seq_len``.
+
+    Inputs: ``data`` (batch, seq_len) int tokens, ``softmax_label``
+    (batch, seq_len).
+    """
+    data = sym.Variable("data")
+    embed = sym.Embedding(data=data, input_dim=num_classes,
+                          output_dim=num_embed, name="embed")
+
+    stack = rnn_cell.SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(rnn_cell.LSTMCell(num_hidden=num_hidden, prefix="lstm_l%d_" % i))
+        if dropout > 0:
+            stack.add(rnn_cell.DropoutCell(dropout, prefix="lstm_d%d_" % i))
+
+    outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+    pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(data=pred, num_hidden=num_classes, name="pred")
+    label = sym.Variable("softmax_label")
+    label = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+
+def sym_gen_factory(num_classes, num_embed=200, num_hidden=200, num_layers=2,
+                    dropout=0.0):
+    """Return a ``sym_gen(bucket_key)`` for BucketingModule."""
+
+    def sym_gen(seq_len):
+        s = get_symbol(num_classes=num_classes, seq_len=seq_len,
+                       num_embed=num_embed, num_hidden=num_hidden,
+                       num_layers=num_layers, dropout=dropout)
+        return s, ("data",), ("softmax_label",)
+
+    return sym_gen

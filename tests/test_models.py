@@ -1,0 +1,75 @@
+"""Model zoo smoke tests: shape inference + a forward pass on small inputs
+(the reference exercises its symbols via tests/python/train and
+benchmark_score.py; here shape-level checks keep CI fast)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("net,shape", [
+    ("mlp", (2, 1, 28, 28)),
+    ("lenet", (2, 1, 28, 28)),
+])
+def test_small_models_forward(net, shape):
+    sym = models.get_symbol(net, num_classes=10)
+    exe = sym.simple_bind(ctx=mx.cpu(), data=shape, softmax_label=(shape[0],))
+    exe.arg_dict["data"][:] = np.random.uniform(size=shape).astype(np.float32)
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (shape[0], 10)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+@pytest.mark.parametrize("net", ["resnet-18", "resnet-50", "resnext"])
+def test_resnet_shapes(net):
+    sym = models.get_symbol(net, num_classes=1000)
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(
+        data=(2, 3, 224, 224), softmax_label=(2,))
+    assert out_shapes[0] == (2, 1000)
+
+
+@pytest.mark.parametrize("net", ["alexnet", "vgg", "googlenet",
+                                 "inception-bn", "inception-v3"])
+def test_big_convnets_infer(net):
+    shape = (2, 3, 299, 299) if net == "inception-v3" else (2, 3, 224, 224)
+    sym = models.get_symbol(net, num_classes=1000)
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(
+        data=shape, softmax_label=(2,))
+    assert out_shapes[0] == (2, 1000)
+
+
+def test_resnet_cifar_forward():
+    sym = models.get_symbol("resnet", num_classes=10, num_layers=8,
+                            image_shape=(3, 28, 28))
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 28, 28),
+                          softmax_label=(2,))
+    exe.arg_dict["data"][:] = np.random.uniform(size=(2, 3, 28, 28)).astype(np.float32)
+    # init BN gammas to 1 so the forward is non-degenerate
+    for k, v in exe.arg_dict.items():
+        if k.endswith("_gamma"):
+            v[:] = 1.0
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (2, 10)
+
+
+def test_resnet_bf16():
+    sym = models.get_symbol("resnet", num_classes=10, num_layers=8,
+                            image_shape=(3, 28, 28), dtype="bfloat16")
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 28, 28),
+                          softmax_label=(2,))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (2, 10)
+    assert str(out.dtype) == "float32"  # loss head cast back
+
+
+def test_lstm_lm_forward():
+    from mxnet_tpu.models import lstm
+    s = lstm.get_symbol(num_classes=50, seq_len=7, num_embed=16,
+                        num_hidden=16, num_layers=2)
+    exe = s.simple_bind(ctx=mx.cpu(), data=(4, 7), softmax_label=(4, 7),
+                        type_dict={"data": "int32"})
+    exe.arg_dict["data"][:] = np.random.randint(0, 50, size=(4, 7))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (4 * 7, 50)
