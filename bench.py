@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Baseline: the reference's published ResNet-50 training speed, batch 32 on
+1x P100 = 181.53 img/s (reference docs/how_to/perf.md:181-188; BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config is the TPU-idiomatic equivalent of the reference's benchmark_score.py
+training loop: bf16 activations with fp32 MXU accumulation, fused
+fwd+bwd+SGD-momentum step, synthetic data (the reference benchmark also uses
+synthetic data).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # ResNet-50 train, batch 32, 1x P100
+
+
+def main():
+    import jax
+    import mxnet_tpu  # noqa: F401
+    from jax.sharding import Mesh
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    platform = jax.devices()[0].platform
+    batch = int(os.environ.get("BENCH_BATCH", "128" if platform == "tpu" else "8"))
+    image = 224 if platform == "tpu" else 28
+    layers = 50 if platform == "tpu" else 8
+    steps = int(os.environ.get("BENCH_STEPS", "50" if platform == "tpu" else "3"))
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
+                            image_shape=(3, image, image), dtype="bfloat16")
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(
+        sym, mesh,
+        data_shapes={"data": (batch, 3, image, image)},
+        label_shapes={"softmax_label": (batch,)},
+        momentum=0.9, learning_rate=0.1, wd=1e-4, rescale_grad=1.0 / batch,
+    )
+    params, moms, aux = tr.init(seed=0)
+    data = tr.place_batch({
+        "data": np.random.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32),
+        "softmax_label": np.random.randint(0, 1000, (batch,)).astype(np.float32),
+    })
+    step = tr.step_fn()
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile.  NOTE: on remote-tunneled devices block_until_ready
+    # does not actually block; a tiny host fetch is the only true sync, so
+    # warm the fetch path too and time loop+fetch.
+    def sync(tree):
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        return np.asarray(jax.numpy.ravel(leaf)[0])
+
+    outs, params, moms, aux = step(params, moms, aux, data, key)
+    sync(outs)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        outs, params, moms, aux = step(params, moms, aux, data, key)
+    sync(outs)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput" if platform == "tpu"
+                  else "resnet8_cpu_smoke_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

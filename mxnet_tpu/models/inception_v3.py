@@ -50,7 +50,7 @@ def inception7b(data, num_3x3, num_d3x3_red, num_d3x3, pool, name):
 
 
 def inception7c(data, num_1x1, num_d7_red, num_d7_1, num_d7_2, num_q7_red,
-                num_q7_1, num_q7_2, pool, proj, name):
+                num_q7_1, num_q7_2, num_q7_3, num_q7_4, pool, proj, name):
     tower_1x1 = conv(data, num_1x1, name=("%s_conv" % name))
     tower_d7 = conv(data, num_d7_red, name=("%s_tower" % name), suffix="_conv")
     tower_d7 = conv(tower_d7, num_d7_1, kernel=(1, 7), pad=(0, 3),
@@ -60,11 +60,11 @@ def inception7c(data, num_1x1, num_d7_red, num_d7_1, num_d7_2, num_q7_red,
     tower_q7 = conv(data, num_q7_red, name=("%s_tower_1" % name), suffix="_conv")
     tower_q7 = conv(tower_q7, num_q7_1, kernel=(7, 1), pad=(3, 0),
                     name=("%s_tower_1" % name), suffix="_conv_1")
-    tower_q7 = conv(tower_q7, num_q7_1, kernel=(1, 7), pad=(0, 3),
-                    name=("%s_tower_1" % name), suffix="_conv_2")
-    tower_q7 = conv(tower_q7, num_q7_2, kernel=(7, 1), pad=(3, 0),
-                    name=("%s_tower_1" % name), suffix="_conv_3")
     tower_q7 = conv(tower_q7, num_q7_2, kernel=(1, 7), pad=(0, 3),
+                    name=("%s_tower_1" % name), suffix="_conv_2")
+    tower_q7 = conv(tower_q7, num_q7_3, kernel=(7, 1), pad=(3, 0),
+                    name=("%s_tower_1" % name), suffix="_conv_3")
+    tower_q7 = conv(tower_q7, num_q7_4, kernel=(1, 7), pad=(0, 3),
                     name=("%s_tower_1" % name), suffix="_conv_4")
     pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                           pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
@@ -136,14 +136,14 @@ def get_symbol(num_classes=1000, dtype="float32", **kwargs):
     in3c = inception7a(in3b, 64, 48, 64, 64, 96, "avg", 64, "mixed_2")
     in3d = inception7b(in3c, 384, 64, 96, "max", "mixed_3")
     # stage 4
-    in4a = inception7c(in3d, 192, 128, 128, 192, 128, 128, 192, "avg", 192,
-                       "mixed_4")
-    in4b = inception7c(in4a, 192, 160, 160, 192, 160, 160, 192, "avg", 192,
-                       "mixed_5")
-    in4c = inception7c(in4b, 192, 160, 160, 192, 160, 160, 192, "avg", 192,
-                       "mixed_6")
-    in4d = inception7c(in4c, 192, 192, 192, 192, 192, 192, 192, "avg", 192,
-                       "mixed_7")
+    in4a = inception7c(in3d, 192, 128, 128, 192, 128, 128, 128, 128, 192,
+                       "avg", 192, "mixed_4")
+    in4b = inception7c(in4a, 192, 160, 160, 192, 160, 160, 160, 160, 192,
+                       "avg", 192, "mixed_5")
+    in4c = inception7c(in4b, 192, 160, 160, 192, 160, 160, 160, 160, 192,
+                       "avg", 192, "mixed_6")
+    in4d = inception7c(in4c, 192, 192, 192, 192, 192, 192, 192, 192, 192,
+                       "avg", 192, "mixed_7")
     in4e = inception7d(in4d, 192, 320, 192, 192, 192, 192, "max", "mixed_8")
     # stage 5
     in5a = inception7e(in4e, 320, 384, 384, 384, 448, 384, 384, 384, "avg", 192,

@@ -22,16 +22,19 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
                   num_group=1, bn_mom=BN_MOM):
     """Pre-activation residual unit (v2)."""
     if bottle_neck:
+        # resnext (grouped) bottlenecks are twice as wide: 0.5x vs 0.25x
+        # (reference resnext.py int(num_filter*0.5) vs resnet.py 0.25)
+        width = num_filter // 2 if num_group > 1 else num_filter // 4
         bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=BN_EPS,
                             momentum=bn_mom, name=name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=num_filter // 4,
+        conv1 = sym.Convolution(data=act1, num_filter=width,
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
                                 no_bias=True, name=name + "_conv1")
         bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=BN_EPS,
                             momentum=bn_mom, name=name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=num_filter // 4,
+        conv2 = sym.Convolution(data=act2, num_filter=width,
                                 num_group=num_group, kernel=(3, 3),
                                 stride=stride, pad=(1, 1), no_bias=True,
                                 name=name + "_conv2")
@@ -93,7 +96,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                            pad=(1, 1), pool_type="max")
 
     for i in range(num_stages):
-        stride = (1, 1) if i == 0 and height > 32 else (2, 2) if i > 0 else (1, 1)
+        stride = (1, 1) if i == 0 else (2, 2)
         body = residual_unit(body, filter_list[i + 1], stride, False,
                              name="stage%d_unit%d" % (i + 1, 1),
                              bottle_neck=bottle_neck, num_group=num_group,

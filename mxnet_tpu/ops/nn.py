@@ -209,12 +209,16 @@ def _pooling(attrs, data):
     padding = ((0, 0), (0, 0)) + tuple(pads)
     pt = attrs["pool_type"]
     if pt == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        # scalar (not Array) init value so jax dispatches to the monoid
+        # reduce_window_max primitive, which has a linearization rule
+        init = -_np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else _np.iinfo(data.dtype).min
         return jax.lax.reduce_window(
-            data, jnp.asarray(init, data.dtype), jax.lax.max, window, strides, padding
+            data, init, jax.lax.max, window, strides, padding
         )
     summed = jax.lax.reduce_window(
-        data, jnp.asarray(0, data.dtype), jax.lax.add, window, strides, padding
+        data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+        jax.lax.add, window, strides, padding
     )
     if pt == "sum":
         return summed

@@ -1,0 +1,253 @@
+"""GSPMD sharded training — the TPU-native capability layer that subsumes the
+reference's distributed machinery (``DataParallelExecutorGroup`` +
+kvstore reduce + ``PlaceDevice`` model parallelism; reference
+``python/mxnet/module/executor_group.py:77``, ``src/kvstore/comm.h:211``,
+``src/executor/graph_executor.cc:318``) and extends it to the parallelism
+modes the reference lacks (tensor/sequence/expert — SURVEY.md §2.4).
+
+One fused jitted step = forward + backward + optimizer update, with every
+array carrying a ``NamedSharding`` over a ``jax.sharding.Mesh``.  XLA inserts
+the collectives (psum over the ``data`` axis for gradients — the kvstore
+all-reduce; all-gather/reduce-scatter along ``model`` for sharded weights)
+and schedules them to overlap with compute on ICI — the role the reference's
+per-layer ``priority=-index`` push/pull scheduling plays by hand
+(``model.py:94-110``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["ShardedTrainer", "auto_tp_specs"]
+
+
+def auto_tp_specs(symbol, arg_shapes, mesh, data_axis="data", model_axis="model"):
+    """Heuristic tensor-parallel sharding specs for a symbol's parameters.
+
+    Megatron-style: FullyConnected / Convolution output channels shard along
+    ``model_axis`` when divisible by its size; everything else replicates.
+    (The reference has no TP at all — this is capability-gap item §2.4.)
+    """
+    if model_axis not in mesh.axis_names:
+        return {}
+    msize = mesh.shape[model_axis]
+    specs = {}
+    for name, shape in arg_shapes.items():
+        if name.endswith("_weight") and len(shape) >= 2 and shape[0] % msize == 0:
+            specs[name] = P(model_axis, *([None] * (len(shape) - 1)))
+        elif name.endswith("_bias") and len(shape) == 1 and shape[0] % msize == 0:
+            specs[name] = P(model_axis)
+    return specs
+
+
+def _sgd_update(w, g, mom, lr, momentum, wd, rescale, clip):
+    g = g * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    g = g + wd * w
+    if mom is None:
+        return w - lr * g, None
+    new_mom = momentum * mom - lr * g
+    return w + new_mom, new_mom
+
+
+class ShardedTrainer:
+    """A whole-model sharded training step over a device mesh.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Loss-headed symbol (e.g. ``SoftmaxOutput`` net).
+    mesh : jax.sharding.Mesh
+        Logical device mesh; conventional axes: ``data`` (DP), ``model`` (TP),
+        ``seq`` (SP), ``expert`` (EP), ``pipe`` (PP).
+    data_shapes : dict name -> global shape for data inputs.
+    data_specs : dict name -> PartitionSpec for data inputs (default: batch
+        axis over ``data``, and — when a ``seq`` axis exists in the mesh —
+        axis 1 over ``seq`` for rank>=2 integer/sequence inputs).
+    param_specs : dict name -> PartitionSpec (default: auto_tp_specs).
+    """
+
+    def __init__(self, symbol, mesh: Mesh, data_shapes: Dict[str, tuple],
+                 label_shapes: Optional[Dict[str, tuple]] = None,
+                 data_specs: Optional[Dict[str, P]] = None,
+                 param_specs: Optional[Dict[str, P]] = None,
+                 type_dict: Optional[Dict[str, str]] = None,
+                 learning_rate=0.01, momentum=0.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=None,
+                 data_axis="data", dtype="float32"):
+        from ..executor import _graph_fn
+        from ..symbol import _infer
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.data_axis = data_axis
+        label_shapes = label_shapes or {}
+        type_dict = dict(type_dict or {})
+        shapes = dict(data_shapes)
+        shapes.update(label_shapes)
+        arg_shapes, out_shapes, aux_shapes, arg_dtypes, aux_dtypes = _infer(
+            symbol, shapes, type_dict)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._input_names = set(shapes)
+        self.param_names = [n for n in arg_names if n not in self._input_names]
+        self.arg_shapes = dict(zip(arg_names, arg_shapes))
+        self.aux_shapes = dict(zip(aux_names, aux_shapes))
+        self.arg_dtypes = dict(zip(arg_names, arg_dtypes))
+        self.aux_dtypes = dict(zip(aux_names, aux_dtypes))
+        if any(self.arg_shapes[n] is None for n in arg_names):
+            missing = [n for n in arg_names if self.arg_shapes[n] is None]
+            raise MXNetError("cannot infer shapes for %s" % missing)
+
+        # -- shardings ---------------------------------------------------
+        pspecs = auto_tp_specs(
+            symbol, {n: self.arg_shapes[n] for n in self.param_names}, mesh,
+            data_axis)
+        pspecs.update(param_specs or {})
+        self.param_specs = {n: pspecs.get(n, P()) for n in self.param_names}
+        dspecs = {}
+        for n in self._input_names:
+            shp = self.arg_shapes[n]
+            spec = [None] * len(shp)
+            if len(shp) >= 1 and data_axis in mesh.axis_names \
+                    and shp[0] % mesh.shape[data_axis] == 0:
+                spec[0] = data_axis
+            if len(shp) >= 3 and "seq" in mesh.axis_names \
+                    and shp[1] % mesh.shape["seq"] == 0:
+                spec[1] = "seq"
+            dspecs[n] = P(*spec)
+        dspecs.update(data_specs or {})
+        self.data_specs = dspecs
+
+        self._run = _graph_fn(symbol)
+        self._hyper = (learning_rate, momentum, wd, rescale_grad, clip_gradient)
+        self._use_momentum = momentum != 0.0
+        self._jit_step = None
+        self._jit_fwd = None
+
+    # ------------------------------------------------------------------
+    def _sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def init(self, initializer=None, seed=0):
+        """Create (params, moms, aux) host-side then place sharded on mesh."""
+        from ..initializer import Uniform, InitDesc
+
+        initializer = initializer or Uniform(0.07)
+        rng = _np.random.RandomState(seed)
+        params, moms, aux = {}, {}, {}
+        for n in self.param_names:
+            shp = self.arg_shapes[n]
+            arr = _np.zeros(shp, dtype=self.arg_dtypes.get(n, "float32"))
+            initializer(InitDesc(n), _HostArray(arr, rng))
+            params[n] = jax.device_put(arr, self._sharding(self.param_specs[n]))
+            if self._use_momentum:
+                moms[n] = jax.device_put(
+                    _np.zeros_like(arr), self._sharding(self.param_specs[n]))
+        for n, shp in self.aux_shapes.items():
+            init_val = _np.ones if n.endswith("_var") or "moving_var" in n else _np.zeros
+            aux[n] = jax.device_put(
+                init_val(shp, dtype=self.aux_dtypes.get(n, "float32")),
+                self._sharding(P()))
+        return params, moms, aux
+
+    def place_batch(self, arrays: Dict[str, _np.ndarray]):
+        """Shard a host batch onto the mesh along the declared input specs."""
+        return {
+            n: jax.device_put(_np.asarray(v), self._sharding(self.data_specs[n]))
+            for n, v in arrays.items()
+        }
+
+    # ------------------------------------------------------------------
+    def step_fn(self):
+        """The fused train step: (params, moms, aux, batch, rng) ->
+        (outputs, new_params, new_moms, new_aux)."""
+        if self._jit_step is not None:
+            return self._jit_step
+        run = self._run
+        lr, momentum, wd, rescale, clip = self._hyper
+        use_mom = self._use_momentum
+        diff = [
+            n for n in self.param_names
+            if not _np.issubdtype(_np.dtype(self.arg_dtypes.get(n, "float32")),
+                                  _np.integer)
+        ]
+
+        def step(params, moms, aux, batch, rng):
+            def loss_fn(p):
+                args = dict(batch)
+                args.update(params)
+                args.update(p)
+                outs, new_aux = run(args, aux, rng, True)
+                total = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                return total, (outs, new_aux)
+
+            dparams = {n: params[n] for n in diff}
+            (_, (outs, new_aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(dparams)
+            new_params, new_moms = dict(params), dict(moms)
+            for n in diff:
+                m = moms.get(n) if use_mom else None
+                w, nm = _sgd_update(params[n], grads[n], m, lr, momentum, wd,
+                                    rescale, clip)
+                new_params[n] = w
+                if use_mom:
+                    new_moms[n] = nm
+            return outs, new_params, new_moms, new_aux
+
+        pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
+        mshard = dict(pshard) if use_mom else {}
+        ashard = {n: self._sharding(P()) for n in self.aux_shapes}
+        dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
+        self._jit_step = jax.jit(
+            step,
+            in_shardings=(pshard, mshard, ashard, dshard, None),
+            out_shardings=(None, pshard, mshard, ashard),
+            donate_argnums=(0, 1),
+        )
+        return self._jit_step
+
+    def forward_fn(self):
+        """Jitted inference forward: (params, aux, batch) -> outputs."""
+        if self._jit_fwd is not None:
+            return self._jit_fwd
+        run = self._run
+
+        def fwd(params, aux, batch, rng):
+            args = dict(batch)
+            args.update(params)
+            outs, _ = run(args, aux, rng, False)
+            return outs
+
+        pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
+        ashard = {n: self._sharding(P()) for n in self.aux_shapes}
+        dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
+        self._jit_fwd = jax.jit(
+            fwd, in_shardings=(pshard, ashard, dshard, None))
+        return self._jit_fwd
+
+
+class _HostArray:
+    """Minimal NDArray stand-in so initializer patterns run on numpy buffers."""
+
+    def __init__(self, arr, rng):
+        self._arr = arr
+        self._rng = rng
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    def __setitem__(self, key, value):
+        self._arr[key] = value
+
+    def asnumpy(self):
+        return self._arr
