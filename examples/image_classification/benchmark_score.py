@@ -1,0 +1,75 @@
+"""Inference throughput benchmark on synthetic data (parity: reference
+``example/image-classification/benchmark_score.py``)."""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(network, dev, batch_size, num_batches, image_shape=(3, 224, 224),
+          num_layers=None, dtype="float32"):
+    kwargs = {}
+    if num_layers:
+        kwargs["num_layers"] = num_layers
+    if network == "inception-v3":
+        image_shape = (3, 299, 299)
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=image_shape, dtype=dtype, **kwargs)
+    data_shape = [("data", (batch_size,) + image_shape)]
+    mod = mx.mod.Module(symbol=sym, context=dev)
+    mod.bind(for_training=False, inputs_need_grad=False, data_shapes=data_shape)
+    mod.init_params(initializer=mx.initializer.Xavier(magnitude=2.0))
+    # device-resident synthetic batch: H2D once, not per iteration
+    batch = mx.io.DataBatch(
+        [mx.nd.array(np.random.uniform(-1, 1, (batch_size,) + image_shape),
+                     ctx=dev)], [])
+    def sync():
+        # scalar fetch: the only true device sync over tunneled PJRT, and it
+        # avoids timing the (slow) full-logits host transfer
+        import numpy as _n
+        _n.asarray(mod.get_outputs()[0]._data.ravel()[0])
+
+    # warmup (compile)
+    for _ in range(2):
+        mod.forward(batch, is_train=False)
+    sync()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    sync()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", type=str, default="all")
+    parser.add_argument("--batch-size", type=int, default=0)
+    parser.add_argument("--num-batches", type=int, default=10)
+    parser.add_argument("--dtype", type=str, default="float32")
+    args = parser.parse_args()
+
+    import jax
+    dev = mx.tpu(0) if jax.default_backend() == "tpu" else mx.cpu()
+    networks = (["alexnet", "vgg", "inception-bn", "inception-v3",
+                 "resnet-50", "resnet-152"]
+                if args.network == "all" else [args.network])
+    batch_sizes = [args.batch_size] if args.batch_size else [1, 32, 64, 128]
+    for net in networks:
+        logging.info("network: %s", net)
+        for b in batch_sizes:
+            speed = score(net, dev, b, args.num_batches, dtype=args.dtype)
+            logging.info("batch size %3d, dtype %s, images/sec: %f",
+                         b, args.dtype, speed)

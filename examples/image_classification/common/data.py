@@ -1,0 +1,155 @@
+"""Data providers for the image-classification examples (parity: reference
+``example/image-classification/common/data.py``).
+
+The reference reads RecordIO packs (ImageRecordIter).  Here ``get_rec_iter``
+reads the same ``.rec`` files through ``mx.io.ImageRecordIter`` when
+``--data-train`` exists, and falls back to synthetic data (the approach of
+the reference's ``benchmark_score.py``) when it doesn't — so every example
+runs out of the box on a fresh machine with zero downloads."""
+
+import argparse
+import os
+
+import numpy as np
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data")
+    data.add_argument("--data-val", type=str, help="the validation data")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0,
+                      help="padding the input image")
+    data.add_argument("--image-shape", type=str,
+                      help="the image shape feed into the network, e.g. (3,224,224)")
+    data.add_argument("--num-classes", type=int, help="the number of classes")
+    data.add_argument("--num-examples", type=int, help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, run synthetic-data benchmark")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation", "image augmentations")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """In-memory random images (reference ``benchmark_score.py`` approach)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        self.batch_size = data_shape[0]
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        label = np.random.randint(0, num_classes, [self.batch_size])
+        data = np.random.uniform(-1, 1, data_shape)
+        self.data = mx.nd.array(data.astype(dtype))
+        self.label = mx.nd.array(label.astype(np.float32))
+        self.provide_data = [mx.io.DataDesc("data", data_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (self.batch_size,))]
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter <= self.max_iter:
+            return mx.io.DataBatch(data=[self.data], label=[self.label],
+                                   pad=0, index=None,
+                                   provide_data=self.provide_data,
+                                   provide_label=self.provide_label)
+        raise StopIteration
+
+    __next__ = next
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    image_shape = tuple(int(l) for l in args.image_shape.split(","))
+    if kv:
+        rank, nworker = kv.rank, kv.num_workers
+    else:
+        rank, nworker = 0, 1
+    if args.data_train is None or not os.path.exists(args.data_train):
+        total = args.num_examples or 50000
+        train = SyntheticDataIter(args.num_classes,
+                                  (args.batch_size,) + image_shape,
+                                  max_iter=max(1, total // args.batch_size))
+        return (train, None)
+    rgb_mean = [float(i) for i in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=bool(args.random_crop),
+        rand_mirror=bool(args.random_mirror),
+        preprocess_threads=args.data_nthreads,
+        shuffle=True,
+        num_parts=nworker, part_index=rank,
+    )
+    if args.data_val is None or not os.path.exists(args.data_val):
+        return (train, None)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=False, rand_mirror=False,
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank,
+    )
+    return (train, val)
+
+
+def get_mnist_iter(args, kv):
+    """MNIST iters; reads idx files if present, else synthetic 28x28."""
+    data_dir = getattr(args, "data_dir", "data/mnist")
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img):
+        train = mx.io.MNISTIter(
+            image=img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        return (train, val)
+    n = args.num_examples or 6000
+    rng = np.random.RandomState(7)
+    # separable synthetic digits: class-dependent mean patches
+    labels = rng.randint(0, 10, n)
+    data = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.3
+    for c in range(10):
+        mask = labels == c
+        data[mask, 0, c * 2:c * 2 + 5, c * 2:c * 2 + 5] += 0.7
+    split = int(n * 0.9)
+    train = mx.io.NDArrayIter(data[:split], labels[:split].astype(np.float32),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(data[split:], labels[split:].astype(np.float32),
+                            args.batch_size)
+    return (train, val)

@@ -1,0 +1,42 @@
+"""Train on ImageNet (parity: reference
+``example/image-classification/train_imagenet.py`` — the north-star path of
+SURVEY.md §3.1, with ``--tpus`` replacing ``--gpus``)."""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
+
+import mxnet_tpu as mx
+from common import fit, data
+from mxnet_tpu import models
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=50,
+        data_train="data/imagenet_train.rec",
+        data_val="data/imagenet_val.rec",
+        image_shape="3,224,224",
+        num_classes=1000,
+        num_examples=1281167,
+        batch_size=128,
+        lr_step_epochs="30,60,90",
+        dtype="bfloat16",
+    )
+    args = parser.parse_args()
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape, dtype=args.dtype)
+    fit.fit(args, sym, data.get_rec_iter)

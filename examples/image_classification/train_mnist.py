@@ -1,0 +1,43 @@
+"""Train mlp/lenet on MNIST (parity: reference
+``example/image-classification/train_mnist.py`` — same CLI with ``--tpus``).
+
+Runs out of the box: uses idx files from ``--data-dir`` when present,
+synthetic separable digits otherwise.
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
+
+import mxnet_tpu as mx
+from common import fit, data
+
+
+def get_mnist_sym(args):
+    from mxnet_tpu import models
+    return models.get_symbol(args.network, num_classes=10)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train an image classifier on mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    parser.add_argument("--num-examples", type=int, default=6000)
+    fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp",
+        num_epochs=10,
+        lr=0.05,
+        lr_step_epochs="10",
+        batch_size=64,
+        disp_batches=50,
+    )
+    args = parser.parse_args()
+
+    sym = get_mnist_sym(args)
+    fit.fit(args, sym, data.get_mnist_iter)

@@ -1,0 +1,138 @@
+"""PTB LSTM language model with bucketing (parity: reference
+``example/rnn/lstm_bucketing.py`` — BucketingModule + stacked LSTMCell;
+BASELINE config #4).
+
+Reads PTB text from ``--data-dir`` if present (ptb.train.txt / ptb.valid.txt),
+else generates a synthetic Markov-chain corpus so the example runs with zero
+downloads.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="Train an LSTM language model with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--data-dir", type=str, default="data/ptb")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--tpus", type=str, default=None)
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="sgd")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=0.00001)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--num-sentences", type=int, default=2000,
+                    help="synthetic corpus size when no PTB files found")
+buckets = [10, 20, 30, 40, 50, 60]
+start_label = 1
+invalid_label = 0
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    lines = [filter(None, i.split(" ")) for i in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label)
+    return sentences, vocab
+
+
+def synthetic_corpus(num_sentences, vocab_size=500, seed=3):
+    """Markov-chain sentences: learnable non-uniform bigram structure."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab_size) * 0.05, size=vocab_size)
+    sents = []
+    for _ in range(num_sentences):
+        n = rng.randint(5, 60)
+        s = [int(rng.randint(start_label, vocab_size))]
+        for _ in range(n - 1):
+            s.append(int(rng.choice(vocab_size, p=trans[s[-1]])))
+        sents.append([max(t, start_label) for t in s])
+    return sents
+
+
+if __name__ == "__main__":
+    import logging
+
+    head = "%(asctime)-15s %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head)
+    args = parser.parse_args()
+
+    train_file = os.path.join(args.data_dir, "ptb.train.txt")
+    if os.path.exists(train_file):
+        train_sent, vocab = tokenize_text(
+            train_file, start_label=start_label, invalid_label=invalid_label)
+        val_sent, vocab = tokenize_text(
+            os.path.join(args.data_dir, "ptb.valid.txt"), vocab=vocab,
+            start_label=start_label, invalid_label=invalid_label)
+    else:
+        logging.info("no PTB data under %s; using synthetic corpus", args.data_dir)
+        sents = synthetic_corpus(args.num_sentences)
+        split = int(len(sents) * 0.9)
+        train_sent, val_sent = sents[:split], sents[split:]
+        vocab = {i: i for i in range(501)}
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=invalid_label)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets,
+                                         invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=len(vocab),
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=len(vocab),
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    import jax
+    if args.tpus:
+        contexts = [mx.tpu(int(i)) for i in args.tpus.split(",")]
+    elif jax.default_backend() == "tpu":
+        contexts = [mx.tpu(0)]
+    else:
+        contexts = [mx.cpu()]
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=contexts)
+
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))

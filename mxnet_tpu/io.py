@@ -23,7 +23,7 @@ from .base import MXNetError, mx_dtype
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -424,3 +424,39 @@ class CSVIter(NDArrayIter):
                 label = label.reshape(-1)
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="pad" if round_batch else "discard")
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                    std_b=1.0, rand_crop=False, rand_mirror=False,
+                    resize=0, shuffle=False, preprocess_threads=4,
+                    num_parts=1, part_index=0, prefetch_buffer=4,
+                    label_width=1, data_name="data",
+                    label_name="softmax_label", **kwargs):
+    """RecordIO image iterator with the C-iterator parameter surface
+    (parity: reference ``src/io/iter_image_recordio_2.cc:559-579`` /
+    ``ImageRecordIter`` registration).
+
+    Decoding/augmentation runs through ``mx.image.ImageIter`` wrapped in a
+    ``PrefetchingIter`` for double-buffering — the role of the reference's
+    ``PrefetcherIter`` + OMP decode threads (``iter_prefetcher.h:129``).
+    When the native C++ loader extension is built it takes over the decode
+    path transparently.
+    """
+    from .image import ImageIter
+
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = _np.array([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = _np.array([std_r, std_g, std_b])
+    inner = ImageIter(
+        batch_size=batch_size, data_shape=data_shape,
+        label_width=label_width, path_imgrec=path_imgrec,
+        path_imgidx=path_imgidx, shuffle=shuffle, part_index=part_index,
+        num_parts=num_parts, data_name=data_name, label_name=label_name,
+        resize=resize, rand_crop=rand_crop, rand_mirror=rand_mirror,
+        mean=mean, std=std,
+    )
+    return PrefetchingIter(inner)

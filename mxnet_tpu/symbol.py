@@ -113,6 +113,15 @@ class Symbol:
     def list_inputs(self):
         return [n.name for n in self._topo() if n.is_variable]
 
+    # -- pickling (JSON round-trip; ops re-resolve from the registry, so
+    # compute closures never enter the pickle — the reference pickles the
+    # C handle the same way for kvstore set_optimizer) -----------------
+    def __getstate__(self):
+        return {"json": self.tojson()}
+
+    def __setstate__(self, state):
+        self._outputs = load_json(state["json"])._outputs
+
     # -- composition ---------------------------------------------------
     def __getitem__(self, index):
         if isinstance(index, str):
