@@ -101,13 +101,7 @@ def add_fit_args(parser):
 
 def get_devices(args):
     """``--tpus`` -> context list (the reference's ``--gpus`` mapping)."""
-    import jax
-
-    if args.tpus:
-        return [mx.tpu(int(i)) for i in args.tpus.split(",")]
-    if jax.default_backend() == "tpu":
-        return [mx.tpu(0)]
-    return [mx.cpu()]
+    return mx.context.devices_from_arg(args.tpus)
 
 
 def fit(args, network, data_loader, **kwargs):

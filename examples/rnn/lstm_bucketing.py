@@ -111,13 +111,7 @@ if __name__ == "__main__":
         pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
         return pred, ("data",), ("softmax_label",)
 
-    import jax
-    if args.tpus:
-        contexts = [mx.tpu(int(i)) for i in args.tpus.split(",")]
-    elif jax.default_backend() == "tpu":
-        contexts = [mx.tpu(0)]
-    else:
-        contexts = [mx.cpu()]
+    contexts = mx.context.devices_from_arg(args.tpus)
 
     model = mx.mod.BucketingModule(
         sym_gen=sym_gen,

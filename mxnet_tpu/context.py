@@ -123,3 +123,17 @@ def num_tpus():
     import jax
 
     return len(jax.devices())
+
+
+def devices_from_arg(tpus_arg):
+    """Map a ``--tpus`` CLI string (e.g. ``"0,1,2"``) to a context list —
+    the TPU twin of the reference examples' ``--gpus`` mapping
+    (``example/image-classification/common/fit.py``).  Empty/None picks
+    tpu(0) when a TPU backend is present, else cpu()."""
+    import jax
+
+    if tpus_arg:
+        return [tpu(int(i)) for i in tpus_arg.split(",")]
+    if jax.default_backend() == "tpu":
+        return [tpu(0)]
+    return [cpu()]

@@ -315,6 +315,23 @@ class ImageIter(DataIter):
             if path_imgidx:
                 self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
                 self.imgidx = list(self.imgrec.keys)
+            elif shuffle or num_parts > 1:
+                # no .idx sidecar but random access is needed: build the
+                # index in-memory with one sequential scan (the reference's
+                # C++ iter would refuse; scanning keeps shuffle/sharding
+                # semantics working on bare .rec files)
+                rec = MXIndexedRecordIO(path_imgrec + ".__noidx__",
+                                        path_imgrec, "r")
+                pos = rec.tell()
+                i = 0
+                while rec.read() is not None:
+                    rec.idx[i] = pos
+                    rec.keys.append(i)
+                    i += 1
+                    pos = rec.tell()
+                rec.handle.seek(0)
+                self.imgrec = rec
+                self.imgidx = list(rec.keys)
             else:
                 self.imgrec = MXRecordIO(path_imgrec, "r")
                 self.imgidx = None

@@ -151,44 +151,70 @@ class NDArray:
         return NDArray(self._data.T, self._ctx)
 
     # -- arithmetic (broadcasting, like reference broadcast_* sugar) ---
-    def _binary(self, other, fn, scalar_fn=None):
+    def _binary(self, other, fn, op_name=None, scalar_op=None, swap=False):
+        # when the autograd tape is active, route through the op registry so
+        # the op is recorded (parity: reference sugar maps to broadcast_* /
+        # _*_scalar ops which MXImperativeInvoke tapes)
+        from .contrib import autograd as _ag
+
+        if _ag.is_training() and (op_name or scalar_op):
+            if isinstance(other, (int, float)) and scalar_op:
+                # _r*_scalar ops encode the operand order themselves
+                return invoke(scalar_op, [self], {"scalar": float(other)})
+            if op_name:
+                o = other if isinstance(other, NDArray) else \
+                    NDArray(jnp.asarray(other, dtype=self.dtype), self._ctx)
+                pair = [o, self] if swap else [self, o]
+                return invoke(op_name, pair)
         if isinstance(other, NDArray):
-            return NDArray(fn(self._data, other._data), self._ctx)
-        return NDArray(fn(self._data, jnp.asarray(other, dtype=self.dtype)), self._ctx)
+            a, b = self._data, other._data
+        else:
+            a, b = self._data, jnp.asarray(other, dtype=self.dtype)
+        if swap:
+            a, b = b, a
+        return NDArray(fn(a, b), self._ctx)
 
     def __add__(self, other):
-        return self._binary(other, jnp.add)
+        return self._binary(other, jnp.add, "broadcast_add", "_plus_scalar")
 
     __radd__ = __add__
 
     def __sub__(self, other):
-        return self._binary(other, jnp.subtract)
+        return self._binary(other, jnp.subtract, "broadcast_sub",
+                            "_minus_scalar")
 
     def __rsub__(self, other):
-        return self._binary(other, lambda a, b: b - a)
+        return self._binary(other, jnp.subtract, "broadcast_sub",
+                            "_rminus_scalar", swap=True)
 
     def __mul__(self, other):
-        return self._binary(other, jnp.multiply)
+        return self._binary(other, jnp.multiply, "broadcast_mul", "_mul_scalar")
 
     __rmul__ = __mul__
 
     def __div__(self, other):
-        return self._binary(other, jnp.divide)
+        return self._binary(other, jnp.divide, "broadcast_div", "_div_scalar")
 
     __truediv__ = __div__
 
     def __rdiv__(self, other):
-        return self._binary(other, lambda a, b: b / a)
+        return self._binary(other, jnp.divide, "broadcast_div", "_rdiv_scalar",
+                            swap=True)
 
     __rtruediv__ = __rdiv__
 
     def __pow__(self, other):
-        return self._binary(other, jnp.power)
+        return self._binary(other, jnp.power, "broadcast_power",
+                            "_power_scalar")
 
     def __mod__(self, other):
-        return self._binary(other, jnp.mod)
+        return self._binary(other, jnp.mod, "broadcast_mod", "_mod_scalar")
 
     def __neg__(self):
+        from .contrib import autograd as _ag
+
+        if _ag.is_training():
+            return invoke("negative", [self])
         return NDArray(-self._data, self._ctx)
 
     def __iadd__(self, other):

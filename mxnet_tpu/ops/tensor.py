@@ -742,40 +742,95 @@ def _sample(name, aliases, extra, draw):
 
 _sample(
     "_random_uniform",
-    ["_sample_uniform", "uniform", "random_uniform"],
+    ["uniform", "random_uniform"],
     {"low": P("float", 0.0), "high": P("float", 1.0)},
     lambda k, a, s: jax.random.uniform(k, s, minval=a["low"], maxval=a["high"]),
 )
 _sample(
     "_random_normal",
-    ["_sample_normal", "normal", "random_normal"],
+    ["normal", "random_normal"],
     {"loc": P("float", 0.0), "scale": P("float", 1.0)},
     lambda k, a, s: a["loc"] + a["scale"] * jax.random.normal(k, s),
 )
 _sample(
     "_random_gamma",
-    ["_sample_gamma"],
+    ["random_gamma"],
     {"alpha": P("float", 1.0), "beta": P("float", 1.0)},
     lambda k, a, s: jax.random.gamma(k, a["alpha"], s) * a["beta"],
 )
 _sample(
     "_random_exponential",
-    ["_sample_exponential"],
+    ["random_exponential"],
     {"lam": P("float", 1.0)},
     lambda k, a, s: jax.random.exponential(k, s) / a["lam"],
 )
 _sample(
     "_random_poisson",
-    ["_sample_poisson"],
+    ["random_poisson"],
     {"lam": P("float", 1.0)},
     lambda k, a, s: jax.random.poisson(k, a["lam"], s).astype(jnp.float32),
 )
 _sample(
     "_random_negative_binomial",
-    ["_sample_negbinomial"],
+    ["random_negative_binomial"],
     {"k": P("float", 1.0), "p": P("float", 0.5)},
     lambda k, a, s: jax.random.poisson(
         k, jax.random.gamma(jax.random.fold_in(k, 1), a["k"], s) * (1 - a["p"]) / a["p"]
+    ).astype(jnp.float32),
+)
+
+
+def _multisample(name, aliases, arg_names, draw):
+    """Per-row sampling with tensor distribution params (parity: the
+    reference's ``multisample_op`` family, ``src/operator/tensor/
+    multisample_op.cc``): inputs are 1-D parameter arrays; output is
+    ``param_shape + shape`` with row i drawn from distribution(params[i])."""
+    params = {"shape": P("shape", None), "dtype": P("str", "float32")}
+
+    @register(name, aliases=aliases, arg_names=list(arg_names), params=params,
+              needs_rng=True)
+    def _op(attrs, *ps, rng=None, _draw=draw):
+        from ..base import mx_dtype
+
+        shape = attrs["shape"] or ()
+        if isinstance(shape, int):
+            shape = (shape,)
+        full = tuple(ps[0].shape) + tuple(shape)
+        # broadcast each 1-D param against the sample dims
+        expand = (...,) + (None,) * len(shape)
+        bps = [p[expand] if shape else p for p in ps]
+        return _draw(rng, full, *bps).astype(mx_dtype(attrs["dtype"]))
+
+    return _op
+
+
+_multisample(
+    "_sample_uniform", ["sample_uniform"], ["low", "high"],
+    lambda k, s, lo, hi: lo + (hi - lo) * jax.random.uniform(k, s),
+)
+_multisample(
+    "_sample_normal", ["sample_normal"], ["mu", "sigma"],
+    lambda k, s, mu, sig: mu + sig * jax.random.normal(k, s),
+)
+_multisample(
+    "_sample_gamma", ["sample_gamma"], ["alpha", "beta"],
+    lambda k, s, a, b: jax.random.gamma(k, jnp.broadcast_to(a, s)) * b,
+)
+_multisample(
+    "_sample_exponential", ["sample_exponential"], ["lam"],
+    lambda k, s, lam: jax.random.exponential(k, s) / lam,
+)
+_multisample(
+    "_sample_poisson", ["sample_poisson"], ["lam"],
+    lambda k, s, lam: jax.random.poisson(k, jnp.broadcast_to(lam, s)).astype(
+        jnp.float32),
+)
+_multisample(
+    "_sample_negbinomial", ["sample_negbinomial"], ["k", "p"],
+    lambda key, s, kk, p: jax.random.poisson(
+        key,
+        jax.random.gamma(jax.random.fold_in(key, 1), jnp.broadcast_to(kk, s))
+        * (1 - p) / p,
     ).astype(jnp.float32),
 )
 

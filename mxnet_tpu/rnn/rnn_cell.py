@@ -609,8 +609,10 @@ class ZoneoutCell(ModifierCell):
         next_output, next_states = cell(inputs, states)
         mask = lambda p, like: symbol.Dropout(  # noqa: E731
             symbol.ones_like(like), p=p)
+        # the reference seeds prev_output with zeros(shape=(0,0)) and relies on
+        # 0=unknown shape inference; with static shapes use zeros_like instead
         prev_output = self.prev_output if self.prev_output is not None else \
-            symbol.zeros((0, 0))
+            symbol.zeros_like(next_output)
         output = (symbol.where(mask(p_outputs, next_output), next_output,
                                prev_output)
                   if p_outputs != 0.0 else next_output)

@@ -18,6 +18,7 @@ functions (``src/executor/graph_executor.cc:425-442``).
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Dict, List, Optional, Tuple
 
@@ -240,6 +241,11 @@ class Symbol:
         return shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
+        """Forward dtype propagation (parity: ``symbol.py:infer_type`` /
+        per-op ``InferType``, ``graph_executor.cc:426``).  Unlike shape
+        inference it does not need ``jax.eval_shape``: most ops preserve the
+        promoted input dtype, and dtype-attr ops (Cast, init/sample ops)
+        override it."""
         arg_names = self.list_arguments()
         tdict = {}
         if args:
@@ -247,10 +253,42 @@ class Symbol:
                 if t is not None:
                     tdict[name] = t
         tdict.update(kwargs)
-        # needs shapes too; use dummy 1-sized dims — dtype propagation only
-        raise NotImplementedError(
-            "infer_type requires shapes; use simple_bind/infer_shape instead"
-        )
+        tdict = {k: _np.dtype(v) for k, v in tdict.items()}
+
+        node_types: Dict[int, _np.dtype] = {}
+        nodes = self._topo()
+        for n in nodes:
+            if n.is_variable:
+                if n.name in tdict:
+                    node_types[n._id] = tdict[n.name]
+                continue
+            in_t = [node_types[src._id] for src, _ in n.inputs
+                    if src._id in node_types]
+            dtype_override = n.attrs.get("dtype") is not None
+            if dtype_override:
+                t = mx_dtype(n.attrs["dtype"])
+            elif in_t:
+                t = _np.dtype(functools.reduce(_np.promote_types, in_t))
+            else:
+                t = _np.dtype("float32")
+            node_types[n._id] = t
+            # backward-fill untyped variable inputs (elemwise same-type rule,
+            # like the reference's bidirectional InferType) — but not through
+            # dtype-attr ops like Cast, whose input dtype is unconstrained
+            if not dtype_override:
+                for src, _ in n.inputs:
+                    if src.is_variable and src._id not in node_types:
+                        node_types[src._id] = t
+        # any variable still untyped defaults to float32
+        for n in nodes:
+            if n.is_variable and n._id not in node_types:
+                node_types[n._id] = _np.dtype("float32")
+
+        by_name = {n.name: node_types[n._id] for n in nodes if n.is_variable}
+        arg_types = [by_name[nm] for nm in arg_names]
+        aux_types = [by_name[nm] for nm in self.list_auxiliary_states()]
+        out_types = [node_types[n._id] for n, _ in self._outputs]
+        return arg_types, out_types, aux_types
 
     # -- serialization -------------------------------------------------
     def tojson(self):
@@ -683,6 +721,21 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
         solved["label"] = dshape
     elif op.name in ("SVMOutput", "softmax_cross_entropy"):
         solved["label"] = (dshape[0],)
+    elif op.name == "RNN":
+        # packed cuDNN-layout parameter blob + initial states
+        # (reference rnn-inl.h InferShape)
+        from .ops.rnn_op import rnn_param_size
+
+        T, B, D = dshape
+        h = a["state_size"]
+        nl = a["num_layers"]
+        bi = bool(a.get("bidirectional", False))
+        dirs = 2 if bi else 1
+        solved["parameters"] = (
+            rnn_param_size(nl, D, h, bi, a.get("mode", "lstm")),)
+        solved["state"] = (nl * dirs, B, h)
+        if a.get("mode", "lstm") == "lstm":
+            solved["state_cell"] = (nl * dirs, B, h)
     else:
         return False
     progress = False

@@ -1,0 +1,55 @@
+"""Symbol attributes and AttrScope (parity model: reference
+``tests/python/unittest/test_attr.py``)."""
+
+import mxnet_tpu as mx
+
+
+def test_attr_basic():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope():
+    with mx.AttrScope(__group__="4", __data__="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data", "__init__": "0"})
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("__group__") == "4"
+    assert data.attr("__group__") == "4"
+    assert data.attr("__data__") == "great"
+    # explicit attr wins over scope
+    assert data.attr("dtype") == "data"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(__group__="a"):
+        with mx.AttrScope(__group__="b"):
+            x = mx.sym.Variable("x")
+        y = mx.sym.Variable("y")
+    assert x.attr("__group__") == "b"
+    assert y.attr("__group__") == "a"
+
+
+def test_attr_dict():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"})
+    d = op.attr_dict()
+    assert d["data"]["mood"] == "angry"
+    assert d["conv"]["__mood__"] == "so so"
+
+
+def test_list_attr():
+    a = mx.sym.Variable("a", attr={"x": "1"})
+    attrs = a.list_attr()
+    assert attrs.get("x") == "1"
+
+
+def test_lr_mult_attr_reaches_optimizer():
+    w = mx.sym.Variable("w", attr={"__lr_mult__": "0.25"})
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"), weight=w,
+                               num_hidden=4, no_bias=True, name="fc")
+    opt = mx.optimizer.SGD(learning_rate=1.0, sym=fc)
+    assert opt.lr_mult.get("w") == 0.25

@@ -1,0 +1,97 @@
+"""Imperative autograd (parity model: reference
+``tests/python/unittest/test_contrib_autograd.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def autograd_assert(*args, **kwargs):
+    func = kwargs["func"]
+    grad_f = kwargs["grad_func"]
+    argnum = kwargs.get("argnum", None)
+    grad_func = ag.grad_and_loss(func, argnum)
+    grad_vals, output = grad_func(*args)
+    res = func(*args)
+    assert_almost_equal(output.asnumpy(), res.asnumpy())
+    grad_res = grad_f(*args)
+    assert len(grad_vals) == len(grad_res)
+    for a, b in zip(grad_vals, grad_res):
+        assert_almost_equal(a.asnumpy(), b.asnumpy(), rtol=1e-4)
+
+
+def test_unary_func():
+    x = mx.nd.uniform(shape=(4, 5))
+    autograd_assert(x, func=lambda x: x + 1,
+                    grad_func=lambda x: [mx.nd.ones((4, 5))])
+    autograd_assert(x, func=lambda x: x * x,
+                    grad_func=lambda x: [x * 2])
+
+
+def test_binary_func():
+    x = mx.nd.uniform(shape=(4, 5))
+    y = mx.nd.uniform(shape=(4, 5))
+    autograd_assert(x, y, func=lambda x, y: x * y,
+                    grad_func=lambda x, y: [y, x])
+
+
+def test_argnum():
+    def f_with_mode(a, b, mode):
+        if mode:
+            return a + b
+        return a * b
+
+    a = mx.nd.uniform(shape=(3, 2))
+    b = mx.nd.uniform(shape=(3, 2))
+    autograd_assert(a, b, True, argnum=[0, 1],
+                    func=f_with_mode,
+                    grad_func=lambda a, b, m: [mx.nd.ones((3, 2)),
+                                               mx.nd.ones((3, 2))])
+
+
+def test_training_scope():
+    assert not ag.is_training()
+    with ag.train_section():
+        assert ag.is_training()
+        with ag.test_section():
+            assert not ag.is_training()
+        assert ag.is_training()
+    assert not ag.is_training()
+
+
+def test_grad_and_loss_chain():
+    def f(x):
+        return mx.nd.sum(mx.nd.exp(x) * x)
+
+    x_np = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    grads, loss = ag.grad_and_loss(f)(x)
+    expect = np.exp(x_np) * x_np + np.exp(x_np)
+    assert_almost_equal(grads[0].asnumpy(), expect, rtol=1e-4)
+    assert_almost_equal(loss.asnumpy(), np.sum(np.exp(x_np) * x_np),
+                        rtol=1e-4)
+
+
+def test_mark_variables_compute_gradient():
+    x = mx.nd.array(np.random.uniform(-1, 1, (3, 4)).astype(np.float32))
+    gx = mx.nd.zeros((3, 4))
+    ag.mark_variables([x], [gx])
+    with ag.train_section():
+        y = mx.nd.sum(x * x)
+        ag.compute_gradient([y])
+    assert_almost_equal(gx.asnumpy(), 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_reflected_ops_under_training():
+    """__rsub__/__rdiv__ keep operand order on the taped path."""
+    a_np = np.array([1.0, 2.0, 3.0], np.float32)
+    a = mx.nd.array(a_np)
+    with ag.train_section():
+        r1 = (10.0 - a).asnumpy()
+        r2 = (12.0 / a).asnumpy()
+        r3 = (a + np.ones(3, np.float32)).asnumpy()  # array operand
+    assert_almost_equal(r1, 10.0 - a_np)
+    assert_almost_equal(r2, 12.0 / a_np, rtol=1e-5)
+    assert_almost_equal(r3, a_np + 1.0)

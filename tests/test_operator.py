@@ -1,0 +1,513 @@
+"""Per-op numerics (parity model: reference
+``tests/python/unittest/test_operator.py`` — numeric-gradient checking vs
+finite differences + golden forward/backward, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+    check_symbolic_forward,
+    check_symbolic_backward,
+)
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- elemwise
+
+
+@pytest.mark.parametrize(
+    "name,npf",
+    [
+        ("exp", np.exp),
+        ("log", None),
+        ("sqrt", None),
+        ("square", lambda x: x * x),
+        ("tanh", np.tanh),
+        ("sigmoid", lambda x: 1.0 / (1.0 + np.exp(-x))),
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sin", np.sin),
+        ("cos", np.cos),
+        ("abs", np.abs),
+    ],
+)
+def test_unary_forward_and_grad(name, npf):
+    x = mx.sym.Variable("x")
+    sym = getattr(mx.sym, name)(x)
+    if name in ("log", "sqrt"):
+        data = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+        npf = np.log if name == "log" else np.sqrt
+    else:
+        data = _rand(3, 4)
+    check_symbolic_forward(sym, [data], [npf(data)], rtol=1e-5)
+    if name != "abs":  # |x| kink breaks finite differences near 0
+        check_numeric_gradient(sym, [data], numeric_eps=1e-3, rtol=5e-2,
+                               atol=1e-3)
+
+
+def test_binary_ops_forward():
+    a, b = _rand(4, 5), np.random.uniform(0.5, 2.0, (4, 5)).astype(np.float32)
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    for sym, ref in [
+        (x + y, a + b),
+        (x - y, a - b),
+        (x * y, a * b),
+        (x / y, a / b),
+        (mx.sym.maximum(x, y), np.maximum(a, b)),
+        (mx.sym.minimum(x, y), np.minimum(a, b)),
+    ]:
+        check_symbolic_forward(sym, {"x": a, "y": b}, [ref], rtol=1e-5)
+
+
+def test_binary_grad():
+    a, b = _rand(4, 5), np.random.uniform(0.5, 2.0, (4, 5)).astype(np.float32)
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    og = _rand(4, 5)
+    check_symbolic_backward(x * y, {"x": a, "y": b}, [og],
+                            {"x": og * b, "y": og * a}, rtol=1e-5)
+    check_symbolic_backward(x / y, {"x": a, "y": b}, [og],
+                            {"x": og / b, "y": -og * a / (b * b)}, rtol=1e-4)
+
+
+def test_scalar_ops():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    for sym, ref in [
+        (x + 2.0, a + 2.0),
+        (2.0 - x, 2.0 - a),
+        (x * 3.0, a * 3.0),
+        (6.0 / (x + 3.0), 6.0 / (a + 3.0)),
+        (x ** 2.0, a ** 2.0),
+    ]:
+        check_symbolic_forward(sym, [a], [ref], rtol=1e-5)
+
+
+# ---------------------------------------------------------------- broadcast
+
+
+def test_broadcast_binary():
+    a = _rand(2, 1, 4)
+    b = _rand(2, 3, 1)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.broadcast_add(x, y), {"x": a, "y": b},
+                           [a + b])
+    check_symbolic_forward(mx.sym.broadcast_mul(x, y), {"x": a, "y": b},
+                           [a * b])
+    check_numeric_gradient(mx.sym.broadcast_mul(x, y), {"x": a, "y": b},
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_broadcast_to_and_axis():
+    a = _rand(1, 3, 1)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.broadcast_to(x, shape=(2, 3, 4)), [a],
+                           [np.broadcast_to(a, (2, 3, 4))])
+    check_symbolic_forward(
+        mx.sym.broadcast_axis(x, axis=0, size=5), [a],
+        [np.broadcast_to(a, (5, 3, 1))])
+
+
+# ---------------------------------------------------------------- reductions
+
+
+@pytest.mark.parametrize("name,npf", [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max),
+    ("min", np.min), ("prod", np.prod),
+])
+def test_reductions(name, npf):
+    a = np.random.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    x = mx.sym.Variable("x")
+    f = getattr(mx.sym, name)
+    check_symbolic_forward(f(x), [a], [npf(a).reshape(())], rtol=1e-5)
+    check_symbolic_forward(f(x, axis=1), [a], [npf(a, axis=1)], rtol=1e-5)
+    check_symbolic_forward(f(x, axis=(0, 2), keepdims=True), [a],
+                           [npf(a, axis=(0, 2), keepdims=True)], rtol=1e-5)
+
+
+def test_sum_grad():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    check_numeric_gradient(mx.sym.sum(x, axis=1), [a], rtol=5e-2, atol=1e-3)
+
+
+def test_norm():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.norm(x), [a],
+                           [np.linalg.norm(a).reshape(())], rtol=1e-5)
+
+
+def test_nansum():
+    a = _rand(3, 4)
+    a[0, 0] = np.nan
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.nansum(x), [a],
+                           [np.nansum(a).reshape(())], rtol=1e-5)
+
+
+# ---------------------------------------------------------------- linalg
+
+
+def test_dot():
+    a, b = _rand(3, 4), _rand(4, 5)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.dot(x, y), {"x": a, "y": b}, [a @ b],
+                           rtol=1e-4)
+    check_numeric_gradient(mx.sym.dot(x, y), {"x": a, "y": b}, rtol=5e-2,
+                           atol=1e-3)
+
+
+def test_dot_transpose():
+    a, b = _rand(4, 3), _rand(5, 4)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(
+        mx.sym.dot(x, y, transpose_a=True, transpose_b=True),
+        {"x": a, "y": b}, [a.T @ b.T], rtol=1e-4)
+
+
+def test_batch_dot():
+    a, b = _rand(6, 3, 4), _rand(6, 4, 5)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.batch_dot(x, y), {"x": a, "y": b},
+                           [np.einsum("bij,bjk->bik", a, b)], rtol=1e-4)
+
+
+# ---------------------------------------------------------------- shape manip
+
+
+def test_reshape_transpose_etc():
+    a = _rand(2, 3, 4)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.reshape(x, shape=(4, 6)), [a],
+                           [a.reshape(4, 6)])
+    check_symbolic_forward(mx.sym.transpose(x, axes=(2, 0, 1)), [a],
+                           [a.transpose(2, 0, 1)])
+    check_symbolic_forward(mx.sym.swapaxes(x, dim1=0, dim2=2), [a],
+                           [a.swapaxes(0, 2)])
+    check_symbolic_forward(mx.sym.expand_dims(x, axis=1), [a],
+                           [a[:, None]])
+    check_symbolic_forward(mx.sym.flatten(x), [a], [a.reshape(2, 12)])
+
+
+def test_slice_ops():
+    a = _rand(4, 6)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(
+        mx.sym.slice(x, begin=(1, 2), end=(3, 5)), [a], [a[1:3, 2:5]])
+    check_symbolic_forward(
+        mx.sym.slice_axis(x, axis=1, begin=1, end=4), [a], [a[:, 1:4]])
+    check_numeric_gradient(
+        mx.sym.slice_axis(x, axis=1, begin=1, end=4), [a], rtol=5e-2,
+        atol=1e-3)
+
+
+def test_repeat_tile_reverse():
+    a = _rand(2, 3)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.repeat(x, repeats=2, axis=1), [a],
+                           [np.repeat(a, 2, axis=1)])
+    check_symbolic_forward(mx.sym.tile(x, reps=(2, 3)), [a],
+                           [np.tile(a, (2, 3))])
+    check_symbolic_forward(mx.sym.reverse(x, axis=1), [a], [a[:, ::-1]])
+
+
+def test_concat_split_stack():
+    a, b = _rand(2, 3), _rand(2, 3)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.Concat(x, y, dim=1), {"x": a, "y": b},
+                           [np.concatenate([a, b], axis=1)])
+    out = mx.sym.SliceChannel(x, num_outputs=3, axis=1)
+    ex = out.bind(mx.cpu(), {"x": mx.nd.array(a)})
+    res = ex.forward()
+    for i in range(3):
+        assert_almost_equal(res[i].asnumpy(), a[:, i:i + 1])
+    check_symbolic_forward(mx.sym.stack(x, y, axis=0), {"x": a, "y": b},
+                           [np.stack([a, b])])
+
+
+def test_clip_where():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.clip(x, a_min=-0.5, a_max=0.5), [a],
+                           [np.clip(a, -0.5, 0.5)])
+    cond = (np.random.rand(3, 4) > 0.5).astype(np.float32)
+    c, y = mx.sym.Variable("c"), mx.sym.Variable("y")
+    b = _rand(3, 4)
+    check_symbolic_forward(
+        mx.sym.where(c, x, y), {"c": cond, "x": a, "y": b},
+        [np.where(cond > 0, a, b)])
+
+
+# ---------------------------------------------------------------- indexing
+
+
+def test_take_one_hot_pick():
+    a = _rand(5, 4)
+    idx = np.array([0, 2, 4, 1], np.float32)
+    x, i = mx.sym.Variable("x"), mx.sym.Variable("i")
+    check_symbolic_forward(mx.sym.take(x, i), {"x": a, "i": idx},
+                           [a[idx.astype(int)]])
+    check_symbolic_forward(
+        mx.sym.one_hot(i, depth=5), {"i": idx},
+        [np.eye(5, dtype=np.float32)[idx.astype(int)]])
+    pidx = np.array([1, 3, 0, 2, 1], np.float32)
+    check_symbolic_forward(
+        mx.sym.pick(x, i, axis=1), {"x": a, "i": pidx},
+        [a[np.arange(5), pidx.astype(int)]])
+
+
+def test_embedding_forward_grad():
+    W = _rand(10, 4)
+    idx = np.array([1, 5, 1, 9], np.float32)
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("weight")
+    sym = mx.sym.Embedding(data=d, weight=w, input_dim=10, output_dim=4)
+    check_symbolic_forward(sym, {"data": idx, "weight": W},
+                           [W[idx.astype(int)]])
+    # gradient accumulates over duplicate indices
+    gw = mx.nd.zeros((10, 4))
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(idx), "weight": mx.nd.array(W)},
+                  args_grad={"weight": gw}, grad_req={"weight": "write",
+                                                      "data": "null"})
+    ex.forward(is_train=True)
+    og = np.ones((4, 4), np.float32)
+    ex.backward(mx.nd.array(og))
+    expect = np.zeros((10, 4), np.float32)
+    for j, k in enumerate(idx.astype(int)):
+        expect[k] += og[j]
+    assert_almost_equal(gw.asnumpy(), expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- ordering
+
+
+def test_sort_argsort_topk():
+    a = np.random.uniform(-1, 1, (4, 6)).astype(np.float32)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.sort(x, axis=1), [a], [np.sort(a, axis=1)])
+    check_symbolic_forward(mx.sym.argsort(x, axis=1), [a],
+                           [np.argsort(a, kind="stable", axis=1).astype(np.float32)])
+    check_symbolic_forward(mx.sym.argmax(x, axis=1), [a],
+                           [np.argmax(a, axis=1).astype(np.float32)])
+    check_symbolic_forward(mx.sym.argmin(x, axis=1), [a],
+                           [np.argmin(a, axis=1).astype(np.float32)])
+    # topk returns indices of the k largest by default
+    k = 3
+    top = mx.sym.topk(x, k=k, axis=1)
+    ex = top.bind(mx.cpu(), {"x": mx.nd.array(a)})
+    got = ex.forward()[0].asnumpy().astype(int)
+    ref = np.argsort(-a, axis=1)[:, :k]
+    gathered = np.take_along_axis(a, got, axis=1)
+    expect = np.take_along_axis(a, ref, axis=1)
+    assert_almost_equal(gathered, expect, rtol=1e-6)
+
+
+# ---------------------------------------------------------------- NN layers
+
+
+def test_fully_connected():
+    a, w, b = _rand(4, 8), _rand(3, 8), _rand(3)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data=d, num_hidden=3, name="fc")
+    check_symbolic_forward(
+        sym, {"data": a, "fc_weight": w, "fc_bias": b},
+        [a @ w.T + b], rtol=1e-4)
+    check_numeric_gradient(sym, {"data": a, "fc_weight": w, "fc_bias": b},
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_convolution_vs_numpy():
+    # golden check vs direct convolution
+    a = _rand(2, 3, 5, 5)
+    w = _rand(4, 3, 3, 3)
+    b = _rand(4)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(data=d, num_filter=4, kernel=(3, 3), name="c")
+    ref = np.zeros((2, 4, 3, 3), np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(3):
+                for j in range(3):
+                    ref[n, f, i, j] = np.sum(
+                        a[n, :, i:i + 3, j:j + 3] * w[f]) + b[f]
+    check_symbolic_forward(sym, {"data": a, "c_weight": w, "c_bias": b},
+                           [ref], rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    a = _rand(1, 2, 5, 5)
+    w = _rand(3, 2, 3, 3)
+    b = _rand(3)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(data=d, num_filter=3, kernel=(3, 3), stride=(2, 2),
+                             pad=(1, 1), name="c")
+    check_numeric_gradient(sym, {"data": a, "c_weight": w, "c_bias": b},
+                           numeric_eps=1e-2, rtol=1e-1, atol=1e-2)
+
+
+def test_pooling():
+    a = _rand(1, 2, 4, 4)
+    d = mx.sym.Variable("data")
+    mxp = mx.sym.Pooling(data=d, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = a.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(mxp, [a], [ref])
+    avg = mx.sym.Pooling(data=d, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    refa = a.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(avg, [a], [refa], rtol=1e-5)
+    check_numeric_gradient(avg, [a], rtol=5e-2, atol=1e-3)
+
+
+def test_batchnorm_inference_stats():
+    np.random.seed(0)
+    a = np.random.normal(3.0, 2.0, (16, 4, 5, 5)).astype(np.float32)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(data=d, fix_gamma=False, name="bn")
+    ex = sym.simple_bind(mx.cpu(), data=a.shape)
+    ex.arg_dict["data"][:] = a
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.arg_dict["bn_beta"][:] = 0.0
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    # normalized output: per-channel mean ~0, var ~1
+    assert np.allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-3)
+    assert np.allclose(out.var(axis=(0, 2, 3)), 1.0, atol=1e-2)
+
+
+def test_activation_types():
+    a = _rand(3, 4)
+    d = mx.sym.Variable("data")
+    for act, ref in [
+        ("relu", np.maximum(a, 0)),
+        ("sigmoid", 1 / (1 + np.exp(-a))),
+        ("tanh", np.tanh(a)),
+        ("softrelu", np.log1p(np.exp(a))),
+    ]:
+        check_symbolic_forward(mx.sym.Activation(data=d, act_type=act), [a],
+                               [ref], rtol=1e-5)
+
+
+def test_leaky_relu():
+    a = _rand(3, 4)
+    d = mx.sym.Variable("data")
+    check_symbolic_forward(
+        mx.sym.LeakyReLU(data=d, act_type="leaky", slope=0.1), [a],
+        [np.where(a > 0, a, 0.1 * a)], rtol=1e-5)
+
+
+def test_softmax_ops():
+    a = _rand(4, 5)
+    x = mx.sym.Variable("x")
+    e = np.exp(a - a.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    check_symbolic_forward(mx.sym.softmax(x), [a], [sm], rtol=1e-5)
+    check_symbolic_forward(mx.sym.log_softmax(x), [a], [np.log(sm)],
+                           rtol=1e-5)
+    check_numeric_gradient(mx.sym.softmax(x), [a], rtol=5e-2, atol=1e-3)
+
+
+def test_softmax_output_ignores_label_grad():
+    a = _rand(4, 5)
+    lbl = np.array([0, 1, 2, 3], np.float32)
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data=d, label=l)
+    ga = mx.nd.zeros((4, 5))
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(a), "label": mx.nd.array(lbl)},
+                  args_grad={"data": ga}, grad_req={"data": "write",
+                                                    "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    e = np.exp(a - a.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    expect = sm.copy()
+    expect[np.arange(4), lbl.astype(int)] -= 1.0
+    assert_almost_equal(ga.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_vs_test():
+    a = np.ones((100, 100), np.float32)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.Dropout(data=d, p=0.5)
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(a)})
+    ex.forward(is_train=True)
+    out_t = ex.outputs[0].asnumpy()
+    frac = (out_t == 0).mean()
+    assert 0.4 < frac < 0.6
+    # kept units are scaled by 1/(1-p)
+    assert np.allclose(out_t[out_t != 0], 2.0)
+    out_i = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_i, a)
+
+
+def test_block_grad():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    sym = mx.sym.sum(mx.sym.BlockGrad(x * x) + x)
+    g = mx.nd.zeros((3, 4))
+    ex = sym.bind(mx.cpu(), {"x": mx.nd.array(a)}, args_grad={"x": g})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(g.asnumpy(), np.ones_like(a))
+
+
+def test_cast():
+    a = _rand(3, 4)
+    x = mx.sym.Variable("x")
+    ex = mx.sym.Cast(x, dtype="float16").bind(mx.cpu(), {"x": mx.nd.array(a)})
+    out = ex.forward()[0]
+    assert out.dtype == np.float16
+
+
+def test_sequence_mask_last_reverse():
+    # sequence ops use (seq, batch, ...) layout
+    a = _rand(5, 3, 2)
+    length = np.array([2, 5, 3], np.float32)
+    d = mx.sym.Variable("data")
+    sl = mx.sym.Variable("len")
+    masked = mx.sym.SequenceMask(data=d, sequence_length=sl,
+                                 use_sequence_length=True, value=0.0)
+    ref = a.copy()
+    for b, L in enumerate(length.astype(int)):
+        ref[L:, b] = 0.0
+    check_symbolic_forward(masked, {"data": a, "len": length}, [ref])
+
+    last = mx.sym.SequenceLast(data=d, sequence_length=sl,
+                               use_sequence_length=True)
+    refl = np.stack([a[int(L) - 1, b] for b, L in enumerate(length)])
+    check_symbolic_forward(last, {"data": a, "len": length}, [refl])
+
+    rev = mx.sym.SequenceReverse(data=d, sequence_length=sl,
+                                 use_sequence_length=True)
+    refr = a.copy()
+    for b, L in enumerate(length.astype(int)):
+        refr[:L, b] = a[:L, b][::-1]
+    check_symbolic_forward(rev, {"data": a, "len": length}, [refr])
+
+
+def test_l2_normalization():
+    a = _rand(3, 4)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.L2Normalization(data=d)
+    ref = a / np.sqrt((a * a).sum(axis=1, keepdims=True) + 1e-10)
+    check_symbolic_forward(sym, [a], [ref], rtol=1e-4)
+
+
+def test_instance_norm():
+    a = _rand(2, 3, 4, 4)
+    d = mx.sym.Variable("data")
+    g = mx.sym.Variable("gamma")
+    b = mx.sym.Variable("beta")
+    sym = mx.sym.InstanceNorm(data=d, gamma=g, beta=b)
+    mean = a.mean(axis=(2, 3), keepdims=True)
+    var = a.var(axis=(2, 3), keepdims=True)
+    ref = (a - mean) / np.sqrt(var + 1e-3)
+    check_symbolic_forward(
+        sym, {"data": a, "gamma": np.ones(3, np.float32),
+              "beta": np.zeros(3, np.float32)}, [ref], rtol=1e-3, atol=1e-4)

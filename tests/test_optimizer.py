@@ -1,0 +1,148 @@
+"""Optimizers vs python reference updaters (parity model: reference
+``tests/python/unittest/test_optimizer.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run(opt, w0, g, steps=3):
+    """Apply `opt` for `steps` steps on a copy of w0 with constant grad g."""
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def _prep(g, rescale, clip):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    return g
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.uniform(-1, 1, (5, 4)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (5, 4)).astype(np.float32)
+    for momentum in (0.0, 0.9):
+        for wd in (0.0, 0.05):
+            for clip in (None, 0.1):
+                opt = mx.optimizer.SGD(learning_rate=0.1, momentum=momentum,
+                                       wd=wd, rescale_grad=0.5,
+                                       clip_gradient=clip)
+                got = _run(opt, w0, g)
+                w = w0.copy()
+                mom = np.zeros_like(w)
+                for _ in range(3):
+                    gg = _prep(g, 0.5, clip)
+                    mom = momentum * mom - 0.1 * (gg + wd * w)
+                    w = w + mom
+                assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    opt = mx.optimizer.Adam(learning_rate=0.01, beta1=b1, beta2=b2,
+                            epsilon=eps, wd=0.02)
+    got = _run(opt, w0, g)
+    w = w0.copy()
+    mean = np.zeros_like(w)
+    var = np.zeros_like(w)
+    for t in range(1, 4):
+        gg = g + 0.02 * w
+        mean = b1 * mean + (1 - b1) * gg
+        var = b2 * var + (1 - b2) * gg * gg
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr * mean / (np.sqrt(var) + eps)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.95)
+    got = _run(opt, w0, g)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for _ in range(3):
+        n = 0.95 * n + 0.05 * g * g
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad_matches_numpy():
+    w0 = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    opt = mx.optimizer.AdaGrad(learning_rate=0.1, eps=1e-7)
+    got = _run(opt, w0, g)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for _ in range(3):
+        h = h + g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_nag_differs_from_sgd():
+    w0 = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    sgd = _run(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9), w0, g)
+    nag = _run(mx.optimizer.NAG(learning_rate=0.1, momentum=0.9), w0, g)
+    assert not np.allclose(sgd, nag)
+
+
+def test_create_by_name_and_registry():
+    for name in ("sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "sgld", "dcasgd", "test", "ccsgd"):
+        opt = mx.optimizer.create(name)
+        assert isinstance(opt, mx.optimizer.Optimizer)
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "w_weight", 1: "b_bias"}, wd=0.1)
+    opt.set_lr_mult({"w_weight": 0.5})
+    opt.set_wd_mult({})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    # bias gets wd_mult 0 by the _weight/_gamma convention
+    assert opt._get_wd(1) == 0.0
+    assert abs(opt._get_wd(0) - 0.1) < 1e-12
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.zeros((2,))
+    g = mx.nd.ones((2,))
+    lrs = []
+    for _ in range(6):
+        opt.update(0, w, g, None)
+        lrs.append(opt._get_lr(0))
+    assert lrs[0] == 1.0
+    assert lrs[-1] < lrs[0]
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[3, 6], factor=0.1)
+    sched.base_lr = 1.0
+    assert abs(sched(1) - 1.0) < 1e-9
+    assert abs(sched(4) - 0.1) < 1e-9
+    assert abs(sched(7) - 0.01) < 1e-9
+
+
+def test_updater_and_serialization():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.full((3,), 0.5, np.float32))
+    upd(0, g, w)
+    states = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.SGD(learning_rate=0.1,
+                                                     momentum=0.9))
+    upd2.set_states(states)
+    assert 0 in upd2.states

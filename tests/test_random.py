@@ -1,0 +1,71 @@
+"""Random sampling moment checks (parity model: reference
+``tests/python/unittest/test_random.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_uniform_moments():
+    mx.random.seed(7)
+    x = mx.nd.uniform(low=-2.0, high=4.0, shape=(2000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.15
+    assert x.min() >= -2.0 and x.max() < 4.0
+
+
+def test_normal_moments():
+    mx.random.seed(8)
+    x = mx.nd.normal(loc=3.0, scale=2.0, shape=(4000,)).asnumpy()
+    assert abs(x.mean() - 3.0) < 0.15
+    assert abs(x.std() - 2.0) < 0.15
+
+
+def test_seed_determinism():
+    mx.random.seed(123)
+    a = mx.nd.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(123)
+    b = mx.nd.uniform(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd.uniform(shape=(100,)).asnumpy()
+    assert not np.array_equal(a, c)
+
+
+def test_sym_random():
+    mx.random.seed(5)
+    u = mx.sym.uniform(low=0, high=1, shape=(500,))
+    ex = u.bind(mx.cpu(), {})
+    x = ex.forward()[0].asnumpy()
+    assert 0.0 <= x.min() and x.max() < 1.0
+    assert abs(x.mean() - 0.5) < 0.1
+
+
+def test_gamma_moments():
+    mx.random.seed(9)
+    # Gamma(shape=3, scale=2): mean 6, var 12
+    x = mx.nd._random_gamma(alpha=3.0, beta=2.0, shape=(4000,)).asnumpy()
+    assert abs(x.mean() - 6.0) < 0.5
+    assert abs(x.var() - 12.0) < 3.0
+
+
+def test_exponential_moments():
+    mx.random.seed(10)
+    x = mx.nd._random_exponential(lam=2.0, shape=(4000,)).asnumpy()
+    assert abs(x.mean() - 0.5) < 0.1
+
+
+def test_poisson_moments():
+    mx.random.seed(11)
+    x = mx.nd._random_poisson(lam=4.0, shape=(4000,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.3
+    assert abs(x.var() - 4.0) < 0.6
+
+
+def test_sample_ops_per_distribution_params():
+    """_sample_* draw per-row samples for an array of params."""
+    mx.random.seed(12)
+    mu = mx.nd.array(np.array([0.0, 10.0], np.float32))
+    sigma = mx.nd.array(np.array([1.0, 1.0], np.float32))
+    x = mx.nd._sample_normal(mu=mu, sigma=sigma, shape=(2000,)).asnumpy()
+    assert x.shape == (2, 2000)
+    assert abs(x[0].mean() - 0.0) < 0.2
+    assert abs(x[1].mean() - 10.0) < 0.2

@@ -1,0 +1,109 @@
+"""RNN cells (parity model: reference ``tests/python/unittest/test_rnn.py`` —
+shape checks + fused-vs-unfused equivalence)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _unroll_outputs(cell, T=3, B=4, D=8, merge=False):
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(T)]
+    outputs, states = cell.unroll(T, inputs)
+    out = mx.sym.Concat(*[mx.sym.expand_dims(o, axis=0) for o in outputs],
+                        dim=0)
+    shapes = {("t%d_data" % i): (B, D) for i in range(T)}
+    arg_shapes, out_shapes, _ = out.infer_shape(**shapes)
+    return out, dict(zip(out.list_arguments(), arg_shapes)), out_shapes
+
+
+def test_rnn_cell_shapes():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    out, args, out_shapes = _unroll_outputs(cell)
+    assert args["rnn_i2h_weight"] == (10, 8)
+    assert args["rnn_h2h_weight"] == (10, 10)
+    assert out_shapes == [(3, 4, 10)]
+
+
+def test_lstm_cell_shapes():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+    out, args, out_shapes = _unroll_outputs(cell)
+    assert args["lstm_i2h_weight"] == (40, 8)
+    assert args["lstm_h2h_weight"] == (40, 10)
+    assert out_shapes == [(3, 4, 10)]
+
+
+def test_gru_cell_shapes():
+    cell = mx.rnn.GRUCell(10, prefix="gru_")
+    out, args, out_shapes = _unroll_outputs(cell)
+    assert args["gru_i2h_weight"] == (30, 8)
+    assert out_shapes == [(3, 4, 10)]
+
+
+def test_stacked_and_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(12, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(12, prefix="l1_"))
+    out, args, out_shapes = _unroll_outputs(stack)
+    assert out_shapes == [(3, 4, 12)]
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(6, prefix="f_"),
+                                  mx.rnn.LSTMCell(6, prefix="b_"))
+    out, args, out_shapes = _unroll_outputs(bi)
+    assert out_shapes == [(3, 4, 12)]  # concat of both directions
+
+
+def test_fused_unfused_equivalence():
+    """FusedRNNCell (lax.scan lowered) must match per-step LSTMCell unroll."""
+    T, B, D, H = 4, 2, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_",
+                                get_next_state=True)
+    unfused = fused.unfuse()
+
+    x = np.random.uniform(-1, 1, (T, B, D)).astype(np.float32)
+
+    # fused path: per-step inputs are stacked to (T,B,D) and run as one
+    # lax.scan RNN op
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(T)]
+    f_out, f_states = fused.unroll(T, inputs)
+    shapes = {("t%d_data" % i): (B, D) for i in range(T)}
+    f_ex = f_out.simple_bind(mx.cpu(), **shapes)
+
+    # copy fused params into the executor, then unpack for the unfused run
+    arg_dict = f_ex.arg_dict
+    data_keys = set(shapes)
+    for k, v in arg_dict.items():
+        if k not in data_keys:
+            v[:] = np.random.uniform(-0.1, 0.1, v.shape).astype(np.float32)
+    for i in range(T):
+        arg_dict["t%d_data" % i][:] = x[i]
+    # default layout NTC -> (B,T,H); compare in (T,B,H)
+    fused_y = f_ex.forward()[0].asnumpy().swapaxes(0, 1)
+
+    outputs, _ = unfused.unroll(T, inputs)
+    u_out = mx.sym.Concat(*[mx.sym.expand_dims(o, axis=0) for o in outputs],
+                          dim=0)
+    u_ex = u_out.simple_bind(mx.cpu(), **shapes)
+    params = fused.unpack_weights(
+        {k: mx.nd.array(v.asnumpy()) for k, v in arg_dict.items()
+         if k not in data_keys})
+    for k, v in u_ex.arg_dict.items():
+        if k.endswith("_data"):
+            i = int(k[1:k.index("_")])
+            v[:] = x[i]
+        elif k in params:
+            v[:] = params[k].asnumpy()
+    unfused_y = u_ex.forward()[0].asnumpy()
+    assert_almost_equal(fused_y, unfused_y, rtol=1e-4, atol=1e-5)
+
+
+def test_zoneout_dropout_cells():
+    base = mx.rnn.LSTMCell(8, prefix="z_")
+    cell = mx.rnn.ZoneoutCell(base, zoneout_outputs=0.2, zoneout_states=0.2)
+    out, args, out_shapes = _unroll_outputs(cell)
+    assert out_shapes == [(3, 4, 8)]
+
+    dc = mx.rnn.DropoutCell(0.5)
+    outputs, _ = dc.unroll(3, [mx.sym.Variable("t%d_data" % i)
+                               for i in range(3)])
+    assert len(outputs) == 3
