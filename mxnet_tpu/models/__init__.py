@@ -18,6 +18,7 @@ the Convolution/FullyConnected ops (the fp16-variant symbols of the reference,
 
 from . import mlp, lenet, alexnet, vgg, googlenet, inception_bn, inception_v3, resnet
 from . import lstm
+from . import transformer
 
 _REGISTRY = {
     "mlp": mlp,
@@ -37,6 +38,8 @@ _REGISTRY = {
     "resnet-101": resnet,
     "resnet-152": resnet,
     "resnext": resnet,
+    "transformer": transformer,
+    "gpt": transformer,
 }
 
 _DEPTH = {"resnet-18": 18, "resnet-34": 34, "resnet-50": 50,

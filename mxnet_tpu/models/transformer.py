@@ -1,0 +1,51 @@
+"""Decoder-only transformer language model — the long-context flagship of the
+capability layer (the 2017 reference has no attention models; SURVEY.md §2.4
+lists sequence/context parallelism as a required capability gap).
+
+Pre-norm GPT-style blocks over ``MultiHeadAttention`` (Pallas flash attention
+on-chip; ring attention across a mesh ``seq`` axis when
+``context_parallel_axis='seq'``).  Same Module/fit contract as the rest of the
+model zoo: inputs ``data`` (batch, seq_len) int tokens and ``softmax_label``
+(batch, seq_len); single ``SoftmaxOutput`` head named ``softmax``.
+"""
+
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
+               num_layers=6, dropout=0.0, causal=True,
+               context_parallel_axis="", dtype="float32", **kwargs):
+    data = sym.Variable("data")
+    x = sym.Embedding(data=data, input_dim=num_classes, output_dim=num_embed,
+                      name="embed")
+    pos = sym.Variable("pos_embed_weight", shape=(1, seq_len, num_embed))
+    x = sym.broadcast_add(x, pos)
+    if dtype != "float32":
+        x = sym.Cast(x, dtype=dtype)
+
+    for i in range(num_layers):
+        h = sym.LayerNorm(x, name="l%d_ln1" % i)
+        h = sym.MultiHeadAttention(
+            h, num_heads=num_heads, causal=causal,
+            context_parallel_axis=context_parallel_axis,
+            name="l%d_attn" % i)
+        if dropout > 0:
+            h = sym.Dropout(h, p=dropout, name="l%d_attndrop" % i)
+        x = x + h
+        h = sym.LayerNorm(x, name="l%d_ln2" % i)
+        h = sym.FullyConnected(h, num_hidden=4 * num_embed, flatten=False,
+                               name="l%d_ffn1" % i)
+        h = sym.Activation(h, act_type="gelu", name="l%d_gelu" % i)
+        h = sym.FullyConnected(h, num_hidden=num_embed, flatten=False,
+                               name="l%d_ffn2" % i)
+        if dropout > 0:
+            h = sym.Dropout(h, p=dropout, name="l%d_ffndrop" % i)
+        x = x + h
+
+    x = sym.LayerNorm(x, name="final_ln")
+    if dtype != "float32":
+        x = sym.Cast(x, dtype="float32")
+    pred = sym.Reshape(x, shape=(-1, num_embed))
+    pred = sym.FullyConnected(pred, num_hidden=num_classes, name="pred")
+    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label, name="softmax")

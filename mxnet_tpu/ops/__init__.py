@@ -6,3 +6,4 @@ from .registry import OP_REGISTRY, Op, ParamSpec, get_op, list_ops, register
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn_op  # noqa: F401
+from . import attention  # noqa: F401

@@ -1,0 +1,407 @@
+"""Attention ops: Pallas flash attention + ring attention (context parallel).
+
+The reference (2017-era MXNet) has **no** attention or sequence/context
+parallelism — SURVEY.md §2.4 lists them as capability gaps the TPU build must
+cover natively (§7.10).  Long sequences in the reference are handled only by
+bucketing and model-parallel LSTM; here they are handled the TPU way:
+
+* ``flash_attention`` — blockwise-softmax attention.  On TPU the forward is a
+  Pallas kernel (one VMEM pass per query block, online softmax, MXU matmuls);
+  elsewhere a numerically identical jax fallback runs.  The backward is an
+  exact recompute in plain jax (XLA fuses it well).
+* ``ring_attention`` — context-parallel attention for sequences sharded along
+  a mesh ``seq`` axis: K/V blocks rotate around the ring via ``ppermute``
+  while each device's query block folds them into an online softmax.  Used
+  inside ``shard_map``; communication rides ICI and overlaps with compute.
+* ``MultiHeadAttention`` / ``LayerNorm`` symbol ops so transformer models
+  compose the same way the reference's CNN/RNN layers do.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import ParamSpec as P, register
+
+__all__ = ["flash_attention", "ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(bq, bk, q_offset, k_offset):
+    """Boolean [bq, bk] mask: query global pos >= key global pos."""
+    qi = q_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    ki = k_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return qi >= ki
+
+
+# ----------------------------------------------------------------------
+# plain-jax reference path (also the backward's recompute)
+# ----------------------------------------------------------------------
+
+
+def _attention_fwd_ref(q, k, v, causal, sm_scale):
+    """Exact softmax attention on [B, H, T, D] tensors, fp32 softmax."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        mask = _causal_mask(q.shape[2], k.shape[2], 0, 0)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / l
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ----------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ----------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
+                  block_k, seq_len):
+    """One (batch*head, q-block) program: stream K/V blocks through an
+    online softmax.  q_ref: [1, block_q, D]; k/v_ref: [1, T, D] in VMEM."""
+    import jax.experimental.pallas as pl
+
+    q_block_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    d = q.shape[-1]
+    n_k = seq_len // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            mask = _causal_mask(block_q, block_k, q_block_idx * block_q,
+                                j * block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o * alpha[:, None] + pv, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only K blocks up to and including this Q block's diagonal
+        n_k_eff = jnp.minimum(
+            n_k, (q_block_idx * block_q + block_q + block_k - 1) // block_k)
+    else:
+        n_k_eff = n_k
+    o, m, l = lax.fori_loop(0, n_k_eff, body, (o0, m0, l0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128,
+                      interpret=False):
+    """Pallas forward on [B, H, T, D].  T is padded to block multiples."""
+    import jax.experimental.pallas as pl
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, max(8, T))
+    block_k = min(block_k, max(8, Tk))
+    if T % block_q or Tk % block_k:
+        # ragged tail: the exact reference path (XLA still fuses it well);
+        # production shapes are block multiples
+        return _attention_fwd_ref(q, k, v, causal, sm_scale)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    grid = (B * H, T // block_q)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=Tk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)
+
+
+# ----------------------------------------------------------------------
+# flash_attention: public entry with custom VJP
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, interpret):
+    return _flash_dispatch(q, k, v, causal, sm_scale, interpret)
+
+
+def _flash_dispatch(q, k, v, causal, sm_scale, interpret):
+    platform = jax.default_backend()
+    if platform == "tpu" or interpret:
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                 interpret=interpret and platform != "tpu")
+    return _attention_fwd_ref(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd_vjp(q, k, v, causal, sm_scale, interpret):
+    out = _flash_dispatch(q, k, v, causal, sm_scale, interpret)
+    return out, (q, k, v, out)
+
+
+_BWD_BLOCK_K = 512
+
+
+def _flash_bwd_vjp(causal, sm_scale, interpret, res, do):
+    """Blockwise flash backward: two O(T·bk)-memory passes over K blocks
+    (stats, then dq/dk/dv) — never materializes the [T, T] attention matrix,
+    matching the forward kernel's memory profile."""
+    q, k, v, o = res
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bk = min(_BWD_BLOCK_K, Tk)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    if Tk % bk:
+        bk = Tk  # ragged small sequence: single block
+
+    n_k = Tk // bk
+    kb = k.astype(jnp.float32).reshape(B, H, n_k, bk, D).transpose(2, 0, 1, 3, 4)
+    vb = v.astype(jnp.float32).reshape(B, H, n_k, bk, D).transpose(2, 0, 1, 3, 4)
+    k_offs = jnp.arange(n_k) * bk
+    qi = lax.broadcasted_iota(jnp.int32, (T, bk), 0)
+    ki_local = lax.broadcasted_iota(jnp.int32, (T, bk), 1)
+
+    def scores(k_blk, k_off):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            mask = (qi >= k_off + ki_local)[None, None]
+            s = jnp.where(mask, s, _NEG_INF)
+        return s
+
+    # pass 1: per-row log-sum-exp
+    def stats_step(carry, xs):
+        m, l = carry
+        k_blk, k_off = xs
+        s = scores(k_blk, k_off)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]), -1)
+        return (m_new, l), None
+
+    m0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    (m, l), _ = lax.scan(stats_step, (m0, l0), (kb, k_offs))
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B,H,T]
+
+    # pass 2: accumulate dq; emit dk/dv per block
+    def grad_step(dq, xs):
+        k_blk, v_blk, k_off = xs
+        s = scores(k_blk, k_off)
+        p = jnp.exp(s - lse[..., None])
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk) * sm_scale
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((B, H, T, D), jnp.float32)
+    dq, (dkb, dvb) = lax.scan(grad_step, dq0, (kb, vb, k_offs))
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tk, D)
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(B, H, Tk, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False):
+    """Softmax attention over [B, H, T, D] tensors.
+
+    On TPU the forward runs as a Pallas flash kernel (O(T) memory); the
+    backward is an exact jax recompute.  ``interpret=True`` forces the Pallas
+    kernel in interpreter mode (CPU testing).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, bool(causal), float(sm_scale), bool(interpret))
+
+
+# ----------------------------------------------------------------------
+# ring attention (context parallel, inside shard_map)
+# ----------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Blockwise ring attention for use **inside** ``shard_map``.
+
+    Each device holds the local sequence shard ``q/k/v: [B, H, T_local, D]``
+    of a sequence sharded along mesh axis ``axis_name``.  K/V rotate around
+    the ring with ``lax.ppermute`` while the local queries fold each visiting
+    block into an online softmax — the all-gather-free long-context pattern
+    (PAPERS.md ring-attention family).  Differentiable (pure jax + scan).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        o, m, l, kc, vc = carry
+        # kc originated on device (my - s) mod n
+        src = (my - s) % n
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qi = my * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+            ki = src * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+            mask = (qi >= ki)[None, None]
+            sc = jnp.where(mask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        o_new = o * alpha[..., None] + pv
+        k_next = lax.ppermute(kc, axis_name, perm)
+        v_next = lax.ppermute(vc, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    # derive the initial carry from q so it inherits q's varying-manual-axes
+    # type (newer jax rejects scan carries whose vma set changes)
+    o0 = qf * 0.0
+    m0 = qf[..., 0] * 0.0 + _NEG_INF
+    l0 = qf[..., 0] * 0.0
+    (o, m, l, _, _), _ = lax.scan(
+        jax.checkpoint(step), (o0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# symbol ops: LayerNorm, MultiHeadAttention
+# ----------------------------------------------------------------------
+
+
+@register(
+    "LayerNorm",
+    arg_names=["data", "gamma", "beta"],
+    params={"axis": P("int", -1), "eps": P("float", 1e-5)},
+)
+def _layer_norm(attrs, data, gamma, beta):
+    """Layer normalization (absent in the 2017 reference; required by the
+    transformer capability layer)."""
+    axis = attrs["axis"]
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + attrs["eps"])
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return (y * gamma.reshape(shape).astype(jnp.float32)
+            + beta.reshape(shape).astype(jnp.float32)).astype(data.dtype)
+
+
+def _mha_input_names(attrs):
+    names = ["data", "qkv_weight", "out_weight"]
+    if not attrs.get("no_bias", True):
+        names += ["qkv_bias", "out_bias"]
+    return names
+
+
+@register(
+    "MultiHeadAttention",
+    aliases=["_contrib_MultiHeadAttention"],
+    arg_names=["data", "qkv_weight", "out_weight"],
+    input_names_fn=_mha_input_names,
+    params={
+        "num_heads": P("int", required=True),
+        "causal": P("bool", False),
+        "no_bias": P("bool", True),
+        # mesh axis for context parallelism; '' disables
+        "context_parallel_axis": P("str", ""),
+        "interpret": P("bool", False),
+    },
+)
+def _multi_head_attention(attrs, data, qkv_weight, out_weight,
+                          qkv_bias=None, out_bias=None):
+    """Self-attention layer on [B, T, C]: fused QKV projection → flash or
+    ring attention → output projection.
+
+    When ``context_parallel_axis`` names an axis of the active default mesh
+    (``mx.parallel.set_default_mesh``), attention runs as ring attention
+    under ``shard_map`` with the sequence dimension sharded along that axis —
+    the long-context path the reference lacks (SURVEY.md §5 'Long-context').
+    """
+    B, T, C = data.shape
+    H = attrs["num_heads"]
+    D = C // H
+    qkv = jnp.einsum("btc,fc->btf", data, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    qkv = qkv.reshape(B, T, 3, H, D).transpose(2, 0, 3, 1, 4)  # [3,B,H,T,D]
+    q, k, v = qkv[0], qkv[1], qkv[2]
+
+    axis = attrs.get("context_parallel_axis") or ""
+    mesh = _default_mesh()
+    if axis and mesh is not None and axis in mesh.axis_names \
+            and mesh.shape[axis] > 1:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec
+
+        # keep the batch sharded along the data axis too — otherwise every
+        # data-parallel group would all-gather and redundantly compute the
+        # full batch's attention
+        batch_axis = None
+        for cand in ("data", "batch"):
+            if cand in mesh.axis_names and cand != axis \
+                    and B % mesh.shape[cand] == 0:
+                batch_axis = cand
+                break
+        spec = PartitionSpec(batch_axis, None, axis, None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name=axis,
+                              causal=attrs["causal"]),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        out = fn(q, k, v)
+    else:
+        out = flash_attention(q, k, v, causal=attrs["causal"],
+                              interpret=attrs.get("interpret", False))
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, C)
+    out = jnp.einsum("btc,fc->btf", out, out_weight)
+    if out_bias is not None:
+        out = out + out_bias
+    return out.astype(data.dtype)
+
+
+def _default_mesh():
+    from ..parallel import get_default_mesh
+
+    return get_default_mesh()

@@ -235,7 +235,8 @@ def _pooling(attrs, data):
     "Activation",
     params={
         "act_type": P(
-            "str", "relu", enum=["relu", "sigmoid", "tanh", "softrelu", "softsign"]
+            "str", "relu",
+            enum=["relu", "sigmoid", "tanh", "softrelu", "softsign", "gelu"]
         )
     },
 )
@@ -243,6 +244,8 @@ def _activation(attrs, x):
     t = attrs["act_type"]
     if t == "relu":
         return jax.nn.relu(x)
+    if t == "gelu":  # transformer capability layer (absent in 2017 reference)
+        return jax.nn.gelu(x)
     if t == "sigmoid":
         return jax.nn.sigmoid(x)
     if t == "tanh":

@@ -11,3 +11,30 @@ from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, local_mesh,
                    make_mesh, replicate, shard_batch)
 from . import collectives
 from .collectives import allreduce_hosts, barrier, init_process_group, rank, size
+
+# the "active" mesh ops consult at trace time (ring attention's shard_map);
+# scoped via default_mesh() by ShardedTrainer, or installed by the user
+import contextlib as _contextlib
+
+_DEFAULT_MESH = [None]
+
+
+def set_default_mesh(mesh):
+    """Install `mesh` as the ambient mesh for mesh-aware ops (returns previous)."""
+    prev = _DEFAULT_MESH[0]
+    _DEFAULT_MESH[0] = mesh
+    return prev
+
+
+@_contextlib.contextmanager
+def default_mesh(mesh):
+    """Scoped ambient mesh: restores the previous mesh on exit."""
+    prev = set_default_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_default_mesh(prev)
+
+
+def get_default_mesh():
+    return _DEFAULT_MESH[0]

@@ -86,6 +86,8 @@ class ShardedTrainer:
         from ..executor import _graph_fn
         from ..symbol import _infer
 
+        from . import default_mesh
+
         self.symbol = symbol
         self.mesh = mesh
         self.data_axis = data_axis
@@ -93,8 +95,11 @@ class ShardedTrainer:
         type_dict = dict(type_dict or {})
         shapes = dict(data_shapes)
         shapes.update(label_shapes)
-        arg_shapes, out_shapes, aux_shapes, arg_dtypes, aux_dtypes = _infer(
-            symbol, shapes, type_dict)
+        # mesh-aware ops (ring attention) consult the ambient mesh while the
+        # graph traces; scope it so multiple trainers don't clobber each other
+        with default_mesh(mesh):
+            arg_shapes, out_shapes, aux_shapes, arg_dtypes, aux_dtypes = _infer(
+                symbol, shapes, type_dict)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         self._input_names = set(shapes)
@@ -120,7 +125,7 @@ class ShardedTrainer:
             if len(shp) >= 1 and data_axis in mesh.axis_names \
                     and shp[0] % mesh.shape[data_axis] == 0:
                 spec[0] = data_axis
-            if len(shp) >= 3 and "seq" in mesh.axis_names \
+            if len(shp) >= 2 and "seq" in mesh.axis_names \
                     and shp[1] % mesh.shape["seq"] == 0:
                 spec[1] = "seq"
             dspecs[n] = P(*spec)
@@ -142,21 +147,30 @@ class ShardedTrainer:
         from ..initializer import Uniform, InitDesc
 
         initializer = initializer or Uniform(0.07)
-        rng = _np.random.RandomState(seed)
-        params, moms, aux = {}, {}, {}
-        for n in self.param_names:
-            shp = self.arg_shapes[n]
-            arr = _np.zeros(shp, dtype=self.arg_dtypes.get(n, "float32"))
-            initializer(InitDesc(n), _HostArray(arr, rng))
-            params[n] = jax.device_put(arr, self._sharding(self.param_specs[n]))
-            if self._use_momentum:
-                moms[n] = jax.device_put(
-                    _np.zeros_like(arr), self._sharding(self.param_specs[n]))
-        for n, shp in self.aux_shapes.items():
-            init_val = _np.ones if n.endswith("_var") or "moving_var" in n else _np.zeros
-            aux[n] = jax.device_put(
-                init_val(shp, dtype=self.aux_dtypes.get(n, "float32")),
-                self._sharding(P()))
+        # initializers draw from the global numpy stream (reference
+        # initializer.py does the same); seed it for reproducibility but
+        # restore the caller's stream position afterwards
+        saved_state = _np.random.get_state()
+        _np.random.seed(seed)
+        try:
+            params, moms, aux = {}, {}, {}
+            for n in self.param_names:
+                shp = self.arg_shapes[n]
+                arr = _np.zeros(shp, dtype=self.arg_dtypes.get(n, "float32"))
+                initializer(InitDesc(n), _HostArray(arr))
+                params[n] = jax.device_put(
+                    arr, self._sharding(self.param_specs[n]))
+                if self._use_momentum:
+                    moms[n] = jax.device_put(
+                        _np.zeros_like(arr), self._sharding(self.param_specs[n]))
+            for n, shp in self.aux_shapes.items():
+                init_val = (_np.ones if n.endswith("_var") or "moving_var" in n
+                            else _np.zeros)
+                aux[n] = jax.device_put(
+                    init_val(shp, dtype=self.aux_dtypes.get(n, "float32")),
+                    self._sharding(P()))
+        finally:
+            _np.random.set_state(saved_state)
         return params, moms, aux
 
     def place_batch(self, arrays: Dict[str, _np.ndarray]):
@@ -207,12 +221,12 @@ class ShardedTrainer:
         mshard = dict(pshard) if use_mom else {}
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
-        self._jit_step = jax.jit(
+        self._jit_step = self._with_mesh(jax.jit(
             step,
             in_shardings=(pshard, mshard, ashard, dshard, None),
             out_shardings=(None, pshard, mshard, ashard),
             donate_argnums=(0, 1),
-        )
+        ))
         return self._jit_step
 
     def forward_fn(self):
@@ -230,17 +244,27 @@ class ShardedTrainer:
         pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
-        self._jit_fwd = jax.jit(
-            fwd, in_shardings=(pshard, ashard, dshard, None))
+        self._jit_fwd = self._with_mesh(jax.jit(
+            fwd, in_shardings=(pshard, ashard, dshard, None)))
         return self._jit_fwd
+
+    def _with_mesh(self, jitted):
+        """Call `jitted` with this trainer's mesh ambient, so mesh-aware ops
+        trace against the right mesh no matter which trainer traced last."""
+        from . import default_mesh
+
+        def call(*args, **kwargs):
+            with default_mesh(self.mesh):
+                return jitted(*args, **kwargs)
+
+        return call
 
 
 class _HostArray:
     """Minimal NDArray stand-in so initializer patterns run on numpy buffers."""
 
-    def __init__(self, arr, rng):
+    def __init__(self, arr):
         self._arr = arr
-        self._rng = rng
 
     @property
     def shape(self):

@@ -711,6 +711,16 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
         solved["gamma"] = (dshape[1] if len(dshape) > 1 else dshape[0],)
     elif op.name == "Embedding":
         solved["weight"] = (a["input_dim"], a["output_dim"])
+    elif op.name == "LayerNorm":
+        c = dshape[a.get("axis", -1)]
+        solved["gamma"] = (c,)
+        solved["beta"] = (c,)
+    elif op.name == "MultiHeadAttention":
+        c = dshape[-1]
+        solved["qkv_weight"] = (3 * c, c)
+        solved["out_weight"] = (c, c)
+        solved["qkv_bias"] = (3 * c,)
+        solved["out_bias"] = (c,)
     elif op.name == "SoftmaxOutput":
         if a.get("multi_output"):
             solved["label"] = (dshape[0],) + tuple(dshape[2:])

@@ -1,0 +1,185 @@
+"""Attention stack: Pallas flash kernel numerics, ring attention vs the exact
+reference, gradients, and an end-to-end context-parallel transformer step
+(SURVEY.md §4 multi-device tier: 'multiple ctx on one box' → 8-device CPU
+mesh; §2.4 capability gaps: sequence/context parallelism)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.attention import (_attention_fwd_ref, flash_attention,
+                                     ring_attention)
+
+
+def _rand_qkv(b=2, h=2, t=128, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.normal(size=(b, h, t, d)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_matches_reference(causal):
+    q, k, v = _rand_qkv(t=128, d=32)
+    ref = _attention_fwd_ref(q, k, v, causal, q.shape[-1] ** -0.5)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ragged_tail_fallback():
+    q, k, v = _rand_qkv(t=100, d=16)
+    ref = _attention_fwd_ref(q, k, v, True, q.shape[-1] ** -0.5)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_reference(causal):
+    q, k, v = _rand_qkv(b=1, h=2, t=64, d=16)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_fwd_ref(q, k, v, causal, scale) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    q, k, v = _rand_qkv(b=1, h=2, t=256, d=16)
+    ref = _attention_fwd_ref(q, k, v, causal, q.shape[-1] ** -0.5)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    spec = P(None, None, "seq", None)
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grad_matches_reference():
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    q, k, v = _rand_qkv(b=1, h=1, t=64, d=8)
+    scale = q.shape[-1] ** -0.5
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = P(None, None, "seq", None)
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(_attention_fwd_ref(q, k, v, True, scale) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_op():
+    x = np.random.RandomState(0).normal(size=(4, 8, 16)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    out = mx.sym.LayerNorm(data, name="ln")
+    exe = out.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["ln_gamma"][:] = np.ones(16, np.float32)
+    exe.arg_dict["ln_beta"][:] = np.zeros(16, np.float32)
+    y = exe.forward()[0].asnumpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_mha_symbol_shapes():
+    s = mx.sym.MultiHeadAttention(mx.sym.Variable("data"), num_heads=4,
+                                  causal=True, name="attn")
+    args, outs, _ = s.infer_shape(data=(2, 32, 64))
+    assert outs[0] == (2, 32, 64)
+    arg_shapes = dict(zip(s.list_arguments(), args))
+    assert arg_shapes["attn_qkv_weight"] == (192, 64)
+    assert arg_shapes["attn_out_weight"] == (64, 64)
+
+
+def test_transformer_context_parallel_step():
+    """Full train step of the transformer LM over a dp x sp mesh with ring
+    attention — the long-context path the reference lacks."""
+    from jax.sharding import Mesh
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    vocab, B, T = 97, 4, 64
+    sym = transformer.get_symbol(
+        num_classes=vocab, seq_len=T, num_embed=32, num_heads=2,
+        num_layers=2, context_parallel_axis="seq")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+    tr = ShardedTrainer(sym, mesh,
+                        data_shapes={"data": (B, T)},
+                        label_shapes={"softmax_label": (B, T)},
+                        type_dict={"data": "int32", "softmax_label": "float32"},
+                        learning_rate=0.1)
+    params, moms, aux = tr.init(seed=0)
+    rng = np.random.RandomState(0)
+    batch = tr.place_batch({
+        "data": rng.randint(0, vocab, (B, T)).astype(np.int32),
+        "softmax_label": rng.randint(0, vocab, (B, T)).astype(np.float32),
+    })
+    step = tr.step_fn()
+    outs, params2, _, _ = step(params, moms, aux, batch, jax.random.PRNGKey(0))
+    probs = np.asarray(outs[0])
+    assert probs.shape == (B * T, vocab)
+    assert np.all(np.isfinite(probs))
+    # params actually moved
+    assert any(
+        not np.allclose(np.asarray(params2[n]), 0) for n in params2)
+
+
+def test_transformer_ring_equals_flash():
+    """Same transformer forward: ring attention (dp x sp mesh) vs single-mesh
+    flash path must agree numerically (the reference's check_consistency
+    cross-impl tier, test_utils.py:676)."""
+    from jax.sharding import Mesh
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    vocab, B, T = 31, 2, 32
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, vocab, (B, T)).astype(np.int32)
+    label = rng.randint(0, vocab, (B, T)).astype(np.float32)
+
+    outs = {}
+    for name, axis, meshdevs in [
+        ("ring", "seq", np.array(jax.devices()[:4]).reshape(1, 4)),
+        ("flash", "", np.array(jax.devices()[:1]).reshape(1, 1)),
+    ]:
+        sym = transformer.get_symbol(
+            num_classes=vocab, seq_len=T, num_embed=16, num_heads=2,
+            num_layers=1, context_parallel_axis=axis)
+        mesh = Mesh(meshdevs, ("data", "seq"))
+        tr = ShardedTrainer(sym, mesh,
+                            data_shapes={"data": (B, T)},
+                            label_shapes={"softmax_label": (B, T)},
+                            type_dict={"data": "int32"})
+        params, _, aux = tr.init(seed=3)
+        fwd = tr.forward_fn()
+        batch = tr.place_batch({"data": data, "softmax_label": label})
+        outs[name] = np.asarray(
+            fwd(params, aux, batch, jax.random.PRNGKey(0))[0])
+    np.testing.assert_allclose(outs["ring"], outs["flash"],
+                               rtol=2e-4, atol=2e-4)
