@@ -1,0 +1,172 @@
+"""ctypes binding to the native runtime (``native/`` → ``libmxtpu.so``).
+
+The reference loads ``libmxnet.so`` through ctypes (``python/mxnet/base.py``:
+``_LIB``/``check_call``); this is the same pattern for the TPU build's native
+core (engine, storage, profiler, recordio — see ``native/include/mxtpu/c_api.h``).
+The library is built on demand with ``make`` the first time it's needed and
+cached; every consumer has a pure-Python fallback so the framework degrades
+gracefully when no C++ toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["lib", "available", "RecordLoader", "buf_to_bytes"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libmxtpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _configure(lib):
+    """Declare argtypes/restypes for the C ABI."""
+    c = ctypes
+    lib.mxtpu_var_new.restype = c.c_void_p
+    lib.mxtpu_var_delete.argtypes = [c.c_void_p]
+    lib.mxtpu_push.argtypes = [
+        c.CFUNCTYPE(None, c.c_void_p), c.c_void_p,
+        c.CFUNCTYPE(None, c.c_void_p),
+        c.POINTER(c.c_void_p), c.c_int, c.POINTER(c.c_void_p), c.c_int,
+        c.c_int, c.c_int, c.c_char_p]
+    lib.mxtpu_wait_for_var.argtypes = [c.c_void_p]
+    lib.mxtpu_engine_pending.restype = c.c_long
+    lib.mxtpu_storage_alloc.restype = c.c_void_p
+    lib.mxtpu_storage_alloc.argtypes = [c.c_size_t]
+    lib.mxtpu_storage_free.argtypes = [c.c_void_p, c.c_size_t]
+    lib.mxtpu_storage_direct_free.argtypes = [c.c_void_p, c.c_size_t]
+    lib.mxtpu_storage_pooled_bytes.restype = c.c_size_t
+    lib.mxtpu_storage_used_bytes.restype = c.c_size_t
+    lib.mxtpu_profiler_set_state.argtypes = [c.c_int]
+    lib.mxtpu_profiler_dump.argtypes = [c.c_char_p]
+    lib.mxtpu_profiler_add_event.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_int64, c.c_int64, c.c_int]
+    lib.mxtpu_recordio_writer_open.restype = c.c_void_p
+    lib.mxtpu_recordio_writer_open.argtypes = [c.c_char_p]
+    lib.mxtpu_recordio_writer_write.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t]
+    lib.mxtpu_recordio_writer_tell.restype = c.c_long
+    lib.mxtpu_recordio_writer_tell.argtypes = [c.c_void_p]
+    lib.mxtpu_recordio_writer_close.argtypes = [c.c_void_p]
+    lib.mxtpu_recordio_reader_open.restype = c.c_void_p
+    lib.mxtpu_recordio_reader_open.argtypes = [c.c_char_p]
+    lib.mxtpu_recordio_reader_next.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_size_t)]
+    lib.mxtpu_recordio_reader_close.argtypes = [c.c_void_p]
+    lib.mxtpu_loader_create.restype = c.c_void_p
+    lib.mxtpu_loader_create.argtypes = [
+        c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_uint, c.c_int, c.c_int]
+    lib.mxtpu_loader_next.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_size_t)]
+    lib.mxtpu_loader_reset.argtypes = [c.c_void_p]
+    lib.mxtpu_loader_free.argtypes = [c.c_void_p]
+    lib.mxtpu_buf_free.argtypes = [c.POINTER(c.c_char)]
+    lib.mxtpu_version.restype = c.c_char_p
+    return lib
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-s", "-j4"], cwd=_NATIVE_DIR, check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except Exception:
+        return False
+
+
+def lib():
+    """Return the configured CDLL, building it if needed; None on failure.
+
+    Disable entirely with MXTPU_NO_NATIVE=1 (forces pure-Python fallbacks —
+    the analog of the reference's NaiveEngine debug switch at the build level).
+    """
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXTPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH) and os.path.isdir(_NATIVE_DIR):
+            _build()
+        if os.path.exists(_SO_PATH):
+            try:
+                _lib = _configure(ctypes.CDLL(_SO_PATH))
+            except (OSError, AttributeError):
+                # stale .so missing newer symbols: rebuild once, then retry
+                _lib = None
+                if _build():
+                    try:
+                        _lib = _configure(ctypes.CDLL(_SO_PATH))
+                    except (OSError, AttributeError):
+                        _lib = None
+        return _lib
+
+
+def available():
+    return lib() is not None
+
+
+def buf_to_bytes(libh, ptr, length):
+    """Copy a malloc'd native buffer into bytes and free it."""
+    data = ctypes.string_at(ptr, length)
+    libh.mxtpu_buf_free(ptr)
+    return data
+
+
+class RecordLoader(object):
+    """Threaded prefetching sharded record loader (native
+    ``mxtpu_loader_*``; the dmlc ``ThreadedIter``+``InputSplit`` role —
+    reference ``src/io/iter_image_recordio_2.cc:104-112``)."""
+
+    def __init__(self, path, part_index=0, num_parts=1, shuffle=False,
+                 seed=0, queue_size=256, shuffle_chunk=1024):
+        self._lib = lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.mxtpu_loader_create(
+            path.encode(), part_index, num_parts, int(shuffle), seed,
+            queue_size, shuffle_chunk)
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        rec = self.next_record()
+        if rec is None:
+            raise StopIteration
+        return rec
+
+    def next_record(self):
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = ctypes.c_size_t()
+        r = self._lib.mxtpu_loader_next(self._h, ctypes.byref(out),
+                                        ctypes.byref(n))
+        if r == 1:
+            return buf_to_bytes(self._lib, out, n.value)
+        if r == 0:
+            return None
+        raise IOError("record stream corrupt")
+
+    def reset(self):
+        self._lib.mxtpu_loader_reset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_loader_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

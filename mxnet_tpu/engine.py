@@ -1,0 +1,177 @@
+"""Dependency engine — Python surface over the native async scheduler.
+
+Parity: reference ``Engine::Get()->PushAsync/NewVariable/WaitForVar/
+WaitForAll`` (``include/mxnet/engine.h:75-250``); engine selection via env
+(``src/engine/engine.cc:13-39``, ``MXNET_ENGINE_TYPE`` → ``MXTPU_ENGINE_TYPE``).
+
+TPU framing: XLA/PJRT owns device async; this engine orders *host-side* work
+— record IO, decode, batch staging, checkpoint writes, host kvstore
+reductions — on C++ worker pools keyed by ``FnProperty`` (normal/io/copy,
+the per-device pool idea of ``threaded_engine_perdevice.cc:55-105`` at host
+scope).  Functions pushed here are Python callables executed on native
+threads (ctypes re-acquires the GIL per call, so pure-numpy/file work
+overlaps fully only when it releases the GIL — same caveat class as the
+reference's Python ``CustomOp`` callbacks).
+
+Falls back to a synchronous in-process engine when the native library is
+unavailable (semantics of the reference ``NaiveEngine``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import itertools
+import threading
+
+from . import _native
+
+__all__ = ["Var", "push", "new_variable", "wait_for_var", "wait_for_all",
+           "engine_type", "FnProperty"]
+
+
+class FnProperty(object):
+    """Worker-pool classes (parity: ``engine.h FnProperty``)."""
+    NORMAL = 0
+    IO = 1
+    COPY = 2
+
+
+class Var(object):
+    """Dependency variable (parity: ``Engine::NewVariable``)."""
+
+    __slots__ = ("handle",)
+
+    def __init__(self, handle):
+        self.handle = handle
+
+
+# --- native trampoline machinery -----------------------------------------
+
+_cb_lock = threading.Lock()
+_cb_registry = {}
+_cb_seq = itertools.count(1)
+
+_CBTYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+@_CBTYPE
+def _run_cb(key):
+    fn = _cb_registry.get(key)
+    if fn is not None:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — exceptions can't cross the C ABI
+            import traceback
+            traceback.print_exc()
+
+
+@_CBTYPE
+def _del_cb(key):
+    with _cb_lock:
+        _cb_registry.pop(key, None)
+
+
+_NULL_CB = ctypes.cast(None, _CBTYPE)
+
+
+class _NativeEngine(object):
+    def __init__(self, lib):
+        self._lib = lib
+
+    def new_variable(self):
+        return Var(self._lib.mxtpu_var_new())
+
+    def delete_variable(self, var):
+        self._lib.mxtpu_var_delete(var.handle)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop=FnProperty.NORMAL, name="opr"):
+        key = next(_cb_seq)
+        with _cb_lock:
+            _cb_registry[key] = fn
+        n_c, n_m = len(const_vars), len(mutable_vars)
+        c_arr = (ctypes.c_void_p * max(n_c, 1))(
+            *[v.handle for v in const_vars])
+        m_arr = (ctypes.c_void_p * max(n_m, 1))(
+            *[v.handle for v in mutable_vars])
+        self._lib.mxtpu_push(_run_cb, ctypes.c_void_p(key), _del_cb,
+                             c_arr, n_c, m_arr, n_m, priority, prop,
+                             name.encode())
+
+    def wait_for_var(self, var):
+        self._lib.mxtpu_wait_for_var(var.handle)
+
+    def wait_for_all(self):
+        self._lib.mxtpu_wait_all()
+
+    def engine_type(self):
+        return ("NaiveEngine" if self._lib.mxtpu_engine_type() == 1
+                else "ThreadedEnginePerDevice")
+
+
+class _SerialEngine(object):
+    """Pure-Python synchronous fallback (reference ``NaiveEngine``)."""
+
+    def new_variable(self):
+        return Var(None)
+
+    def delete_variable(self, var):
+        pass
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop=FnProperty.NORMAL, name="opr"):
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    def engine_type(self):
+        return "SerialEngine"
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def _get():
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                lib = _native.lib()
+                _engine = _NativeEngine(lib) if lib else _SerialEngine()
+                # drain before interpreter teardown so worker threads never
+                # call back into a finalized interpreter
+                atexit.register(_engine.wait_for_all)
+    return _engine
+
+
+def new_variable():
+    return _get().new_variable()
+
+
+def delete_variable(var):
+    _get().delete_variable(var)
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority=0,
+         prop=FnProperty.NORMAL, name="opr"):
+    """Push async host fn with read deps ``const_vars`` and write deps
+    ``mutable_vars`` (parity: ``Engine::PushAsync``)."""
+    _get().push(fn, const_vars, mutable_vars, priority, prop, name)
+
+
+def wait_for_var(var):
+    _get().wait_for_var(var)
+
+
+def wait_for_all():
+    _get().wait_for_all()
+
+
+def engine_type():
+    return _get().engine_type()

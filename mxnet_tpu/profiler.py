@@ -1,19 +1,28 @@
 """Profiler (parity: reference ``python/mxnet/profiler.py`` +
 ``src/engine/profiler.cc``).
 
-The reference hooks the engine to emit chrome://tracing JSON.  The TPU-native
-equivalent is the jax/XLA profiler (xplane): ``profiler_set_state('run')``
-starts a jax trace; ``dump_profile()`` stops it and leaves a trace viewable in
-TensorBoard/Perfetto.  The ``profiler_set_config`` filename becomes the trace
-directory.
+Two lanes, merged under one API:
+ - **device**: the jax/XLA profiler (xplane) — ``profiler_set_state('run')``
+   starts a trace viewable in TensorBoard/Perfetto.  This is the TPU
+   equivalent of the reference's GPU op timing.
+ - **host engine**: the native engine profiler (``native/src/profiler.cc``)
+   records per-op start/end/thread for host-side engine work and dumps
+   chrome://tracing JSON — the direct equivalent of the reference's
+   ``OprExecStat`` → ``DumpProfile`` path
+   (``src/engine/profiler.h:20-141``, hook ``threaded_engine.h:294-308``).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
+import time
 
-__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+from . import _native
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "scope"]
 
 _STATE = {"mode": "symbolic", "dir": "profile_output", "running": False}
 
@@ -25,21 +34,58 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 
 def profiler_set_state(state="stop"):
-    """'run' starts an xplane trace; 'stop' ends it (parity:
-    ``profiler.py:profiler_set_state``)."""
+    """'run' starts the xplane trace + native engine recording; 'stop' ends
+    both (parity: ``profiler.py:profiler_set_state``)."""
     import jax
 
+    lib = _native.lib()
     if state == "run" and not _STATE["running"]:
         os.makedirs(_STATE["dir"], exist_ok=True)
         jax.profiler.start_trace(_STATE["dir"])
+        if lib is not None:
+            lib.mxtpu_profiler_clear()  # fresh session, drop stale events
+            lib.mxtpu_profiler_set_state(1)
         _STATE["running"] = True
     elif state == "stop" and _STATE["running"]:
         jax.profiler.stop_trace()
+        if lib is not None:
+            lib.mxtpu_profiler_set_state(0)
         _STATE["running"] = False
     else:
         logging.debug("profiler state change to %r ignored", state)
 
 
 def dump_profile():
-    """Stop + flush the trace (parity: ``profiler.py:dump_profile``)."""
+    """Stop + flush both traces; the host-engine chrome trace lands at
+    ``<dir>/engine_trace.json`` (parity: ``profiler.py:dump_profile`` /
+    ``Profiler::DumpProfile``)."""
     profiler_set_state("stop")
+    lib = _native.lib()
+    if lib is not None:
+        os.makedirs(_STATE["dir"], exist_ok=True)
+        path = os.path.join(_STATE["dir"], "engine_trace.json")
+        n = lib.mxtpu_profiler_dump(path.encode())
+        logging.info("dumped %d engine events to %s", n, path)
+        return path
+    return None
+
+
+class scope(object):
+    """Context manager recording a named frontend span into the host trace
+    (the ``mx.profiler``-visible analog of engine op events)."""
+
+    def __init__(self, name, cat="frontend"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t0 = int(time.monotonic() * 1e6)
+        return self
+
+    def __exit__(self, *exc):
+        lib = _native.lib()
+        if lib is not None and lib.mxtpu_profiler_state():
+            lib.mxtpu_profiler_add_event(
+                self.name.encode(), self.cat.encode(), self._t0,
+                int(time.monotonic() * 1e6), threading.get_ident() % 100000)
+        return False

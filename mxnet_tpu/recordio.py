@@ -4,8 +4,10 @@
 Binary format is kept compatible with the reference: records framed with the
 dmlc magic ``0xced7230a`` + length word (upper 3 bits = continuation flag),
 payloads padded to 4 bytes; ``IRHeader`` packs (flag, label, id, id2) with
-``struct '<IfQQ'`` exactly as ``recordio.py:19-168``.  The C++ fast path for
-bulk packing/decode lives in ``src/`` (im2rec equivalent).
+``struct '<IfQQ'`` exactly as ``recordio.py:19-168``.  Sequential read and
+all writes go through the native C++ backend (``native/src/recordio.cc``)
+when built — the dmlc-core recordio role; indexed random access stays in
+Python.  Set ``MXTPU_NO_NATIVE=1`` to force pure Python.
 """
 
 from __future__ import annotations
@@ -18,8 +20,12 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import _native
+
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
+
+_FORCE_PYTHON = False  # test hook: force the pure-Python backend
 
 _MAGIC = 0xCED7230A
 _LREC_KIND_BITS = 29
@@ -43,16 +49,33 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
+        self._nh = None
+        self._nlib = None if _FORCE_PYTHON else _native.lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            if self._nlib is not None:
+                self._nh = self._nlib.mxtpu_recordio_writer_open(
+                    self.uri.encode())
+            self.handle = None if self._nh else open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            # native reader is sequential-only; subclasses needing seek()
+            # (MXIndexedRecordIO) stay on the Python file handle
+            if self._nlib is not None and type(self) is MXRecordIO:
+                self._nh = self._nlib.mxtpu_recordio_reader_open(
+                    self.uri.encode())
+            self.handle = None if self._nh else open(self.uri, "rb")
             self.writable = False
+            self._read_pos = 0
         else:
             raise ValueError("Invalid flag %s" % self.flag)
 
     def close(self):
+        if getattr(self, "_nh", None):
+            if self.writable:
+                self._nlib.mxtpu_recordio_writer_close(self._nh)
+            else:
+                self._nlib.mxtpu_recordio_reader_close(self._nh)
+            self._nh = None
         if self.handle is not None:
             self.handle.close()
             self.handle = None
@@ -65,10 +88,23 @@ class MXRecordIO(object):
         self.open()
 
     def tell(self):
+        if self._nh:
+            if self.writable:
+                return self._nlib.mxtpu_recordio_writer_tell(self._nh)
+            return self._read_pos  # tracked: native reader has no ftell hook
         return self.handle.tell()
 
     def write(self, buf):
         assert self.writable
+        buf = bytes(buf)  # accept bytearray/memoryview on both backends
+        if len(buf) >= 1 << _LREC_KIND_BITS:
+            raise ValueError("record too large for RecordIO framing "
+                             "(%d >= 2^29 bytes)" % len(buf))
+        if self._nh:
+            if self._nlib.mxtpu_recordio_writer_write(
+                    self._nh, buf, len(buf)) != 0:
+                raise IOError("native recordio write failed")
+            return
         self.handle.write(struct.pack("<II", _MAGIC, _encode_lrec(0, len(buf))))
         self.handle.write(buf)
         pad = (4 - len(buf) % 4) % 4
@@ -77,18 +113,35 @@ class MXRecordIO(object):
 
     def read(self):
         assert not self.writable
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
+        if self._nh:
+            out = ctypes.POINTER(ctypes.c_char)()
+            n = ctypes.c_size_t()
+            r = self._nlib.mxtpu_recordio_reader_next(
+                self._nh, ctypes.byref(out), ctypes.byref(n))
+            if r == 1:
+                buf = _native.buf_to_bytes(self._nlib, out, n.value)
+                self._read_pos += 8 + len(buf) + (4 - len(buf) % 4) % 4
+                return buf
+            if r == 0:
+                return None
             raise IOError("Invalid RecordIO magic number")
-        _, length = _decode_lrec(lrec)
-        buf = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return buf
+        # reassemble continuation-framed records (kind 0 = whole record,
+        # 1 = first part, 2 = middle, 3 = last) like the native reader
+        parts = []
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                return None if not parts else b"".join(parts)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise IOError("Invalid RecordIO magic number")
+            kind, length = _decode_lrec(lrec)
+            parts.append(self.handle.read(length))
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            if kind == 0 or kind == 3:
+                return b"".join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -110,7 +163,8 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.keys.append(key)
 
     def close(self):
-        if self.handle is not None and self.writable:
+        is_open = self.handle is not None or getattr(self, "_nh", None)
+        if is_open and self.writable:
             with open(self.idx_path, "w") as fout:
                 for key in self.keys:
                     fout.write("%s\t%d\n" % (str(key), self.idx[key]))

@@ -1,0 +1,122 @@
+/*!
+ * mxtpu C ABI — native runtime for the TPU-native framework.
+ *
+ * TPU-first equivalents of the reference's native core (see SURVEY.md §2.1):
+ *  - dependency engine   (reference: include/mxnet/engine.h:75-250,
+ *                         src/engine/threaded_engine.h) — host-side async
+ *    scheduler ordering IO / staging / host-mutation work.  On TPU the
+ *    *device* async scheduling is PJRT/XLA's job; this engine owns what PJRT
+ *    does not: the host side of the pipeline.
+ *  - pooled storage      (reference: include/mxnet/storage.h:17-75,
+ *                         src/storage/pooled_storage_manager.h) — aligned
+ *    host buffers for staging batches into device memory.
+ *  - profiler            (reference: src/engine/profiler.h:20-141) —
+ *    chrome://tracing JSON of engine op execution.
+ *  - RecordIO            (reference: dmlc-core recordio + src/io) — framed
+ *    record container + threaded prefetching loader (the dmlc::ThreadedIter
+ *    + InputSplit role).
+ *
+ * Design: flat C ABI (the reference exposes 119 MXNET_DLL functions from
+ * include/mxnet/c_api.h for all frontends); here ctypes is the binding layer.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_DLL __attribute__((visibility("default")))
+
+/* ---------------- engine ---------------- */
+
+typedef void *MXTPUVarHandle;
+/* Async fn executed on a worker thread; param is an opaque cookie. */
+typedef void (*MXTPUFn)(void *param);
+
+/* FnProperty: selects the worker pool (reference FnProperty classes,
+ * threaded_engine_perdevice.cc:55-105). */
+#define MXTPU_PROP_NORMAL 0
+#define MXTPU_PROP_IO 1
+#define MXTPU_PROP_COPY 2
+
+MXTPU_DLL MXTPUVarHandle mxtpu_var_new(void);
+/* Async-delete: the var dies after all previously pushed ops on it finish. */
+MXTPU_DLL void mxtpu_var_delete(MXTPUVarHandle var);
+
+/* Push fn with read deps const_vars and write deps mutable_vars.  deleter
+ * (may be NULL) runs after fn completes — used by bindings to drop the
+ * cookie.  Higher priority runs first within a pool. */
+MXTPU_DLL void mxtpu_push(MXTPUFn fn, void *param, MXTPUFn deleter,
+                          const MXTPUVarHandle *const_vars, int n_const,
+                          const MXTPUVarHandle *mutable_vars, int n_mutable,
+                          int priority, int prop, const char *opr_name);
+
+MXTPU_DLL void mxtpu_wait_for_var(MXTPUVarHandle var);
+MXTPU_DLL void mxtpu_wait_all(void);
+/* 0 = threaded, 1 = naive(synchronous).  Selected by MXTPU_ENGINE_TYPE. */
+MXTPU_DLL int mxtpu_engine_type(void);
+MXTPU_DLL int mxtpu_engine_num_workers(void);
+/* #ops pushed - #ops completed (diagnostics). */
+MXTPU_DLL long mxtpu_engine_pending(void);
+
+/* ---------------- storage ---------------- */
+
+MXTPU_DLL void *mxtpu_storage_alloc(size_t size);
+MXTPU_DLL void mxtpu_storage_free(void *ptr, size_t size);     /* to pool  */
+MXTPU_DLL void mxtpu_storage_direct_free(void *ptr, size_t size); /* bypass */
+MXTPU_DLL void mxtpu_storage_release_all(void);
+MXTPU_DLL size_t mxtpu_storage_pooled_bytes(void);
+MXTPU_DLL size_t mxtpu_storage_used_bytes(void);
+
+/* ---------------- profiler ---------------- */
+
+MXTPU_DLL void mxtpu_profiler_set_state(int running);
+MXTPU_DLL int mxtpu_profiler_state(void);
+/* Dump accumulated events as chrome://tracing JSON; returns #events. */
+MXTPU_DLL int mxtpu_profiler_dump(const char *path);
+MXTPU_DLL void mxtpu_profiler_clear(void);
+/* Record an externally timed event (frontend scopes), usec timestamps. */
+MXTPU_DLL void mxtpu_profiler_add_event(const char *name, const char *cat,
+                                        int64_t start_us, int64_t end_us,
+                                        int tid);
+
+/* ---------------- recordio ---------------- */
+
+MXTPU_DLL void *mxtpu_recordio_writer_open(const char *path);
+MXTPU_DLL int mxtpu_recordio_writer_write(void *h, const char *buf,
+                                          size_t len);
+MXTPU_DLL long mxtpu_recordio_writer_tell(void *h);
+MXTPU_DLL void mxtpu_recordio_writer_close(void *h);
+
+MXTPU_DLL void *mxtpu_recordio_reader_open(const char *path);
+/* 1 = record produced (malloc'd *out, caller frees via mxtpu_buf_free),
+ * 0 = eof, -1 = error. */
+MXTPU_DLL int mxtpu_recordio_reader_next(void *h, char **out, size_t *len);
+MXTPU_DLL void mxtpu_recordio_reader_close(void *h);
+
+/* Threaded prefetching loader: background thread reads + shards + (chunk)
+ * shuffles records into a bounded queue (the dmlc::ThreadedIter +
+ * InputSplit role; record i belongs to this part iff i % num_parts ==
+ * part_index). */
+MXTPU_DLL void *mxtpu_loader_create(const char *path, int part_index,
+                                    int num_parts, int shuffle,
+                                    unsigned seed, int queue_size,
+                                    int shuffle_chunk);
+MXTPU_DLL int mxtpu_loader_next(void *h, char **out, size_t *len);
+MXTPU_DLL void mxtpu_loader_reset(void *h);
+MXTPU_DLL void mxtpu_loader_free(void *h);
+
+MXTPU_DLL void mxtpu_buf_free(char *p);
+
+/* ---------------- misc ---------------- */
+MXTPU_DLL const char *mxtpu_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

@@ -1,0 +1,132 @@
+/*!
+ * Engine profiler — chrome://tracing JSON dump.
+ *
+ * Reference behavior matched: OprExecStat records per-op start/end + thread
+ * inside engine execution, Profiler singleton dumps chrome trace JSON
+ * (src/engine/profiler.h:20-141, profiler.cc:65-175, hook in
+ * threaded_engine.h:294-308).
+ *
+ * On TPU, device-side timing comes from the XLA profiler (xplane); this
+ * profiler owns the *host* lanes: engine ops (IO, decode, staging) and
+ * frontend scopes, so mx.profiler can merge both views.
+ */
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+namespace {
+
+struct Event {
+  std::string name;
+  std::string cat;
+  int64_t start_us;
+  int64_t end_us;
+  int tid;
+};
+
+struct ProfilerState {
+  std::mutex m;
+  std::vector<Event> events;
+  std::atomic<bool> running{false};
+};
+
+ProfilerState *GetState() {
+  static ProfilerState *st = new ProfilerState();
+  return st;
+}
+
+void JsonEscape(const std::string &s, std::string *out) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if ((unsigned char)c >= 0x20) {
+      out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+bool ProfilerRunning() { return GetState()->running.load(); }
+
+void ProfilerRecord(const char *name, const char *cat, int64_t start_us,
+                    int64_t end_us, int tid) {
+  ProfilerState *st = GetState();
+  if (!st->running.load()) return;
+  std::lock_guard<std::mutex> lk(st->m);
+  st->events.push_back(Event{name ? name : "opr", cat ? cat : "engine",
+                             start_us, end_us, tid});
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void mxtpu_profiler_set_state(int running) {
+  ::mxtpu::GetState()->running.store(running != 0);
+}
+
+int mxtpu_profiler_state(void) {
+  return ::mxtpu::GetState()->running.load() ? 1 : 0;
+}
+
+void mxtpu_profiler_clear(void) {
+  auto *st = ::mxtpu::GetState();
+  std::lock_guard<std::mutex> lk(st->m);
+  st->events.clear();
+}
+
+void mxtpu_profiler_add_event(const char *name, const char *cat,
+                              int64_t start_us, int64_t end_us, int tid) {
+  auto *st = ::mxtpu::GetState();
+  std::lock_guard<std::mutex> lk(st->m);
+  st->events.push_back(
+      ::mxtpu::Event{name ? name : "event", cat ? cat : "frontend", start_us,
+                     end_us, tid});
+}
+
+int mxtpu_profiler_dump(const char *path) {
+  auto *st = ::mxtpu::GetState();
+  std::vector<::mxtpu::Event> events;
+  {
+    std::lock_guard<std::mutex> lk(st->m);
+    events = st->events;
+  }
+  FILE *f = std::fopen(path, "w");
+  if (!f) return -1;
+  // chrome://tracing "traceEvents" format, complete ('X') events — same
+  // consumer as the reference's DumpProfile output.
+  std::fprintf(f, "{\n\"traceEvents\": [\n");
+  bool first = true;
+  for (const auto &e : events) {
+    std::string name, cat;
+    ::mxtpu::JsonEscape(e.name, &name);
+    ::mxtpu::JsonEscape(e.cat, &cat);
+    std::fprintf(f,
+                 "%s{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\",\"ts\":%lld,"
+                 "\"dur\":%lld,\"pid\":0,\"tid\":%d}",
+                 first ? "" : ",\n", name.c_str(), cat.c_str(),
+                 (long long)e.start_us, (long long)(e.end_us - e.start_us),
+                 e.tid);
+    first = false;
+  }
+  std::fprintf(f, "\n],\n\"displayTimeUnit\": \"ms\"\n}\n");
+  std::fclose(f);
+  return (int)events.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+/*!
+ * Pooled host storage manager.
+ *
+ * Reference behavior matched: Storage::Get()->Alloc/Free/DirectFree with a
+ * size-bucketed free-list pool (include/mxnet/storage.h:17-75,
+ * src/storage/pooled_storage_manager.h:28-103, GPUPooledStorageManager).
+ *
+ * TPU framing: device (HBM) allocation belongs to PJRT/XLA — the host never
+ * hand-allocates HBM.  What the framework *does* allocate over and over is
+ * host staging memory: batch assembly buffers, record scratch, checkpoint
+ * serialization.  This pool keeps those 64-byte aligned (friendly for
+ * zero-copy handoff to jax.device_put / dlpack) and recycled, with the
+ * reserve semantics of MXNET_GPU_MEM_POOL_RESERVE mapped to
+ * MXTPU_MEM_POOL_MAX_MB (pool stops caching beyond the cap).
+ */
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+namespace {
+
+struct Pool {
+  std::mutex m;
+  // exact-size free lists (reference pools by exact size too)
+  std::unordered_map<size_t, std::vector<void *>> free_list;
+  size_t pooled_bytes = 0;
+  size_t used_bytes = 0;
+  size_t max_pool_bytes;
+
+  Pool() {
+    const char *v = std::getenv("MXTPU_MEM_POOL_MAX_MB");
+    max_pool_bytes = (v ? (size_t)std::atol(v) : 1024) * (1 << 20);
+  }
+
+  static size_t RoundSize(size_t size) {
+    // round to 64B lines so near-sizes share buckets
+    return (size + 63) & ~(size_t)63;
+  }
+
+  void *Alloc(size_t size) {
+    size = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(m);
+      auto it = free_list.find(size);
+      if (it != free_list.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes -= size;
+        used_bytes += size;
+        return p;
+      }
+      used_bytes += size;
+    }
+    void *p = nullptr;
+    if (posix_memalign(&p, 64, size) != 0) return nullptr;
+    return p;
+  }
+
+  void Free(void *ptr, size_t size) {
+    size = RoundSize(size);
+    std::lock_guard<std::mutex> lk(m);
+    used_bytes -= size;
+    if (pooled_bytes + size > max_pool_bytes) {
+      free(ptr);
+      return;
+    }
+    free_list[size].push_back(ptr);
+    pooled_bytes += size;
+  }
+
+  void DirectFree(void *ptr, size_t size) {
+    std::lock_guard<std::mutex> lk(m);
+    used_bytes -= RoundSize(size);
+    free(ptr);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(m);
+    for (auto &kv : free_list)
+      for (void *p : kv.second) free(p);
+    free_list.clear();
+    pooled_bytes = 0;
+  }
+};
+
+Pool *GetPool() {
+  static Pool *pool = new Pool();
+  return pool;
+}
+
+}  // namespace
+}  // namespace mxtpu
+
+extern "C" {
+
+void *mxtpu_storage_alloc(size_t size) {
+  return ::mxtpu::GetPool()->Alloc(size);
+}
+void mxtpu_storage_free(void *ptr, size_t size) {
+  ::mxtpu::GetPool()->Free(ptr, size);
+}
+void mxtpu_storage_direct_free(void *ptr, size_t size) {
+  ::mxtpu::GetPool()->DirectFree(ptr, size);
+}
+void mxtpu_storage_release_all(void) { ::mxtpu::GetPool()->ReleaseAll(); }
+size_t mxtpu_storage_pooled_bytes(void) {
+  return ::mxtpu::GetPool()->pooled_bytes;
+}
+size_t mxtpu_storage_used_bytes(void) {
+  return ::mxtpu::GetPool()->used_bytes;
+}
+
+}  // extern "C"

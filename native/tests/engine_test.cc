@@ -1,0 +1,102 @@
+/*!
+ * Engine stress test — the reference's C++ unit tier
+ * (tests/cpp/threaded_engine_test.cc pushes thousands of random-dependency
+ * ops, WaitForAll, then checks invariants).  Here: random ops over a set of
+ * vars, each op atomically bumps counters for its write vars and snapshots
+ * its read vars; afterwards we assert (a) all ops ran, (b) per-var write
+ * serialization held (no torn read-modify-write).
+ */
+#include <algorithm>
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace {
+
+constexpr int kVars = 16;
+constexpr int kOps = 4000;
+
+// Per-var plain (unsynchronized) counter: safe iff the engine serializes
+// writers per var.
+long g_counter[kVars];
+std::atomic<long> g_ops_run{0};
+
+struct OpParam {
+  std::vector<int> writes;
+};
+
+void OpFn(void *p) {
+  auto *param = static_cast<OpParam *>(p);
+  for (int v : param->writes) {
+    long cur = g_counter[v];
+    // widen the race window: if two writers on the same var overlap, the
+    // final count comes up short
+    for (volatile int i = 0; i < 50; ++i) {
+    }
+    g_counter[v] = cur + 1;
+  }
+  g_ops_run.fetch_add(1);
+}
+
+void OpDel(void *p) { delete static_cast<OpParam *>(p); }
+
+}  // namespace
+
+int main() {
+  std::mt19937 rng(42);
+  std::vector<MXTPUVarHandle> vars;
+  for (int i = 0; i < kVars; ++i) vars.push_back(mxtpu_var_new());
+
+  std::vector<long> expected(kVars, 0);
+  for (int i = 0; i < kOps; ++i) {
+    int nr = (int)(rng() % 3);
+    int nw = 1 + (int)(rng() % 2);
+    std::vector<MXTPUVarHandle> creads, cwrites;
+    std::vector<int> widx;
+    // pick distinct vars for this op
+    std::vector<int> perm(kVars);
+    for (int j = 0; j < kVars; ++j) perm[j] = j;
+    std::shuffle(perm.begin(), perm.end(), rng);
+    for (int j = 0; j < nr; ++j) creads.push_back(vars[perm[j]]);
+    for (int j = nr; j < nr + nw; ++j) {
+      cwrites.push_back(vars[perm[j]]);
+      widx.push_back(perm[j]);
+      expected[perm[j]]++;
+    }
+    auto *param = new OpParam{widx};
+    mxtpu_push(OpFn, param, OpDel, creads.data(), (int)creads.size(),
+               cwrites.data(), (int)cwrites.size(), (int)(rng() % 5), 0,
+               "stress_op");
+  }
+  mxtpu_wait_all();
+
+  assert(g_ops_run.load() == kOps);
+  for (int i = 0; i < kVars; ++i) {
+    if (g_counter[i] != expected[i]) {
+      std::fprintf(stderr, "var %d: got %ld want %ld — write race!\n", i,
+                   g_counter[i], expected[i]);
+      return 1;
+    }
+  }
+  // WaitForVar + var deletion paths
+  for (auto v : vars) mxtpu_wait_for_var(v);
+  for (auto v : vars) mxtpu_var_delete(v);
+  mxtpu_wait_all();
+
+  // storage pool reuse (reference tests/cpp/storage_test.cc tier)
+  void *p1 = mxtpu_storage_alloc(1 << 16);
+  mxtpu_storage_free(p1, 1 << 16);
+  void *p2 = mxtpu_storage_alloc(1 << 16);
+  assert(p1 == p2 && "pool should recycle the freed block");
+  mxtpu_storage_direct_free(p2, 1 << 16);
+
+  std::printf("engine_test: %ld ops, %d workers, engine_type=%d — OK\n",
+              g_ops_run.load(), mxtpu_engine_num_workers(),
+              mxtpu_engine_type());
+  return 0;
+}
