@@ -1,0 +1,196 @@
+"""Train/evaluate SSD on a synthetic shapes dataset (parity: reference
+``example/ssd/train.py`` + ``evaluate.py`` — same Module-based flow with
+MultiBox contrib ops; runs out of the box with no dataset download).
+
+The synthetic task: images contain 1-3 axis-aligned bright rectangles on
+noise; the class is the rectangle's color channel.  Usage:
+
+    python examples/ssd/train.py --num-epochs 5 --batch-size 8 [--tpus 1]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))  # repo root
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+NUM_CLASSES = 3
+MAX_OBJECTS = 3
+
+
+def make_dataset(num_images, image_size=64, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.rand(num_images, 3, image_size, image_size).astype(
+        np.float32) * 0.2
+    labels = -np.ones((num_images, MAX_OBJECTS, 5), dtype=np.float32)
+    for i in range(num_images):
+        for j in range(rng.randint(1, MAX_OBJECTS + 1)):
+            cls = rng.randint(NUM_CLASSES)
+            w, h = rng.uniform(0.2, 0.5, 2)
+            x1 = rng.uniform(0, 1 - w)
+            y1 = rng.uniform(0, 1 - h)
+            px1, py1 = int(x1 * image_size), int(y1 * image_size)
+            px2 = min(int((x1 + w) * image_size) + 1, image_size)
+            py2 = min(int((y1 + h) * image_size) + 1, image_size)
+            data[i, cls, py1:py2, px1:px2] = 1.0
+            labels[i, j] = [cls, x1, y1, x1 + w, y1 + h]
+    return data, labels
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Cross-entropy + smooth-L1 running means (parity:
+    reference ``example/ssd/train/metric.py:MultiBoxMetric``)."""
+
+    takes_all_outputs = True  # consume the full output group, not preds[:1]
+
+    def __init__(self):
+        super().__init__("MultiBox")
+        self.num = 2
+        self.reset()
+
+    def reset(self):
+        self.sum_metric = [0.0, 0.0]
+        self.num_inst = [0, 0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()   # (B, C+1, A)
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()  # (B, A)
+        valid = cls_label >= 0
+        prob = np.moveaxis(cls_prob, 1, -1).reshape(-1, cls_prob.shape[1])
+        lab = cls_label.reshape(-1).astype(int)
+        mask = valid.reshape(-1)
+        p = np.maximum(prob[np.arange(lab.size), np.maximum(lab, 0)], 1e-12)
+        self.sum_metric[0] += float(-(np.log(p) * mask).sum())
+        self.num_inst[0] += int(mask.sum())
+        self.sum_metric[1] += float(loc_loss.sum())
+        self.num_inst[1] += max(int(valid.sum()), 1)
+
+    def get(self):
+        return (["CrossEntropy", "SmoothL1"],
+                [s / max(n, 1) for s, n in zip(self.sum_metric, self.num_inst)])
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+
+def voc_map(dets, gt_labels, iou_thresh=0.5):
+    """VOC-style mean AP over classes (all-point interpolation); dets is
+    (N, A, 6) MultiBoxDetection output, gt_labels (N, M, 5)."""
+
+    def iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for cls in range(NUM_CLASSES):
+        records = []  # (score, is_tp)
+        total_gt = 0
+        for i in range(dets.shape[0]):
+            gts = [g[1:] for g in gt_labels[i] if g[0] == cls]
+            total_gt += len(gts)
+            used = [False] * len(gts)
+            rows = [r for r in dets[i] if r[0] == cls]
+            for r in sorted(rows, key=lambda r: -r[1]):
+                best, best_j = 0.0, -1
+                for j, g in enumerate(gts):
+                    o = iou(r[2:], g)
+                    if o > best and not used[j]:
+                        best, best_j = o, j
+                if best >= iou_thresh:
+                    used[best_j] = True
+                    records.append((r[1], 1))
+                else:
+                    records.append((r[1], 0))
+        if total_gt == 0:
+            continue
+        records.sort(key=lambda x: -x[0])
+        tp = np.cumsum([r[1] for r in records]) if records else np.array([])
+        fp = np.cumsum([1 - r[1] for r in records]) if records else np.array([])
+        if len(tp) == 0:
+            aps.append(0.0)
+            continue
+        recall = tp / total_gt
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        ap = 0.0
+        for t in np.arange(0.0, 1.01, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11.0
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train SSD (synthetic)")
+    parser.add_argument("--num-epochs", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--num-examples", type=int, default=160)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--tpus", type=int, default=0,
+                        help="train on N TPU chips (0 = cpu)")
+    parser.add_argument("--prefix", type=str, default=None,
+                        help="checkpoint prefix")
+    args = parser.parse_args()
+
+    ctx = [mx.tpu(i) for i in range(args.tpus)] if args.tpus else mx.cpu()
+    data, labels = make_dataset(args.num_examples, args.image_size)
+    vdata, vlabels = make_dataset(32, args.image_size, seed=99)
+    train = mx.io.NDArrayIter({"data": data}, {"label": labels},
+                              batch_size=args.batch_size, shuffle=True,
+                              label_name="label")
+
+    net = ssd.get_symbol_train(num_classes=NUM_CLASSES, num_scales=3,
+                               small=True, use_bn=True)
+    mod = mx.mod.Module(net, context=ctx, data_names=("data",),
+                        label_names=("label",))
+    mod.fit(train, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=MultiBoxMetric(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    if args.prefix:
+        mod.save_checkpoint(args.prefix, args.num_epochs)
+
+    # evaluation: rebind detection symbol with trained weights
+    det_sym = ssd.get_symbol(num_classes=NUM_CLASSES, num_scales=3,
+                             small=True, nms_thresh=0.45, use_bn=True)
+    det_mod = mx.mod.Module(det_sym, context=ctx, data_names=("data",),
+                            label_names=())
+    det_mod.bind(data_shapes=[("data", (args.batch_size, 3, args.image_size,
+                                        args.image_size))],
+                 for_training=False)
+    det_mod.set_params(*mod.get_params())
+    all_dets = []
+    for start in range(0, len(vdata), args.batch_size):
+        chunk = vdata[start:start + args.batch_size]
+        pad = args.batch_size - len(chunk)
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+        det_mod.forward(mx.io.DataBatch([mx.nd.array(chunk)]),
+                        is_train=False)
+        out = det_mod.get_outputs()[0].asnumpy()
+        all_dets.append(out[:len(chunk) - pad if pad else len(chunk)])
+    dets = np.concatenate(all_dets)
+    m = voc_map(dets, vlabels)
+    print("validation mAP@0.5 = %.4f" % m)
+    return m
+
+
+if __name__ == "__main__":
+    main()

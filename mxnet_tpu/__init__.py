@@ -6,6 +6,29 @@ Import as ``import mxnet_tpu as mx`` — the namespace mirrors the reference's
 ``python/mxnet/__init__.py``.
 """
 
+# Multi-process bootstrap MUST precede any XLA backend touch, so it runs
+# before everything else when the launcher env is present (parity: the
+# reference's MXInitPSEnv handshake with the dmlc tracker env,
+# tools/launch.py → DMLC_PS_ROOT_URI; here tools/launch.py →
+# MXNET_TPU_COORDINATOR and jax.distributed).
+import os as _os
+
+if _os.environ.get("MXNET_TPU_COORDINATOR"):
+    import jax as _jax
+
+    # plugin platforms may ignore the env var; force via config so local
+    # simulated clusters (tools/launch.py default JAX_PLATFORMS=cpu) really
+    # land on the requested backend
+    if _os.environ.get("JAX_PLATFORMS"):
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    _jax.distributed.initialize(
+        _os.environ["MXNET_TPU_COORDINATOR"],
+        int(_os.environ.get("MXNET_TPU_NUM_PROCS", "1")),
+        int(_os.environ.get("MXNET_TPU_PROC_ID", "0")))
+    # flag for init_process_group that bootstrap already happened (it must
+    # not re-initialize — a second call after backend touch is an error)
+    _os.environ["_MXNET_TPU_DIST_READY"] = "1"
+
 from . import base
 from .base import MXNetError
 from . import context
@@ -46,6 +69,8 @@ from . import image as img
 from . import image
 from . import operator
 from .operator import CustomOp, CustomOpProp
+from . import predict
+from . import engine
 from . import parallel
 from . import contrib
 from . import models

@@ -59,6 +59,8 @@ def _configure(lib):
     lib.mxtpu_recordio_reader_open.argtypes = [c.c_char_p]
     lib.mxtpu_recordio_reader_next.argtypes = [
         c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_size_t)]
+    lib.mxtpu_recordio_reader_tell.restype = c.c_long
+    lib.mxtpu_recordio_reader_tell.argtypes = [c.c_void_p]
     lib.mxtpu_recordio_reader_close.argtypes = [c.c_void_p]
     lib.mxtpu_loader_create.restype = c.c_void_p
     lib.mxtpu_loader_create.argtypes = [

@@ -76,13 +76,18 @@ class Context:
         """
         import jax
 
+        # multi-process SPMD: a context always denotes one of THIS process's
+        # devices (the reference's ctx is likewise process-local; global
+        # placement is the mesh/sharding layer's job)
+        local = jax.process_count() > 1
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                devs = jax.devices("cpu")
+                devs = (jax.local_devices(backend="cpu") if local
+                        else jax.devices("cpu"))
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices() if local else jax.devices()
             return devs[min(self.device_id, len(devs) - 1)]
-        devs = jax.devices()
+        devs = jax.local_devices() if local else jax.devices()
         if self.device_id >= len(devs):
             raise ValueError(
                 "context %s out of range: only %d device(s) visible" % (self, len(devs))

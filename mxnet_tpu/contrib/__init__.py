@@ -3,10 +3,32 @@ autograd API + ``_contrib_*`` op namespaces + tensorboard hook)."""
 
 from . import autograd
 
-# mx.contrib.sym / mx.contrib.nd expose the same generated namespaces; the
-# contrib ops (MultiBox*, Proposal, ...) register under their own names here
-from .. import ndarray as nd
-from .. import symbol as sym
+
+class _ContribNamespace(object):
+    """``mx.contrib.sym.MultiBoxPrior`` → ``sym._contrib_MultiBoxPrior``
+    (parity: reference ``contrib/__init__.py:4-10`` exposing ``_contrib_*``
+    ops without the prefix)."""
+
+    def __init__(self, base_module):
+        self._base = base_module
+
+    def __getattr__(self, name):
+        base = object.__getattribute__(self, "_base")
+        for candidate in ("_contrib_" + name, name):
+            if hasattr(base, candidate):
+                return getattr(base, candidate)
+        raise AttributeError("no contrib op %r" % name)
+
+
+def _make_namespaces():
+    from .. import ndarray as _nd_mod
+    from .. import symbol as _sym_mod
+
+    return _ContribNamespace(_sym_mod), _ContribNamespace(_nd_mod)
+
+
+sym, nd = _make_namespaces()
+ndarray, symbol = nd, sym
 
 
 class TensorBoard(object):

@@ -74,6 +74,14 @@ def imdecode(buf, **kwargs):
     return array(imdecode_bytes(buf))
 
 
+def imread(path):
+    """Read an image file to an HWC uint8 numpy array (PIL or .npy)."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    with open(path, "rb") as f:
+        return imdecode_bytes(f.read())
+
+
 def scale_down(src_size, size):
     """(parity: ``image.py:scale_down``)"""
     w, h = size

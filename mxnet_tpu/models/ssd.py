@@ -1,0 +1,157 @@
+"""SSD detector symbols (parity: reference ``example/ssd/symbol/``
+``symbol_builder.py``/``symbol_factory.py`` — VGG16-reduced SSD-300 is the
+north-star config; see SURVEY.md §2.5).
+
+TPU-first notes: the multibox contrib ops here are static-shape JAX rules
+(``ops/contrib_op.py``), so the whole train graph — backbone, heads,
+MultiBoxTarget matching, losses — traces into ONE XLA computation; there is
+no CPU round-trip for target assignment the way the reference splits
+CUDA kernels.  bf16-friendly: pass ``dtype='bfloat16'`` to run the conv
+stack in bf16 with fp32 heads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .. import symbol as sym
+
+__all__ = ["get_symbol_train", "get_symbol"]
+
+
+def _conv_act(data, name, num_filter, kernel=(3, 3), pad=(1, 1),
+              stride=(1, 1), use_bn=False):
+    net = sym.Convolution(data, name=name, num_filter=num_filter,
+                          kernel=kernel, pad=pad, stride=stride,
+                          no_bias=use_bn)
+    if use_bn:
+        net = sym.BatchNorm(net, name=name + "_bn")
+    return sym.Activation(net, act_type="relu", name=name + "_relu")
+
+
+def _vgg_reduced_body(data, small=False, use_bn=False):
+    """VGG-16-reduced backbone (reference ``example/ssd/symbol/vgg16_reduced
+    .py``): returns the two base feature maps (conv4-stage, conv7/fc7-stage).
+    ``small=True`` shrinks widths for unit tests / tiny inputs."""
+    f = (lambda n: max(n // 8, 8)) if small else (lambda n: n)
+    conv = functools.partial(_conv_act, use_bn=use_bn)
+    net = data
+    for i, (reps, width) in enumerate([(2, 64), (2, 128), (3, 256)]):
+        for j in range(reps):
+            net = conv(net, "conv%d_%d" % (i + 1, j + 1), f(width))
+        net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                          name="pool%d" % (i + 1))
+    for j in range(3):
+        net = conv(net, "conv4_%d" % (j + 1), f(512))
+    feat1 = net  # stride 8 map, the classic conv4_3 attach point
+    net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                      name="pool4")
+    for j in range(3):
+        net = conv(net, "conv5_%d" % (j + 1), f(512))
+    # reduced fc6/fc7 as convs (the "reduced" part of vgg16_reduced)
+    net = conv(net, "fc6", f(1024), kernel=(3, 3), pad=(1, 1))
+    net = conv(net, "fc7", f(1024), kernel=(1, 1), pad=(0, 0))
+    return feat1, net
+
+
+def _multi_scale_layers(body_out, num_extra, small=False, use_bn=False):
+    """Extra SSD feature layers: 1x1 squeeze + stride-2 3x3 conv per scale
+    (reference ``symbol_builder.py:add_extras``-style)."""
+    f = (lambda n: max(n // 8, 8)) if small else (lambda n: n)
+    feats = []
+    net = body_out
+    for i in range(num_extra):
+        net = _conv_act(net, "multi_feat_%d_1x1" % i, f(256), kernel=(1, 1),
+                        pad=(0, 0), use_bn=use_bn)
+        net = _conv_act(net, "multi_feat_%d_3x3" % i, f(512), kernel=(3, 3),
+                        pad=(1, 1), stride=(2, 2), use_bn=use_bn)
+        feats.append(net)
+    return feats
+
+
+def _multibox_layer(from_layers, num_classes, sizes, ratios, clip=False):
+    """Per-scale loc/cls heads + priors, concatenated (reference
+    ``example/ssd/symbol/common.py:multibox_layer``)."""
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    num_classes_b = num_classes + 1  # + background
+    for k, from_layer in enumerate(from_layers):
+        size, ratio = sizes[k], ratios[k]
+        num_anchors = len(size) + len(ratio) - 1
+        loc = sym.Convolution(from_layer, num_filter=num_anchors * 4,
+                              kernel=(3, 3), pad=(1, 1),
+                              name="loc_pred_%d_conv" % k)
+        loc = sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc_layers.append(sym.Flatten(loc))
+        cls = sym.Convolution(from_layer,
+                              num_filter=num_anchors * num_classes_b,
+                              kernel=(3, 3), pad=(1, 1),
+                              name="cls_pred_%d_conv" % k)
+        cls = sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls_layers.append(sym.Flatten(cls))
+        anchor_layers.append(
+            sym.Reshape(
+                sym.contrib_MultiBoxPrior(
+                    from_layer, sizes=tuple(size), ratios=tuple(ratio),
+                    clip=clip, name="anchors_%d" % k),
+                shape=(1, -1, 4)))
+    loc_preds = sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_concat = sym.Concat(*cls_layers, dim=1)
+    cls_preds = sym.transpose(
+        sym.Reshape(cls_concat, shape=(0, -1, num_classes_b)),
+        axes=(0, 2, 1), name="multibox_cls_pred")  # (B, C+1, A)
+    anchors = sym.Concat(*anchor_layers, dim=1, name="multibox_anchors")
+    return loc_preds, cls_preds, anchors
+
+
+def _build_heads(num_classes, num_scales, small, clip, use_bn=False):
+    data = sym.Variable("data")
+    feat1, body = _vgg_reduced_body(data, small=small, use_bn=use_bn)
+    extras = _multi_scale_layers(body, max(num_scales - 2, 0), small=small,
+                                 use_bn=use_bn)
+    from_layers = [feat1, body] + extras
+    base_sizes = [0.1, 0.2, 0.37, 0.54, 0.71, 0.88, 1.05]
+    sizes = [[base_sizes[i], (base_sizes[i] * base_sizes[i + 1]) ** 0.5]
+             for i in range(len(from_layers))]
+    ratios = [[1.0, 2.0, 0.5]] * len(from_layers)
+    return _multibox_layer(from_layers, num_classes, sizes, ratios, clip=clip)
+
+
+def get_symbol_train(num_classes=20, num_scales=4, small=False,
+                     overlap_threshold=0.5, negative_mining_ratio=3.0,
+                     smooth_l1_sigma=1.0, use_bn=False):
+    """Training symbol: heads + MultiBoxTarget + softmax/smooth-L1 losses
+    (reference ``symbol_builder.py:get_symbol_train``).  Label input
+    ``label`` is (B, M, 5) rows [cls, x1, y1, x2, y2], cls<0 padding."""
+    label = sym.Variable("label")
+    loc_preds, cls_preds, anchors = _build_heads(
+        num_classes, num_scales, small, clip=False, use_bn=use_bn)
+    loc_target, loc_mask, cls_target = sym.contrib_MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=overlap_threshold,
+        ignore_label=-1, negative_mining_ratio=negative_mining_ratio,
+        negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2),
+        name="multibox_target")
+    cls_prob = sym.SoftmaxOutput(cls_preds, cls_target, ignore_label=-1,
+                                 use_ignore=True, multi_output=True,
+                                 normalization="valid", name="cls_prob")
+    loc_diff = loc_mask * (loc_preds - loc_target)
+    loc_loss = sym.MakeLoss(
+        sym.smooth_l1(loc_diff, scalar=smooth_l1_sigma),
+        normalization="valid", name="loc_loss")
+    # metrics need the targets; BlockGrad keeps them out of backward
+    cls_label = sym.BlockGrad(cls_target, name="cls_label")
+    det = sym.BlockGrad(loc_mask, name="loc_mask_out")
+    return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(num_classes=20, num_scales=4, small=False, nms_thresh=0.5,
+               force_suppress=False, nms_topk=400, use_bn=False):
+    """Detection symbol: heads + softmax + MultiBoxDetection (reference
+    ``symbol_builder.py:get_symbol``).  Output (B, A, 6) rows
+    [cls_id, score, x1, y1, x2, y2], cls_id −1 = suppressed."""
+    loc_preds, cls_preds, anchors = _build_heads(
+        num_classes, num_scales, small, clip=False, use_bn=use_bn)
+    cls_prob = sym.softmax(cls_preds, axis=1, name="cls_prob")
+    return sym.contrib_MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=nms_topk, name="detection")

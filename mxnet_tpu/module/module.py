@@ -427,8 +427,13 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         outputs = self.get_outputs()
-        eval_metric.update(labels, outputs[: len(labels)] if len(labels) and
-                           len(outputs) > len(labels) else outputs)
+        # classifier-style metrics pair preds 1:1 with labels; metrics that
+        # consume the whole output group (e.g. SSD's MultiBoxMetric) opt out
+        # via takes_all_outputs
+        if (not getattr(eval_metric, "takes_all_outputs", False)
+                and len(labels) and len(outputs) > len(labels)):
+            outputs = outputs[: len(labels)]
+        eval_metric.update(labels, outputs)
 
     # ------------------------------------------------------------------
     # optimizer states

@@ -398,6 +398,14 @@ def load(fname):
         return {k: array(f[k]) for k in keys}
 
 
+def load_frombuffer(buf):
+    """Load NDArrays from serialized bytes (parity: ``mx.nd.load_frombuffer``
+    / ``MXNDArrayLoadFromBuffer`` — the predict API's param path)."""
+    import io as _io
+
+    return load(_io.BytesIO(buf))
+
+
 # ----------------------------------------------------------------------
 # op namespace generation (parity: _init_ndarray_module)
 # ----------------------------------------------------------------------

@@ -1,0 +1,557 @@
+"""contrib operators (parity: reference ``src/operator/contrib/*`` — SSD's
+MultiBoxPrior/Target/Detection, RCNN Proposal, CTCLoss, FFT/IFFT,
+count_sketch, quantize/dequantize).
+
+TPU-first design notes: the reference implements these as hand CUDA kernels
+with data-dependent control flow (e.g. ``multibox_detection.cu`` NMS loops,
+vendored warp-ctc).  Here every op is a traceable JAX rule with **static
+shapes**: matching/NMS/proposal selection produce fixed-size outputs with
+sentinel entries (-1) instead of dynamically-sized ones, greedy NMS is a
+``lax.fori_loop`` over a score-sorted suppression mask (O(A^2) vector work on
+the VPU), and CTC is a log-space ``lax.scan`` over time — differentiable by
+construction, replacing warp-ctc's hand-written gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import ParamSpec as P
+from .registry import register
+
+__all__ = []
+
+
+def _tuple_of_floats(v, default):
+    if v is None:
+        return default
+    if isinstance(v, str):
+        v = v.strip("() ").split(",")
+        v = [x for x in (s.strip() for s in v) if x]
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def _iou_matrix(boxes_a, boxes_b):
+    """Pairwise IoU: (A,4) x (M,4) -> (A,M); boxes are (x1,y1,x2,y2)."""
+    ax1, ay1, ax2, ay2 = [boxes_a[:, i, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [boxes_b[None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ----------------------------------------------------------------------
+# MultiBoxPrior (reference src/operator/contrib/multibox_prior.cc)
+# ----------------------------------------------------------------------
+
+@register(
+    "_contrib_MultiBoxPrior",
+    arg_names=["data"],
+    params={
+        "sizes": P("any", (1.0,)),
+        "ratios": P("any", (1.0,)),
+        "clip": P("bool", False),
+        "steps": P("any", (-1.0, -1.0)),
+        "offsets": P("any", (0.5, 0.5)),
+    },
+)
+def _multibox_prior(attrs, data):
+    """Anchor boxes per feature-map pixel; output (1, H*W*A, 4) in corner
+    format normalized to [0,1].  A = len(sizes)+len(ratios)-1: (s_i, r_0) for
+    all sizes plus (s_0, r_j) for j>0 (reference multibox_prior-inl.h)."""
+    sizes = _tuple_of_floats(attrs["sizes"], (1.0,))
+    ratios = _tuple_of_floats(attrs["ratios"], (1.0,))
+    offs = _tuple_of_floats(attrs["offsets"], (0.5, 0.5))
+    steps = _tuple_of_floats(attrs["steps"], (-1.0, -1.0))
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offs[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offs[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H,W)
+    wh = [(s * _np.sqrt(r) / 2.0, s / _np.sqrt(r) / 2.0)
+          for s, r in [(s, ratios[0]) for s in sizes]
+          + [(sizes[0], r) for r in ratios[1:]]]
+    half_w = jnp.asarray([x[0] for x in wh], dtype=jnp.float32)
+    half_h = jnp.asarray([x[1] for x in wh], dtype=jnp.float32)
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = jnp.stack(
+        [cxg - half_w, cyg - half_h, cxg + half_w, cyg + half_h], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if attrs["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ----------------------------------------------------------------------
+# MultiBoxTarget (reference src/operator/contrib/multibox_target.cc)
+# ----------------------------------------------------------------------
+
+def _encode_loc(gt, anchors, variances):
+    """Box regression targets: center-offset encoding with variances."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+    th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+@register(
+    "_contrib_MultiBoxTarget",
+    arg_names=["anchor", "label", "cls_pred"],
+    num_outputs=3,
+    output_names=["loc_target", "loc_mask", "cls_target"],
+    params={
+        "overlap_threshold": P("float", 0.5),
+        "ignore_label": P("float", -1.0),
+        "negative_mining_ratio": P("float", -1.0),
+        "negative_mining_thresh": P("float", 0.5),
+        "minimum_negative_samples": P("int", 0),
+        "variances": P("any", (0.1, 0.1, 0.2, 0.2)),
+    },
+)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """SSD training targets.  anchor (1,A,4); label (B,M,5) rows
+    [cls, x1,y1,x2,y2] with cls<0 = padding; cls_pred (B,C,A).
+    Outputs loc_target (B,A*4), loc_mask (B,A*4), cls_target (B,A) where
+    cls_target is gt_class+1, 0 = background, -1 = ignored (mined out).
+    Matching: each GT claims its best anchor; remaining anchors match their
+    best GT when IoU > overlap_threshold (reference multibox_target-inl.h)."""
+    variances = _tuple_of_floats(attrs["variances"], (0.1, 0.1, 0.2, 0.2))
+    thresh = attrs["overlap_threshold"]
+    mine_ratio = attrs["negative_mining_ratio"]
+    mine_thresh = attrs["negative_mining_thresh"]
+    min_neg = attrs["minimum_negative_samples"]
+    anchors = anchor[0]  # (A,4)
+    A = anchors.shape[0]
+    M = label.shape[1]
+
+    def one_sample(lab, pred):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt_boxes) * valid[None, :]  # (A,M)
+        # stage 1: each valid GT force-matches its best anchor (invalid GTs
+        # scatter to index A which is dropped, so they can't clobber slot 0)
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        scatter_idx = jnp.where(valid, best_anchor, A)
+        forced = (jnp.zeros((A,), dtype=jnp.int32) - 1).at[scatter_idx].set(
+            jnp.arange(M, dtype=jnp.int32), mode="drop")
+        # stage 2: unforced anchors take their best GT above threshold
+        best_gt = jnp.argmax(iou, axis=1)  # (A,)
+        best_iou = jnp.max(iou, axis=1) if M > 0 else jnp.zeros((A,))
+        stage2 = jnp.where(best_iou > thresh, best_gt.astype(jnp.int32), -1)
+        match = jnp.where(forced >= 0, forced, stage2)  # (A,) gt idx or -1
+        matched = match >= 0
+        safe_match = jnp.maximum(match, 0)
+        cls_t = jnp.where(matched, lab[safe_match, 0].astype(jnp.int32) + 1, 0)
+        # negative mining: keep top-k background anchors by max non-bg
+        # confidence; the rest become ignore_label
+        if mine_ratio > 0:
+            neg_cand = (~matched) & (best_iou < mine_thresh)
+            conf = jnp.max(pred[1:, :], axis=0)  # (A,) max non-bg score
+            conf = jnp.where(neg_cand, conf, -jnp.inf)
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (mine_ratio * num_pos).astype(jnp.int32), min_neg)
+            order = jnp.argsort(-conf)  # high-confidence negatives first
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            keep_neg = neg_cand & (rank < num_neg)
+            cls_t = jnp.where(matched | keep_neg, cls_t,
+                              jnp.int32(attrs["ignore_label"]))
+        loc_t = _encode_loc(gt_boxes[safe_match], anchors, variances)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((A, 4)), 0.0).reshape(-1)
+        return loc_t, loc_m, cls_t.astype(anchor.dtype)
+
+    loc_target, loc_mask, cls_target = jax.vmap(one_sample)(label, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+# ----------------------------------------------------------------------
+# greedy NMS on a score-sorted set (shared by Detection/Proposal)
+# ----------------------------------------------------------------------
+
+def _greedy_nms(boxes, scores, classes, nms_threshold, force_suppress,
+                topk):
+    """Returns keep mask over the first ``topk`` score-ranked candidates.
+    boxes (A,4); suppressed = IoU > thresh with a kept higher-scored box of
+    the same class (any class when force_suppress)."""
+    A = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    sclasses = classes[order]
+    valid = scores[order] > -jnp.inf
+    if 0 < topk < A:
+        valid = valid & (jnp.arange(A) < topk)
+    iou = _iou_matrix(sboxes, sboxes)
+    same_cls = (sclasses[:, None] == sclasses[None, :]) | force_suppress
+
+    def body(i, keep):
+        sup = keep[i] & (iou[i] > nms_threshold) & same_cls[i] \
+            & (jnp.arange(A) > i)
+        return keep & ~sup
+
+    keep_sorted = lax.fori_loop(0, A, body, valid)
+    keep = jnp.zeros((A,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+# ----------------------------------------------------------------------
+# MultiBoxDetection (reference src/operator/contrib/multibox_detection.cc)
+# ----------------------------------------------------------------------
+
+@register(
+    "_contrib_MultiBoxDetection",
+    arg_names=["cls_prob", "loc_pred", "anchor"],
+    params={
+        "clip": P("bool", True),
+        "threshold": P("float", 0.01),
+        "background_id": P("int", 0),
+        "nms_threshold": P("float", 0.5),
+        "force_suppress": P("bool", False),
+        "variances": P("any", (0.1, 0.1, 0.2, 0.2)),
+        "nms_topk": P("int", -1),
+    },
+)
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + NMS.  cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) →
+    (B,A,6) rows [cls_id, score, x1,y1,x2,y2]; cls_id −1 = suppressed."""
+    variances = _tuple_of_floats(attrs["variances"], (0.1, 0.1, 0.2, 0.2))
+    bg = attrs["background_id"]
+    anchors = anchor[0]
+    A = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one_sample(probs, loc):
+        loc = loc.reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if attrs["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # per-anchor best non-background class
+        masked = probs.at[bg, :].set(-jnp.inf)
+        cls_id = jnp.argmax(masked, axis=0).astype(jnp.int32)
+        score = jnp.max(masked, axis=0)
+        ok = score > attrs["threshold"]
+        nms_scores = jnp.where(ok, score, -jnp.inf)
+        keep = _greedy_nms(boxes, nms_scores, cls_id,
+                           attrs["nms_threshold"], attrs["force_suppress"],
+                           attrs["nms_topk"])
+        final = ok & keep
+        # reference reports class ids with background removed: id-1 when bg=0
+        out_cls = jnp.where(
+            final, (cls_id - (1 if bg == 0 else 0)).astype(cls_prob.dtype),
+            -1.0)
+        return jnp.concatenate(
+            [out_cls[:, None], jnp.where(final, score, 0.0)[:, None], boxes],
+            axis=-1)
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)
+
+
+# ----------------------------------------------------------------------
+# Proposal (reference src/operator/contrib/proposal.cc — Faster R-CNN RPN)
+# ----------------------------------------------------------------------
+
+def _generate_base_anchors(stride, scales, ratios):
+    base = _np.array([0, 0, stride - 1, stride - 1], dtype=_np.float32)
+    w, h = base[2] - base[0] + 1, base[3] - base[1] + 1
+    cx, cy = base[0] + (w - 1) / 2, base[1] + (h - 1) / 2
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = _np.round(_np.sqrt(size / r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            anchors.append([cx - (ws * s - 1) / 2, cy - (hs * s - 1) / 2,
+                            cx + (ws * s - 1) / 2, cy + (hs * s - 1) / 2])
+    return _np.array(anchors, dtype=_np.float32)  # (R*S, 4)
+
+
+@register(
+    "_contrib_Proposal",
+    arg_names=["cls_prob", "bbox_pred", "im_info"],
+    params={
+        "rpn_pre_nms_top_n": P("int", 6000),
+        "rpn_post_nms_top_n": P("int", 300),
+        "threshold": P("float", 0.7),
+        "rpn_min_size": P("int", 16),
+        "feature_stride": P("int", 16),
+        "scales": P("any", (4.0, 8.0, 16.0, 32.0)),
+        "ratios": P("any", (0.5, 1.0, 2.0)),
+        "output_score": P("bool", False),
+        "iou_loss": P("bool", False),
+    },
+    num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+    output_names=["output", "score"],
+)
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposals.  cls_prob (B,2K,H,W), bbox_pred (B,4K,H,W), im_info
+    (B,3)=[h,w,scale] → rois (B*post_nms,5) rows [batch_idx,x1,y1,x2,y2];
+    slots past the kept proposals repeat the best box (the reference pads
+    with copies as well)."""
+    scales = _tuple_of_floats(attrs["scales"], (4.0, 8.0, 16.0, 32.0))
+    ratios = _tuple_of_floats(attrs["ratios"], (0.5, 1.0, 2.0))
+    stride = attrs["feature_stride"]
+    pre_n = attrs["rpn_pre_nms_top_n"]
+    post_n = attrs["rpn_post_nms_top_n"]
+    B, _, H, W = cls_prob.shape
+    K = len(scales) * len(ratios)
+    base = jnp.asarray(_generate_base_anchors(stride, scales, ratios))
+    shift_x = jnp.arange(W, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H,W,4)
+    anchors = (shifts[:, :, None, :] + base[None, None, :, :]).reshape(-1, 4)
+    A = anchors.shape[0]  # H*W*K
+
+    def one_sample(probs, deltas, info):
+        # foreground scores: channels K..2K over (H,W) → (H,W,K) → (A,)
+        fg = probs[K:].transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(K, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + (aw - 1) / 2
+        acy = anchors[:, 1] + (ah - 1) / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(d[:, 2]) * aw
+        h = jnp.exp(d[:, 3]) * ah
+        boxes = jnp.stack([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                           cx + (w - 1) / 2, cy + (h - 1) / 2], axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        min_size = attrs["rpn_min_size"] * info[2]
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        score = jnp.where((ws >= min_size) & (hs >= min_size), fg, -jnp.inf)
+        # pre-NMS top-N then greedy NMS (class-agnostic)
+        if 0 < pre_n < A:
+            kth = jnp.sort(score)[-pre_n]
+            score = jnp.where(score >= kth, score, -jnp.inf)
+        keep = _greedy_nms(boxes, score, jnp.zeros((A,), jnp.int32),
+                           attrs["threshold"], True, pre_n)
+        score = jnp.where(keep, score, -jnp.inf)
+        order = jnp.argsort(-score)[:min(post_n, A)]
+        rois = boxes[order]
+        kept = score[order] > -jnp.inf
+        # pad dead slots with the top proposal (static shape, valid boxes);
+        # when A < post_n the reference pads with copies too
+        rois = jnp.where(kept[:, None], rois, rois[0][None, :])
+        out_score = jnp.where(kept, score[order], 0.0)
+        if A < post_n:
+            reps = post_n - A
+            rois = jnp.concatenate(
+                [rois, jnp.tile(rois[0][None, :], (reps, 1))], axis=0)
+            out_score = jnp.concatenate(
+                [out_score, jnp.zeros((reps,), out_score.dtype)], axis=0)
+        return rois, out_score
+
+    rois, scores = jax.vmap(one_sample)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=rois.dtype), post_n)
+    rois = jnp.concatenate(
+        [batch_idx[:, None], rois.reshape(B * post_n, 4)], axis=-1)
+    if attrs.get("output_score"):
+        return rois, scores.reshape(B * post_n, 1)
+    return rois
+
+
+# ----------------------------------------------------------------------
+# CTCLoss (reference src/operator/contrib/ctc_loss.cc — vendored warp-ctc)
+# ----------------------------------------------------------------------
+
+def _ctc_forward(log_probs, labels, label_len, T_len):
+    """Log-space CTC alpha recursion for one sample.
+    log_probs (T,C) log-softmax scores, labels (L,) int (0 = padding),
+    blank = 0 as in warp-ctc.  Returns -log p(labels | probs)."""
+    T, C = log_probs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((S,), jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    S_len = 2 * label_len + 1
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+    # skip-connection allowed where ext[s] != ext[s-2] (and not blank)
+    can_skip = jnp.concatenate(
+        [jnp.zeros((2,), bool), (ext[2:] != ext[:-2]) & (ext[2:] != 0)])
+    alpha0 = jnp.full((S,), neg_inf).at[0].set(log_probs[0, 0])
+    alpha0 = alpha0.at[1].set(
+        jnp.where(label_len > 0, log_probs[0, ext[1]], neg_inf))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((1,), neg_inf), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + log_probs[t, ext]
+        # frames past this sample's length keep alpha frozen
+        new = jnp.where(t < T_len, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last = alpha[jnp.maximum(S_len - 1, 0)]
+    second_last = jnp.where(S_len >= 2, alpha[jnp.maximum(S_len - 2, 0)],
+                            neg_inf)
+    return -jnp.logaddexp(last, second_last)
+
+
+@register(
+    "_contrib_ctc_loss",
+    aliases=("_contrib_CTCLoss",),
+    arg_names=["data", "label"],
+    params={
+        "use_data_lengths": P("bool", False),
+        "use_label_lengths": P("bool", False),
+        "blank_label": P("str", "first", enum=["first", "last"]),
+    },
+    input_names_fn=lambda attrs: (
+        ["data", "label"]
+        + (["data_lengths"] if attrs.get("use_data_lengths") else [])
+        + (["label_lengths"] if attrs.get("use_label_lengths") else [])),
+)
+def _ctc_loss(attrs, data, label, *lengths):
+    """CTC loss.  data (T,B,C) activations (softmax applied internally),
+    label (B,L) with 0-padding; blank index 0 ('first') or C-1 ('last').
+    Output (B,) per-sample loss; fully differentiable (vjp replaces
+    warp-ctc's hand gradient)."""
+    T, B, C = data.shape
+    li = 0
+    if attrs.get("use_data_lengths"):
+        data_len = lengths[li].astype(jnp.int32)
+        li += 1
+    else:
+        data_len = jnp.full((B,), T, jnp.int32)
+    if attrs.get("use_label_lengths"):
+        label_len = lengths[li].astype(jnp.int32)
+    else:
+        label_len = jnp.sum(label > 0, axis=1).astype(jnp.int32)
+    log_probs = jax.nn.log_softmax(data, axis=-1)  # (T,B,C)
+    labels = label.astype(jnp.int32)
+    if attrs.get("blank_label") == "last":
+        # internally blank=0: rotate so class C-1 becomes 0, labels shift +1
+        log_probs = jnp.concatenate(
+            [log_probs[..., -1:], log_probs[..., :-1]], axis=-1)
+        labels = jnp.where(labels >= 0, labels + 1, labels)
+    return jax.vmap(_ctc_forward, in_axes=(1, 0, 0, 0))(
+        log_probs, labels, label_len, data_len)
+
+
+# ----------------------------------------------------------------------
+# quantize / dequantize (reference src/operator/contrib/quantize.cc)
+# ----------------------------------------------------------------------
+
+@register(
+    "_contrib_quantize",
+    arg_names=["data", "min_range", "max_range"],
+    num_outputs=3,
+    output_names=["output", "min_output", "max_output"],
+    params={"out_type": P("str", "uint8", enum=["uint8", "int8"])},
+)
+def _quantize(attrs, data, min_range, max_range):
+    """Affine-quantize float data into uint8/int8 given the float range."""
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    if attrs["out_type"] == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-8)
+    q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
+    return q.astype(dt), lo[None], hi[None]
+
+
+@register(
+    "_contrib_dequantize",
+    arg_names=["data", "min_range", "max_range"],
+    params={"out_type": P("str", "float32", enum=["float32"])},
+)
+def _dequantize(attrs, data, min_range, max_range):
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = jnp.maximum(hi - lo, 1e-8) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + lo
+
+
+# ----------------------------------------------------------------------
+# fft / ifft (reference src/operator/contrib/fft.cc — cuFFT)
+# ----------------------------------------------------------------------
+
+@register(
+    "_contrib_fft",
+    arg_names=["data"],
+    params={"compute_size": P("int", 128)},
+)
+def _fft(attrs, data):
+    """FFT along the last dim of real input (..., d) → (..., 2d) with
+    interleaved re/im, matching the reference's cuFFT layout."""
+    spec = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(*data.shape[:-1], data.shape[-1] * 2).astype(jnp.float32)
+
+
+@register(
+    "_contrib_ifft",
+    arg_names=["data"],
+    params={"compute_size": P("int", 128)},
+)
+def _ifft(attrs, data):
+    """Inverse of ``_contrib_fft``: (..., 2d) interleaved → (..., d) real.
+    Matches the reference (unnormalized cuFFT inverse: scaled by d)."""
+    d = data.shape[-1] // 2
+    c = data.reshape(*data.shape[:-1], d, 2)
+    spec = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(spec, axis=-1).real * d).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# count_sketch (reference src/operator/contrib/count_sketch.cc)
+# ----------------------------------------------------------------------
+
+@register(
+    "_contrib_count_sketch",
+    arg_names=["data", "h", "s"],
+    params={"out_dim": P("int", required=True),
+            "processing_batch_size": P("int", 32)},
+)
+def _count_sketch(attrs, data, h, s):
+    """Count sketch projection: out[:, h[i]] += s[i]*data[:, i]
+    (hash h (1,d) in [0,out_dim), signs s (1,d) in {+1,-1})."""
+    out_dim = attrs["out_dim"]
+    idx = h[0].astype(jnp.int32)
+    sign = s[0].astype(data.dtype)
+    signed = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(signed)

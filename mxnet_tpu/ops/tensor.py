@@ -962,3 +962,18 @@ def _rmspropalex_update(attrs, w, grad, n, g, delta):
         new_n - jnp.square(new_g) + attrs["epsilon"]
     )
     return w + new_delta, new_n, new_g, new_delta
+
+
+@register(
+    "smooth_l1",
+    arg_names=["data"],
+    params={"scalar": P("float", 1.0)},
+)
+def _smooth_l1(attrs, x):
+    """Huber-style smooth L1 (reference ``src/operator/tensor/
+    elemwise_unary_op.cc:smooth_l1``): 0.5*(sigma*x)^2 for |x| < 1/sigma^2,
+    |x| - 0.5/sigma^2 otherwise.  Used by SSD/RCNN bbox regression."""
+    sigma2 = attrs["scalar"] ** 2
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(x),
+                     ax - 0.5 / sigma2)

@@ -11,6 +11,10 @@ from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, local_mesh,
                    make_mesh, replicate, shard_batch)
 from . import collectives
 from .collectives import allreduce_hosts, barrier, init_process_group, rank, size
+from . import moe
+from . import pipeline
+from .moe import init_moe_params, moe_ffn
+from .pipeline import PipelinedTrainer, pipeline_apply, stack_stage_params
 
 # the "active" mesh ops consult at trace time (ring attention's shard_map);
 # scoped via default_mesh() by ShardedTrainer, or installed by the user

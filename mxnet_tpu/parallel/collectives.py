@@ -30,6 +30,10 @@ def init_process_group(coordinator_address=None, num_processes=None, process_id=
     coordinator_address = coordinator_address or os.environ.get("MXNET_TPU_COORDINATOR")
     if coordinator_address is None:
         return  # single-process mode
+    if os.environ.get("_MXNET_TPU_DIST_READY"):
+        # the package-import bootstrap (mxnet_tpu/__init__.py) already ran
+        _INITIALIZED["v"] = True
+        return
     num_processes = num_processes or int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
     process_id = process_id if process_id is not None else int(
         os.environ.get("MXNET_TPU_PROC_ID", "0"))
@@ -53,22 +57,13 @@ def allreduce_hosts(array):
     """
     if jax.process_count() == 1:
         return array
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
 
-    devs = _np.array(jax.devices())  # globally visible devices, all hosts
-    mesh = Mesh(devs, ("workers",))
-    # each process contributes its local array; form a global batch then sum
-    stacked = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("workers")),
-        _np.asarray(array)[None],
-        (len(devs),) + tuple(array.shape),
-    )
-
-    @jax.jit
-    def _sum(x):
-        return jnp.sum(x, axis=0)
-
-    return _sum(stacked)
+    # gather every process's contribution then sum: one cross-process
+    # all-gather on the global mesh (multihost_utils handles the
+    # host-local -> global array plumbing)
+    stacked = multihost_utils.process_allgather(_np.asarray(array))
+    return jnp.sum(jnp.asarray(stacked), axis=0)
 
 
 def barrier():

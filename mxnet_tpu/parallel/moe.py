@@ -1,0 +1,85 @@
+"""Mixture-of-Experts with expert parallelism over an ``expert`` mesh axis.
+
+Capability-gap item (SURVEY.md §2.4 "NOT present": expert parallelism).
+TPU-first design: GShard/Switch-style top-k routing with a fixed expert
+capacity so every shape is static, dispatch/combine as einsums, and the
+expert dimension annotated with ``with_sharding_constraint`` — GSPMD then
+inserts the all-to-alls that move tokens from data-sharded to
+expert-sharded layout and back (the scaling-book recipe: annotate, let XLA
+place collectives on ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["moe_ffn", "init_moe_params", "router_top1"]
+
+
+def router_top1(logits, capacity):
+    """Switch top-1 router.  logits (T, E) → dispatch (T, E, C) one-hot,
+    combine (T, E, C) gate-weighted, aux load-balancing loss (scalar).
+    Tokens over a full expert buffer are dropped (standard capacity
+    semantics)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)            # (T,)
+    gate = jnp.max(probs, axis=-1)                 # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)  # (T,E)
+    # position of each token within its expert's buffer (arrival order)
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot     # (T,E)
+    pos = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (T,)
+    keep = pos < capacity
+    dispatch = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
+        pos, capacity, dtype=logits.dtype)[:, None, :]       # (T,E,C)
+    combine = dispatch * gate[:, None, None]
+    # GShard aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+    return dispatch, combine, aux_loss
+
+
+def init_moe_params(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1 = (2.0 / d_model) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts), dtype) * s1,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden),
+                                dtype) * s1,
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model), dtype)
+        * (2.0 / d_hidden) ** 0.5,
+    }
+
+
+def moe_ffn(params, x, *, capacity_factor=2.0, expert_axis="expert",
+            mesh=None):
+    """Expert-parallel FFN:  x (B, S, d) → (B, S, d), plus aux loss.
+
+    Inside jit over a mesh with an ``expert`` axis, the sharding constraints
+    below make GSPMD all-to-all the (E, C, d) expert buffers onto the expert
+    axis, run each expert's matmuls on its own devices, and all-to-all back.
+    Without a mesh (or without the axis) it's a plain dense MoE — same math,
+    no collectives, so unit tests can diff the two paths.
+    """
+    B, S, d = x.shape
+    E = params["w1"].shape[0]
+    tokens = x.reshape(B * S, d)
+    capacity = max(int(capacity_factor * B * S / E), 1)
+    logits = tokens @ params["router"]
+    dispatch, combine, aux_loss = router_top1(logits, capacity)
+    # (T,E,C) x (T,d) → expert buffers (E,C,d)
+    buf = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    if mesh is not None and expert_axis in mesh.axis_names:
+        buf = jax.lax.with_sharding_constraint(
+            buf, jax.sharding.NamedSharding(mesh, P(expert_axis, None, None)))
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, params["w1"]))
+    out_buf = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+    if mesh is not None and expert_axis in mesh.axis_names:
+        out_buf = jax.lax.with_sharding_constraint(
+            out_buf,
+            jax.sharding.NamedSharding(mesh, P(expert_axis, None, None)))
+    out = jnp.einsum("tec,ecd->td", combine, out_buf)
+    return out.reshape(B, S, d), aux_loss

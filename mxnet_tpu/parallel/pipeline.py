@@ -1,0 +1,143 @@
+"""Pipeline parallelism — GPipe-style microbatched stage pipeline over a
+``pipe`` mesh axis.
+
+Capability-gap item (SURVEY.md §2.4 "NOT present": true pipeline
+parallelism; the reference only gets op-level dataflow overlap from its
+async engine).  TPU-first design: the canonical shard_map + ``ppermute``
+rotation schedule — each device owns one stage's weights (stacked pytree,
+leading stage axis sharded over ``pipe``), activations rotate along the ICI
+ring each tick, and the whole schedule is one jitted computation.
+Differentiating through it gives the reverse (backward) pipeline
+automatically: the transpose of ``ppermute`` is the reverse rotation, so
+grads flow stage-to-stage without hand-written scheduling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+import inspect as _inspect
+
+# replication checking kw was renamed check_rep -> check_vma in jax 0.8
+_CHECK_KW = ("check_vma" if "check_vma"
+             in _inspect.signature(shard_map).parameters else "check_rep")
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params", "PipelinedTrainer"]
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage pytrees into one pytree with a leading stage axis
+    (to be sharded over ``pipe``)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *stage_params_list)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, *, mesh: Mesh,
+                   n_microbatch: int, axis: str = "pipe"):
+    """Run ``x`` through S pipelined stages of ``stage_fn``.
+
+    stage_fn(params_i, x_mb) -> y_mb, applied S times in sequence, where
+    ``stacked_params`` has leading axis S == mesh.shape[axis].  ``x`` is the
+    global batch (B, ...); it is split into ``n_microbatch`` microbatches
+    which flow through the stage ring GPipe-style: total ticks =
+    n_microbatch + S - 1, with activations rotated one hop per tick.
+
+    Returns the full output batch (B, ...), replicated across ``axis``
+    (shard it downstream as needed).  All stages must preserve the
+    microbatch shape (homogeneous-block pipelines — transformer stacks).
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    assert B % n_microbatch == 0, "batch must divide into microbatches"
+    mb = B // n_microbatch
+
+    def per_device(params, xs):
+        # params: (1, ...) this device's stage slice; xs: full batch
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage_idx = lax.axis_index(axis)
+        xs = xs.reshape(n_microbatch, mb, *xs.shape[1:])
+        n_ticks = n_microbatch + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            cur_in, acc = carry
+            # stage 0 ingests microbatch t (garbage after the last one —
+            # masked out of the output accumulation below)
+            feed = xs[jnp.minimum(t, n_microbatch - 1)]
+            cur_in = jnp.where(stage_idx == 0, feed, cur_in)
+            y = stage_fn(params, cur_in)
+            # last stage banks its finished microbatch t-(S-1)
+            done = (stage_idx == S - 1) & (t >= S - 1)
+            slot = jnp.clip(t - (S - 1), 0, n_microbatch - 1)
+            acc = lax.cond(
+                done, lambda a: a.at[slot].set(y), lambda a: a, acc)
+            nxt = lax.ppermute(y, axis, perm)
+            return (nxt, acc), None
+
+        init = (jnp.zeros((mb,) + xs.shape[2:], x.dtype),
+                jnp.zeros((n_microbatch, mb) + xs.shape[2:], x.dtype))
+        (_, acc), _ = lax.scan(tick, init, jnp.arange(n_ticks))
+        # broadcast the last stage's accumulated outputs to every device
+        acc = lax.psum(jnp.where(stage_idx == S - 1, acc, 0.0), axis)
+        return acc.reshape(B, *x.shape[1:])
+
+    pspec = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params,
+        is_leaf=lambda l: isinstance(l, jnp.ndarray))
+    in_specs = (pspec, P())
+    # other mesh axes (e.g. data) stay unmapped: this helper owns only pipe
+    return shard_map(
+        per_device, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        **{_CHECK_KW: False})(stacked_params, x)
+
+
+class PipelinedTrainer:
+    """Minimal fused train step for a pipelined homogeneous-stage model:
+    embed -> S pipelined blocks -> head, with SGD update.  Demonstrates the
+    composition Module users get via ``ShardedTrainer`` elsewhere; also the
+    unit under test for the ``pipe`` mesh axis."""
+
+    def __init__(self, stage_fn, loss_fn, mesh, n_microbatch, axis="pipe",
+                 learning_rate=0.1):
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.n_microbatch = n_microbatch
+        self.axis = axis
+        self.lr = learning_rate
+        self._jit = None
+
+    def step_fn(self):
+        if self._jit is not None:
+            return self._jit
+
+        def step(stacked_params, x, target):
+            def loss(p):
+                y = pipeline_apply(self.stage_fn, p, x, mesh=self.mesh,
+                                   n_microbatch=self.n_microbatch,
+                                   axis=self.axis)
+                return self.loss_fn(y, target)
+
+            l, grads = jax.value_and_grad(loss)(stacked_params)
+            new_params = jax.tree_util.tree_map(
+                lambda w, g: w - self.lr * g, stacked_params, grads)
+            return l, new_params
+
+        self._jit = jax.jit(step, donate_argnums=(0,))
+        return self._jit
+
+    def place_params(self, stage_params_list):
+        stacked = stack_stage_params(stage_params_list)
+        shard = NamedSharding(self.mesh, P(self.axis))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, shard), stacked)

@@ -1,0 +1,141 @@
+"""Deployment predict API (parity: reference ``include/mxnet/c_predict_api.h``
++ ``src/c_api/c_predict_api.cc`` — ``MXPredCreate/SetInput/Forward/
+GetOutput/Reshape``, the amalgamation-friendly inference-only surface).
+
+TPU framing: a ``Predictor`` is one AOT-jitted forward executable per input
+shape (the ``MXNET_PREDICT_ONLY`` bind of the reference becomes an XLA
+compile), with an executable cache keyed by shape so ``reshape`` is cheap
+after first compile — the bucketing executors' trick applied to serving.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "load"]
+
+
+class Predictor(object):
+    """Forward-only model loaded from checkpoint artifacts.
+
+    Parameters
+    ----------
+    symbol_json : str — Symbol JSON (contents, not path).
+    param_bytes : bytes or dict — serialized params (``nd.save`` format) or
+        an in-memory ``{'arg:name'/'aux:name' -> NDArray}`` dict.
+    ctx : Context
+    input_shapes : dict name -> shape
+    """
+
+    def __init__(self, symbol_json, param_bytes, ctx=None, input_shapes=None,
+                 output_index=None):
+        from . import context, ndarray, symbol
+
+        self._ctx = ctx or context.current_context()
+        self.symbol = symbol.load_json(symbol_json)
+        if isinstance(param_bytes, dict):
+            saved = param_bytes
+        else:
+            saved = ndarray.load_frombuffer(param_bytes)
+        self._arg_params, self._aux_params = {}, {}
+        for k, v in saved.items():
+            if k.startswith("arg:"):
+                self._arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self._aux_params[k[4:]] = v
+            else:
+                self._arg_params[k] = v
+        if not input_shapes:
+            raise MXNetError("input_shapes required")
+        self._input_shapes = dict(input_shapes)
+        self._exec_cache = {}
+        self._inputs = {n: None for n in self._input_shapes}
+        self._output_index = output_index
+        self._bind()
+
+    # -- executor cache ------------------------------------------------
+    def _bind(self):
+        from . import ndarray
+
+        key = tuple(sorted((n, tuple(s))
+                           for n, s in self._input_shapes.items()))
+        if key not in self._exec_cache:
+            # place loaded params on the serving device (checkpoint loads
+            # land on host; every array must live on self._ctx before bind)
+            args = {n: v.as_in_context(self._ctx)
+                    for n, v in self._arg_params.items()}
+            aux = {n: v.as_in_context(self._ctx)
+                   for n, v in self._aux_params.items()}
+            for n, s in self._input_shapes.items():
+                args[n] = ndarray.zeros(s, ctx=self._ctx)
+            # loss-layer label args have no saved params: zero-fill at their
+            # inferred shapes (the reference's predict-only bind does the
+            # same — labels are dead inputs in inference)
+            missing = [n for n in self.symbol.list_arguments()
+                       if n not in args]
+            if missing:
+                arg_shapes, _, _ = self.symbol.infer_shape(
+                    **{n: tuple(s) for n, s in self._input_shapes.items()})
+                shape_map = dict(zip(self.symbol.list_arguments(),
+                                     arg_shapes))
+                for n in missing:
+                    if shape_map.get(n) is None:
+                        raise MXNetError(
+                            "missing param %r with uninferrable shape" % n)
+                    args[n] = ndarray.zeros(shape_map[n], ctx=self._ctx)
+            self._exec_cache[key] = self.symbol.bind(
+                self._ctx, args, aux_states=aux, grad_req="null")
+        self._exec = self._exec_cache[key]
+
+    def reshape(self, input_shapes):
+        """Rebind for new input shapes (parity: ``MXPredReshape``); cached
+        per shape like bucketing executors."""
+        self._input_shapes = dict(input_shapes)
+        self._bind()
+
+    # -- the MXPred* surface -------------------------------------------
+    def set_input(self, name, value):
+        """(parity: ``MXPredSetInput``)"""
+        from . import ndarray
+
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %r" % name)
+        value = _np.asarray(value, dtype=_np.float32)
+        if tuple(value.shape) != tuple(self._input_shapes[name]):
+            self.reshape({**self._input_shapes, name: value.shape})
+        self._exec.arg_dict[name][:] = ndarray.array(value, ctx=self._ctx)
+
+    def forward(self, **inputs):
+        """(parity: ``MXPredForward``); optional inputs by kwarg."""
+        for n, v in inputs.items():
+            self.set_input(n, v)
+        self._exec.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        """(parity: ``MXPredGetOutput``) → numpy array.  When the Predictor
+        was built with ``output_index``, the view is scoped to that single
+        output (``MXPredCreatePartialOut`` semantics)."""
+        if self._output_index is not None:
+            assert index == 0, "output_index-scoped predictor has 1 output"
+            index = self._output_index
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        if self._output_index is not None:
+            return 1
+        return len(self._exec.outputs)
+
+
+def load(prefix, epoch, ctx=None, input_shapes=None):
+    """Build a Predictor straight from ``save_checkpoint`` artifacts
+    (``prefix-symbol.json`` + ``prefix-%04d.params``)."""
+    with open("%s-symbol.json" % prefix) as f:
+        symbol_json = f.read()
+    with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+        param_bytes = f.read()
+    return Predictor(symbol_json, param_bytes, ctx=ctx,
+                     input_shapes=input_shapes)

@@ -65,7 +65,6 @@ class MXRecordIO(object):
                     self.uri.encode())
             self.handle = None if self._nh else open(self.uri, "rb")
             self.writable = False
-            self._read_pos = 0
         else:
             raise ValueError("Invalid flag %s" % self.flag)
 
@@ -91,7 +90,7 @@ class MXRecordIO(object):
         if self._nh:
             if self.writable:
                 return self._nlib.mxtpu_recordio_writer_tell(self._nh)
-            return self._read_pos  # tracked: native reader has no ftell hook
+            return self._nlib.mxtpu_recordio_reader_tell(self._nh)
         return self.handle.tell()
 
     def write(self, buf):
@@ -119,9 +118,7 @@ class MXRecordIO(object):
             r = self._nlib.mxtpu_recordio_reader_next(
                 self._nh, ctypes.byref(out), ctypes.byref(n))
             if r == 1:
-                buf = _native.buf_to_bytes(self._nlib, out, n.value)
-                self._read_pos += 8 + len(buf) + (4 - len(buf) % 4) % 4
-                return buf
+                return _native.buf_to_bytes(self._nlib, out, n.value)
             if r == 0:
                 return None
             raise IOError("Invalid RecordIO magic number")

@@ -96,6 +96,7 @@ MXTPU_DLL void *mxtpu_recordio_reader_open(const char *path);
 /* 1 = record produced (malloc'd *out, caller frees via mxtpu_buf_free),
  * 0 = eof, -1 = error. */
 MXTPU_DLL int mxtpu_recordio_reader_next(void *h, char **out, size_t *len);
+MXTPU_DLL long mxtpu_recordio_reader_tell(void *h);
 MXTPU_DLL void mxtpu_recordio_reader_close(void *h);
 
 /* Threaded prefetching loader: background thread reads + shards + (chunk)

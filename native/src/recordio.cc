@@ -251,6 +251,10 @@ int mxtpu_recordio_reader_next(void *h, char **out, size_t *len) {
   return 1;
 }
 
+long mxtpu_recordio_reader_tell(void *h) {
+  return std::ftell(((::mxtpu::Reader *)h)->f);
+}
+
 void mxtpu_recordio_reader_close(void *h) {
   auto *r = (::mxtpu::Reader *)h;
   std::fclose(r->f);

@@ -1,0 +1,50 @@
+"""Multi-process dist_sync kvstore worker script (parity: reference
+``tests/nightly/dist_sync_kvstore.py:14-45`` — exact-arithmetic assertions on
+sync push/pull, launched as N local processes via ``tools/launch.py``)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    init_process_group()
+    kv = mx.kv.create("dist_sync")
+    rank, nworkers = kv.rank, kv.num_workers
+    assert nworkers == int(os.environ.get("MXNET_TPU_NUM_PROCS", "1")), \
+        (nworkers, os.environ.get("MXNET_TPU_NUM_PROCS"))
+
+    shape = (3, 4)
+    big_shape = (50, 100)  # the big-array striping case of the reference
+    kv.init("3", mx.nd.ones(shape))
+    kv.init("99", mx.nd.ones(big_shape))
+
+    nrepeat = 3
+    for i in range(nrepeat):
+        kv.push("3", mx.nd.ones(shape) * (rank + 1))
+        kv.push("99", mx.nd.ones(big_shape) * (rank + 1))
+        kv.barrier()
+
+    # default updater accumulates: expected = 1 + nrepeat * sum(1..W)
+    expected = 1 + nrepeat * sum(range(1, nworkers + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("3", out=out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full(shape, expected, np.float32))
+    out_big = mx.nd.zeros(big_shape)
+    kv.pull("99", out=out_big)
+    np.testing.assert_array_equal(out_big.asnumpy(),
+                                  np.full(big_shape, expected, np.float32))
+    print("worker %d/%d: dist_sync kvstore OK (expected=%d)"
+          % (rank, nworkers, expected))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,242 @@
+"""contrib op tests (reference tier: ``tests/python/unittest/test_operator.py``
+contrib sections — MultiBox*, Proposal, CTC, quantize, FFT — checked against
+inline numpy references, same strategy as ``check_symbolic_forward``)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, dtype=np.float32))
+
+
+def np_iou(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_multibox_prior_shapes_and_values():
+    data = _nd(np.zeros((1, 3, 4, 6)))
+    out = contrib.nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    a = out.asnumpy()
+    # A = len(sizes)+len(ratios)-1 = 3 anchors per pixel
+    assert a.shape == (1, 4 * 6 * 3, 4)
+    # first anchor at pixel (0,0): center ((0+.5)/6, (0+.5)/4), size 0.5
+    np.testing.assert_allclose(
+        a[0, 0], [0.5 / 6 - 0.25, 0.5 / 4 - 0.25,
+                  0.5 / 6 + 0.25, 0.5 / 4 + 0.25], rtol=1e-5)
+    # second anchor: size 0.25
+    np.testing.assert_allclose(
+        a[0, 1], [0.5 / 6 - 0.125, 0.5 / 4 - 0.125,
+                  0.5 / 6 + 0.125, 0.5 / 4 + 0.125], rtol=1e-5)
+    # third anchor: size 0.5 ratio 2 → w=0.5*sqrt(2)/2, h=0.5/sqrt(2)/2
+    w, h = 0.5 * np.sqrt(2) / 2, 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(
+        a[0, 2], [0.5 / 6 - w, 0.5 / 4 - h, 0.5 / 6 + w, 0.5 / 4 + h],
+        rtol=1e-5)
+
+
+def test_multibox_target_matching():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.4, 0.4, 0.6, 0.6]]], dtype=np.float32)
+    # one GT overlapping anchor 0 well, class 2
+    label = np.array([[[2.0, 0.05, 0.05, 0.45, 0.45],
+                       [-1, 0, 0, 0, 0]]], dtype=np.float32)
+    cls_pred = np.zeros((1, 4, 4), dtype=np.float32)
+    loc_t, loc_m, cls_t = contrib.nd.MultiBoxTarget(
+        _nd(anchors), _nd(label), _nd(cls_pred), overlap_threshold=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 3.0          # matched → class+1
+    assert all(cls_t[1:] == 0.0)    # others background
+    m = loc_m.asnumpy()[0].reshape(4, 4)
+    assert m[0].sum() == 4 and m[1:].sum() == 0
+    # encoded loc target matches the manual formula
+    t = loc_t.asnumpy()[0].reshape(4, 4)[0]
+    aw = ah = 0.5
+    gcx = gcy = 0.25; acx = acy = 0.25
+    gw = gh = 0.4
+    np.testing.assert_allclose(
+        t, [(gcx - acx) / aw / 0.1, (gcy - acy) / ah / 0.1,
+            np.log(gw / aw) / 0.2, np.log(gh / ah) / 0.2],
+        rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    A = 10
+    anchors = np.zeros((1, A, 4), dtype=np.float32)
+    anchors[0, :, 2:] = 0.1  # tiny boxes at origin
+    anchors[0, 0] = [0.0, 0.0, 0.5, 0.5]
+    label = np.array([[[1.0, 0.0, 0.0, 0.5, 0.5]]], dtype=np.float32)
+    cls_pred = np.random.RandomState(0).rand(1, 3, A).astype(np.float32)
+    _, _, cls_t = contrib.nd.MultiBoxTarget(
+        _nd(anchors), _nd(label), _nd(cls_pred),
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 2.0
+    # 1 positive → 3 negatives kept, rest ignored (-1)
+    assert (cls_t == 0).sum() == 3
+    assert (cls_t == -1).sum() == A - 4
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.9, 0.9]]], dtype=np.float32)
+    # zero loc_pred → boxes = anchors
+    loc_pred = np.zeros((1, 12), dtype=np.float32)
+    cls_prob = np.array([[[0.1, 0.2, 0.05],     # background
+                          [0.8, 0.7, 0.1],      # class 0
+                          [0.1, 0.1, 0.85]]],   # class 1
+                        dtype=np.float32)
+    out = contrib.nd.MultiBoxDetection(
+        _nd(cls_prob), _nd(loc_pred), _nd(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    # anchor1 suppressed by anchor0 (same class, IoU high); anchor2 kept
+    r0, r1, r2 = out[0], out[1], out[2]
+    assert r0[0] == 0.0 and abs(r0[1] - 0.8) < 1e-6
+    np.testing.assert_allclose(r0[2:], anchors[0, 0], atol=1e-5)
+    assert r1[0] == -1.0
+    assert r2[0] == 1.0 and abs(r2[1] - 0.85) < 1e-6
+    np.testing.assert_allclose(r2[2:], anchors[0, 2], atol=1e-5)
+
+
+def test_multibox_detection_variance_decode():
+    anchors = np.array([[[0.2, 0.2, 0.4, 0.4]]], dtype=np.float32)
+    loc = np.array([[1.0, 0.5, 0.2, -0.2]], dtype=np.float32).reshape(1, 4)
+    cls_prob = np.array([[[0.1], [0.9]]], dtype=np.float32)
+    out = contrib.nd.MultiBoxDetection(
+        _nd(cls_prob), _nd(loc), _nd(anchors), clip=False).asnumpy()[0][0]
+    aw = ah = 0.2; acx = acy = 0.3
+    cx = acx + 1.0 * 0.1 * aw
+    cy = acy + 0.5 * 0.1 * ah
+    w = np.exp(0.2 * 0.2) * aw / 2
+    h = np.exp(-0.2 * 0.2) * ah / 2
+    np.testing.assert_allclose(out[2:], [cx - w, cy - h, cx + w, cy + h],
+                               rtol=1e-4)
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    B, K, H, W = 1, 3, 4, 4
+    cls_prob = rng.rand(B, 2 * K, H, W).astype(np.float32)
+    bbox_pred = (rng.rand(B, 4 * K, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64.0, 64.0, 1.0]], dtype=np.float32)
+    rois = contrib.nd.Proposal(
+        _nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+        feature_stride=16, scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8,
+        rpn_min_size=4).asnumpy()
+    assert rois.shape == (8, 5)
+    assert (rois[:, 0] == 0).all()
+    # boxes inside the image and non-degenerate
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 63).all()
+    assert (rois[:, 3] >= rois[:, 1]).all() and (rois[:, 4] >= rois[:, 2]).all()
+
+
+def _brute_force_ctc(probs, labels):
+    """Sum of path probabilities over all valid alignments (tiny cases)."""
+    import itertools
+    T, C = probs.shape
+
+    def collapse(path):
+        out = []
+        prev = None
+        for p in path:
+            if p != prev and p != 0:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(labels):
+            p = 1.0
+            for t, k in enumerate(path):
+                p *= probs[t, k]
+            total += p
+    return -np.log(total)
+
+
+def test_ctc_loss_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    T, B, C = 4, 2, 3
+    data = rng.randn(T, B, C).astype(np.float32)
+    label = np.array([[1, 2], [1, 0]], dtype=np.float32)  # 0 = padding
+    loss = contrib.nd.ctc_loss(_nd(data), _nd(label)).asnumpy()
+    probs = np.exp(data) / np.exp(data).sum(-1, keepdims=True)
+    want0 = _brute_force_ctc(probs[:, 0], [1, 2])
+    want1 = _brute_force_ctc(probs[:, 1], [1])
+    np.testing.assert_allclose(loss, [want0, want1], rtol=1e-4)
+
+
+def test_ctc_loss_grad_finite():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op("_contrib_ctc_loss")
+    attrs = op.parse_attrs({})
+    rng = np.random.RandomState(1)
+    data = jnp.asarray(rng.randn(5, 1, 4).astype(np.float32))
+    label = jnp.asarray(np.array([[2, 3, 0]], dtype=np.float32))
+
+    def f(d):
+        (out,), _ = op.apply(attrs, [d, label])
+        return out.sum()
+
+    g = jax.grad(f)(data)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-3, 5, (4, 7)).astype(np.float32)
+    q, lo, hi = contrib.nd.quantize(
+        _nd(data), _nd([-3.0]), _nd([5.0]), out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = contrib.nd.dequantize(q, lo, hi).asnumpy()
+    assert np.abs(back - data).max() < (5 - (-3)) / 255.0 * 0.51 + 1e-6
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    data = rng.randn(3, 8).astype(np.float32)
+    spec = contrib.nd.fft(_nd(data)).asnumpy()
+    assert spec.shape == (3, 16)
+    want = np.fft.fft(data, axis=-1)
+    np.testing.assert_allclose(spec[:, 0::2], want.real, atol=1e-4)
+    np.testing.assert_allclose(spec[:, 1::2], want.imag, atol=1e-4)
+    # unnormalized inverse (reference cuFFT semantics): ifft(fft(x)) = d*x
+    back = contrib.nd.ifft(mx.nd.array(spec)).asnumpy()
+    np.testing.assert_allclose(back, data * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(0)
+    N, d, out_dim = 2, 6, 4
+    data = rng.randn(N, d).astype(np.float32)
+    h = rng.randint(0, out_dim, (1, d)).astype(np.float32)
+    s = (rng.randint(0, 2, (1, d)) * 2 - 1).astype(np.float32)
+    out = contrib.nd.count_sketch(
+        _nd(data), _nd(h), _nd(s), out_dim=out_dim).asnumpy()
+    want = np.zeros((N, out_dim), dtype=np.float32)
+    for i in range(d):
+        want[:, int(h[0, i])] += s[0, i] * data[:, i]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_ops_in_symbol_graph():
+    # contrib ops compose into Symbol graphs like any op
+    data = mx.sym.Variable("data")
+    prior = contrib.sym.MultiBoxPrior(data, sizes=(0.3,), ratios=(1.0,))
+    ex = prior.bind(mx.cpu(), {"data": _nd(np.zeros((1, 3, 2, 2)))})
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 4, 4)

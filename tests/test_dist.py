@@ -1,0 +1,30 @@
+"""Distributed kvstore tests through the real launcher (reference strategy:
+``tests/nightly/test_all.sh:37`` runs ``../../tools/launch.py -n 4 python
+dist_sync_kvstore.py`` — a simulated cluster of N local processes)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, script, timeout=240):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXNET_TPU_", "XLA_FLAGS"))}
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", str(n), sys.executable, script],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO)
+
+
+@pytest.mark.parametrize("n", [2])
+def test_dist_sync_kvstore_via_launcher(n):
+    r = _launch(n, os.path.join(_REPO, "tests", "dist",
+                                "dist_sync_kvstore.py"))
+    ok_lines = [l for l in r.stdout.splitlines() if "dist_sync kvstore OK" in l]
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert len(ok_lines) == n, r.stdout + "\n" + r.stderr

@@ -1,0 +1,79 @@
+"""SSD model tests (reference tier: ``example/ssd`` configs exercised in
+``tests/python/unittest`` style — train symbol fwd/bwd/update + detection
+symbol sharing the trained weights)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+def _toy_batch(B=2, M=3, size=32):
+    rng = np.random.RandomState(0)
+    data = rng.rand(B, 3, size, size).astype(np.float32)
+    label = -np.ones((B, M, 5), np.float32)
+    label[0, 0] = [1, 0.1, 0.1, 0.5, 0.5]
+    label[1, 0] = [0, 0.3, 0.3, 0.8, 0.8]
+    return data, label
+
+
+def test_ssd_train_and_detect_roundtrip():
+    B = 2
+    data, label = _toy_batch(B)
+    net = ssd.get_symbol_train(num_classes=3, num_scales=2, small=True,
+                               use_bn=True)
+    mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                        label_names=("label",))
+    it = mx.io.NDArrayIter({"data": data}, {"label": label}, batch_size=B,
+                           label_name="label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    batch = next(iter(it))
+    losses = []
+    for _ in range(4):
+        mod.forward(batch)
+        cls_prob, loc_loss, cls_target, _ = [
+            o.asnumpy() for o in mod.get_outputs()]
+        # positives exist for both images (forced matching guarantees it)
+        assert (cls_target > 0).any(axis=1).all()
+        losses.append(loc_loss.sum())
+        mod.backward()
+        mod.update()
+    assert np.isfinite(losses).all()
+
+    det_sym = ssd.get_symbol(num_classes=3, num_scales=2, small=True,
+                             use_bn=True)
+    det = mx.mod.Module(det_sym, context=mx.cpu(), data_names=("data",),
+                        label_names=())
+    det.bind(data_shapes=[("data", (B, 3, 32, 32))], for_training=False)
+    det.set_params(*mod.get_params())
+    det.forward(mx.io.DataBatch([mx.nd.array(data)]), is_train=False)
+    out = det.get_outputs()[0].asnumpy()
+    A = out.shape[1]
+    assert out.shape == (B, A, 6)
+    kept = out[out[:, :, 0] >= 0]
+    # detections are well-formed: class in range, boxes ordered, score in (0,1]
+    assert kept.size > 0
+    assert ((kept[:, 0] >= 0) & (kept[:, 0] < 3)).all()
+    assert (kept[:, 1] > 0).all() and (kept[:, 1] <= 1).all()
+    assert (kept[:, 4] >= kept[:, 2]).all() and (kept[:, 5] >= kept[:, 3]).all()
+
+
+def test_ssd_checkpoint_roundtrip(tmp_path):
+    net = ssd.get_symbol_train(num_classes=2, num_scales=2, small=True)
+    mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",),
+                        label_names=("label",))
+    data, label = _toy_batch(2)
+    it = mx.io.NDArrayIter({"data": data}, {"label": label}, batch_size=2,
+                           label_name="label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "ssd")
+    mod.save_checkpoint(prefix, 1)
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    assert sorted(sym2.list_arguments()) == sorted(net.list_arguments())
+    a1, x1 = mod.get_params()
+    for k in a1:
+        np.testing.assert_array_equal(a1[k].asnumpy(), args2[k].asnumpy())

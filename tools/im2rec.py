@@ -1,0 +1,147 @@
+"""im2rec — pack an image directory/list into RecordIO (parity: reference
+``tools/im2rec.py`` + ``tools/im2rec.cc``; same .lst format
+``index\\tlabel[s]\\tpath`` and .rec/.idx output, readable by
+``mx.io.ImageRecordIter``).
+
+The bulk write path goes through the native C++ recordio writer
+(``native/src/recordio.cc``) when built.  Image encode uses the framework's
+``image.imencode`` (PNG/npy — no OpenCV dependency in this build).
+
+Usage:
+    python tools/im2rec.py prefix image_root --list          # make .lst
+    python tools/im2rec.py prefix image_root                  # pack .rec/.idx
+"""
+
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".npy")
+
+
+def list_images(root, recursive):
+    i = 0
+    cat = {}
+    if recursive:
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                label_dir = os.path.relpath(path, root).split(os.sep)[0]
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield i, os.path.relpath(os.path.join(path, fname), root), \
+                    cat[label_dir]
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield i, fname, 0
+                i += 1
+
+
+def write_list(args):
+    entries = list(list_images(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    n_train = int(len(entries) * args.train_ratio)
+    chunks = {"train": entries[:n_train], "val": entries[n_train:]} \
+        if args.train_ratio < 1.0 else {"": entries}
+    for suffix, chunk in chunks.items():
+        if not chunk:
+            continue
+        name = args.prefix + ("_" + suffix if suffix else "") + ".lst"
+        with open(name, "w") as f:
+            for i, (idx, path, label) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, float(label), path))
+        print("wrote %s (%d entries)" % (name, len(chunk)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, parts[-1], labels
+
+
+def _load_image(path):
+    from mxnet_tpu.image import imread
+
+    return imread(path)
+
+
+def write_record(args, lst_path):
+    out_prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(out_prefix + ".idx",
+                                     out_prefix + ".rec", "w")
+    count = 0
+    for idx, rel_path, labels in read_list(lst_path):
+        img = _load_image(os.path.join(args.root, rel_path))
+        if args.resize:
+            from mxnet_tpu.image import resize_short
+
+            img = resize_short(img, args.resize)
+        label = labels[0] if len(labels) == 1 else np.array(labels)
+        packed = recordio.pack_img((0, label, idx, 0), img,
+                                   quality=args.quality,
+                                   img_fmt=args.encoding)
+        rec.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    rec.close()
+    print("wrote %s.rec / .idx (%d records)" % (out_prefix, count))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="make a RecordIO dataset from images",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("prefix", help="output prefix (or .lst path)")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", action="store_true",
+                        help="generate .lst only")
+    parser.add_argument("--recursive", action="store_true",
+                        help="label = top-level subdir index")
+    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", type=str, default=".png",
+                        choices=[".png", ".npy"])
+    args = parser.parse_args()
+
+    if args.list:
+        write_list(args)
+        return
+    if os.path.isfile(args.prefix) and args.prefix.endswith(".lst"):
+        lsts = [args.prefix]
+    else:
+        d = os.path.dirname(args.prefix) or "."
+        base = os.path.basename(args.prefix)
+        lsts = [os.path.join(d, f) for f in sorted(os.listdir(d))
+                if f.startswith(base) and f.endswith(".lst")]
+    if not lsts:
+        sys.exit("no .lst found for prefix %s (run with --list first)"
+                 % args.prefix)
+    for lst in lsts:
+        write_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Distributed launch tool (parity: reference ``tools/launch.py`` — the
+dmlc-core tracker that spawns scheduler/server/worker processes and wires
+their env).
+
+TPU-native topology has no separate server/scheduler roles: every worker
+runs the same SPMD program under ``jax.distributed`` with process 0 hosting
+the coordination service.  This launcher covers the reference's ``local``
+("simulated cluster = N local processes", the tests/nightly strategy) and
+ssh modes:
+
+    python tools/launch.py -n 4 python my_training_script.py
+    python tools/launch.py -n 4 --launcher ssh -H hostfile python script.py
+
+Env handed to each process (the DMLC_PS_ROOT_URI / DMLC_ROLE analogs):
+``MXNET_TPU_COORDINATOR``, ``MXNET_TPU_NUM_PROCS``, ``MXNET_TPU_PROC_ID``;
+scripts pick them up via ``mxnet_tpu.parallel.init_process_group()``.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, cmd):
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for i in range(args.num_workers):
+        env = dict(os.environ)
+        env["MXNET_TPU_COORDINATOR"] = coordinator
+        env["MXNET_TPU_NUM_PROCS"] = str(args.num_workers)
+        env["MXNET_TPU_PROC_ID"] = str(i)
+        # each local worker gets its own CPU "chip" (the one-host simulated
+        # cluster of tests/nightly); --platform overrides, e.g. for a real
+        # one-process-per-host TPU launch
+        env["JAX_PLATFORMS"] = args.platform
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    try:
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    return code
+
+
+def launch_ssh(args, cmd):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    assert len(hosts) >= args.num_workers, "hostfile too small"
+    coordinator = "%s:%d" % (hosts[0], args.port or _free_port())
+    procs = []
+    for i in range(args.num_workers):
+        env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
+               "MXNET_TPU_PROC_ID=%d" % (coordinator, args.num_workers, i))
+        remote = "cd %s && %s %s" % (os.getcwd(), env, " ".join(cmd))
+        procs.append(subprocess.Popen(["ssh", hosts[i], remote]))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="launch a distributed job",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--platform", type=str, default="cpu",
+                        help="JAX platform for local workers")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher == "ssh":
+        sys.exit(launch_ssh(args, args.command))
+    sys.exit(launch_local(args, args.command))
+
+
+if __name__ == "__main__":
+    main()
