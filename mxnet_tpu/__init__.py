@@ -71,6 +71,9 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import predict
 from . import engine
+from . import rtc
+from . import torch_bridge
+from . import torch_bridge as th
 from . import parallel
 from . import contrib
 from . import models

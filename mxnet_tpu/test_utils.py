@@ -248,8 +248,8 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
         sym_list = [sym_] * len(ctx_list)
     else:
         sym_list = sym_
-    output_points = None
     results = []
+    data_vals = {}  # one draw shared by every context (incl. data inputs)
     for s, ctx_spec in zip(sym_list, ctx_list):
         ctx_spec = dict(ctx_spec)
         ctx = ctx_spec.pop("ctx", None) or cpu()
@@ -264,8 +264,13 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
                         size=arr.shape, scale=scale).astype(_np.float32)
         for name, arr in exe.arg_dict.items():
             if name in shapes:
-                arr[:] = _np.random.uniform(-1, 1, arr.shape) if name not in \
-                    (arg_params or {}) else arg_params[name]
+                if name in arg_params:
+                    arr[:] = arg_params[name]
+                else:
+                    if name not in data_vals:
+                        data_vals[name] = _np.random.uniform(
+                            -1, 1, arr.shape).astype(_np.float32)
+                    arr[:] = data_vals[name]
             elif name in arg_params:
                 arr[:] = arg_params[name]
         if aux_params:
@@ -280,6 +285,16 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
     for exe in results[1:]:
         for a, b in zip(out0, exe.outputs):
             assert_almost_equal(a, b.asnumpy(), rtol=tol, atol=tol)
+    if grad_req != "null":
+        # gradients must agree too (reference compares exe.grad_arrays)
+        grads0 = {n: g.asnumpy()
+                  for n, g in results[0].grad_dict.items() if g is not None}
+        for exe in results[1:]:
+            for n, g0 in grads0.items():
+                g = exe.grad_dict.get(n)
+                if g is not None:
+                    assert_almost_equal(g0, g.asnumpy(), rtol=tol, atol=tol,
+                                        names=("grad:%s" % n,) * 2)
     return results
 
 

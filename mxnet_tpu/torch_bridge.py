@@ -1,0 +1,86 @@
+"""Torch interop (parity: reference ``python/mxnet/torch.py`` +
+``plugin/torch`` — calling Torch tensor functions and nn modules on MXNet
+NDArrays).
+
+The reference binds LuaTorch through a C plugin; here the baked-in PyTorch
+(CPU) interops zero-ceremony via numpy: ``mx.th.call`` applies any
+``torch.*`` function to NDArrays; ``TorchModule`` wraps a ``torch.nn``
+module for inference inside the imperative flow.  Device arrays round-trip
+through host — torch has no TPU backend, so this is a host-side escape
+hatch exactly like the reference's CPU Torch path.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["call", "TorchModule", "available"]
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError:
+        raise MXNetError("torch is not installed")
+
+
+def available():
+    try:
+        import torch  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def call(fname, *args, **kwargs):
+    """Apply ``torch.<fname>`` to the given arrays (parity: the generated
+    ``mxnet.th.*`` wrappers).  NDArray args convert to torch tensors; NDArray
+    results convert back."""
+    torch = _torch()
+    fn = torch
+    for part in fname.split("."):
+        fn = getattr(fn, part, None)
+        if fn is None:
+            raise MXNetError("no torch function %r" % fname)
+
+    def to_t(a):
+        # copy: jax owns the source buffer; in-place torch ops (abs_, add_)
+        # must never write through into XLA memory
+        return (torch.from_numpy(a.asnumpy().copy())
+                if isinstance(a, NDArray) else a)
+
+    out = fn(*[to_t(a) for a in args],
+             **{k: to_t(v) for k, v in kwargs.items()})
+    if isinstance(out, (list, tuple)):
+        return type(out)(array(o.numpy()) if hasattr(o, "numpy") else o
+                         for o in out)
+    return array(out.numpy()) if hasattr(out, "numpy") else out
+
+
+class TorchModule(object):
+    """Wrap a ``torch.nn.Module`` for forward inference on NDArrays
+    (parity: ``plugin/torch`` TorchModuleOp)."""
+
+    def __init__(self, module):
+        import copy
+
+        torch = _torch()
+        if not isinstance(module, torch.nn.Module):
+            raise MXNetError("expected a torch.nn.Module")
+        # deep copy so eval() (and inference use) never mutates the caller's
+        # module mid-training
+        self.module = copy.deepcopy(module).eval()
+
+    def __call__(self, *inputs):
+        torch = _torch()
+        tins = [torch.from_numpy(i.asnumpy().copy()) if isinstance(i, NDArray)
+                else i for i in inputs]
+        with torch.no_grad():
+            out = self.module(*tins)
+        if isinstance(out, (list, tuple)):
+            return [array(o.numpy()) for o in out]
+        return array(out.numpy())

@@ -1,0 +1,75 @@
+"""rtc + torch-interop tests (reference tiers: ``tests/python/gpu/test_rtc.py``
+and the plugin/torch path)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rtc_plain_kernel():
+    rtc = mx.rtc.Rtc("axpy", ["x", "y"], ["out"], """
+def axpy(x, y):
+    return 2.0 * x + y
+""")
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = mx.nd.ones((2, 3))
+    out = rtc.push([a, b])
+    np.testing.assert_allclose(out.asnumpy(), 2 * a.asnumpy() + 1)
+
+
+def test_rtc_writes_outs_and_multi_output():
+    rtc = mx.rtc.Rtc("split", ["x"], ["lo", "hi"], """
+def split(x):
+    return jnp.minimum(x, 0.0), jnp.maximum(x, 0.0)
+""")
+    x = mx.nd.array(np.array([[-1.0, 2.0]], np.float32))
+    lo = mx.nd.zeros((1, 2))
+    hi = mx.nd.zeros((1, 2))
+    rtc.push([x], outs=[lo, hi])
+    np.testing.assert_allclose(lo.asnumpy(), [[-1.0, 0.0]])
+    np.testing.assert_allclose(hi.asnumpy(), [[0.0, 2.0]])
+
+
+def test_rtc_bad_source_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("f", ["x"], ["y"], "def f(x) return x")  # syntax error
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("g", ["x"], ["y"], "def f(x): return x")  # wrong name
+
+
+def test_torch_call():
+    if not mx.th.available():
+        pytest.skip("torch not installed")
+    a = mx.nd.array(np.array([[1.0, -2.0]], np.float32))
+    out = mx.th.call("abs", a)
+    np.testing.assert_allclose(out.asnumpy(), [[1.0, 2.0]])
+    s = mx.th.call("nn.functional.softmax", a, dim=1)
+    want = np.exp(a.asnumpy()) / np.exp(a.asnumpy()).sum()
+    np.testing.assert_allclose(s.asnumpy(), want, rtol=1e-5)
+
+
+def test_torch_module():
+    if not mx.th.available():
+        pytest.skip("torch not installed")
+    import torch
+
+    lin = torch.nn.Linear(4, 2)
+    tm = mx.torch_bridge.TorchModule(lin)
+    x = mx.nd.array(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    out = tm(x)
+    want = lin(torch.from_numpy(x.asnumpy())).detach().numpy()
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_check_consistency_cpu_contexts():
+    # the cross-backend consistency tier (reference test_utils.py:676
+    # check_consistency) — here cpu-vs-cpu as the always-available pair;
+    # on a TPU host the same helper compares cpu vs tpu
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    ctx_list = [
+        {"ctx": mx.cpu(0), "data": (2, 3)},
+        {"ctx": mx.cpu(0), "data": (2, 3)},
+    ]
+    mx.test_utils.check_consistency(sym, ctx_list)

@@ -1,0 +1,114 @@
+"""Train-to-accuracy integration gates (reference tier:
+``tests/python/train/{test_mlp.py,test_conv.py,test_dtype.py}`` — small
+end-to-end convergence assertions incl. dtype coverage)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _blobs(n=400, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 3.0
+    labels = rng.randint(0, k, n)
+    data = (centers[labels] + rng.randn(n, d)).astype(np.float32)
+    return data, labels.astype(np.float32), k
+
+
+def _digits(n=256, seed=0):
+    """Tiny synthetic 'mnist': the class is which quadrant lights up."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 4, n)
+    data = rng.rand(n, 1, 8, 8).astype(np.float32) * 0.1
+    for i, c in enumerate(labels):
+        y, x = divmod(int(c), 2)
+        data[i, 0, y * 4:(y + 1) * 4, x * 4:(x + 1) * 4] += 1.0
+    return data, labels.astype(np.float32)
+
+
+def _fit_and_score(sym, data, labels, batch=32, epochs=12, lr=0.1):
+    it = mx.io.NDArrayIter(data, labels, batch_size=batch, shuffle=True)
+    mod = mx.mod.Module(sym, context=mx.test_utils.default_context())
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(data, labels, batch_size=batch),
+                      "acc")
+    return score[0][1]
+
+
+def _mlp(k, dtype="float32"):
+    data = mx.sym.Variable("data")
+    if dtype != "float32":
+        data = mx.sym.Cast(data, dtype=dtype)
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    if dtype != "float32":
+        net = mx.sym.Cast(net, dtype="float32")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_mlp_converges():
+    data, labels, k = _blobs()
+    acc = _fit_and_score(_mlp(k), data, labels)
+    assert acc > 0.95, acc
+
+
+def test_mlp_bf16_converges():
+    # dtype tier (reference test_dtype.py): bf16 compute path must converge
+    data, labels, k = _blobs(seed=1)
+    acc = _fit_and_score(_mlp(k, dtype="bfloat16"), data, labels)
+    assert acc > 0.93, acc
+
+
+def test_lenet_conv_converges():
+    data, labels = _digits()
+    net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=8,
+                             kernel=(3, 3), pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    acc = _fit_and_score(net, data, labels, epochs=8, lr=0.05)
+    assert acc > 0.95, acc
+
+
+def test_resume_from_checkpoint(tmp_path):
+    # --load-epoch resume semantics (reference fit.py:24-43)
+    data, labels, k = _blobs(seed=2)
+    it = mx.io.NDArrayIter(data, labels, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(k), context=mx.cpu())
+    prefix = str(tmp_path / "ckpt")
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    it.reset()
+    mod2.fit(it, num_epoch=6, begin_epoch=3, arg_params=args,
+             aux_params=auxs, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1})
+    acc = mod2.score(mx.io.NDArrayIter(data, labels, batch_size=32), "acc")
+    assert acc[0][1] > 0.9, acc
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+def test_dtype_forward_finite(dtype):
+    # half-precision forward path (reference fp16 model variants)
+    data, labels, k = _blobs(n=64)
+    sym = _mlp(k, dtype=dtype)
+    ex = sym.bind(mx.cpu(), {
+        "data": mx.nd.array(data[:32]),
+        "fc1_weight": mx.nd.array(np.random.randn(64, 16).astype(np.float32) * 0.1),
+        "fc1_bias": mx.nd.zeros((64,)),
+        "fc2_weight": mx.nd.array(np.random.randn(k, 64).astype(np.float32) * 0.1),
+        "fc2_bias": mx.nd.zeros((k,)),
+        "softmax_label": mx.nd.array(labels[:32]),
+    })
+    out = ex.forward()[0].asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=2e-2)
