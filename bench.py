@@ -33,8 +33,10 @@ def main():
     layers = 50 if platform == "tpu" else 8
     steps = int(os.environ.get("BENCH_STEPS", "50" if platform == "tpu" else "3"))
 
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if platform == "tpu" else "NCHW")
     sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
-                            image_shape=(3, image, image), dtype="bfloat16")
+                            image_shape=(3, image, image), dtype="bfloat16",
+                            layout=layout)
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     tr = ShardedTrainer(
         sym, mesh,

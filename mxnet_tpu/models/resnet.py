@@ -7,9 +7,13 @@ The flagship benchmark model: ResNet-50 at ``image_shape=(3,224,224)`` is
 BASELINE config #2/#3 (``docs/how_to/perf.md:181-188``, 181.53 img/s train on
 1×P100).
 
-``dtype='bfloat16'`` runs activations bf16 end-to-end with fp32 MXU
-accumulation inside conv/FC, and BatchNorm statistics kept fp32 by the op —
-the TPU-native analogue of the reference's fp16 symbol variants.
+TPU-first knobs:
+- ``dtype='bfloat16'`` runs activations bf16 end-to-end with fp32 MXU
+  accumulation inside conv/FC, and BatchNorm statistics kept fp32 by the op —
+  the TPU-native analogue of the reference's fp16 symbol variants.
+- ``layout='NHWC'`` runs the whole conv stack channels-last (the TPU's
+  preferred conv layout; input is transposed once at the stem).  API inputs
+  stay NCHW for iterator compatibility.
 """
 
 from .. import symbol as sym
@@ -18,99 +22,113 @@ BN_MOM = 0.9
 BN_EPS = 2e-5
 
 
+def _layer_fns(layout, bn_mom):
+    """conv/bn/pool closures for the chosen layout."""
+    bn_axis = 3 if layout == "NHWC" else 1
+
+    def conv(**kw):
+        return sym.Convolution(layout=layout, **kw)
+
+    def bn(**kw):
+        return sym.BatchNorm(axis=bn_axis, momentum=bn_mom, eps=BN_EPS, **kw)
+
+    def pool(**kw):
+        return sym.Pooling(layout=layout, **kw)
+
+    return conv, bn, pool
+
+
 def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
-                  num_group=1, bn_mom=BN_MOM):
+                  num_group=1, bn_mom=BN_MOM, layout="NCHW"):
     """Pre-activation residual unit (v2)."""
+    conv, bn, _ = _layer_fns(layout, bn_mom)
     if bottle_neck:
         # resnext (grouped) bottlenecks are twice as wide: 0.5x vs 0.25x
         # (reference resnext.py int(num_filter*0.5) vs resnet.py 0.25)
         width = num_filter // 2 if num_group > 1 else num_filter // 4
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=BN_EPS,
-                            momentum=bn_mom, name=name + "_bn1")
+        bn1 = bn(data=data, fix_gamma=False, name=name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=width,
-                                kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=BN_EPS,
-                            momentum=bn_mom, name=name + "_bn2")
+        conv1 = conv(data=act1, num_filter=width,
+                     kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                     no_bias=True, name=name + "_conv1")
+        bn2 = bn(data=conv1, fix_gamma=False, name=name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=width,
-                                num_group=num_group, kernel=(3, 3),
-                                stride=stride, pad=(1, 1), no_bias=True,
-                                name=name + "_conv2")
-        bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=BN_EPS,
-                            momentum=bn_mom, name=name + "_bn3")
+        conv2 = conv(data=act2, num_filter=width,
+                     num_group=num_group, kernel=(3, 3),
+                     stride=stride, pad=(1, 1), no_bias=True,
+                     name=name + "_conv2")
+        bn3 = bn(data=conv2, fix_gamma=False, name=name + "_bn3")
         act3 = sym.Activation(data=bn3, act_type="relu", name=name + "_relu3")
-        conv3 = sym.Convolution(data=act3, num_filter=num_filter, kernel=(1, 1),
-                                stride=(1, 1), pad=(0, 0), no_bias=True,
-                                name=name + "_conv3")
+        conv3 = conv(data=act3, num_filter=num_filter, kernel=(1, 1),
+                     stride=(1, 1), pad=(0, 0), no_bias=True,
+                     name=name + "_conv3")
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+            shortcut = conv(data=act1, num_filter=num_filter,
+                            kernel=(1, 1), stride=stride,
+                            no_bias=True, name=name + "_sc")
         return conv3 + shortcut
     else:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=BN_EPS,
-                            momentum=bn_mom, name=name + "_bn1")
+        bn1 = bn(data=data, fix_gamma=False, name=name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=num_filter, kernel=(3, 3),
-                                stride=stride, pad=(1, 1), no_bias=True,
-                                name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=BN_EPS,
-                            momentum=bn_mom, name=name + "_bn2")
+        conv1 = conv(data=act1, num_filter=num_filter, kernel=(3, 3),
+                     stride=stride, pad=(1, 1), no_bias=True,
+                     name=name + "_conv1")
+        bn2 = bn(data=conv1, fix_gamma=False, name=name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=num_filter, kernel=(3, 3),
-                                stride=(1, 1), pad=(1, 1), no_bias=True,
-                                name=name + "_conv2")
+        conv2 = conv(data=act2, num_filter=num_filter, kernel=(3, 3),
+                     stride=(1, 1), pad=(1, 1), no_bias=True,
+                     name=name + "_conv2")
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+            shortcut = conv(data=act1, num_filter=num_filter,
+                            kernel=(1, 1), stride=stride,
+                            no_bias=True, name=name + "_sc")
         return conv2 + shortcut
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, num_group=1, bn_mom=BN_MOM, dtype="float32"):
+           bottle_neck=True, num_group=1, bn_mom=BN_MOM, dtype="float32",
+           layout="NCHW"):
+    conv, bn, pool = _layer_fns(layout, bn_mom)
     data = sym.Variable("data")
     if dtype != "float32":
         data = sym.Cast(data=data, dtype=dtype)
+    if layout == "NHWC":
+        # one transpose at the stem; everything downstream is channels-last
+        data = sym.transpose(data, axes=(0, 2, 3, 1), name="to_nhwc")
     (nchannel, height, width) = image_shape
-    data = sym.BatchNorm(data=data, fix_gamma=True, eps=BN_EPS,
-                         momentum=bn_mom, name="bn_data")
+    data = bn(data=data, fix_gamma=True, name="bn_data")
     if height <= 32:  # cifar-style stem
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0")
+        body = conv(data=data, num_filter=filter_list[0],
+                    kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                    no_bias=True, name="conv0")
     else:  # imagenet stem
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0")
-        body = sym.BatchNorm(data=body, fix_gamma=False, eps=BN_EPS,
-                             momentum=bn_mom, name="bn0")
+        body = conv(data=data, num_filter=filter_list[0],
+                    kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                    no_bias=True, name="conv0")
+        body = bn(data=body, fix_gamma=False, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
-        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                           pad=(1, 1), pool_type="max")
+        body = pool(data=body, kernel=(3, 3), stride=(2, 2),
+                    pad=(1, 1), pool_type="max")
 
     for i in range(num_stages):
         stride = (1, 1) if i == 0 else (2, 2)
         body = residual_unit(body, filter_list[i + 1], stride, False,
                              name="stage%d_unit%d" % (i + 1, 1),
                              bottle_neck=bottle_neck, num_group=num_group,
-                             bn_mom=bn_mom)
+                             bn_mom=bn_mom, layout=layout)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
                                  bottle_neck=bottle_neck, num_group=num_group,
-                                 bn_mom=bn_mom)
-    bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=BN_EPS,
-                        momentum=bn_mom, name="bn1")
+                                 bn_mom=bn_mom, layout=layout)
+    bn1 = bn(data=body, fix_gamma=False, name="bn1")
     relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
-    pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
-                        pool_type="avg", name="pool1")
+    pool1 = pool(data=relu1, global_pool=True, kernel=(7, 7),
+                 pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
     if dtype != "float32":
@@ -119,7 +137,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               num_group=1, dtype="float32", **kwargs):
+               num_group=1, dtype="float32", layout="NCHW", **kwargs):
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(","))
     height = image_shape[1]
@@ -159,4 +177,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
 
     return resnet(units=units, num_stages=num_stages, filter_list=filter_list,
                   num_classes=num_classes, image_shape=image_shape,
-                  bottle_neck=bottle_neck, num_group=num_group, dtype=dtype)
+                  bottle_neck=bottle_neck, num_group=num_group, dtype=dtype,
+                  layout=layout)

@@ -89,7 +89,7 @@ def _conv_dnums(nd):
         "no_bias": P("bool", False),
         "cudnn_tune": P("str", None),
         "cudnn_off": P("bool", False),
-        "layout": P("str", None),
+        "layout": P("str", None, enum=["NCHW", "NCW", "NCDHW", "NHWC"]),
     },
 )
 def _convolution(attrs, data, weight, bias=None):
@@ -97,6 +97,7 @@ def _convolution(attrs, data, weight, bias=None):
     stride = attrs["stride"] or (1,) * nd
     dilate = attrs["dilate"] or (1,) * nd
     pad = attrs["pad"] or (0,) * nd
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
     # bf16 inputs accumulate in fp32 on the MXU
     acc = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
     out = jax.lax.conv_general_dilated(
@@ -105,12 +106,15 @@ def _convolution(attrs, data, weight, bias=None):
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
-        dimension_numbers=_conv_dnums(nd),
+        # NHWC: channels-last activations + OHWI weights — the TPU-preferred
+        # layout (no relayout copies around each conv)
+        dimension_numbers=("NHWC", "OHWI", "NHWC") if nhwc
+        else _conv_dnums(nd),
         feature_group_count=attrs["num_group"],
         preferred_element_type=acc,
     ).astype(data.dtype)
     if not attrs["no_bias"]:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + (bias if nhwc else bias.reshape((1, -1) + (1,) * nd))
     return out
 
 
@@ -131,7 +135,7 @@ def _convolution(attrs, data, weight, bias=None):
         "no_bias": P("bool", True),
         "cudnn_tune": P("str", None),
         "cudnn_off": P("bool", False),
-        "layout": P("str", None),
+        "layout": P("str", None, enum=["NCHW", "NCW", "NCDHW", "NHWC"]),
     },
 )
 def _deconvolution(attrs, data, weight, bias=None):
@@ -179,12 +183,15 @@ def _deconvolution(attrs, data, weight, bias=None):
         "stride": P("shape", None),
         "pad": P("shape", None),
         "cudnn_off": P("bool", False),
+        "layout": P("str", None, enum=["NCHW", "NCW", "NCDHW", "NHWC"]),
     },
 )
 def _pooling(attrs, data):
     nd = data.ndim - 2
+    nhwc = attrs.get("layout") == "NHWC" and nd == 2
+    spatial0 = 1 if nhwc else 2  # first spatial dim index
     if attrs["global_pool"]:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(spatial0, spatial0 + nd))
         if attrs["pool_type"] == "max":
             out = jnp.max(data, axis=axes, keepdims=True)
         elif attrs["pool_type"] == "sum":
@@ -197,16 +204,21 @@ def _pooling(attrs, data):
     pad = attrs["pad"] or (0,) * nd
     pads = []
     for i in range(nd):
-        x, k, s, p = data.shape[2 + i], kernel[i], stride[i], pad[i]
+        x, k, s, p = data.shape[spatial0 + i], kernel[i], stride[i], pad[i]
         if attrs["pooling_convention"] == "full":
             out_sz = int(_np.ceil((x + 2 * p - k) / s)) + 1
         else:
             out_sz = (x + 2 * p - k) // s + 1
         need = max((out_sz - 1) * s + k - x - p, p)
         pads.append((p, need))
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = ((0, 0), (0, 0)) + tuple(pads)
+    if nhwc:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = ((0, 0),) + tuple(pads) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = ((0, 0), (0, 0)) + tuple(pads)
     pt = attrs["pool_type"]
     if pt == "max":
         # scalar (not Array) init value so jax dispatches to the monoid
@@ -323,14 +335,17 @@ def _dropout(attrs, x, is_train=False, rng=None):
         "use_global_stats": P("bool", False),
         "output_mean_var": P("bool", False),
         "cudnn_off": P("bool", False),
+        "axis": P("int", 1),
     },
     needs_mode=True,
 )
 def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var, is_train=False):
     eps = attrs["eps"]
     mom = attrs["momentum"]
-    axes = (0,) + tuple(range(2, data.ndim))
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    # channel axis: 1 (NCHW default) or -1/ndim-1 for channels-last graphs
+    ch = attrs.get("axis", 1) % data.ndim
+    axes = tuple(i for i in range(data.ndim) if i != ch)
+    bshape = tuple(-1 if i == ch else 1 for i in range(data.ndim))
     if attrs["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     use_batch = is_train and not attrs["use_global_stats"]

@@ -692,7 +692,10 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
     elif op.name in ("Convolution",):
         k = a["kernel"]
         ng = a.get("num_group", 1)
-        solved["weight"] = (a["num_filter"], dshape[1] // ng) + tuple(k)
+        if a.get("layout") == "NHWC" and len(k) == 2:
+            solved["weight"] = (a["num_filter"],) + tuple(k) + (dshape[-1] // ng,)
+        else:
+            solved["weight"] = (a["num_filter"], dshape[1] // ng) + tuple(k)
         solved["bias"] = (a["num_filter"],)
     elif op.name == "Deconvolution":
         k = a["kernel"]
@@ -700,7 +703,8 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
         solved["weight"] = (dshape[1], a["num_filter"] // ng) + tuple(k)
         solved["bias"] = (a["num_filter"],)
     elif op.name in ("BatchNorm",):
-        c = dshape[1] if len(dshape) > 1 else dshape[0]
+        ch = a.get("axis", 1) % len(dshape) if len(dshape) > 1 else 0
+        c = dshape[ch]
         for p in ("gamma", "beta", "moving_mean", "moving_var"):
             solved[p] = (c,)
     elif op.name == "InstanceNorm":

@@ -1,0 +1,75 @@
+"""Channels-last (NHWC) layout tests — the TPU-preferred conv layout knob
+(reference parity: the ``layout`` attribute of Convolution/Pooling,
+``src/operator/convolution-inl.h`` param surface)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet
+
+
+def test_conv_pool_bn_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 4, 8, 8).astype(np.float32)
+    w = rng.rand(6, 4, 3, 3).astype(np.float32)
+
+    out_nchw = mx.nd.Pooling(
+        mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                          kernel=(3, 3), pad=(1, 1), num_filter=6,
+                          no_bias=True),
+        kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+
+    x_l = np.transpose(x, (0, 2, 3, 1))
+    w_l = np.transpose(w, (0, 2, 3, 1))  # OIHW -> OHWI
+    out_nhwc = mx.nd.Pooling(
+        mx.nd.Convolution(mx.nd.array(x_l), mx.nd.array(w_l),
+                          kernel=(3, 3), pad=(1, 1), num_filter=6,
+                          no_bias=True, layout="NHWC"),
+        kernel=(2, 2), stride=(2, 2), pool_type="max",
+        layout="NHWC").asnumpy()
+
+    np.testing.assert_allclose(out_nchw, np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_axis():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    g = np.ones((5,), np.float32)
+    b = np.zeros((5,), np.float32)
+    mm = np.zeros((5,), np.float32)
+    mv = np.ones((5,), np.float32)
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          mx.nd.array(mm), mx.nd.array(mv), axis=3,
+                          fix_gamma=False, use_global_stats=True,
+                          eps=1e-5).asnumpy()
+    np.testing.assert_allclose(out, x / np.sqrt(1 + 1e-5), rtol=1e-5)
+
+
+def test_resnet_nhwc_matches_nchw_forward():
+    rng = np.random.RandomState(0)
+    data = rng.rand(2, 3, 32, 32).astype(np.float32)
+    label = rng.randint(0, 10, (2,)).astype(np.float32)
+    outs = {}
+    ref = None
+    for layout in ("NCHW", "NHWC"):
+        sym = resnet.get_symbol(num_classes=10, num_layers=18,
+                                image_shape=(3, 32, 32), layout=layout)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 3, 32, 32))],
+                 label_shapes=[("softmax_label", (2,))])
+        mod.init_params(mx.initializer.Xavier())
+        if layout == "NCHW":
+            ref = mod.get_params()
+        else:
+            args0, aux0 = ref
+            mapped = {n: mx.nd.array(
+                v.asnumpy().transpose(0, 2, 3, 1)
+                if n.endswith("_weight") and v.asnumpy().ndim == 4
+                else v.asnumpy()) for n, v in args0.items()}
+            mod.set_params(mapped, aux0)
+        mod.forward(mx.io.DataBatch([mx.nd.array(data)],
+                                    [mx.nd.array(label)]), is_train=False)
+        outs[layout] = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(outs["NCHW"], outs["NHWC"],
+                               rtol=1e-4, atol=1e-5)
