@@ -37,14 +37,24 @@ from .symbol import Symbol, _infer
 __all__ = ["Executor"]
 
 
-def _graph_fn(symbol: Symbol):
+def _graph_fn(symbol: Symbol, node_device=None):
     """Build the pure function evaluating the symbol graph.
 
     Returns ``run(arg_values, aux_values, rng, is_train) -> (outputs, new_aux)``
     where arg/aux values are name->jax array dicts.
+
+    ``node_device`` (node_id -> jax.Device) enables ``group2ctx`` model
+    parallelism (parity: ``nnvm::pass::PlaceDevice`` + ``_CrossDeviceCopy``
+    insertion, reference ``graph_executor.cc:318``,
+    ``src/operator/cross_device_copy.cc``): each node runs on its assigned
+    device, ``jax.device_put`` on its inputs being the cross-device copy
+    (a no-op for inputs already there).  A placed graph must run eagerly —
+    heterogeneous placement can't live inside one XLA computation — and is
+    differentiable: eager ``jax.vjp`` transposes the copies back.
     """
     nodes = symbol._topo()
     out_entries = list(symbol._outputs)
+    node_device = node_device or {}
 
     def run(arg_values, aux_values, rng, is_train):
         env = {}
@@ -58,6 +68,9 @@ def _graph_fn(symbol: Symbol):
                 continue
             op = node.op
             ins = [env[s._id][i] for s, i in node.inputs]
+            dev = node_device.get(node._id)
+            if dev is not None:
+                ins = [jax.device_put(v, dev) for v in ins]
             n_args = len(op.input_names(node.attrs))
             args, auxs = ins[:n_args], ins[n_args:]
             node_rng = jax.random.fold_in(rng, node._id) if op.needs_rng else None
@@ -98,7 +111,30 @@ class Executor:
             k: (grad_req.get(k, "null") if grad_dict.get(k) is not None else "null")
             for k in self._arg_names
         }
-        self._run = _graph_fn(symbol)
+        # group2ctx model parallelism: when groups land on other devices,
+        # switch to the placed (eager, per-op dispatch) walker.  Ungrouped
+        # nodes run on the main ctx (the reference's PlaceDevice default),
+        # so mixed-device inputs always get an explicit copy.
+        self._placed = False
+        node_device = {}
+        if group2ctx:
+            main_dev = self._ctx.jax_device
+            var_device = {}
+            for node in symbol._topo():
+                if node.is_variable:
+                    continue
+                grp = node.extra_attrs.get("ctx_group")
+                dev = (group2ctx[grp].jax_device
+                       if grp and grp in group2ctx else main_dev)
+                node_device[node._id] = dev
+                if dev != main_dev:
+                    self._placed = True
+                for src, _ in node.inputs:
+                    if src.is_variable:
+                        var_device.setdefault(src.name, dev)
+            if self._placed:
+                self._var_device = var_device
+        self._run = _graph_fn(symbol, node_device if self._placed else None)
         self._jit_fwd = {}     # is_train -> jitted forward
         self._jit_step = None  # fused fwd+bwd
         self._outputs: Optional[List[NDArray]] = None
@@ -176,6 +212,18 @@ class Executor:
     # execution
     # ------------------------------------------------------------------
     def _gather(self):
+        if self._placed:
+            # keep each array on its consumer group's device, writing the
+            # placement back so re-initialized params pay one copy, not one
+            # per step (the reference pins params on their PlaceDevice
+            # device at bind)
+            for d in (self.arg_dict, self.aux_dict):
+                for name, arr in d.items():
+                    dev = self._var_device.get(name)
+                    if dev is not None and arr is not None:
+                        placed = jax.device_put(arr._data, dev)
+                        if placed is not arr._data:
+                            arr._set_data(placed)
         args = {k: v._data for k, v in self.arg_dict.items()}
         auxs = {k: v._data for k, v in self.aux_dict.items()}
         return args, auxs
@@ -187,7 +235,8 @@ class Executor:
             def f(args, auxs, rng):
                 return run(args, auxs, rng, is_train)
 
-            self._jit_fwd[is_train] = jax.jit(f)
+            # placed (group2ctx) graphs span devices: eager dispatch, no jit
+            self._jit_fwd[is_train] = f if self._placed else jax.jit(f)
         return self._jit_fwd[is_train]
 
     def _place(self, data):
@@ -270,7 +319,7 @@ class Executor:
                 grads = vjp_fn((cot, zero_aux))[0]
                 return outs, new_aux, grads
 
-            self._jit_step = jax.jit(step)
+            self._jit_step = step if self._placed else jax.jit(step)
         return self._jit_step
 
     def backward(self, out_grads=None):

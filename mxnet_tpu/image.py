@@ -316,18 +316,30 @@ class ImageIter(DataIter):
                  **kwargs):
         super().__init__()
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        self._loader = None
         if path_imgrec:
+            from . import _native
             from .recordio import MXIndexedRecordIO, MXRecordIO
 
             logging.info("loading recordio %s...", path_imgrec)
+            loader_seed = int(kwargs.pop("seed", 0) or 0)
             if path_imgidx:
                 self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
                 self.imgidx = list(self.imgrec.keys)
+            elif (_native.available() and not path_imglist
+                  and not isinstance(imglist, list)):
+                # no .idx sidecar: the native threaded loader owns the hot
+                # path — background read thread, worker sharding, chunk
+                # shuffle (the reference's dmlc::ThreadedIter + InputSplit
+                # pipeline, iter_image_recordio_2.cc:104-112)
+                self.imgrec = None
+                self.imgidx = None
+                self._loader = _native.RecordLoader(
+                    path_imgrec, part_index=part_index, num_parts=num_parts,
+                    shuffle=shuffle, seed=loader_seed)
             elif shuffle or num_parts > 1:
-                # no .idx sidecar but random access is needed: build the
-                # index in-memory with one sequential scan (the reference's
-                # C++ iter would refuse; scanning keeps shuffle/sharding
-                # semantics working on bare .rec files)
+                # pure-python fallback: build the index in-memory with one
+                # sequential scan so shuffle/sharding still work
                 rec = MXIndexedRecordIO(path_imgrec + ".__noidx__",
                                         path_imgrec, "r")
                 pos = rec.tell()
@@ -405,11 +417,19 @@ class ImageIter(DataIter):
             _pyrandom.shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
+        if self._loader is not None:
+            self._loader.reset()
         self.cur = 0
 
     def next_sample(self):
         from .recordio import unpack
 
+        if self._loader is not None:
+            s = self._loader.next_record()
+            if s is None:
+                raise StopIteration
+            header, img = unpack(s)
+            return header.label, img
         if self.seq is not None:
             if self.cur >= len(self.seq):
                 raise StopIteration

@@ -38,7 +38,7 @@ class Module(BaseModule):
 
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None):
+                 fixed_param_names=None, group2ctx=None):
         super().__init__(logger=logger)
         if context is None:
             context = [cpu()]
@@ -46,6 +46,9 @@ class Module(BaseModule):
             context = [context]
         self._context = [c if c is not None else cpu() for c in context]
         self._work_load_list = work_load_list
+        # ctx_group -> Context placement map for model parallelism (parity:
+        # symbol.bind's group2ctx, reference graph_executor.cc:318)
+        self._group2ctx = group2ctx
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -262,7 +265,7 @@ class Module(BaseModule):
         shared_exec = shared_module._exec if shared_module is not None else None
         self._exec = self._symbol.simple_bind(
             self._context[0], grad_req=req, type_dict=type_dict,
-            shared_exec=shared_exec, **shape_dict
+            shared_exec=shared_exec, group2ctx=self._group2ctx, **shape_dict
         )
         if len(self._context) > 1:
             self._setup_mesh()

@@ -23,8 +23,15 @@ def _launch(n, script, timeout=240):
 
 @pytest.mark.parametrize("n", [2])
 def test_dist_sync_kvstore_via_launcher(n):
-    r = _launch(n, os.path.join(_REPO, "tests", "dist",
-                                "dist_sync_kvstore.py"))
-    ok_lines = [l for l in r.stdout.splitlines() if "dist_sync kvstore OK" in l]
-    assert r.returncode == 0, r.stdout + "\n" + r.stderr
-    assert len(ok_lines) == n, r.stdout + "\n" + r.stderr
+    # one retry: on a loaded single-core box the 30 s gloo handshake
+    # occasionally times out; a genuine regression fails both attempts
+    last = None
+    for _ in range(2):
+        r = _launch(n, os.path.join(_REPO, "tests", "dist",
+                                    "dist_sync_kvstore.py"))
+        ok = [l for l in r.stdout.splitlines()
+              if "dist_sync kvstore OK" in l]
+        if r.returncode == 0 and len(ok) == n:
+            return
+        last = r
+    raise AssertionError(last.stdout + "\n" + last.stderr)

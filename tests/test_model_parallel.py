@@ -1,0 +1,82 @@
+"""group2ctx model parallelism tests (reference tier:
+``tests/python/unittest/test_model_parallel.py`` — ctx_group attrs +
+group2ctx bind place parts of one graph on different devices)."""
+
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _two_cpus():
+    if len(jax.devices()) < 2:
+        pytest.skip("need 2 devices")
+    return mx.cpu(0), mx.cpu(1)
+
+
+def _net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = mx.sym.Activation(h, act_type="tanh", name="act1")
+    with mx.AttrScope(ctx_group="dev2"):
+        h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+        out = mx.sym.LinearRegressionOutput(h, mx.sym.Variable("label"),
+                                            name="out")
+    return out
+
+
+def test_group2ctx_forward_matches_single_device():
+    c0, c1 = _two_cpus()
+    net = _net()
+    rng = np.random.RandomState(0)
+    arrays = {
+        "data": rng.randn(3, 5).astype(np.float32),
+        "fc1_weight": rng.randn(8, 5).astype(np.float32),
+        "fc1_bias": np.zeros(8, np.float32),
+        "fc2_weight": rng.randn(4, 8).astype(np.float32),
+        "fc2_bias": np.zeros(4, np.float32),
+        "label": rng.randn(3, 4).astype(np.float32),
+    }
+
+    def bind(group2ctx):
+        args = {k: mx.nd.array(v) for k, v in arrays.items()}
+        grads = {k: mx.nd.zeros(v.shape) for k, v in arrays.items()
+                 if k not in ("data", "label")}
+        return net.bind(c0, args, args_grad=grads, group2ctx=group2ctx)
+
+    ex_mp = bind({"dev1": c0, "dev2": c1})
+    assert ex_mp._placed, "expected placed execution across devices"
+    ex_sd = bind(None)
+    out_mp = ex_mp.forward(is_train=False)[0].asnumpy()
+    out_sd = ex_sd.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_mp, out_sd, rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_training_grads_match():
+    c0, c1 = _two_cpus()
+    net = _net()
+    rng = np.random.RandomState(1)
+    arrays = {
+        "data": rng.randn(4, 5).astype(np.float32),
+        "fc1_weight": rng.randn(8, 5).astype(np.float32) * 0.3,
+        "fc1_bias": np.zeros(8, np.float32),
+        "fc2_weight": rng.randn(4, 8).astype(np.float32) * 0.3,
+        "fc2_bias": np.zeros(4, np.float32),
+        "label": rng.randn(4, 4).astype(np.float32),
+    }
+
+    grads = {}
+    for mode, g2c in (("mp", {"dev1": c0, "dev2": c1}), ("sd", None)):
+        args = {k: mx.nd.array(v) for k, v in arrays.items()}
+        gdict = {k: mx.nd.zeros(v.shape) for k, v in arrays.items()
+                 if k not in ("data", "label")}
+        ex = net.bind(c0, args, args_grad=gdict, group2ctx=g2c)
+        ex.forward(is_train=True)
+        ex.backward()
+        grads[mode] = {k: v.asnumpy() for k, v in gdict.items()}
+
+    for k in grads["sd"]:
+        np.testing.assert_allclose(grads["mp"][k], grads["sd"][k],
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
