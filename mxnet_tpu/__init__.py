@@ -70,6 +70,8 @@ from . import image
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import predict
+from . import deploy
+from . import kvstore_server
 from . import engine
 from . import rtc
 from . import torch_bridge

@@ -1,0 +1,123 @@
+"""Ahead-of-time model export (parity: reference ``amalgamation/`` — the
+single-artifact deployment build of the predict API for mobile/JS, plus the
+``MXPredCreate``-from-bytes flow of ``c_predict_api.h``).
+
+TPU-native equivalent: ``jax.export`` serializes the predictor's forward as
+a **StableHLO artifact** — one portable blob, loadable by any process with
+jax (or any StableHLO runtime) **without this framework installed**, with
+parameters baked in or passed at call time.  That is the amalgamation
+story re-based on the XLA ecosystem's stable interchange format.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["export_model", "load_exported", "ExportedModel"]
+
+_MANIFEST = "MXTPU_EXPORT.json"
+_HLO = "forward.stablehlo"
+_PARAMS = "params.npz"
+
+
+def export_model(prefix, epoch, input_shapes, ctx=None, bake_params=True):
+    """Export checkpoint artifacts to one deployable ``.mxtpu`` zip.
+
+    Parameters
+    ----------
+    prefix, epoch : the ``save_checkpoint`` artifacts to load.
+    input_shapes : dict name -> shape for the serving signature.
+    bake_params : fold the weights into the artifact (single-blob deploy);
+        otherwise the artifact takes them as a call argument.
+
+    Returns the artifact path ``prefix-export.mxtpu``.
+    """
+    import jax
+    from jax import export as jax_export
+
+    from . import predict
+
+    pred = predict.load(prefix, epoch, ctx=ctx, input_shapes=input_shapes)
+    exe = pred._exec
+    args, auxs = exe._gather()
+    input_names = sorted(input_shapes)
+    param_names = sorted(n for n in args if n not in input_shapes)
+
+    def fwd(params, *inputs):
+        all_args = dict(params)
+        all_args.update(dict(zip(input_names, inputs)))
+        outs, _ = exe._run(all_args, auxs, jax.random.PRNGKey(0), False)
+        return tuple(outs)
+
+    params = {n: args[n] for n in param_names}
+    in_structs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]),
+                                       _np.dtype(_np.float32))
+                  for n in input_names]
+    if bake_params:
+        import functools
+
+        fixed = jax.jit(functools.partial(fwd, params))
+        exported = jax_export.export(fixed)(*in_structs)
+    else:
+        pstructs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for n, v in params.items()}
+        exported = jax_export.export(jax.jit(fwd))(pstructs, *in_structs)
+
+    path = "%s-export.mxtpu" % prefix
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(_MANIFEST, json.dumps({
+            "format": 1,
+            "inputs": {n: list(input_shapes[n]) for n in input_names},
+            "baked": bool(bake_params),
+        }))
+        z.writestr(_HLO, exported.serialize())
+        if not bake_params:
+            buf = io.BytesIO()
+            _np.savez(buf, **{n: _np.asarray(v) for n, v in params.items()})
+            z.writestr(_PARAMS, buf.getvalue())
+    return path
+
+
+class ExportedModel(object):
+    """Loaded deployment artifact: ``model(data=...) -> [numpy outputs]``."""
+
+    def __init__(self, path):
+        from jax import export as jax_export
+
+        with zipfile.ZipFile(path) as z:
+            manifest = json.loads(z.read(_MANIFEST))
+            self._exported = jax_export.deserialize(z.read(_HLO))
+            self._params = None
+            if not manifest["baked"]:
+                with _np.load(io.BytesIO(z.read(_PARAMS))) as f:
+                    self._params = {k: f[k] for k in f.files}
+        self.input_names = sorted(manifest["inputs"])
+        self.input_shapes = {k: tuple(v)
+                             for k, v in manifest["inputs"].items()}
+
+    def __call__(self, **inputs):
+        vals = []
+        for n in self.input_names:
+            if n not in inputs:
+                raise MXNetError("missing input %r" % n)
+            v = _np.asarray(inputs[n], dtype=_np.float32)
+            if tuple(v.shape) != self.input_shapes[n]:
+                raise MXNetError("input %r shape %s != exported %s"
+                                 % (n, v.shape, self.input_shapes[n]))
+            vals.append(v)
+        if self._params is not None:
+            out = self._exported.call(self._params, *vals)
+        else:
+            out = self._exported.call(*vals)
+        return [_np.asarray(o) for o in out]
+
+
+def load_exported(path):
+    """(parity: ``MXPredCreate`` from an amalgamated artifact)"""
+    return ExportedModel(path)

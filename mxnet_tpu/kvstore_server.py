@@ -1,0 +1,60 @@
+"""KVStore server role (parity: reference ``python/mxnet/kvstore_server.py``
+— ``KVStoreServer.run`` blocks a server process inside the ps-lite topology,
+applying the pickled optimizer to incoming pushes).
+
+The TPU-native topology has **no separate server processes**: every process
+is a worker, reduction is an ICI/DCN collective, and the server-side
+optimizer runs where the reduced values live (``kvstore.py:set_optimizer``).
+This module keeps the launch contract — a script that calls
+``KVStoreServer(kv).run()`` under a role env — working: on the TPU build the
+"server" degenerates to joining the collective group and idling until the
+workers finish (the coordination service plays the scheduler's role).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer(object):
+    """Server-role loop (parity: ``kvstore_server.py:KVStoreServer``)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = getattr(kvstore, "handle", None)
+        self.init_logging = False
+
+    def run(self):
+        """Block as long as the job runs.  On ps-lite this serves pushes;
+        here workers reduce among themselves, so the server (if launched)
+        just waits on the process group's lifetime."""
+        logging.info("TPU kvstore has no server role; idling (workers "
+                     "reduce via collectives)")
+        try:
+            self.kvstore.barrier()
+        except Exception:
+            logging.exception("kvstore server barrier failed — the process "
+                              "group is likely misconfigured")
+            raise
+        while os.environ.get("MXNET_TPU_SERVER_SPIN"):
+            time.sleep(1)
+
+
+def _init_kvstore_server_module():
+    """(parity: the reference's module-level auto-start when
+    ``DMLC_ROLE=server``)"""
+    role = os.environ.get("DMLC_ROLE", os.environ.get("MXNET_TPU_ROLE", ""))
+    if role == "server":
+        from . import kvstore
+
+        server = KVStoreServer(kvstore.create("dist_sync"))
+        server.run()
+
+
+# auto-start matches the reference: importing the module under a server-role
+# env blocks in the server loop
+_init_kvstore_server_module()

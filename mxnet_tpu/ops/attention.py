@@ -63,86 +63,126 @@ def _attention_fwd_ref(q, k, v, causal, sm_scale):
 # ----------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
-                  block_k, seq_len):
-    """One (batch*head, q-block) program: stream K/V blocks through an
-    online softmax.  q_ref: [1, block_q, D]; k/v_ref: [1, T, D] in VMEM."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale, causal, block_q, block_k, n_k, kv_len):
+    """One (batch*head, q-block, k-block) program of the online softmax.
+
+    The k-block grid dimension is sequential ("arbitrary"); VMEM scratch
+    (m/l/acc) carries the running max, denominator, and weighted sum across
+    k steps, so VMEM holds only one q-block and one k/v-block at a time —
+    sequence length is bounded by HBM, not the 16 MB VMEM (the previous
+    kernel staged all of K/V per program and capped out near T=8K)."""
     import jax.experimental.pallas as pl
 
-    q_block_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, D]
-    d = q.shape[-1]
-    n_k = seq_len // block_k
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    def body(j, carry):
-        o, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
-            mask = _causal_mask(block_q, block_k, q_block_idx * block_q,
-                                j * block_k)
-            s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        pv = jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return o * alpha[:, None] + pv, m_new, l_new
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
     if causal:
-        # only K blocks up to and including this Q block's diagonal
-        n_k_eff = jnp.minimum(
-            n_k, (q_block_idx * block_q + block_q + block_k - 1) // block_k)
+        # skip blocks entirely above the diagonal
+        run = ki * block_k <= qi * block_q + block_q - 1
     else:
-        n_k_eff = n_k
-    o, m, l = lax.fori_loop(0, n_k_eff, body, (o0, m0, l0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        mask = None
+        if causal:
+            mask = _causal_mask(block_q, block_k, qi * block_q, ki * block_k)
+        if kv_len % block_k:
+            # ragged tail: padded key columns contribute nothing
+            col = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = col < kv_len
+            mask = valid if mask is None else (mask & valid)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = l_scr[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
 
 
-def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128,
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
                       interpret=False):
     """Pallas forward on [B, H, T, D].  T is padded to block multiples."""
     import jax.experimental.pallas as pl
+
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
     Tk = k.shape[2]
     block_q = min(block_q, max(8, T))
     block_k = min(block_k, max(8, Tk))
-    if T % block_q or Tk % block_k:
-        # ragged tail: the exact reference path (XLA still fuses it well);
-        # production shapes are block multiples
-        return _attention_fwd_ref(q, k, v, causal, sm_scale)
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
-    grid = (B * H, T // block_q)
+    # ragged shapes: pad to block multiples.  Padded q rows are sliced off
+    # the output; padded key columns are masked inside the kernel (kv_len).
+    Tp = -(-T // block_q) * block_q
+    Tkp = -(-Tk // block_k) * block_k
+    if Tp != T:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Tp - T), (0, 0)))
+    if Tkp != Tk:
+        pad = ((0, 0), (0, 0), (0, Tkp - Tk), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    qf = q.reshape(B * H, Tp, D)
+    kf = k.reshape(B * H, Tkp, D)
+    vf = v.reshape(B * H, Tkp, D)
+    n_k = Tkp // block_k
+    grid = (B * H, Tp // block_q, n_k)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=Tk)
+        block_k=block_k, n_k=n_k, kv_len=Tk)
+    kwargs = {}
+    if not interpret:
+        params_cls = getattr(pltpu, "CompilerParams",
+                             getattr(pltpu, "TPUCompilerParams", None))
+        if params_cls is not None:
+            kwargs["compiler_params"] = params_cls(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
         interpret=interpret,
+        **kwargs,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, D)
+    out = out.reshape(B, H, Tp, D)
+    return out[:, :, :T] if Tp != T else out
 
 
 # ----------------------------------------------------------------------
@@ -157,9 +197,14 @@ def _flash(q, k, v, causal, sm_scale, interpret):
 
 def _flash_dispatch(q, k, v, causal, sm_scale, interpret):
     platform = jax.default_backend()
-    if platform == "tpu" or interpret:
+    if interpret:
         return _flash_fwd_pallas(q, k, v, causal, sm_scale,
-                                 interpret=interpret and platform != "tpu")
+                                 interpret=platform != "tpu")
+    # short sequences: one fused XLA kernel beats the blocked Pallas loop
+    # (measured crossover ~2-4K on v5e); long sequences need the O(T)
+    # streaming kernel — exact attention OOMs past 8K
+    if platform == "tpu" and (q.shape[2] > 2048 or k.shape[2] > 2048):
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale)
     return _attention_fwd_ref(q, k, v, causal, sm_scale)
 
 

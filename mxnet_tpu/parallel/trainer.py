@@ -82,7 +82,8 @@ class ShardedTrainer:
                  type_dict: Optional[Dict[str, str]] = None,
                  learning_rate=0.01, momentum=0.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=None,
-                 data_axis="data", dtype="float32"):
+                 data_axis="data", dtype="float32",
+                 remat=False, remat_policy=None):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -133,6 +134,14 @@ class ShardedTrainer:
         self.data_specs = dspecs
 
         self._run = _graph_fn(symbol)
+        # rematerialization: trade FLOPs for HBM in backward (the reference's
+        # memonger / MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:87-89 —
+        # here it's jax.checkpoint over the traced graph).  remat_policy is
+        # a jax.checkpoint_policies name, e.g. 'dots_saveable' keeps matmul
+        # outputs (MXU work) and recomputes the cheap elementwise chains.
+        self._remat = bool(remat) or remat_policy is not None
+        self._remat_policy = (getattr(jax.checkpoint_policies, remat_policy)
+                              if remat_policy is not None else None)
         self._hyper = (learning_rate, momentum, wd, rescale_grad, clip_gradient)
         self._use_momentum = momentum != 0.0
         self._jit_step = None
@@ -195,12 +204,17 @@ class ShardedTrainer:
                                   _np.integer)
         ]
 
+        graph = run
+        if self._remat:
+            graph = jax.checkpoint(
+                run, policy=self._remat_policy, static_argnums=(3,))
+
         def step(params, moms, aux, batch, rng):
             def loss_fn(p):
                 args = dict(batch)
                 args.update(params)
                 args.update(p)
-                outs, new_aux = run(args, aux, rng, True)
+                outs, new_aux = graph(args, aux, rng, True)
                 total = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
                 return total, (outs, new_aux)
 

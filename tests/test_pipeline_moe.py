@@ -191,3 +191,35 @@ def test_moe_differentiable():
     for k, g in grads.items():
         assert np.isfinite(np.asarray(g)).all(), k
     assert np.abs(np.asarray(grads["router"])).sum() > 0
+
+
+def test_remat_matches_nonremat():
+    # memonger analog: jax.checkpoint remat must not change numerics
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("data",))
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Activation(mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=16, name="fc1"),
+            act_type="relu"), num_hidden=4, name="fc2"), name="softmax")
+    batch_np = {
+        "data": np.random.RandomState(0).randn(4, 8).astype(np.float32),
+        "softmax_label": np.array([0, 1, 2, 3], np.float32)}
+    results = {}
+    for remat in (False, True):
+        tr = ShardedTrainer(sym, mesh, data_shapes={"data": (4, 8)},
+                            label_shapes={"softmax_label": (4,)},
+                            momentum=0.9, remat=remat,
+                            remat_policy="dots_saveable" if remat else None)
+        params, moms, aux = tr.init(seed=0)
+        batch = tr.place_batch(batch_np)
+        step = tr.step_fn()
+        for i in range(3):
+            outs, params, moms, aux = step(params, moms, aux, batch,
+                                           jax.random.PRNGKey(i))
+        results[remat] = {k: np.asarray(v) for k, v in params.items()}
+    for k in results[False]:
+        np.testing.assert_allclose(results[True][k], results[False][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)

@@ -97,3 +97,28 @@ def test_im2rec_roundtrip(tmp_path):
                                data_shape=(3, 10, 12), batch_size=4)
     batch = next(iter(it))
     assert batch.data[0].shape == (4, 3, 10, 12)
+
+
+def test_export_model_roundtrip(tmp_path):
+    # amalgamation-analog: StableHLO artifact serves without the Module stack
+    from mxnet_tpu import deploy
+
+    prefix, data, mod = _train_tiny(tmp_path)
+    path = deploy.export_model(prefix, 3, input_shapes={"data": (8, 6)})
+    assert path.endswith("-export.mxtpu") and os.path.exists(path)
+    model = deploy.load_exported(path)
+    out = model(data=data[:8])
+    assert out[0].shape == (8, 2)
+
+    pred = predict.load(prefix, 3, ctx=mx.cpu(),
+                        input_shapes={"data": (8, 6)})
+    pred.forward(data=data[:8])
+    np.testing.assert_allclose(out[0], pred.get_output(0),
+                               rtol=1e-5, atol=1e-6)
+
+    # unbaked variant: params travel beside the graph
+    path2 = deploy.export_model(prefix, 3, input_shapes={"data": (8, 6)},
+                                bake_params=False)
+    model2 = deploy.load_exported(path2)
+    np.testing.assert_allclose(model2(data=data[:8])[0], out[0],
+                               rtol=1e-5, atol=1e-6)
