@@ -937,3 +937,107 @@ def _spatial_transformer(attrs, data, loc):
         {"transform_type": "affine", "target_shape": attrs["target_shape"]}, loc
     )
     return _bilinear_sample(data, grid)
+
+
+# ----------------------------------------------------------------------
+# Correlation (reference src/operator/correlation-inl.h — FlowNet-style
+# patch correlation between two feature maps)
+# ----------------------------------------------------------------------
+
+
+@register(
+    "Correlation",
+    arg_names=["data1", "data2"],
+    params={
+        "kernel_size": P("int", 1),
+        "max_displacement": P("int", 1),
+        "stride1": P("int", 1),
+        "stride2": P("int", 1),
+        "pad_size": P("int", 0),
+        "is_multiply": P("bool", True),
+    },
+)
+def _correlation(attrs, data1, data2):
+    """Correlation volume: for every displacement d in a (2m+1)^2 grid,
+    the K*K*C-normalized patch product (or abs-difference) of data1 and
+    shifted data2.  Output (B, D*D, H', W').  Vectorized as a static
+    python loop over displacements (the grid is small) with XLA window
+    sums — no im2col scratch like the reference's CUDA kernel."""
+    K = attrs["kernel_size"]
+    md = attrs["max_displacement"]
+    s1, s2 = attrs["stride1"], attrs["stride2"]
+    pad = attrs["pad_size"]
+    B, C, H, W = data1.shape
+    rad = (K - 1) // 2
+    border = md + rad
+    grid_rad = md // s2
+    D = 2 * grid_rad + 1
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    out_h = int(_np.ceil((Hp - border * 2) / float(s1)))
+    out_w = int(_np.ceil((Wp - border * 2) / float(s1)))
+    norm = float(K * K * C)
+    window = (1, 1, K, K)
+    strides = (1, 1, 1, 1)
+    maps = []
+    for di in range(-grid_rad, grid_rad + 1):
+        for dj in range(-grid_rad, grid_rad + 1):
+            dy, dx = di * s2, dj * s2
+            shifted = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            if attrs["is_multiply"]:
+                prod = jnp.sum(p1 * shifted, axis=1, keepdims=True)
+            else:
+                prod = jnp.sum(jnp.abs(p1 - shifted), axis=1, keepdims=True)
+            acc = jax.lax.reduce_window(
+                prod, 0.0, jax.lax.add, window, strides,
+                [(0, 0), (0, 0), (rad, rad), (rad, rad)])
+            sl = acc[:, 0, border:border + out_h * s1:s1,
+                     border:border + out_w * s1:s1]
+            maps.append(sl / norm)
+    return jnp.stack(maps, axis=1)
+
+
+# ----------------------------------------------------------------------
+# IdentityAttachKLSparseReg (reference
+# src/operator/identity_attach_KL_sparse_reg-inl.h — identity forward,
+# KL sparsity penalty injected into backward; moving-average activation)
+# ----------------------------------------------------------------------
+
+
+@register(
+    "IdentityAttachKLSparseReg",
+    arg_names=["data"],
+    aux_names=["moving_avg"],
+    params={
+        "sparseness_target": P("float", 0.1),
+        "penalty": P("float", 0.001),
+        "momentum": P("float", 0.9),
+    },
+    needs_mode=True,
+)
+def _identity_kl_sparse(attrs, data, moving_avg, is_train=False):
+    rho = attrs["sparseness_target"]
+    penalty = attrs["penalty"]
+    mom = attrs["momentum"]
+
+    @jax.custom_vjp
+    def ident(x, avg):
+        return x
+
+    def fwd(x, avg):
+        return x, (x, avg)
+
+    def bwd(res, dy):
+        x, avg = res
+        # KL'(rho || rho_hat) per unit, broadcast over the batch
+        rho_hat = jnp.clip(avg, 1e-6, 1.0 - 1e-6)
+        kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return dy + jnp.broadcast_to(kl_grad, x.shape), None
+
+    ident.defvjp(fwd, bwd)
+
+    batch_mean = jnp.mean(data, axis=0)
+    new_avg = jnp.where(
+        is_train, mom * moving_avg + (1 - mom) * batch_mean, moving_avg)
+    return ident(data, jax.lax.stop_gradient(new_avg)), new_avg

@@ -511,3 +511,54 @@ def test_instance_norm():
     check_symbolic_forward(
         sym, {"data": a, "gamma": np.ones(3, np.float32),
               "beta": np.zeros(3, np.float32)}, [ref], rtol=1e-3, atol=1e-4)
+
+
+def test_correlation_brute_force():
+    rng = np.random.RandomState(0)
+    B, C, H, W = 1, 2, 5, 5
+    d1 = rng.randn(B, C, H, W).astype(np.float32)
+    d2 = rng.randn(B, C, H, W).astype(np.float32)
+    md, pad = 1, 1
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=1, max_displacement=md, stride1=1,
+                            stride2=1, pad_size=pad,
+                            is_multiply=True).asnumpy()
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = H + 2 * pad - 2 * md
+    want = np.zeros((B, 9, oh, oh), np.float32)
+    idx = 0
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            for i in range(oh):
+                for j in range(oh):
+                    y, x = i + md, j + md
+                    want[0, idx, i, j] = (
+                        p1[0, :, y, x] * p2[0, :, y + di, x + dj]).sum() / C
+            idx += 1
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 3).astype(np.float32)
+    sym = mx.sym.IdentityAttachKLSparseReg(mx.sym.Variable("data"),
+                                           penalty=0.01, momentum=0.9,
+                                           sparseness_target=0.1)
+    aux_name = sym.list_auxiliary_states()[0]
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(xv)},
+                  args_grad={"data": mx.nd.zeros((4, 3))},
+                  aux_states={aux_name: mx.nd.ones((3,)) * 0.5})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.zeros((4, 3)))
+    # forward updates the moving average first; backward uses the new one
+    # (reference identity_attach_KL_sparse_reg-inl.h order)
+    avg_new = 0.9 * 0.5 + 0.1 * xv.mean(axis=0)
+    # no batch division — reference adds the raw penalty per element
+    want = 0.01 * (-0.1 / avg_new + 0.9 / (1 - avg_new))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.broadcast_to(want, (4, 3)), rtol=1e-4)
+    np.testing.assert_allclose(ex.aux_dict[aux_name].asnumpy(), avg_new,
+                               rtol=1e-5)
+    # forward output is the identity
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), xv, rtol=1e-6)
