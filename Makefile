@@ -1,0 +1,28 @@
+# Top-level convenience targets (the reference's Makefile/CI entrypoints
+# role — see tests/ and native/ for the real work).
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(MAKE) -C native test
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
+
+bench:
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py
+
+dist-test:
+	python tools/launch.py -n 2 python tests/dist/dist_sync_kvstore.py
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native test test-fast bench dryrun dist-test clean
