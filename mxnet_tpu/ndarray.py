@@ -461,9 +461,19 @@ def invoke(op_name, args, kwargs=None, out=None, is_train=False):
     if op.needs_rng:
         tensors.append(_random.next_key())
     fn = _jitted_apply(
-        op_name, op.attrs_key(attrs), len(arg_list), n_aux, is_train, op.needs_rng
+        op_name, op.attrs_key(attrs), len(arg_list), n_aux, is_train,
+        op.needs_rng
     )
-    results = fn(*tensors)
+    if op.mesh_aware:
+        # eager calls run dense on the inputs' device: sharding constraints
+        # belong to mesh-scoped traced graphs (ShardedTrainer), and a cached
+        # eager trace must never bake in an ambient mesh
+        from .parallel import default_mesh
+
+        with default_mesh(None):
+            results = fn(*tensors)
+    else:
+        results = fn(*tensors)
     n_out = op.n_outputs(attrs)
     outputs = [NDArray(r, ctx) for r in results[:n_out]]
     # autograd tape hook (contrib.autograd train_section)

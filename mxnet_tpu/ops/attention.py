@@ -394,6 +394,7 @@ def _mha_input_names(attrs):
         "context_parallel_axis": P("str", ""),
         "interpret": P("bool", False),
     },
+    mesh_aware=True,
 )
 def _multi_head_attention(attrs, data, qkv_weight, out_weight,
                           qkv_bias=None, out_bias=None):
@@ -450,3 +451,42 @@ def _default_mesh():
     from ..parallel import get_default_mesh
 
     return get_default_mesh()
+
+
+# ----------------------------------------------------------------------
+# MoELayer symbol op: expert-parallel FFN inside Symbol graphs
+# ----------------------------------------------------------------------
+
+
+@register(
+    "MoELayer",
+    aliases=["_contrib_MoELayer"],
+    arg_names=["data", "gate_weight", "w1_weight", "w2_weight"],
+    num_outputs=2,
+    output_names=["output", "aux_loss"],
+    params={
+        "num_experts": P("int", required=True),
+        "hidden_size": P("int", required=True),
+        "capacity_factor": P("float", 2.0),
+        "expert_axis": P("str", "expert"),
+    },
+    mesh_aware=True,
+)
+def _moe_layer(attrs, data, gate_weight, w1_weight, w2_weight):
+    """Mixture-of-experts FFN as a graph node (capability-gap op — the
+    reference has no MoE).  data (B, S, d); gate_weight (d, E);
+    w1_weight (E, d, h); w2_weight (E, h, d).  Outputs the mixed tokens
+    plus the load-balancing aux loss (add it to the objective via
+    ``MakeLoss``).  When the ambient mesh has an ``expert`` axis
+    (``ShardedTrainer`` sets it), GSPMD all-to-alls the expert buffers
+    across it."""
+    from ..parallel import get_default_mesh
+    from ..parallel.moe import moe_ffn
+
+    params = {"router": gate_weight, "w1": w1_weight, "w2": w2_weight}
+    # moe_ffn itself checks the axis is present on the mesh
+    out, aux_loss = moe_ffn(params, data,
+                            capacity_factor=attrs["capacity_factor"],
+                            expert_axis=attrs["expert_axis"],
+                            mesh=get_default_mesh())
+    return out, aux_loss[None]

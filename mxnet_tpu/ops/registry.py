@@ -116,6 +116,7 @@ class Op:
         output_names: Optional[Sequence[str]] = None,
         input_names_fn: Optional[Callable] = None,
         collect_extra: bool = False,
+        mesh_aware: bool = False,
     ):
         self.name = name
         self.fn = fn
@@ -131,6 +132,9 @@ class Op:
         self.output_names = list(output_names) if output_names else None
         self.input_names_fn = input_names_fn
         self.collect_extra = collect_extra
+        # mesh_aware: the compute rule consults the ambient default mesh at
+        # trace time, so jit caches must key on the mesh identity too
+        self.mesh_aware = mesh_aware
 
     # -- attrs ---------------------------------------------------------
     def parse_attrs(self, kwargs: Dict) -> Dict:

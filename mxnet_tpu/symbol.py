@@ -719,6 +719,13 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
         c = dshape[a.get("axis", -1)]
         solved["gamma"] = (c,)
         solved["beta"] = (c,)
+    elif op.name == "MoELayer":
+        d = dshape[-1]
+        e = a["num_experts"]
+        h = a["hidden_size"]
+        solved["gate_weight"] = (d, e)
+        solved["w1_weight"] = (e, d, h)
+        solved["w2_weight"] = (e, h, d)
     elif op.name == "MultiHeadAttention":
         c = dshape[-1]
         solved["qkv_weight"] = (3 * c, c)
