@@ -409,9 +409,12 @@ def _multi_head_attention(attrs, data, qkv_weight, out_weight,
     B, T, C = data.shape
     H = attrs["num_heads"]
     D = C // H
+    # mixed precision: fp32 master weights cast to the activation dtype
+    qkv_weight = qkv_weight.astype(data.dtype)
+    out_weight = out_weight.astype(data.dtype)
     qkv = jnp.einsum("btc,fc->btf", data, qkv_weight)
     if qkv_bias is not None:
-        qkv = qkv + qkv_bias
+        qkv = qkv + qkv_bias.astype(data.dtype)
     qkv = qkv.reshape(B, T, 3, H, D).transpose(2, 0, 3, 1, 4)  # [3,B,H,T,D]
     q, k, v = qkv[0], qkv[1], qkv[2]
 
@@ -443,7 +446,7 @@ def _multi_head_attention(attrs, data, qkv_weight, out_weight,
     out = out.transpose(0, 2, 1, 3).reshape(B, T, C)
     out = jnp.einsum("btc,fc->btf", out, out_weight)
     if out_bias is not None:
-        out = out + out_bias
+        out = out + out_bias.astype(out.dtype)
     return out.astype(data.dtype)
 
 

@@ -48,12 +48,22 @@ def _fc_input_names(attrs):
 def _fully_connected(attrs, data, weight, bias=None):
     if attrs["flatten"] and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    acc = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
-    out = jax.lax.dot_general(
-        data, weight, (((data.ndim - 1,), (1,)), ((), ())), preferred_element_type=acc
-    ).astype(data.dtype)
+    # mixed precision: fp32 master weights cast to the activation dtype at
+    # use; the MXU accumulates fp32 (preferred_element_type)
+    weight = weight.astype(data.dtype)
+    # NB: no preferred_element_type — the TPU MXU accumulates fp32 for bf16
+    # operands anyway, and a widened primal output breaks the conv/dot
+    # transpose (f32 cotangent vs bf16 operand) under vjp.  fp16 (whose
+    # accumulation is NOT guaranteed fp32 on all backends) computes in fp32.
+    if data.dtype == jnp.float16:
+        out = jax.lax.dot_general(
+            data.astype(jnp.float32), weight.astype(jnp.float32),
+            (((data.ndim - 1,), (1,)), ((), ()))).astype(jnp.float16)
+    else:
+        out = jax.lax.dot_general(
+            data, weight, (((data.ndim - 1,), (1,)), ((), ())))
     if not attrs["no_bias"]:
-        out = out + bias
+        out = out + bias.astype(data.dtype)
     return out
 
 
@@ -98,8 +108,14 @@ def _convolution(attrs, data, weight, bias=None):
     dilate = attrs["dilate"] or (1,) * nd
     pad = attrs["pad"] or (0,) * nd
     nhwc = attrs.get("layout") == "NHWC" and nd == 2
-    # bf16 inputs accumulate in fp32 on the MXU
-    acc = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
+    # mixed precision: fp32 master weights cast to the activation dtype;
+    # bf16 accumulates fp32 on the MXU implicitly; fp16 (no such guarantee
+    # on all backends) computes in fp32 and casts back — see the FC note
+    out_dtype = data.dtype
+    weight = weight.astype(out_dtype)
+    if out_dtype == jnp.float16:
+        data = data.astype(jnp.float32)
+        weight = weight.astype(jnp.float32)
     out = jax.lax.conv_general_dilated(
         data,
         weight,
@@ -111,9 +127,9 @@ def _convolution(attrs, data, weight, bias=None):
         dimension_numbers=("NHWC", "OHWI", "NHWC") if nhwc
         else _conv_dnums(nd),
         feature_group_count=attrs["num_group"],
-        preferred_element_type=acc,
-    ).astype(data.dtype)
+    ).astype(out_dtype)
     if not attrs["no_bias"]:
+        bias = bias.astype(out_dtype)
         out = out + (bias if nhwc else bias.reshape((1, -1) + (1,) * nd))
     return out
 
@@ -149,10 +165,10 @@ def _deconvolution(attrs, data, weight, bias=None):
     padding = [
         (k[i] - 1 - pad[i], k[i] - 1 - pad[i] + adj[i]) for i in range(nd)
     ]
+    weight = weight.astype(data.dtype)
     w = jnp.swapaxes(weight, 0, 1)  # (in, out/g, *k) -> (out/g, in, *k)... see below
     # weight layout for Deconvolution in the reference is (in_ch, out_ch/g, *k)
     w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
-    acc = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
     out = jax.lax.conv_general_dilated(
         data,
         w,
@@ -161,10 +177,9 @@ def _deconvolution(attrs, data, weight, bias=None):
         lhs_dilation=stride,
         dimension_numbers=_conv_dnums(nd),
         feature_group_count=attrs["num_group"],
-        preferred_element_type=acc,
-    ).astype(data.dtype)
+    )
     if not attrs["no_bias"] and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
     return out
 
 
@@ -361,7 +376,8 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var, is_train=Fals
     inv = jax.lax.rsqrt(var + eps)
     out = (data - mean.reshape(bshape).astype(data.dtype)) * (
         inv.reshape(bshape).astype(data.dtype)
-    ) * gamma.reshape(bshape) + beta.reshape(bshape)
+    ) * gamma.reshape(bshape).astype(data.dtype) \
+        + beta.reshape(bshape).astype(data.dtype)
     return out, new_mm, new_mv
 
 

@@ -665,7 +665,9 @@ def _infer(symbol: Symbol, shape_dict: Dict[str, tuple], type_dict=None, partial
         else:
             out_shapes.append(None)
             out_types.append(None)
-    return arg_shapes, out_shapes, aux_shapes, out_types, aux_types
+    # NB position 4 is ARG types (ShardedTrainer consumes them for param
+    # dtype resolution); per-output types come from Symbol.infer_type
+    return arg_shapes, out_shapes, aux_shapes, arg_types, aux_types
 
 
 def _try_param_solve(node, shapes_out, resolved, resolved_types):
