@@ -7,7 +7,7 @@ Produces two artifacts under ``--output-dir``:
  - ``engine_trace.json`` (host engine + frontend scopes; open in
    chrome://tracing or Perfetto)
 
-    python examples/profiler_example.py --steps 10 [--tpus 1]
+    python examples/profiler_example.py --steps 10 [--tpus 0]
 """
 
 import argparse
@@ -28,10 +28,10 @@ def main():
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--output-dir", type=str, default="profile_output")
-    parser.add_argument("--tpus", type=int, default=0)
+    parser.add_argument("--tpus", type=str, default=None)
     args = parser.parse_args()
 
-    ctx = mx.tpu(0) if args.tpus else mx.cpu()
+    ctx = mx.context.devices_from_arg(args.tpus)[0]
     rng = np.random.RandomState(0)
     data = rng.rand(args.batch_size * args.steps, 1, 28, 28).astype(np.float32)
     labels = rng.randint(0, 10, len(data)).astype(np.float32)

@@ -5,7 +5,7 @@ MultiBox contrib ops; runs out of the box with no dataset download).
 The synthetic task: images contain 1-3 axis-aligned bright rectangles on
 noise; the class is the rectangle's color channel.  Usage:
 
-    python examples/ssd/train.py --num-epochs 5 --batch-size 8 [--tpus 1]
+    python examples/ssd/train.py --num-epochs 5 --batch-size 8 [--tpus 0]
 """
 
 import argparse
@@ -139,13 +139,13 @@ def main():
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--num-examples", type=int, default=160)
     parser.add_argument("--image-size", type=int, default=64)
-    parser.add_argument("--tpus", type=int, default=0,
-                        help="train on N TPU chips (0 = cpu)")
+    parser.add_argument("--tpus", type=str, default=None,
+                        help="tpu id list, e.g. '0' or '0,1' (empty = auto)")
     parser.add_argument("--prefix", type=str, default=None,
                         help="checkpoint prefix")
     args = parser.parse_args()
 
-    ctx = [mx.tpu(i) for i in range(args.tpus)] if args.tpus else mx.cpu()
+    ctx = mx.context.devices_from_arg(args.tpus)
     data, labels = make_dataset(args.num_examples, args.image_size)
     vdata, vlabels = make_dataset(32, args.image_size, seed=99)
     train = mx.io.NDArrayIter({"data": data}, {"label": labels},

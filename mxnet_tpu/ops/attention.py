@@ -410,6 +410,10 @@ def _multi_head_attention(attrs, data, qkv_weight, out_weight,
     H = attrs["num_heads"]
     D = C // H
     # mixed precision: fp32 master weights cast to the activation dtype
+    # (bf16 einsums accumulate fp32 on the MXU; fp16 projections compute in
+    # fp32 — the FC note in ops/nn.py)
+    if data.dtype == jnp.float16:
+        data = data.astype(jnp.float32)
     qkv_weight = qkv_weight.astype(data.dtype)
     out_weight = out_weight.astype(data.dtype)
     qkv = jnp.einsum("btc,fc->btf", data, qkv_weight)

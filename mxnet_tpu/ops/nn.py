@@ -165,7 +165,11 @@ def _deconvolution(attrs, data, weight, bias=None):
     padding = [
         (k[i] - 1 - pad[i], k[i] - 1 - pad[i] + adj[i]) for i in range(nd)
     ]
-    weight = weight.astype(data.dtype)
+    out_dtype = data.dtype
+    weight = weight.astype(out_dtype)
+    if out_dtype == jnp.float16:  # see the FC fp16 note
+        data = data.astype(jnp.float32)
+        weight = weight.astype(jnp.float32)
     w = jnp.swapaxes(weight, 0, 1)  # (in, out/g, *k) -> (out/g, in, *k)... see below
     # weight layout for Deconvolution in the reference is (in_ch, out_ch/g, *k)
     w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
@@ -177,9 +181,9 @@ def _deconvolution(attrs, data, weight, bias=None):
         lhs_dilation=stride,
         dimension_numbers=_conv_dnums(nd),
         feature_group_count=attrs["num_group"],
-    )
+    ).astype(out_dtype)
     if not attrs["no_bias"] and bias is not None:
-        out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
+        out = out + bias.astype(out_dtype).reshape((1, -1) + (1,) * nd)
     return out
 
 

@@ -235,7 +235,7 @@ class Symbol:
                     known[name] = shape
         known.update({k: v for k, v in kwargs.items() if v is not None})
         type_dict = {k: _np.float32 for k in known}
-        shapes, out_shapes, aux_shapes, out_types, aux_types = _infer(
+        shapes, out_shapes, aux_shapes, _arg_types, _aux_types = _infer(
             self, known, type_dict, partial=partial
         )
         return shapes, out_shapes, aux_shapes
@@ -655,16 +655,13 @@ def _infer(symbol: Symbol, shape_dict: Dict[str, tuple], type_dict=None, partial
         aux_shapes.append(s)
         aux_types.append(t)
     out_shapes = []
-    out_types = []
     for e in symbol._outputs:
         node, idx = e
         if node._id in shapes_out:
             s = shapes_out[node._id][idx]
             out_shapes.append(tuple(s.shape))
-            out_types.append(_np.dtype(s.dtype))
         else:
             out_shapes.append(None)
-            out_types.append(None)
     # NB position 4 is ARG types (ShardedTrainer consumes them for param
     # dtype resolution); per-output types come from Symbol.infer_type
     return arg_shapes, out_shapes, aux_shapes, arg_types, aux_types
