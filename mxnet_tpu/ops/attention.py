@@ -411,7 +411,8 @@ def _multi_head_attention(attrs, data, qkv_weight, out_weight,
     D = C // H
     # mixed precision: fp32 master weights cast to the activation dtype
     # (bf16 einsums accumulate fp32 on the MXU; fp16 projections compute in
-    # fp32 — the FC note in ops/nn.py)
+    # fp32 and cast back — the FC note in ops/nn.py)
+    out_dtype = data.dtype
     if data.dtype == jnp.float16:
         data = data.astype(jnp.float32)
     qkv_weight = qkv_weight.astype(data.dtype)
@@ -451,7 +452,7 @@ def _multi_head_attention(attrs, data, qkv_weight, out_weight,
     out = jnp.einsum("btc,fc->btf", out, out_weight)
     if out_bias is not None:
         out = out + out_bias.astype(out.dtype)
-    return out.astype(data.dtype)
+    return out.astype(out_dtype)
 
 
 def _default_mesh():

@@ -1,0 +1,40 @@
+"""Tooling tests (reference tier: tools/ utilities — parse_log, bandwidth)."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "t.log"
+    log.write_text(
+        "x Epoch[0] Batch [50]\tSpeed: 99.5 samples/sec\t"
+        "Train-accuracy=0.51\n"
+        "x Epoch[0] Train-accuracy=0.55\n"
+        "x Epoch[0] Time cost=12.3\n"
+        "x Epoch[0] Validation-accuracy=0.52\n"
+        "x Epoch[1] Train-accuracy=0.75\n"
+        "x Epoch[1] Validation-accuracy=0.70\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "parse_log.py"),
+         str(log), "--metric", "accuracy", "--format", "csv"],
+        capture_output=True, text=True, check=True)
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "epoch,train,val,samples_per_sec,time_s"
+    assert lines[1].startswith("0,0.55,0.52,99.5,12.3")
+    assert lines[2].startswith("1,0.75,0.7")
+
+
+def test_bandwidth_smoke():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bandwidth.py"),
+         "--size-mb", "4", "--repeat", "3", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "h2d:" in r.stdout and "all-reduce" in r.stdout

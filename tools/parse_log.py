@@ -1,0 +1,72 @@
+"""Parse training logs into accuracy/throughput tables (parity: reference
+``tools/parse_log.py`` — extracts per-epoch train/val metrics from fit
+logs).
+
+    python tools/parse_log.py train.log [--metric accuracy] [--format md]
+"""
+
+import argparse
+import re
+import sys
+
+_EPOCH = re.compile(
+    r"Epoch\[(\d+)\]\s+(?:Train-)?([\w-]+)=([\d.eE+-]+)")
+_SPEED = re.compile(r"Epoch\[(\d+)\].*Speed:\s*([\d.]+)\s*samples/sec")
+_VALID = re.compile(r"Epoch\[(\d+)\]\s+Validation-([\w-]+)=([\d.eE+-]+)")
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse(path, metric):
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            m = _SPEED.search(line)
+            if m:
+                e = int(m.group(1))
+                rows.setdefault(e, {}).setdefault("speeds", []).append(
+                    float(m.group(2)))
+            m = _TIME.search(line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["time"] = \
+                    float(m.group(2))
+            m = _VALID.search(line)
+            if m and (metric is None or m.group(2).lower().startswith(metric)):
+                rows.setdefault(int(m.group(1)), {})["val"] = \
+                    float(m.group(3))
+                continue
+            m = _EPOCH.search(line)
+            if m and "Validation" not in line and (
+                    metric is None
+                    or m.group(2).lower().startswith(metric)):
+                rows.setdefault(int(m.group(1)), {})["train"] = \
+                    float(m.group(3))
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile")
+    parser.add_argument("--metric", type=str, default=None,
+                        help="metric name prefix filter (e.g. accuracy)")
+    parser.add_argument("--format", choices=["md", "csv"], default="md")
+    args = parser.parse_args()
+    rows = parse(args.logfile, args.metric and args.metric.lower())
+    if not rows:
+        sys.exit("no epoch records found in %s" % args.logfile)
+    if args.format == "md":
+        print("| epoch | train | val | samples/s | time(s) |")
+        print("|---|---|---|---|---|")
+        fmt = "| %d | %s | %s | %s | %s |"
+    else:
+        print("epoch,train,val,samples_per_sec,time_s")
+        fmt = "%d,%s,%s,%s,%s"
+    for e in sorted(rows):
+        r = rows[e]
+        speed = ("%.1f" % (sum(r["speeds"]) / len(r["speeds"]))
+                 if r.get("speeds") else "")
+        print(fmt % (e, r.get("train", ""), r.get("val", ""), speed,
+                     r.get("time", "")))
+
+
+if __name__ == "__main__":
+    main()
