@@ -60,6 +60,7 @@ from .model import FeedForward
 from . import module
 from . import module as mod
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import profiler
 from . import visualization

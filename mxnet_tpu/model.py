@@ -178,8 +178,26 @@ class FeedForward(object):
             mod.set_params(self.arg_params or {}, self.aux_params or {},
                            allow_missing=False)
             self._module = mod
-        outputs = mod.predict(X, num_batch=num_batch)
-        if isinstance(outputs, list) and len(outputs) == 1:
+        if return_data:
+            # reference contract: (outputs, datas, labels), gathered per batch
+            outs, datas, labels = [], [], []
+            for nbatch, batch in enumerate(X):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                mod.forward(batch, is_train=False)
+                keep = batch.data[0].shape[0] - (batch.pad or 0)
+                outs.append(mod.get_outputs()[0].asnumpy()[:keep])
+                datas.append(batch.data[0].asnumpy()[:keep])
+                if batch.label:
+                    labels.append(batch.label[0].asnumpy()[:keep])
+            import numpy as _np
+
+            return (_np.concatenate(outs), _np.concatenate(datas),
+                    _np.concatenate(labels) if labels else None)
+        # always_output_list: a bare NDArray here would be iterated row by
+        # row below — hundreds of eager per-row gathers
+        outputs = mod.predict(X, num_batch=num_batch, always_output_list=True)
+        if len(outputs) == 1:
             return outputs[0].asnumpy()
         return [o.asnumpy() for o in outputs]
 

@@ -128,8 +128,17 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, o.shape) for n, o in
-                zip(self._output_names, self._exec.outputs)]
+        # infer from the bound input shapes — executor outputs don't exist
+        # until the first forward (SequentialModule chains shapes at bind).
+        # Memoized: whole-graph abstract tracing per property access would
+        # tax every chained-module forward.
+        if getattr(self, "_output_shapes_memo", None) is None:
+            shape_dict = {d.name: d.shape for d in self._data_shapes}
+            shape_dict.update({l.name: l.shape for l in self._label_shapes})
+            _, out_shapes, _ = self._symbol.infer_shape_partial(**shape_dict)
+            self._output_shapes_memo = list(
+                zip(self._output_names, out_shapes))
+        return self._output_shapes_memo
 
     # ------------------------------------------------------------------
     # parameters
@@ -302,6 +311,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._mesh = None
+        self._output_shapes_memo = None
 
     # ------------------------------------------------------------------
     # optimizer

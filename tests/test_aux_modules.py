@@ -1,0 +1,106 @@
+"""Aux module-surface tests: Monitor, FeedForward, SequentialModule,
+PythonModule, visualization (reference tier: ``tests/python/unittest``
+subsystem files for each)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _xor_data(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 2, (n, 2)).astype(np.float32)
+    y = (x[:, 0] != x[:, 1]).astype(np.float32)
+    return x + rng.randn(n, 2).astype(np.float32) * 0.1, y
+
+
+def _mlp(hidden=16, classes=2):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=hidden,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_monitor_captures_tensors():
+    data, labels = _xor_data(64)
+    it = mx.io.NDArrayIter(data, labels, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    seen = []
+    mon = mx.mon.Monitor(1, stat_func=lambda a: a,
+                         pattern=".*fc1.*", sort=True)
+    mod.install_monitor(mon)
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    stats = mon.toc()
+    names = [n for _, n, _ in stats]
+    assert any("fc1" in n for n in names), names
+    assert all("fc2" not in n for n in names)
+
+
+def test_feedforward_fit_predict():
+    data, labels = _xor_data(200)
+    ff = mx.model.FeedForward(
+        _mlp(), ctx=mx.cpu(), num_epoch=20,
+        optimizer="sgd",
+        learning_rate=0.5, momentum=0.9,
+        initializer=mx.initializer.Xavier())
+    ff.fit(X=mx.io.NDArrayIter(data, labels, batch_size=20, shuffle=True))
+    prob = ff.predict(mx.io.NDArrayIter(data, batch_size=20))
+    acc = ((prob[:, 1] > 0.5).astype(np.float32) == labels).mean()
+    assert acc > 0.9, acc
+
+
+def test_sequential_module():
+    data, labels = _xor_data(64)
+    net1 = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=8, name="fc1"),
+        act_type="tanh", name="act1")
+    net2 = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("act1_output"), num_hidden=2, name="fc2"),
+        name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, context=mx.cpu(), label_names=[]))
+    seq.add(mx.mod.Module(net2, context=mx.cpu(),
+                          data_names=("act1_output",)),
+            take_labels=True)
+    it = mx.io.NDArrayIter(data, labels, batch_size=32)
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    seq.forward(batch)
+    out = seq.get_outputs()[0].asnumpy()
+    assert out.shape == (32, 2)
+    seq.backward()
+    seq.update()
+
+
+def test_python_module_loss():
+    # PythonLossModule-style usage: a python-computed loss gradient
+    data, labels = _xor_data(64)
+    mod = mx.mod.PythonLossModule()
+    x = mx.nd.array(data[:32])
+    mod.forward(mx.io.DataBatch([x], [mx.nd.array(labels[:32])]))
+    outs = mod.get_outputs()
+    assert outs[0].shape == x.shape
+
+
+def test_visualization_print_summary(capsys):
+    sym = _mlp()
+    mx.viz.print_summary(sym, shape={"data": (1, 2)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+
+
+def test_visualization_plot_network_graphviz_optional():
+    sym = _mlp()
+    try:
+        g = mx.viz.plot_network(sym, shape={"data": (1, 2)})
+    except ImportError:
+        return  # graphviz not installed — acceptable
+    assert g is not None
