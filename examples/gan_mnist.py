@@ -74,15 +74,19 @@ def main():
     parser.add_argument("--tpus", type=str, default=None)
     args = parser.parse_args()
 
-    ctx = mx.context.devices_from_arg(args.tpus)[0]
+    devs = mx.context.devices_from_arg(args.tpus)
+    if len(devs) > 1:
+        print("note: GAN example trains on one device; using %s" % devs[0])
+    ctx = devs[0]
     B, cd = args.batch_size, args.code_dim
+    if args.num_examples < B:
+        sys.exit("--num-examples must be >= --batch-size")
     rng = np.random.RandomState(42)
     real = synthetic_digits(args.num_examples)
 
     gen = mx.mod.Module(make_generator(code_dim=cd), context=ctx,
                         data_names=("code",), label_names=())
-    gen.bind(data_shapes=[("code", (B, cd, 1, 1))], for_training=True,
-             inputs_need_grad=True)
+    gen.bind(data_shapes=[("code", (B, cd, 1, 1))], for_training=True)
     gen.init_params(mx.initializer.Normal(0.02))
     gen.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": args.lr,
@@ -111,20 +115,22 @@ def main():
             fake = gen.get_outputs()[0]
 
             # --- discriminator step: fake=0, real=1 ---
+            # (read outputs AFTER backward: the executor defers the train
+            # forward into the fused fwd+bwd step)
             disc.forward(mx.io.DataBatch([fake], [zeros]), is_train=True)
-            out_f = disc.get_outputs()[0].asnumpy()
             disc.backward()
-            grads_fake = [[g.copy() for g in disc._exec.grad_dict.values()
-                           if g is not None]]
+            out_f = disc.get_outputs()[0].asnumpy()
+            grads_fake = [(k, g.copy())
+                          for k, g in disc._exec.grad_dict.items()
+                          if g is not None and k != "data"]
             disc.forward(mx.io.DataBatch(
                 [mx.nd.array(real[s:s + B], ctx=ctx)], [ones]),
                 is_train=True)
-            out_r = disc.get_outputs()[0].asnumpy()
             disc.backward()
+            out_r = disc.get_outputs()[0].asnumpy()
             # accumulate the fake-pass grads (reference dcgan sums the two)
-            for tgt, src in zip(
-                    [g for g in disc._exec.grad_dict.values()
-                     if g is not None], grads_fake[0]):
+            for k, src in grads_fake:
+                tgt = disc._exec.grad_dict[k]
                 tgt[:] = tgt + src
             disc.update()
             d_acc += ((out_f < 0.5).mean() + (out_r > 0.5).mean()) / 2
