@@ -1,0 +1,106 @@
+"""Fine-tune a checkpointed model on a new task (parity: reference
+``example/image-classification/fine-tune.py`` — load prefix/epoch, cut the
+graph at a feature layer, attach a fresh classifier head, train with the
+backbone params as initialization).
+
+    python examples/image_classification/fine_tune.py \
+        --pretrained-model prefix,epoch --num-classes 4 [--tpus 0]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))
+
+import mxnet_tpu as mx
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten"):
+    """Cut at ``layer_name`` output, attach a fresh FC+softmax (parity:
+    ``fine-tune.py:get_fine_tune_model``)."""
+    all_layers = symbol.get_internals()
+    outputs = all_layers.list_outputs()
+    matches = [n for n in outputs if layer_name in n]
+    if not matches:
+        raise ValueError("no internal output matching %r; have e.g. %s"
+                         % (layer_name, outputs[-8:]))
+    net = all_layers[matches[-1]]
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    # keep only backbone params (the new head re-initializes)
+    new_args = {k: v for k, v in arg_params.items()
+                if k in net.list_arguments()}
+    return net, new_args
+
+
+def _infer_data_shape(sym, arg_params, batch_size):
+    """Recover the input shape from the first layer's weight."""
+    first = sym.list_arguments()[1] if len(sym.list_arguments()) > 1 else None
+    w = arg_params.get(first)
+    if w is not None and len(w.shape) == 4:      # conv: (O, C, kh, kw)
+        c = w.shape[1]
+        return (batch_size, c, 28 if c == 1 else 32, 28 if c == 1 else 32)
+    if w is not None and len(w.shape) == 2:      # fc: (O, C*H*W) — assume sq
+        n = w.shape[1]
+        side = int(round((n) ** 0.5))
+        if side * side == n:
+            return (batch_size, 1, side, side)
+        return (batch_size, n)
+    return (batch_size, 1, 28, 28)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="fine-tune a checkpoint")
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="prefix,epoch")
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten")
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-examples", type=int, default=640)
+    parser.add_argument("--tpus", type=str, default=None)
+    args = parser.parse_args()
+
+    prefix, epoch = args.pretrained_model.split(",")
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        prefix, int(epoch))
+    net, backbone_args = get_fine_tune_model(
+        sym, arg_params, args.num_classes, args.layer_before_fullc)
+
+    # synthetic target task: fewer classes, same input shape as the
+    # backbone.  The input channel/size comes from the checkpoint's first
+    # conv/fc weight (backward shape inference can't reach 'data').
+    data_shape = _infer_data_shape(sym, arg_params, args.batch_size)
+    rng = np.random.RandomState(11)
+    labels = rng.randint(0, args.num_classes, args.num_examples)
+    data = rng.rand(args.num_examples, *data_shape[1:]).astype(np.float32) * 0.3
+    side = data_shape[-1]
+    patch = max(3, side // 6)
+    for c in range(args.num_classes):
+        m = labels == c
+        off = int(c * (side - patch) / max(args.num_classes - 1, 1))
+        data[m, 0, off:off + patch, off:off + patch] += 0.7
+    it = mx.io.NDArrayIter(data, labels.astype(np.float32),
+                           args.batch_size, shuffle=True)
+
+    mod = mx.mod.Module(net, context=mx.context.devices_from_arg(args.tpus))
+    mod.fit(it, num_epoch=args.num_epochs,
+            arg_params=backbone_args, aux_params=aux_params,
+            allow_missing=True,  # fc_new initializes fresh
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9})
+    acc = mod.score(it, "acc")
+    print("fine-tuned accuracy: %s" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

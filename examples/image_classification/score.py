@@ -1,0 +1,72 @@
+"""Score a checkpointed model on a validation set (parity: reference
+``example/image-classification/score.py`` — load prefix/epoch, run metrics
+over an iterator).
+
+    python examples/image_classification/score.py --model prefix,epoch \
+        [--data-val path.rec] [--tpus 0]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))
+
+import mxnet_tpu as mx
+
+
+def score(model, data_val, metrics, tpus=None, batch_size=32,
+          data_shape=(3, 28, 28), num_examples=640, seed=99):
+    prefix, epoch = model.split(",")
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        prefix, int(epoch))
+    devs = mx.context.devices_from_arg(tpus)
+    if data_val and not os.path.exists(data_val):
+        sys.exit("--data-val %r does not exist" % data_val)
+    if data_val:
+        it = mx.io.ImageRecordIter(path_imgrec=data_val,
+                                   data_shape=data_shape,
+                                   batch_size=batch_size)
+    else:
+        print("note: no --data-val given; scoring on the synthetic "
+              "separable-digit set")
+        # synthetic fallback: the same separable-digit generator the train
+        # examples use, so a checkpoint from train_mnist scores sensibly
+        import types
+
+        from common import data as common_data
+
+        fake_args = types.SimpleNamespace(batch_size=batch_size,
+                                          num_examples=num_examples,
+                                          data_dir="data/mnist")
+        kv = types.SimpleNamespace(num_workers=1, rank=0)
+        _, it = common_data.get_mnist_iter(fake_args, kv)
+
+    mod = mx.mod.Module(symbol=sym, context=devs)
+    mod.bind(for_training=False, data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.set_params(arg_params, aux_params)
+    results = mod.score(it, metrics)
+    for name, value in results:
+        print("%s=%f" % (name, value))
+    return results
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score a model")
+    parser.add_argument("--model", type=str, required=True,
+                        help="prefix,epoch of the checkpoint")
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--data-shape", type=str, default="3,28,28")
+    parser.add_argument("--tpus", type=str, default=None)
+    args = parser.parse_args()
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    score(args.model, args.data_val,
+          [mx.metric.create("acc"), mx.metric.create("top_k_accuracy",
+                                                     top_k=5)],
+          tpus=args.tpus, batch_size=args.batch_size, data_shape=shape)

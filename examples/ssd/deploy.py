@@ -1,0 +1,53 @@
+"""Export a trained SSD detector as a deployable artifact (parity:
+reference ``example/ssd/deploy.py`` — strip the training graph to the
+detection symbol and save it for serving).
+
+    python examples/ssd/train.py --num-epochs 8 --prefix /tmp/ssd
+    python examples/ssd/deploy.py --prefix /tmp/ssd --epoch 8
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+def main():
+    parser = argparse.ArgumentParser(description="deploy SSD")
+    parser.add_argument("--prefix", type=str, required=True)
+    parser.add_argument("--epoch", type=int, required=True)
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=1)
+    parser.add_argument("--nms-thresh", type=float, default=0.45)
+    args = parser.parse_args()
+
+    # re-head the checkpoint with the detection (NMS) symbol
+    _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                         args.epoch)
+    det_sym = ssd.get_symbol(num_classes=args.num_classes, num_scales=3,
+                             small=True, use_bn=True,
+                             nms_thresh=args.nms_thresh)
+    deploy_prefix = args.prefix + "-deploy"
+    det_args = {k: v for k, v in arg_params.items()
+                if k in det_sym.list_arguments()}
+    mx.model.save_checkpoint(deploy_prefix, args.epoch, det_sym, det_args,
+                             aux_params)
+    print("saved %s-symbol.json / -%04d.params" % (deploy_prefix, args.epoch))
+
+    # and a single-artifact StableHLO export (runs without this framework)
+    from mxnet_tpu import deploy as dep
+
+    shape = (args.batch_size, 3, args.image_size, args.image_size)
+    path = dep.export_model(deploy_prefix, args.epoch,
+                            input_shapes={"data": shape})
+    print("exported %s" % path)
+
+
+if __name__ == "__main__":
+    main()
