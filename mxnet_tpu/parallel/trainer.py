@@ -235,13 +235,23 @@ class ShardedTrainer:
         mshard = dict(pshard) if use_mom else {}
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
-        self._jit_step = self._with_mesh(jax.jit(
+        self._jit_step_raw = jax.jit(
             step,
             in_shardings=(pshard, mshard, ashard, dshard, None),
             out_shardings=(None, pshard, mshard, ashard),
             donate_argnums=(0, 1),
-        ))
+        )
+        self._jit_step = self._with_mesh(self._jit_step_raw)
         return self._jit_step
+
+    def lowered_step(self, params, moms, aux, batch, rng):
+        """AOT-lower the fused step for inspection (cost/memory analysis via
+        ``.compile().memory_analysis()`` — the memonger accounting)."""
+        from . import default_mesh
+
+        self.step_fn()
+        with default_mesh(self.mesh):
+            return self._jit_step_raw.lower(params, moms, aux, batch, rng)
 
     def forward_fn(self):
         """Jitted inference forward: (params, aux, batch) -> outputs."""
