@@ -21,21 +21,31 @@ def _launch(n, script, timeout=240):
         cwd=_REPO)
 
 
-@pytest.mark.parametrize("n", [2])
-def test_dist_sync_kvstore_via_launcher(n):
-    # retries: on a loaded single-core box the 30 s gloo handshake
-    # occasionally times out; a genuine regression fails every attempt
+def _launch_and_expect(n, script, marker, attempts=3):
+    """Launch + assert all ranks print ``marker``.  Retries: on a loaded
+    single-core box the 30 s gloo handshake occasionally times out; a
+    genuine regression fails every attempt."""
     import time
 
     last = None
-    for attempt in range(3):
-        r = _launch(n, os.path.join(_REPO, "tests", "dist",
-                                    "dist_sync_kvstore.py"))
-        ok = [l for l in r.stdout.splitlines()
-              if "dist_sync kvstore OK" in l]
+    for attempt in range(attempts):
+        r = _launch(n, os.path.join(_REPO, "tests", "dist", script))
+        ok = [l for l in r.stdout.splitlines() if marker in l]
         if r.returncode == 0 and len(ok) == n:
             return
         last = r
-        if attempt < 2:
+        if attempt < attempts - 1:
             time.sleep(5 * (attempt + 1))  # let the load spike drain
     raise AssertionError(last.stdout + "\n" + last.stderr)
+
+
+@pytest.mark.parametrize("n", [2])
+def test_dist_sync_kvstore_via_launcher(n):
+    _launch_and_expect(n, "dist_sync_kvstore.py", "dist_sync kvstore OK")
+
+
+def test_dist_sharded_trainer_via_launcher():
+    # cross-process GSPMD: one global mesh, grads psum over the process
+    # boundary, params stay replicated, model converges
+    _launch_and_expect(2, "dist_sharded_trainer.py",
+                       "dist GSPMD training OK")

@@ -95,3 +95,40 @@ def test_symbol_save_load(tmp_path):
     net.save(fname)
     net2 = mx.sym.load(fname)
     assert net2.list_arguments() == net.list_arguments()
+
+
+def test_load_json_legacy_variants():
+    # reference-era JSON quirks: per-node "param" (not "attrs"), 3-element
+    # input entries [id, idx, version], versioned heads
+    # (legacy_json_util.cc back-compat tier)
+    import json
+
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": [], "param": {}},
+            {"op": "null", "name": "fc_weight", "inputs": [], "param": {}},
+            {"op": "null", "name": "fc_bias", "inputs": [], "param": {}},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4", "no_bias": "False"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "null", "name": "softmax_label", "inputs": [],
+             "param": {}},
+            {"op": "SoftmaxOutput", "name": "softmax", "param": {},
+             "inputs": [[3, 0, 0], [4, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 4],
+        "heads": [[5, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 905]},
+    }
+    sym = mx.sym.load_json(json.dumps(legacy))
+    assert sym.list_arguments() == ["data", "fc_weight", "fc_bias",
+                                    "softmax_label"]
+    ex = sym.bind(mx.cpu(), {
+        "data": mx.nd.array(np.ones((2, 3), np.float32)),
+        "fc_weight": mx.nd.array(np.ones((4, 3), np.float32)),
+        "fc_bias": mx.nd.zeros((4,)),
+        "softmax_label": mx.nd.zeros((2,)),
+    })
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
