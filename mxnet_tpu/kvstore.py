@@ -156,6 +156,13 @@ class KVStore(object):
     def send_command_to_servers(self, head, body):
         pass
 
+    def num_dead_node(self, node_id):
+        """Liveness probe (parity: ``kvstore.h:242`` /
+        ``ps::Postoffice::get_num_dead_node``).  The coordination service
+        fails the whole job on a lost process rather than reporting
+        stragglers, so a reachable store implies zero dead nodes."""
+        return 0
+
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")

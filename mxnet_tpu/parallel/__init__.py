@@ -15,6 +15,9 @@ from . import moe
 from . import pipeline
 from .moe import init_moe_params, moe_ffn
 from .pipeline import PipelinedTrainer, pipeline_apply, stack_stage_params
+from . import checkpoint
+from . import trainer
+from .trainer import ShardedTrainer
 
 # the "active" mesh ops consult at trace time (ring attention's shard_map);
 # scoped via default_mesh() by ShardedTrainer, or installed by the user
