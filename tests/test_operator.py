@@ -562,3 +562,26 @@ def test_identity_attach_kl_sparse_reg():
                                rtol=1e-5)
     # forward output is the identity
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), xv, rtol=1e-6)
+
+
+def test_correlation_numeric_gradient():
+    rng = np.random.RandomState(0)
+    d1 = rng.rand(1, 2, 4, 4).astype(np.float32)
+    d2 = rng.rand(1, 2, 4, 4).astype(np.float32)
+    sym = mx.sym.Correlation(mx.sym.Variable("data1"),
+                             mx.sym.Variable("data2"),
+                             kernel_size=1, max_displacement=1, stride1=1,
+                             stride2=1, pad_size=1)
+    mx.test_utils.check_numeric_gradient(
+        sym, {"data1": d1, "data2": d2}, numeric_eps=1e-3, rtol=1e-2,
+        atol=1e-3)
+
+
+def test_smooth_l1_numeric_gradient():
+    rng = np.random.RandomState(1)
+    # stay away from the |x|=1/sigma^2 kink where the numeric grad is bogus
+    x = rng.uniform(1.2, 2.5, (3, 4)).astype(np.float32) * \
+        np.sign(rng.randn(3, 4)).astype(np.float32)
+    sym = mx.sym.smooth_l1(mx.sym.Variable("data"), scalar=1.0)
+    mx.test_utils.check_numeric_gradient(
+        sym, {"data": x}, numeric_eps=1e-3, rtol=1e-2, atol=1e-3)
