@@ -108,6 +108,11 @@ MXTPU_DLL void *mxtpu_loader_create(const char *path, int part_index,
                                     unsigned seed, int queue_size,
                                     int shuffle_chunk);
 MXTPU_DLL int mxtpu_loader_next(void *h, char **out, size_t *len);
+/* Pop up to max_n records in one call: outs/lens are caller arrays of
+ * size max_n.  Returns the number of records produced (0 = eof, -1 =
+ * error); buffers are malloc'd, caller frees each via mxtpu_buf_free. */
+MXTPU_DLL int mxtpu_loader_next_batch(void *h, int max_n, char **outs,
+                                      size_t *lens);
 MXTPU_DLL void mxtpu_loader_reset(void *h);
 MXTPU_DLL void mxtpu_loader_free(void *h);
 

@@ -180,6 +180,34 @@ struct Loader {
     cv_cons.notify_all();
   }
 
+  // Pop up to max_n queued records at once (amortizes the binding-layer
+  // crossing; blocks only for the first record).  Records move out of the
+  // queue under the lock; the malloc+copy runs unlocked so the producer
+  // keeps filling while the consumer marshals.
+  int NextBatch(int max_n, char **outs, size_t *lens) {
+    std::vector<std::vector<char>> grabbed;
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv_cons.wait(lk, [this] { return !q.empty() || eof || stop; });
+      if (q.empty()) return error ? -1 : 0;
+      int n = 0;
+      while (n < max_n && !q.empty()) {
+        grabbed.push_back(std::move(q.front()));
+        q.pop_front();
+        ++n;
+      }
+      cv_prod.notify_all();
+    }
+    for (size_t i = 0; i < grabbed.size(); ++i) {
+      const auto &rec = grabbed[i];
+      char *buf = (char *)std::malloc(rec.size() ? rec.size() : 1);
+      std::memcpy(buf, rec.data(), rec.size());
+      outs[i] = buf;
+      lens[i] = rec.size();
+    }
+    return (int)grabbed.size();
+  }
+
   // 1 = record, 0 = eof, -1 = error
   int Next(char **out, size_t *len) {
     std::unique_lock<std::mutex> lk(m);
@@ -273,6 +301,10 @@ void *mxtpu_loader_create(const char *path, int part_index, int num_parts,
 
 int mxtpu_loader_next(void *h, char **out, size_t *len) {
   return ((::mxtpu::Loader *)h)->Next(out, len);
+}
+
+int mxtpu_loader_next_batch(void *h, int max_n, char **outs, size_t *lens) {
+  return ((::mxtpu::Loader *)h)->NextBatch(max_n, outs, lens);
 }
 
 void mxtpu_loader_reset(void *h) { ((::mxtpu::Loader *)h)->Reset(); }
