@@ -57,6 +57,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import model
 from .model import FeedForward
+from . import executor_manager
 from . import module
 from . import module as mod
 from . import monitor

@@ -1,0 +1,124 @@
+"""Legacy data-parallel executor manager (parity: reference
+``python/mxnet/executor_manager.py`` — ``DataParallelExecutorManager``, the
+pre-Module multi-device training helper used by ``FeedForward``).
+
+The reference hand-splits batches across device executors
+(``_split_input_slice``) and scatter/gathers grads; here the same API is a
+thin shim over one GSPMD-bound :class:`~mxnet_tpu.module.Module`, which
+shards the batch across the context list on a mesh — per-device slicing is
+the compiler's job.  Kept for API compatibility with FeedForward-era
+training loops.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["DataParallelExecutorManager", "_split_input_slice"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice a batch by device workload (parity:
+    ``executor_manager.py:_split_input_slice``)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        if end <= start:
+            raise ValueError("Too many slices: batch %d over %d workers"
+                             % (batch_size, len(work_load_list)))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorManager(object):
+    """(parity: ``executor_manager.py:DataParallelExecutorManager``)"""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=logging, sym_gen=None):
+        from .module import Module
+
+        if sym_gen is not None:
+            raise NotImplementedError(
+                "sym_gen (bucketing) is not supported by this shim; use "
+                "mx.mod.BucketingModule")
+        data_names = [d.name if hasattr(d, "name") else d[0]
+                      for d in train_data.provide_data]
+        label_names = [l.name if hasattr(l, "name") else l[0]
+                       for l in (train_data.provide_label or [])]
+        self._module = Module(symbol, data_names=data_names,
+                              label_names=label_names, context=ctx,
+                              work_load_list=work_load_list, logger=logger)
+        self._module.bind(data_shapes=train_data.provide_data,
+                          label_shapes=train_data.provide_label,
+                          for_training=True)
+        self.symbol = symbol
+        self.ctx = ctx
+
+    # -- reference surface ---------------------------------------------
+    def install_monitor(self, monitor):
+        self._module.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        self._module.set_params(arg_params, aux_params,
+                                allow_missing=False)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy current params into the given dicts (parity: ``copy_to``)."""
+        args, auxs = self._module.get_params()
+        for name, arr in args.items():
+            if name in arg_params:
+                arr.copyto(arg_params[name])
+            else:
+                arg_params[name] = arr.copy()
+        for name, arr in auxs.items():
+            if name in aux_params:
+                arr.copyto(aux_params[name])
+            else:
+                aux_params[name] = arr.copy()
+
+    @property
+    def param_names(self):
+        return self._module._param_names
+
+    @property
+    def aux_names(self):
+        return self._module._aux_names
+
+    @property
+    def param_arrays(self):
+        exec_ = self._module._exec
+        return [[exec_.arg_dict[n]] for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        # positional 1:1 with param_arrays (None placeholder where a param
+        # has no grad — reference updaters skip None in place)
+        exec_ = self._module._exec
+        return [[exec_.grad_dict.get(n)] for n in self.param_names]
+
+    def load_data_batch(self, data_batch):
+        self._data_batch = data_batch
+
+    def forward(self, is_train=False):
+        self._module.forward(self._data_batch, is_train=is_train)
+
+    def backward(self):
+        self._module.backward()
+
+    def init_optimizer(self, **kwargs):
+        """Attach an optimizer so :meth:`update` works (Module pass-through;
+        the reference updates through an external updater instead)."""
+        self._module.init_optimizer(**kwargs)
+
+    def update(self):
+        self._module.update()
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        if pre_sliced:
+            labels = [l for per_dev in labels for l in per_dev]
+        self._module.update_metric(metric, labels)

@@ -104,3 +104,57 @@ def test_visualization_plot_network_graphviz_optional():
     except ImportError:
         return  # graphviz not installed — acceptable
     assert g is not None
+
+
+def test_executor_manager_surface():
+    # legacy DataParallelExecutorManager shim over Module
+    data, labels = _xor_data(64)
+    it = mx.io.NDArrayIter(data, labels, batch_size=32)
+    em = mx.executor_manager.DataParallelExecutorManager(
+        _mlp(), [mx.cpu()], it)
+    em.set_params(*_init_params(_mlp(), it))
+    metric = mx.metric.Accuracy()
+    batch = next(iter(it))
+    em.load_data_batch(batch)
+    em.forward(is_train=True)
+    em.backward()
+    em.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+    assert len(em.param_arrays) == len(em.param_names)
+    out_args, out_auxs = {}, {}
+    em.copy_to(out_args, out_auxs)
+    assert set(out_args) == set(em.param_names)
+    # slice helper parity
+    sl = mx.executor_manager._split_input_slice(10, [1, 1])
+    assert sl == [slice(0, 5), slice(5, 10)]
+
+
+def _init_params(sym, it):
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    return mod.get_params()
+
+
+def test_executor_manager_guards():
+    import pytest as _pytest
+
+    data, labels = _xor_data(64)
+    it = mx.io.NDArrayIter(data, labels, batch_size=32)
+    with _pytest.raises(NotImplementedError):
+        mx.executor_manager.DataParallelExecutorManager(
+            _mlp(), [mx.cpu()], it, sym_gen=lambda k: _mlp())
+    with _pytest.raises(ValueError):
+        mx.executor_manager._split_input_slice(3, [1, 1, 1, 1])
+    # update() works once an optimizer is attached; grads align with params
+    em = mx.executor_manager.DataParallelExecutorManager(
+        _mlp(), [mx.cpu()], it)
+    em.set_params(*_init_params(_mlp(), it))
+    em.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    em.load_data_batch(batch)
+    em.forward(is_train=True)
+    em.backward()
+    em.update()
+    assert len(em.grad_arrays) == len(em.param_arrays)
