@@ -15,6 +15,9 @@ Types mirror the reference's ``KVStore::Create`` registry
   ``parallel/``); sync semantics match ``dist_sync`` (all workers see the
   aggregated update after pull).  Single-process fallback behaves like
   ``local`` with rank 0 of 1, so the same script runs anywhere.
+  NB deviation: with no server to absorb updates on arrival, ``dist_async``
+  currently shares the synchronous reduce path — the reference's
+  update-on-push staleness semantics (``kvstore.cc:32``) are not modeled.
 
 The optimizer-on-server concept (``kvstore_dist_server.h:136-205``) maps to
 ``set_optimizer``: the updater runs where the reduced value lives (sharded
