@@ -243,6 +243,7 @@ class Module(BaseModule):
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._bound_grad_req = grad_req  # reshape() restores this
         self.binded = True
 
         def _norm(shapes):
@@ -316,6 +317,22 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     # optimizer
     # ------------------------------------------------------------------
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind to new input shapes, keeping parameters and optimizer
+        (parity: ``module.py:reshape`` — the executor-reshape flow for
+        variable batch/sequence sizes).  On XLA this is a new executable
+        (cached per shape by the jit layer), not a buffer reshape.
+        ``_reset_bind`` leaves every optimizer field (updater states,
+        kvstore mode) untouched, so nothing needs restoring."""
+        assert self.binded
+        params = self.get_params() if self.params_initialized else None
+        for_training, need_grad = self.for_training, self.inputs_need_grad
+        self.bind(data_shapes, label_shapes, for_training=for_training,
+                  inputs_need_grad=need_grad, force_rebind=True,
+                  grad_req=self._bound_grad_req)
+        if params is not None:
+            self.set_params(*params)
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):

@@ -130,3 +130,42 @@ def test_module_multi_device_dp():
             initializer=mx.initializer.Xavier())
     score = mod.score(mx.io.NDArrayIter(data, labels, batch_size=40), "acc")
     assert score[0][1] > 0.9, score
+
+
+def test_module_reshape():
+    # reference module.py:reshape — new batch size, params + optimizer kept
+    rng = np.random.RandomState(0)
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 5))], label_shapes=[
+        ("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    b8 = mx.io.DataBatch([mx.nd.array(rng.rand(8, 5).astype(np.float32))],
+                         [mx.nd.array(np.zeros(8, np.float32))])
+    mod.forward(b8); mod.backward(); mod.update()
+    w_before = mod.get_params()[0]["fc_weight"].asnumpy()
+    mom_before = {k: v[0].asnumpy().copy() if isinstance(v, (list, tuple))
+                  else v.asnumpy().copy()
+                  for k, v in mod._updater.states.items()}
+    assert mom_before, "momentum state should exist after one update"
+
+    mod.reshape(data_shapes=[("data", (4, 5))],
+                label_shapes=[("softmax_label", (4,))])
+    # params and accumulated optimizer state both survive the reshape
+    np.testing.assert_array_equal(
+        mod.get_params()[0]["fc_weight"].asnumpy(), w_before)
+    for k, v in mod._updater.states.items():
+        got = v[0].asnumpy() if isinstance(v, (list, tuple)) else v.asnumpy()
+        np.testing.assert_array_equal(got, mom_before[k])
+
+    b4 = mx.io.DataBatch([mx.nd.array(rng.rand(4, 5).astype(np.float32))],
+                         [mx.nd.array(np.zeros(4, np.float32))])
+    mod.forward(b4)
+    assert mod.get_outputs()[0].shape == (4, 3)
+    mod.backward(); mod.update()
+    assert not np.allclose(
+        mod.get_params()[0]["fc_weight"].asnumpy(), w_before)
