@@ -53,6 +53,12 @@ def _init_kvstore_server_module():
 
         server = KVStoreServer(kvstore.create("dist_sync"))
         server.run()
+        # the server process must NOT fall through the import and run the
+        # user's training script as an extra worker (reference
+        # kvstore_server.py:66 exits here for the same reason)
+        import sys
+
+        sys.exit(0)
 
 
 # auto-start matches the reference: importing the module under a server-role

@@ -75,6 +75,22 @@ def latest_step(directory):
     return _manager(directory).latest_step()
 
 
+def _ckpt_has_moms(mgr, step):
+    """True iff the checkpoint at ``step`` contains a non-empty ``moms``
+    subtree (probed from orbax item metadata, no array reads)."""
+    try:
+        meta = mgr.item_metadata(step)
+        tree = getattr(meta, "tree", meta)  # orbax wraps the tree on new APIs
+        if not hasattr(tree, "get"):
+            # unrecognized metadata shape: fail safe — assume momentum was
+            # saved so a genuine restore error is not silently downgraded
+            return True
+        return bool(tree.get("moms"))
+    except Exception:
+        # metadata unavailable (old layout): same fail-safe default
+        return True
+
+
 def restore_sharded(directory, step, trainer=None, shardings=None):
     """Restore ``(params, moms, aux)`` for ``step``.
 
@@ -105,17 +121,15 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
             trainer.aux_dtypes.get(n, "float32"),
             sharding=trainer._sharding(P()))
             for n in trainer.aux_shapes}
-        target = {"params": pstruct,
-                  "moms": dict(pstruct) if trainer._use_momentum else {},
-                  "aux": astruct}
-        try:
-            state = mgr.restore(step, args=ocp.args.StandardRestore(target))
-        except Exception:
-            if not trainer._use_momentum:
-                raise
-            # checkpoint saved without momentum state: restore the rest
-            target["moms"] = {}
-            state = mgr.restore(step, args=ocp.args.StandardRestore(target))
+        moms_target = dict(pstruct) if trainer._use_momentum else {}
+        if trainer._use_momentum and not _ckpt_has_moms(mgr, step):
+            # checkpoint saved without momentum state: restore the rest;
+            # probed from metadata so unrelated restore failures (corrupt
+            # shard, sharding mismatch) still surface instead of being
+            # masked by a blind moms={} retry
+            moms_target = {}
+        target = {"params": pstruct, "moms": moms_target, "aux": astruct}
+        state = mgr.restore(step, args=ocp.args.StandardRestore(target))
         return state["params"], state["moms"], state["aux"]
 
     state = mgr.restore(step)

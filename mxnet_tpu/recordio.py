@@ -128,12 +128,26 @@ class MXRecordIO(object):
         while True:
             header = self.handle.read(8)
             if len(header) < 8:
-                return None if not parts else b"".join(parts)
+                # clean EOF is exactly 0 bytes at a record boundary with no
+                # continuation pending; anything else is a corrupt stream
+                # (the native reader errors here too) — returning a partial
+                # join / None would be silent data corruption
+                if parts:
+                    raise IOError("truncated multi-part RecordIO record "
+                                  "at EOF")
+                if header:
+                    raise IOError("truncated RecordIO header at EOF "
+                                  "(%d of 8 bytes)" % len(header))
+                return None
             magic, lrec = struct.unpack("<II", header)
             if magic != _MAGIC:
                 raise IOError("Invalid RecordIO magic number")
             kind, length = _decode_lrec(lrec)
-            parts.append(self.handle.read(length))
+            payload = self.handle.read(length)
+            if len(payload) < length:
+                raise IOError("truncated RecordIO payload "
+                              "(%d < %d bytes)" % (len(payload), length))
+            parts.append(payload)
             pad = (4 - length % 4) % 4
             if pad:
                 self.handle.read(pad)

@@ -279,10 +279,14 @@ void Engine::Execute(OprBlock *opr) {
     ProfilerRecord(opr->name.empty() ? "opr" : opr->name.c_str(), "engine",
                    t0, NowUs(), tid);
   }
-  // completion: release deps, possibly readying successors
+  // completion: release deps, possibly readying successors.  Naive mode
+  // never called Request() in Push(), so releasing here would underflow
+  // granted_reads / clear a never-set granted_write.
   std::vector<OprBlock *> ready;
-  for (Var *v : opr->const_vars) Release(v, false, &ready);
-  for (Var *v : opr->mutable_vars) Release(v, true, &ready);
+  if (!naive_) {
+    for (Var *v : opr->const_vars) Release(v, false, &ready);
+    for (Var *v : opr->mutable_vars) Release(v, true, &ready);
+  }
   if (opr->deleter) opr->deleter();
   delete opr;
   for (OprBlock *r : ready) Dispatch(r);

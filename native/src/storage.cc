@@ -56,7 +56,12 @@ struct Pool {
       used_bytes += size;
     }
     void *p = nullptr;
-    if (posix_memalign(&p, 64, size) != 0) return nullptr;
+    if (posix_memalign(&p, 64, size) != 0) {
+      // roll back the optimistic accounting or used_bytes stays inflated
+      std::lock_guard<std::mutex> lk(m);
+      used_bytes -= size;
+      return nullptr;
+    }
     return p;
   }
 

@@ -67,3 +67,41 @@ def test_resume_continues_training(tmp_path):
     _, pb, mb, ab = step(pb, mb, ab, batch, jax.random.PRNGKey(2))
     for k in pa:
         np.testing.assert_array_equal(np.asarray(pa[k]), np.asarray(pb[k]))
+
+
+def test_restore_without_moms_yields_empty(tmp_path):
+    """A momentum trainer restoring a checkpoint saved without ``moms``
+    gets {} back (probed from metadata, not a blind retry)."""
+    tr = _trainer()
+    params, moms, aux = tr.init(seed=0)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_sharded(d, 1, params, None, aux)  # no momentum state saved
+    p2, m2, a2 = ckpt.restore_sharded(d, 1, trainer=tr)
+    assert m2 == {}
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p2[k]),
+                                      np.asarray(params[k]))
+
+
+def test_restore_corrupt_shard_raises(tmp_path):
+    """An unrelated restore failure must surface, not be masked by the
+    moms fallback."""
+    import os
+
+    tr = _trainer()
+    params, moms, aux = tr.init(seed=0)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_sharded(d, 1, params, moms, aux)
+    ckpt.close_all()
+    # corrupt the array data in place
+    hit = 0
+    for root, _dirs, files in os.walk(d):
+        for fn in files:
+            path = os.path.join(root, fn)
+            if os.path.getsize(path) > 512:
+                with open(path, "r+b") as f:
+                    f.truncate(97)
+                hit += 1
+    assert hit, "no shard files found to corrupt"
+    with pytest.raises(Exception):
+        ckpt.restore_sharded(d, 1, trainer=tr)
