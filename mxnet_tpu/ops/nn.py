@@ -369,9 +369,18 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var, is_train=Fals
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     use_batch = is_train and not attrs["use_global_stats"]
     if use_batch:
+        # single-pass stats: E[x] and E[x^2] reduce in ONE fused read of the
+        # activation (XLA fuses sibling reductions over the same operand),
+        # halving BN-stat HBM traffic vs the two-pass mean->var form.  fp32
+        # accumulation keeps E[x^2]-E[x]^2 cancellation benign for
+        # BN-scale inputs (conv outputs are near zero-mean).
         x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=axes)
-        var = jnp.var(x32, axis=axes)
+        n = 1.0
+        for i in axes:
+            n *= data.shape[i]
+        mean = jnp.sum(x32, axis=axes) / n
+        var = jnp.sum(jnp.square(x32), axis=axes) / n - jnp.square(mean)
+        var = jnp.maximum(var, 0.0)
         new_mm = mom * moving_mean + (1 - mom) * jax.lax.stop_gradient(mean)
         new_mv = mom * moving_var + (1 - mom) * jax.lax.stop_gradient(var)
     else:

@@ -75,20 +75,25 @@ def latest_step(directory):
     return _manager(directory).latest_step()
 
 
-def _ckpt_has_moms(mgr, step):
-    """True iff the checkpoint at ``step`` contains a non-empty ``moms``
-    subtree (probed from orbax item metadata, no array reads)."""
+def _ckpt_probe_moms(mgr, step):
+    """Tri-state metadata probe: True/False when the checkpoint's metadata
+    definitively shows a non-empty / absent ``moms`` subtree; None when the
+    metadata shape is unrecognized (orbax API variation) or unavailable.
+    Anchored on ``params`` — our save layout always contains it — so an
+    unfamiliar wrapper dict can't masquerade as a definitive answer."""
     try:
         meta = mgr.item_metadata(step)
         tree = getattr(meta, "tree", meta)  # orbax wraps the tree on new APIs
-        if not hasattr(tree, "get"):
-            # unrecognized metadata shape: fail safe — assume momentum was
-            # saved so a genuine restore error is not silently downgraded
-            return True
-        return bool(tree.get("moms"))
+        if hasattr(tree, "get") and "default" in tree \
+                and "params" not in tree:
+            # per-item {'default': ...} wrapper on some orbax versions
+            tree = tree["default"]
+            tree = getattr(tree, "tree", tree)
+        if hasattr(tree, "get") and "params" in tree:
+            return bool(tree.get("moms"))
+        return None
     except Exception:
-        # metadata unavailable (old layout): same fail-safe default
-        return True
+        return None
 
 
 def restore_sharded(directory, step, trainer=None, shardings=None):
@@ -121,15 +126,27 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
             trainer.aux_dtypes.get(n, "float32"),
             sharding=trainer._sharding(P()))
             for n in trainer.aux_shapes}
+        probe = _ckpt_probe_moms(mgr, step) if trainer._use_momentum else False
         moms_target = dict(pstruct) if trainer._use_momentum else {}
-        if trainer._use_momentum and not _ckpt_has_moms(mgr, step):
-            # checkpoint saved without momentum state: restore the rest;
-            # probed from metadata so unrelated restore failures (corrupt
-            # shard, sharding mismatch) still surface instead of being
-            # masked by a blind moms={} retry
+        if probe is False and trainer._use_momentum:
+            # checkpoint definitively saved without momentum state: restore
+            # the rest; because this is probed from metadata, unrelated
+            # restore failures (corrupt shard, sharding mismatch) still
+            # surface instead of being masked by a blind moms={} retry
             moms_target = {}
         target = {"params": pstruct, "moms": moms_target, "aux": astruct}
-        state = mgr.restore(step, args=ocp.args.StandardRestore(target))
+        try:
+            state = mgr.restore(step, args=ocp.args.StandardRestore(target))
+        except Exception:
+            if probe is None and moms_target:
+                # metadata was inconclusive (orbax API variation): legacy
+                # fallback — retry without momentum so a genuinely moms-less
+                # checkpoint stays restorable
+                target["moms"] = {}
+                state = mgr.restore(
+                    step, args=ocp.args.StandardRestore(target))
+            else:
+                raise
         return state["params"], state["moms"], state["aux"]
 
     state = mgr.restore(step)

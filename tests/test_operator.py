@@ -380,6 +380,32 @@ def test_batchnorm_inference_stats():
     assert np.allclose(out.var(axis=(0, 2, 3)), 1.0, atol=1e-2)
 
 
+def test_batchnorm_singlepass_offset_stats():
+    """BN computes var as E[x^2]-E[x]^2 in one fused pass (perf: halves
+    BN-stat HBM reads).  Pin the numerics with a large mean:var ratio —
+    fp32 accumulation must keep cancellation error benign."""
+    np.random.seed(1)
+    # mean ~100, var ~1: ratio 1e4 is far beyond what conv outputs see
+    a = (100.0 + np.random.normal(0.0, 1.0, (32, 4, 8, 8))).astype(np.float32)
+    d = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(data=d, fix_gamma=False, momentum=0.0, name="bn")
+    ex = sym.simple_bind(mx.cpu(), data=a.shape)
+    ex.arg_dict["data"][:] = a
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.arg_dict["bn_beta"][:] = 0.0
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert np.allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-3)
+    assert np.allclose(out.var(axis=(0, 2, 3)), 1.0, atol=5e-2)
+    # the updated moving var (momentum=0 -> pure batch var) must match the
+    # two-pass fp64 reference to fp32-cancellation tolerance: at ratio 1e4
+    # the E[x^2]-E[x]^2 form loses ~mean^2*eps_f32*sqrt(log n) ~ 1e-2 of
+    # variance — the same envelope as cuDNN's single-pass BN
+    ref_var = a.astype(np.float64).transpose(1, 0, 2, 3).reshape(4, -1).var(axis=1)
+    got_var = ex.aux_dict["bn_moving_var"].asnumpy()
+    assert np.allclose(got_var, ref_var, rtol=5e-2), (got_var, ref_var)
+
+
 def test_activation_types():
     a = _rand(3, 4)
     d = mx.sym.Variable("data")

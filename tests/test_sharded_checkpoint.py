@@ -105,3 +105,19 @@ def test_restore_corrupt_shard_raises(tmp_path):
     assert hit, "no shard files found to corrupt"
     with pytest.raises(Exception):
         ckpt.restore_sharded(d, 1, trainer=tr)
+
+
+def test_restore_inconclusive_metadata_falls_back(tmp_path, monkeypatch):
+    """When the metadata probe is inconclusive (orbax API variation), a
+    genuinely moms-less checkpoint must still restore via the legacy
+    moms={} retry."""
+    tr = _trainer()
+    params, moms, aux = tr.init(seed=0)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_sharded(d, 1, params, None, aux)
+    monkeypatch.setattr(ckpt, "_ckpt_probe_moms", lambda mgr, step: None)
+    p2, m2, a2 = ckpt.restore_sharded(d, 1, trainer=tr)
+    assert m2 == {}
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p2[k]),
+                                      np.asarray(params[k]))
