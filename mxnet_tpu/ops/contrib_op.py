@@ -426,7 +426,7 @@ def _ctc_forward(log_probs, labels, label_len, T_len):
 
 @register(
     "_contrib_ctc_loss",
-    aliases=("_contrib_CTCLoss",),
+    aliases=("_contrib_CTCLoss", "CTCLoss", "ctc_loss"),
     arg_names=["data", "label"],
     params={
         "use_data_lengths": P("bool", False),

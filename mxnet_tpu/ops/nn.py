@@ -86,6 +86,7 @@ def _conv_dnums(nd):
 
 @register(
     "Convolution",
+    aliases=["Convolution_v1"],  # legacy pre-NNVM registration, same math
     arg_names=["data", "weight", "bias"],
     input_names_fn=_fc_input_names,
     params={
@@ -194,6 +195,7 @@ def _deconvolution(attrs, data, weight, bias=None):
 
 @register(
     "Pooling",
+    aliases=["Pooling_v1"],  # legacy pre-NNVM registration, same math
     params={
         "kernel": P("shape", None, required=True),
         "pool_type": P("str", "max", enum=["max", "avg", "sum"]),

@@ -416,6 +416,66 @@ def _slice(attrs, x):
     return x[idx]
 
 
+def _norm_slice_bounds(attrs, shape):
+    """Normalize (begin, end) against ``shape`` with negative-index support
+    (matching the sibling ``slice`` op) and validate the extents."""
+    begin = tuple(attrs["begin"])
+    end = tuple(attrs["end"])
+    if len(begin) != len(end) or len(begin) > len(shape):
+        raise ValueError("slice assign: begin %r / end %r invalid for shape %r"
+                         % (begin, end, shape))
+    nb, ne = [], []
+    for b, e, d in zip(begin, end, shape):
+        b = b + d if b < 0 else b
+        e = e + d if e < 0 else e
+        if not (0 <= b <= e <= d):
+            raise ValueError(
+                "slice assign: normalized [%d:%d) out of bounds for dim %d"
+                % (b, e, d))
+        nb.append(b)
+        ne.append(e)
+    return tuple(nb), tuple(ne)
+
+
+@register(
+    "_slice_assign",
+    aliases=["_crop_assign"],
+    arg_names=["lhs", "rhs"],
+    params={"begin": P("shape", None, required=True),
+            "end": P("shape", None, required=True)},
+)
+def _slice_assign(attrs, lhs, rhs):
+    """Functional slice assignment (reference matrix_op.cc ``_crop_assign``,
+    alias ``_slice_assign``): a copy of ``lhs`` with ``lhs[begin:end] = rhs``.
+    On XLA this is a static ``dynamic_update_slice`` — no in-place aliasing
+    needed."""
+    begin, end = _norm_slice_bounds(attrs, lhs.shape)
+    want = tuple(e - b for b, e in zip(begin, end)) + lhs.shape[len(begin):]
+    if tuple(rhs.shape) != want:
+        raise ValueError("slice assign: rhs shape %r != slice extents %r"
+                         % (tuple(rhs.shape), want))
+    return jax.lax.dynamic_update_slice(
+        lhs, rhs.astype(lhs.dtype),
+        begin + (0,) * (lhs.ndim - len(begin)))
+
+
+@register(
+    "_slice_assign_scalar",
+    aliases=["_crop_assign_scalar"],
+    params={"begin": P("shape", None, required=True),
+            "end": P("shape", None, required=True),
+            "scalar": P("float", 0.0)},
+)
+def _slice_assign_scalar(attrs, lhs):
+    """Scalar fill of a slice (reference ``_crop_assign_scalar``)."""
+    begin, end = _norm_slice_bounds(attrs, lhs.shape)
+    fill = jnp.full([e - b for b, e in zip(begin, end)]
+                    + list(lhs.shape[len(begin):]),
+                    attrs["scalar"], dtype=lhs.dtype)
+    return jax.lax.dynamic_update_slice(
+        lhs, fill, begin + (0,) * (lhs.ndim - len(begin)))
+
+
 @register(
     "slice_axis",
     params={
@@ -778,6 +838,24 @@ _sample(
         k, jax.random.gamma(jax.random.fold_in(k, 1), a["k"], s) * (1 - a["p"]) / a["p"]
     ).astype(jnp.float32),
 )
+# generalized (Polya / gamma-Poisson) negative binomial, mean mu and
+# dispersion alpha (reference sample_op.cc GeneralizedNegativeBinomialSampler):
+# lambda ~ Gamma(shape=1/alpha, scale=mu*alpha); x ~ Poisson(lambda).
+# alpha == 0 degenerates to plain Poisson(mu), as in the reference sampler.
+def _gen_nb_draw(k, a, s):
+    if a["alpha"] <= 0.0:
+        return jax.random.poisson(k, a["mu"], s).astype(jnp.float32)
+    lam = jax.random.gamma(jax.random.fold_in(k, 1), 1.0 / a["alpha"], s) \
+        * a["mu"] * a["alpha"]
+    return jax.random.poisson(k, lam).astype(jnp.float32)
+
+
+_sample(
+    "_random_generalized_negative_binomial",
+    ["random_generalized_negative_binomial"],
+    {"mu": P("float", 1.0), "alpha": P("float", 1.0)},
+    _gen_nb_draw,
+)
 
 
 def _multisample(name, aliases, arg_names, draw):
@@ -826,12 +904,30 @@ _multisample(
         jnp.float32),
 )
 _multisample(
-    "_sample_negbinomial", ["sample_negbinomial"], ["k", "p"],
+    "_sample_negbinomial",
+    ["sample_negbinomial", "sample_negative_binomial"], ["k", "p"],
     lambda key, s, kk, p: jax.random.poisson(
         key,
         jax.random.gamma(jax.random.fold_in(key, 1), jnp.broadcast_to(kk, s))
         * (1 - p) / p,
     ).astype(jnp.float32),
+)
+def _gen_nb_multidraw(key, s, mu, al):
+    # alpha entries of 0 degenerate to Poisson(mu); guard the gamma shape
+    # against the division so those lanes stay finite
+    safe = jnp.maximum(al, 1e-6)
+    lam = jax.random.gamma(jax.random.fold_in(key, 1),
+                           jnp.broadcast_to(1.0 / safe, s)) * mu * safe
+    lam = jnp.where(jnp.broadcast_to(al, s) > 0.0, lam,
+                    jnp.broadcast_to(mu, s))
+    return jax.random.poisson(key, lam).astype(jnp.float32)
+
+
+_multisample(
+    "_sample_gennegbinomial",
+    ["sample_gennegbinomial", "sample_generalized_negative_binomial"],
+    ["mu", "alpha"],
+    _gen_nb_multidraw,
 )
 
 

@@ -141,7 +141,16 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
             if probe is None and moms_target:
                 # metadata was inconclusive (orbax API variation): legacy
                 # fallback — retry without momentum so a genuinely moms-less
-                # checkpoint stays restorable
+                # checkpoint stays restorable.  Warn loudly: if the
+                # checkpoint DID contain momentum and its shards are the
+                # broken part, this retry discards optimizer state.
+                import logging
+
+                logging.warning(
+                    "restore_sharded: checkpoint metadata inconclusive and "
+                    "full restore failed; retrying without momentum state "
+                    "(moms={}). If this checkpoint was saved with momentum, "
+                    "optimizer state has been LOST for this resume.")
                 target["moms"] = {}
                 state = mgr.restore(
                     step, args=ocp.args.StandardRestore(target))

@@ -611,3 +611,73 @@ def test_smooth_l1_numeric_gradient():
     sym = mx.sym.smooth_l1(mx.sym.Variable("data"), scalar=1.0)
     mx.test_utils.check_numeric_gradient(
         sym, {"data": x}, numeric_eps=1e-3, rtol=1e-2, atol=1e-3)
+
+
+def test_slice_assign():
+    a = _rand(4, 5)
+    b = _rand(2, 3)
+    got = mx.nd._slice_assign(mx.nd.array(a), mx.nd.array(b),
+                              begin=(1, 1), end=(3, 4)).asnumpy()
+    ref = a.copy()
+    ref[1:3, 1:4] = b
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_slice_assign_scalar():
+    from mxnet_tpu.ops import registry
+    import jax.numpy as jnp
+    a = _rand(4, 5)
+    op = registry.get_op("_crop_assign_scalar")  # via alias
+    got = np.asarray(op.fn({"begin": (0, 2), "end": (2, 5), "scalar": 7.0},
+                           jnp.asarray(a)))
+    ref = a.copy()
+    ref[0:2, 2:5] = 7.0
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_gen_negative_binomial_moments():
+    # mean of GenNB(mu, alpha) is mu; var is mu + alpha*mu^2
+    s = mx.nd.sample_gennegbinomial(
+        mx.nd.array(np.full(2, 5.0, np.float32)),
+        mx.nd.array(np.full(2, 0.1, np.float32)),
+        shape=(4000,)).asnumpy()
+    assert s.shape == (2, 4000)
+    assert np.allclose(s.mean(axis=1), 5.0, atol=0.5), s.mean(axis=1)
+    assert np.allclose(s.var(axis=1), 5.0 + 0.1 * 25.0, atol=2.0)
+
+
+def test_slice_assign_validation_and_negatives():
+    from mxnet_tpu.ops import registry
+    import jax.numpy as jnp
+    a = _rand(4, 5)
+    b = _rand(2, 2)
+    op = registry.get_op("_slice_assign")
+    # negative indices normalize like the sibling slice op
+    got = np.asarray(op.fn({"begin": (1, -4), "end": (3, -2)},
+                           jnp.asarray(a), jnp.asarray(b)))
+    ref = a.copy()
+    ref[1:3, 1:3] = b
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # shape mismatch must raise, not silently write a shifted block
+    with pytest.raises(ValueError):
+        op.fn({"begin": (1, 1), "end": (2, 2)},
+              jnp.asarray(a), jnp.asarray(b))
+    with pytest.raises(ValueError):
+        op.fn({"begin": (3, 0), "end": (6, 2)},
+              jnp.asarray(a), jnp.asarray(b))
+
+
+def test_gen_negative_binomial_alpha_zero():
+    # alpha == 0 degenerates to Poisson(mu) (reference sampler behavior)
+    s = mx.nd.random_generalized_negative_binomial(
+        mu=4.0, alpha=0.0, shape=(8000,)).asnumpy()
+    assert np.isfinite(s).all()
+    assert abs(s.mean() - 4.0) < 0.3
+    assert abs(s.var() - 4.0) < 0.8  # Poisson: var == mean
+    s2 = mx.nd.sample_gennegbinomial(
+        mx.nd.array(np.array([4.0, 4.0], np.float32)),
+        mx.nd.array(np.array([0.0, 0.5], np.float32)),
+        shape=(6000,)).asnumpy()
+    assert np.isfinite(s2).all()
+    assert abs(s2[0].var() - 4.0) < 1.0          # Poisson lane
+    assert s2[1].var() > 8.0                     # overdispersed lane
