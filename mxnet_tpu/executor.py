@@ -135,6 +135,17 @@ class Executor:
             if self._placed:
                 self._var_device = var_device
         self._run = _graph_fn(symbol, node_device if self._placed else None)
+        # stochastic graphs (Dropout, samplers) need a fresh PRNG key per
+        # call; deterministic graphs reuse one cached key — on tunneled
+        # PJRT a per-call eager fold_in is a whole extra device execution
+        # (~10 ms) that would dominate small-batch inference.  Mode-gated
+        # stochastic ops (Dropout: needs_mode) are deterministic at eval,
+        # so inference only pays for always-stochastic ops (samplers).
+        rng_ops = [node.op for node in symbol._topo()
+                   if not node.is_variable and node.op.needs_rng]
+        self._needs_rng_train = bool(rng_ops)
+        self._needs_rng_eval = any(not op.needs_mode for op in rng_ops)
+        self._fixed_rng = None
         self._jit_fwd = {}     # is_train -> jitted forward
         self._jit_step = None  # fused fwd+bwd
         self._outputs: Optional[List[NDArray]] = None
@@ -239,6 +250,15 @@ class Executor:
             self._jit_fwd[is_train] = f if self._placed else jax.jit(f)
         return self._jit_fwd[is_train]
 
+    def _call_rng(self, is_train):
+        """Per-call PRNG key: advancing for graphs stochastic in this mode,
+        cached constant otherwise (no per-call device traffic)."""
+        if self._needs_rng_train if is_train else self._needs_rng_eval:
+            return _random.next_key()
+        if self._fixed_rng is None:
+            self._fixed_rng = _random.next_key()
+        return self._fixed_rng
+
     def _place(self, data):
         """Commit data onto this executor's device (H2D copy if needed) —
         the PJRT transfer that replaces the engine's copy workers."""
@@ -265,7 +285,7 @@ class Executor:
             return None
         self._pending_train = False
         args, auxs = self._gather()
-        outs, new_aux = self._forward_fn(False)(args, auxs, _random.next_key())
+        outs, new_aux = self._forward_fn(False)(args, auxs, self._call_rng(False))
         self._write_aux(new_aux)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         return self._outputs
@@ -273,7 +293,7 @@ class Executor:
     def _materialize_forward(self):
         """Compute deferred train-mode forward without backward."""
         args, auxs = self._gather()
-        outs, new_aux = self._forward_fn(True)(args, auxs, _random.next_key())
+        outs, new_aux = self._forward_fn(True)(args, auxs, self._call_rng(True))
         self._write_aux(new_aux)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train = False
@@ -337,7 +357,7 @@ class Executor:
                 g if g is not None else jnp.ones(s, dtype=d)
                 for g, (s, d) in zip(out_grads, shapes)
             ]
-        outs, new_aux, grads = self._step_fn()(args, auxs, _random.next_key(), out_grads)
+        outs, new_aux, grads = self._step_fn()(args, auxs, self._call_rng(True), out_grads)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train = False
         self._write_aux(new_aux)

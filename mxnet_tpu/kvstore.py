@@ -9,15 +9,19 @@ Types mirror the reference's ``KVStore::Create`` registry
   reduce that the reference does with GPU P2P trees (``comm.h:211-335``) is a
   jitted XLA add-n here, and when values live on a sharded mesh the "reduce"
   is an ICI all-reduce XLA inserts automatically.
-* ``dist_sync`` / ``dist_device_sync`` / ``dist_async`` — multi-process data
-  parallelism.  Instead of ps-lite worker/server RPC over ZMQ, Push/Pull map
-  to ``jax.lax.psum`` collectives across a process-spanning mesh (see
+* ``dist_sync`` / ``dist_device_sync`` — multi-process data parallelism.
+  Instead of ps-lite worker/server RPC over ZMQ, Push/Pull map to
+  ``jax.lax.psum`` collectives across a process-spanning mesh (see
   ``parallel/``); sync semantics match ``dist_sync`` (all workers see the
   aggregated update after pull).  Single-process fallback behaves like
   ``local`` with rank 0 of 1, so the same script runs anywhere.
-  NB deviation: with no server to absorb updates on arrival, ``dist_async``
-  currently shares the synchronous reduce path — the reference's
-  update-on-push staleness semantics (``kvstore.cc:32``) are not modeled.
+* ``dist_async`` — update-on-push with **no barrier** (reference
+  ``kvstore.cc:32`` + async ``DataHandle``,
+  ``kvstore_dist_server.h:136-205``): a host-side parameter server thread
+  on the rank-0 process owns the weights and applies the optimizer the
+  moment each worker's push arrives, so workers progress independently and
+  staleness is observable (``kvstore_async.py``).  Requires
+  ``set_optimizer`` (the updater runs server-side, as in the reference).
 
 The optimizer-on-server concept (``kvstore_dist_server.h:136-205``) maps to
 ``set_optimizer``: the updater runs where the reduced value lives (sharded
@@ -63,6 +67,23 @@ class KVStore(object):
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
+        self._async = None   # AsyncClient for multi-process dist_async
+        self._async_server = None
+        if kind == "dist_async" and self.num_workers > 1:
+            self._init_async()
+
+    def _init_async(self):
+        from . import kvstore_async as ka
+
+        if self.rank == 0:
+            self._async_server = ka.AsyncServer().start()
+            ka.publish_address(self._async_server.address)
+        addr = ka.lookup_address()
+        if addr is None:
+            raise MXNetError(
+                "dist_async needs the jax.distributed coordination service "
+                "(or MXNET_TPU_ASYNC_PS_ADDR) to discover the server")
+        self._async = ka.AsyncClient(addr, self.rank)
 
     # -- identity ------------------------------------------------------
     @property
@@ -91,6 +112,14 @@ class KVStore(object):
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             self._store[k] = vlist[0].copy()
+        if self._async is not None:
+            import numpy as _np
+
+            # same key normalization as push/pull, or digit-string keys
+            # would never match after init
+            self._async.init(
+                [(_updater_key(k), _np.asarray(self._store[k]._data))
+                 for k in keys])
 
     def push(self, key, value, priority=0):
         """Aggregate values into the store (reduce + optional update).
@@ -101,6 +130,7 @@ class KVStore(object):
         """
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
+        pairs = []
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
@@ -110,16 +140,40 @@ class KVStore(object):
                 for v in vlist[1:]:
                     acc = acc + v._data
                 merged = NDArray(acc, vlist[0].context)
+            if self._async is not None:
+                # async: ship the local gradient; the server applies the
+                # update on arrival — no reduce, no barrier, no local copy
+                import numpy as _np
+
+                if self._updater is not None:
+                    raise MXNetError(
+                        "dist_async applies the optimizer on the server: "
+                        "use set_optimizer(), not set_updater()")
+                pairs.append((_updater_key(k), _np.asarray(merged._data)))
+                continue
             if self._kind.startswith("dist"):
                 merged = self._allreduce(merged)
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._store[k])
             else:
                 self._store[k] += merged
+        if pairs:
+            self._async.push(pairs)
 
     def pull(self, key, out=None, priority=0):
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))
+        if self._async is not None:
+            import jax.numpy as jnp
+
+            vals = self._async.pull([_updater_key(k) for k in keys])
+            for k, v, olist in zip(keys, vals, outs):
+                if v is None:
+                    raise MXNetError("key %s has not been initialized" % k)
+                arr = jnp.asarray(v)
+                for o in olist:
+                    o._set_data(arr.astype(o.dtype))
+            return
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
@@ -142,10 +196,18 @@ class KVStore(object):
 
     def set_optimizer(self, optimizer):
         """Register optimizer; in dist modes this plays the reference's
-        'pickle optimizer to servers' role (``kvstore.py:226``) — here the
-        updater simply runs where the reduced values live."""
-        # keep the pickle round-trip to preserve the reference contract
-        optimizer = pickle.loads(pickle.dumps(optimizer))
+        'pickle optimizer to servers' role (``kvstore.py:226``).  Sync
+        modes run the updater where the reduced values live; ``dist_async``
+        ships the pickle to the server thread, which applies it on every
+        push arrival (reference ``kSetOptimizer`` +
+        ``kvstore_dist_server.h:136-205``)."""
+        pickled = pickle.dumps(optimizer)
+        if self._async is not None:
+            if self.rank == 0:  # reference: rank 0 sends to servers
+                self._async.set_optimizer(pickled)
+            self.barrier()  # others wait until the server has it
+            return
+        optimizer = pickle.loads(pickled)
         self._optimizer = optimizer
         self.set_updater(opt.get_updater(optimizer))
 
@@ -157,13 +219,37 @@ class KVStore(object):
             barrier()
 
     def send_command_to_servers(self, head, body):
-        pass
+        """Forward an opaque command to the server role (parity:
+        ``kvstore.py:send_command_to_servers`` / ``kController``).  Only
+        ``dist_async`` has server state to receive it; other modes have no
+        server processes by design, so the call is an error rather than a
+        silent no-op."""
+        if self._async is not None:
+            self._async.command(head, body)
+            return
+        if self._kind == "dist_async":
+            # single-process fallback: no server thread; record locally so
+            # the call is observable rather than silently dropped
+            self._commands = getattr(self, "_commands", [])
+            self._commands.append((head, body))
+            return
+        raise MXNetError(
+            "send_command_to_servers: kvstore type %r has no server role "
+            "(sync modes reduce via collectives; only dist_async runs a "
+            "parameter server)" % self._kind)
 
     def num_dead_node(self, node_id):
         """Liveness probe (parity: ``kvstore.h:242`` /
-        ``ps::Postoffice::get_num_dead_node``).  The coordination service
-        fails the whole job on a lost process rather than reporting
-        stragglers, so a reachable store implies zero dead nodes."""
+        ``ps::Postoffice::get_num_dead_node``).
+
+        ``dist_async``: counted from the parameter server's per-worker
+        heartbeats (a worker silent for ``MXNET_TPU_PS_DEAD_AFTER`` seconds
+        — default 30 — is dead), the ps-lite equivalent.  Sync modes: the
+        jax.distributed coordination service *terminates the job* on a lost
+        process instead of reporting stragglers, so a store you can still
+        call has zero dead nodes by construction."""
+        if self._async is not None:
+            return len(self._async.stats()["dead"])
         return 0
 
     def save_optimizer_states(self, fname):

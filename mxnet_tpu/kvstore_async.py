@@ -1,0 +1,261 @@
+"""Asynchronous parameter server for ``dist_async`` (parity: reference
+``src/kvstore/kvstore_dist_server.h:136-205`` async ``DataHandle`` +
+``kvstore.cc:32``).
+
+Observable semantics match the reference's async mode:
+
+* **update-on-push** — the server applies the optimizer the moment a
+  worker's gradient arrives; there is no cross-worker aggregation and no
+  barrier, so workers progress independently and fast workers see (and
+  compound) updates that slow workers haven't contributed to yet
+  (bounded-by-nothing staleness, exactly ps-lite's behavior).
+* **server-side optimizer** — ``set_optimizer`` pickles the optimizer to
+  the server (reference ``kvstore.py:226`` / ``kSetOptimizer``), which owns
+  the authoritative weights.
+* **pull-anytime** — a pull returns the server's current weight, however
+  stale the puller is.
+
+Topology: the server runs as a thread inside the rank-0 process (the
+TPU-native layout — reduction for *sync* mode rides XLA collectives, so
+only async mode needs a host data plane, and a dedicated thread on the
+coordinator host replaces ps-lite's separate server processes).  Workers
+discover the address through the jax.distributed coordination KV store;
+a ``DMLC_ROLE=server`` process (legacy launch contract) also works: it
+hosts the server loop and exits with the job.
+
+Wire format: length-prefixed pickles over TCP — the host data plane the
+reference implements with ZMQ SArrays.  Tensors cross as numpy; the TPU
+never blocks on this path (grads are fetched to host before push, the
+same D2H the reference does for its CPU-side PS).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["AsyncServer", "AsyncClient", "publish_address", "lookup_address"]
+
+_KV_KEY = "mxtpu_async_ps_addr"
+_DEAD_AFTER_S = float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise EOFError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise EOFError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: AsyncServer = self.server.owner  # type: ignore[attr-defined]
+        try:
+            while True:
+                msg = _recv_msg(self.request)
+                resp = srv.dispatch(msg)
+                _send_msg(self.request, resp)
+        except (EOFError, ConnectionError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class AsyncServer:
+    """The async PS: owns weights, applies updates on arrival."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._store = {}
+        self._updater = None
+        self._commands = []
+        self._lock = threading.Lock()
+        self._heartbeat = {}  # worker rank -> last contact time
+        self._push_counts = {}  # worker rank -> pushes served
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.owner = self
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="mxtpu-async-ps", daemon=True)
+
+    @property
+    def address(self):
+        host, port = self._tcp.server_address[:2]
+        return "%s:%d" % (host, port)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- message dispatch (runs on handler threads) --------------------
+    def dispatch(self, msg):
+        op = msg["op"]
+        rank = msg.get("rank", -1)
+        with self._lock:
+            self._heartbeat[rank] = time.time()
+            if op == "init":
+                # first writer wins (matches reference init-once semantics)
+                for k, v in msg["pairs"]:
+                    self._store.setdefault(k, _np.array(v, copy=True))
+                return {"ok": True}
+            if op == "push":
+                if self._updater is None:
+                    # the reference's async server runs the optimizer; a
+                    # raw-gradient += would be silent lr=-1 ascent
+                    return {"ok": False,
+                            "err": "server optimizer not set — call "
+                                   "set_optimizer() before push"}
+                self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
+                for k, g in msg["pairs"]:
+                    if k not in self._store:
+                        return {"ok": False, "err": "key %r not init" % (k,)}
+                    # update-on-push: no aggregation, no barrier
+                    self._updater(k, g, self._store[k])
+                return {"ok": True}
+            if op == "pull":
+                # copy under the lock: handlers pickle the response after
+                # release, and push handlers mutate weights in place — a
+                # live reference could serialize a torn (mid-update) tensor
+                return {"ok": True,
+                        "vals": [None if self._store.get(k) is None
+                                 else _np.array(self._store[k])
+                                 for k in msg["keys"]]}
+            if op == "set_optimizer":
+                from . import optimizer as opt
+
+                optimizer = pickle.loads(msg["optimizer"])
+                self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+                return {"ok": True}
+            if op == "command":
+                # reference kController escape hatch: kept for inspection
+                self._commands.append((msg["head"], msg["body"]))
+                return {"ok": True}
+            if op == "heartbeat":
+                return {"ok": True}
+            if op == "stats":
+                now = time.time()
+                dead = [r for r, t in self._heartbeat.items()
+                        if now - t > _DEAD_AFTER_S]
+                return {"ok": True, "push_counts": dict(self._push_counts),
+                        "dead": dead, "workers": sorted(self._heartbeat)}
+            return {"ok": False, "err": "unknown op %r" % op}
+
+
+class _NumpyUpdater:
+    """Adapts an mxnet updater (NDArray signature) to numpy server state."""
+
+    def __init__(self, updater):
+        self._updater = updater
+
+    def __call__(self, key, grad, weight):
+        from .ndarray import NDArray
+        import jax.numpy as jnp
+
+        w = NDArray(jnp.asarray(weight))
+        self._updater(key, NDArray(jnp.asarray(grad)), w)
+        weight[...] = _np.asarray(w._data)
+
+
+class AsyncClient:
+    """Worker-side connection to the async PS.
+
+    A daemon thread heartbeats independently of application pushes (the
+    ps-lite model), so liveness is not conflated with push frequency — a
+    worker spending minutes in compute stays alive."""
+
+    def __init__(self, address, rank, heartbeat=True):
+        host, port = address.rsplit(":", 1)
+        self._rank = rank
+        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._lock = threading.Lock()
+        if heartbeat:
+            t = threading.Thread(target=self._heartbeat_loop,
+                                 name="mxtpu-ps-heartbeat", daemon=True)
+            t.start()
+
+    def _heartbeat_loop(self):
+        period = max(_DEAD_AFTER_S / 3.0, 1.0)
+        while True:
+            time.sleep(period)
+            try:
+                self._call({"op": "heartbeat"})
+            except Exception:
+                return  # connection gone; process is exiting
+
+    def _call(self, msg):
+        msg["rank"] = self._rank
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            from .base import MXNetError
+
+            raise MXNetError("async kvstore: %s" % resp.get("err"))
+        return resp
+
+    def init(self, pairs):
+        self._call({"op": "init", "pairs": pairs})
+
+    def push(self, pairs):
+        self._call({"op": "push", "pairs": pairs})
+
+    def pull(self, keys):
+        return self._call({"op": "pull", "keys": keys})["vals"]
+
+    def set_optimizer(self, pickled):
+        self._call({"op": "set_optimizer", "optimizer": pickled})
+
+    def command(self, head, body):
+        self._call({"op": "command", "head": head, "body": body})
+
+    def stats(self):
+        return self._call({"op": "stats"})
+
+
+# -- address discovery over the jax.distributed coordination KV ---------
+
+def publish_address(address):
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is not None:
+        client.key_value_set(_KV_KEY, address)
+
+
+def lookup_address(timeout_s=60):
+    env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDR")
+    if env:
+        return env
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return None
+    return client.blocking_key_value_get(_KV_KEY, int(timeout_s * 1000))

@@ -1,0 +1,89 @@
+"""Multi-process dist_async kvstore worker script (parity: reference
+``dist_async`` mode — update-on-push, no barrier, workers progress
+independently; ``src/kvstore/kvstore_dist_server.h:136-205`` +
+``kvstore.cc:32``).  Launched as N local processes via ``tools/launch.py``.
+
+Asserts, per the round goal:
+* worker step counts **diverge** (the fast worker completes all pushes
+  while the slow worker is still mid-loop — observable staleness),
+* no barrier is needed for progress,
+* training on a quadratic objective still **converges** despite stale
+  updates,
+* the server's per-worker push counts confirm update-on-push arrival.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    init_process_group()
+    kv = mx.kv.create("dist_async")
+    rank, nworkers = kv.rank, kv.num_workers
+    assert nworkers >= 2, "async test needs >= 2 workers"
+
+    shape = (4, 5)
+    kv.init("w", mx.nd.ones(shape))
+    # server-side optimizer: plain SGD, lr chosen for the quadratic below
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                      rescale_grad=1.0, wd=0.0))
+
+    # ---- staleness: fast worker races ahead, slow worker lags ----------
+    nfast, nslow = 30, 6
+    my_steps = nfast if rank == 0 else nslow
+    target = np.full(shape, 3.0, np.float32)
+    seen_weights = []
+    t0 = time.time()
+    for i in range(my_steps):
+        w = mx.nd.zeros(shape)
+        kv.pull("w", out=w)  # pull-anytime: no barrier
+        seen_weights.append(float(w.asnumpy().mean()))
+        grad = mx.nd.array(w.asnumpy() - target)  # d/dw 0.5||w - t||^2
+        kv.push("w", grad)  # update-on-push: applied on arrival
+        if rank != 0:
+            time.sleep(0.05)  # the straggler
+    my_elapsed = time.time() - t0
+
+    # fast worker finished all its pushes while the slow one is mid-loop:
+    # query the server's arrival counts NOW, before any barrier
+    stats = kv._async.stats()
+    counts = stats["push_counts"]
+    if rank == 0:
+        # slow worker cannot have finished yet (it needs >= nslow*50ms)
+        assert counts.get(0, 0) == nfast, counts
+        assert counts.get(1, 0) < nslow or my_elapsed < 0.05 * nslow, \
+            ("no staleness observed", counts, my_elapsed)
+        print("staleness observed: push counts at fast-worker finish = %s"
+              % counts)
+
+    kv.barrier()  # explicit sync point only for the final assertions
+
+    # ---- convergence despite staleness --------------------------------
+    final = mx.nd.zeros(shape)
+    kv.pull("w", out=final)
+    err = float(np.abs(final.asnumpy() - target).max())
+    total_steps = nfast + nslow * (nworkers - 1)
+    assert err < 0.35, ("did not converge", err, final.asnumpy()[0, :3])
+
+    # every worker's pushes arrived (update-on-push bookkeeping)
+    stats = kv._async.stats()
+    assert stats["push_counts"].get(0) == nfast, stats
+    for r in range(1, nworkers):
+        assert stats["push_counts"].get(r) == nslow, stats
+    assert kv.num_dead_node(0) == 0
+    print("worker %d/%d: dist_async kvstore OK (err=%.3f, steps=%d, "
+          "counts=%s)" % (rank, nworkers, err, total_steps,
+                          stats["push_counts"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,3 +49,9 @@ def test_dist_sharded_trainer_via_launcher():
     # boundary, params stay replicated, model converges
     _launch_and_expect(2, "dist_sharded_trainer.py",
                        "dist GSPMD training OK")
+
+
+def test_dist_async_kvstore_via_launcher():
+    # update-on-push, no barrier: worker step counts diverge yet training
+    # converges; staleness asserted from the server's arrival counts
+    _launch_and_expect(2, "dist_async_kvstore.py", "dist_async kvstore OK")

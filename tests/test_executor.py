@@ -132,3 +132,29 @@ def test_dropout_modes():
     # survivors scaled by 1/(1-p)
     assert_almost_equal(out_train[out_train != 0],
                         np.full((out_train != 0).sum(), 2.0, np.float32))
+
+
+def test_rng_key_policy():
+    """Deterministic graphs reuse a cached key (no per-call device traffic);
+    dropout still draws fresh masks per training call but is deterministic
+    at eval."""
+    import numpy as np
+    d = mx.sym.Variable("data")
+    det = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    ex = det.simple_bind(mx.cpu(), data=(2, 3))
+    assert not ex._needs_rng_train and not ex._needs_rng_eval
+    sto = mx.sym.Dropout(mx.sym.FullyConnected(d, num_hidden=16, name="fc"),
+                         p=0.5)
+    ex2 = sto.simple_bind(mx.cpu(), data=(2, 8), grad_req="null")
+    assert ex2._needs_rng_train and not ex2._needs_rng_eval
+    ex2.arg_dict["data"][:] = np.random.randn(2, 8).astype(np.float32)
+    ex2.arg_dict["fc_weight"][:] = np.random.randn(16, 8).astype(np.float32)
+    ex2.arg_dict["fc_bias"][:] = 0.0
+    ex2.forward(is_train=True)
+    a = ex2.outputs[0].asnumpy()
+    ex2.forward(is_train=True)
+    b = ex2.outputs[0].asnumpy()
+    assert not np.allclose(a, b), "train dropout must redraw masks"
+    c = ex2.forward(is_train=False)[0].asnumpy()
+    e = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(c, e)
