@@ -21,6 +21,7 @@ back after the step — the reference mutates them through engine writes.
 from __future__ import annotations
 
 import functools
+import os as _os
 from typing import Dict, List, Optional
 
 import jax
@@ -46,15 +47,22 @@ def _graph_fn(symbol: Symbol, node_device=None):
     ``node_device`` (node_id -> jax.Device) enables ``group2ctx`` model
     parallelism (parity: ``nnvm::pass::PlaceDevice`` + ``_CrossDeviceCopy``
     insertion, reference ``graph_executor.cc:318``,
-    ``src/operator/cross_device_copy.cc``): each node runs on its assigned
-    device, ``jax.device_put`` on its inputs being the cross-device copy
-    (a no-op for inputs already there).  A placed graph must run eagerly —
-    heterogeneous placement can't live inside one XLA computation — and is
-    differentiable: eager ``jax.vjp`` transposes the copies back.
+    ``src/operator/cross_device_copy.cc``): heterogeneous placement can't
+    live inside ONE XLA computation, so the graph is partitioned into
+    contiguous single-device *segments*, each jitted into its own XLA
+    computation — the reference's cached-segment bulk execution
+    (``CreateCachedSegOpr``, ``MXNET_EXEC_BULK_EXEC_TRAIN``) adapted to
+    placement.  Cross-device copies (``jax.device_put``) happen eagerly at
+    segment boundaries only, and the whole composition stays differentiable
+    (``jax.vjp`` through jitted segments transposes the copies back).
+    Set ``MXTPU_PLACED_EAGER=1`` to fall back to the per-op eager walker
+    for debugging (the NaiveEngine analog).
     """
     nodes = symbol._topo()
     out_entries = list(symbol._outputs)
     node_device = node_device or {}
+    if node_device and not _os.environ.get("MXTPU_PLACED_EAGER"):
+        return _placed_graph_fn(nodes, out_entries, node_device)
 
     def run(arg_values, aux_values, rng, is_train):
         env = {}
@@ -82,6 +90,124 @@ def _graph_fn(symbol: Symbol, node_device=None):
                 new_aux[aux_node.name] = new_val
         outputs = [env[n._id][i] for n, i in out_entries]
         # pass untouched aux through so the pytree structure is stable
+        for name in aux_values:
+            new_aux.setdefault(name, aux_values[name])
+        return outputs, new_aux
+
+    return run
+
+
+def _already_on(v, dev):
+    """True iff ``v`` is a concrete single-device array on ``dev`` —
+    cheap guard that skips the eager device_put dispatch (~25-50us each;
+    a placed graph touches hundreds of params per step)."""
+    try:
+        return isinstance(v, jax.Array) and not v.is_deleted() \
+            and v.committed and v.devices() == {dev}
+    except Exception:  # tracers during vjp: fall through to device_put
+        return False
+
+
+def _put(v, dev):
+    return v if _already_on(v, dev) else jax.device_put(v, dev)
+
+
+def _placed_graph_fn(nodes, out_entries, node_device):
+    """Segment-jitted runner for device-placed (group2ctx) graphs."""
+    # ---- partition the topo order into contiguous same-device segments
+    segments = []  # list of dicts: device, nodes
+    for node in nodes:
+        if node.is_variable:
+            continue
+        dev = node_device[node._id]
+        if segments and segments[-1]["device"] is dev:
+            segments[-1]["nodes"].append(node)
+        else:
+            segments.append({"device": dev, "nodes": [node]})
+
+    # ---- per-segment interface: external input entries + exported entries
+    produced_by = {}  # node_id -> segment index
+    for si, seg in enumerate(segments):
+        for node in seg["nodes"]:
+            produced_by[node._id] = si
+    needed = set((n._id, i) for n, i in out_entries)
+    for seg in segments:
+        for node in seg["nodes"]:
+            for src, i in node.inputs:
+                if src.is_variable or produced_by.get(src._id) != \
+                        produced_by[node._id]:
+                    needed.add((src._id, i))
+    for si, seg in enumerate(segments):
+        ext, exports, aux_names = [], [], []
+        seen_ext, seen_exp = set(), set()
+        for node in seg["nodes"]:
+            n_args = len(node.op.input_names(node.attrs))
+            for src, i in node.inputs[:n_args]:
+                entry = (src._id, i)
+                if (src.is_variable or produced_by.get(src._id) != si) \
+                        and entry not in seen_ext:
+                    seen_ext.add(entry)
+                    ext.append(entry)
+            for src, _ in node.inputs[n_args:]:
+                if src.name not in aux_names:
+                    aux_names.append(src.name)
+            for oi in range(node.op.n_outputs(node.attrs)):
+                entry = (node._id, oi)
+                if entry in needed and entry not in seen_exp:
+                    seen_exp.add(entry)
+                    exports.append(entry)
+        seg["ext"], seg["exports"], seg["aux_names"] = ext, exports, aux_names
+
+        seg_nodes = seg["nodes"]
+
+        def seg_fn(ext_vals, aux_vals, rng, is_train,
+                   _ext=tuple(ext), _exports=tuple(exports),
+                   _nodes=tuple(seg_nodes)):
+            env = dict(zip(_ext, ext_vals))
+            aux_env = dict(aux_vals)
+            updates = {}
+            for node in _nodes:
+                n_args = len(node.op.input_names(node.attrs))
+                args = [env[(s._id, i)] for s, i in node.inputs[:n_args]]
+                auxs = [aux_env[s.name] for s, _ in node.inputs[n_args:]]
+                node_rng = (jax.random.fold_in(rng, node._id)
+                            if node.op.needs_rng else None)
+                outs, aux_updates = node.op.apply(
+                    node.attrs, args, auxs, is_train=is_train, rng=node_rng)
+                for oi, o in enumerate(outs):
+                    env[(node._id, oi)] = o
+                for (aux_node, _), new_val in zip(node.inputs[n_args:],
+                                                  aux_updates):
+                    aux_env[aux_node.name] = new_val
+                    updates[aux_node.name] = new_val
+            return [env[e] for e in _exports], updates
+
+        seg["jit"] = {
+            mode: jax.jit(functools.partial(seg_fn, is_train=mode))
+            for mode in (False, True)
+        }
+
+    def run(arg_values, aux_values, rng, is_train):
+        env = {}
+        for node in nodes:
+            if node.is_variable:
+                src = aux_values if node.is_aux else arg_values
+                if node.name not in src:
+                    raise MXNetError("unbound variable %r" % node.name)
+                env[(node._id, 0)] = src[node.name]
+        aux_env = dict(aux_values)
+        new_aux = {}
+        for seg in segments:
+            dev = seg["device"]
+            ext_vals = [_put(env[e], dev) for e in seg["ext"]]
+            aux_in = {n: _put(aux_env[n], dev) for n in seg["aux_names"]}
+            outs, updates = seg["jit"][bool(is_train)](ext_vals, aux_in, rng)
+            for e, o in zip(seg["exports"], outs):
+                env[e] = o
+            for name, val in updates.items():
+                aux_env[name] = val
+                new_aux[name] = val
+        outputs = [env[(n._id, i)] for n, i in out_entries]
         for name in aux_values:
             new_aux.setdefault(name, aux_values[name])
         return outputs, new_aux
@@ -231,7 +357,8 @@ class Executor:
             for d in (self.arg_dict, self.aux_dict):
                 for name, arr in d.items():
                     dev = self._var_device.get(name)
-                    if dev is not None and arr is not None:
+                    if dev is not None and arr is not None \
+                            and not _already_on(arr._data, dev):
                         placed = jax.device_put(arr._data, dev)
                         if placed is not arr._data:
                             arr._set_data(placed)
@@ -246,7 +373,8 @@ class Executor:
             def f(args, auxs, rng):
                 return run(args, auxs, rng, is_train)
 
-            # placed (group2ctx) graphs span devices: eager dispatch, no jit
+            # placed (group2ctx) graphs span devices: _run is already the
+            # segment-jitted composition, so no outer jit
             self._jit_fwd[is_train] = f if self._placed else jax.jit(f)
         return self._jit_fwd[is_train]
 

@@ -86,10 +86,25 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+def _advertise_host():
+    """The address other hosts should dial: override via
+    ``MXNET_TPU_PS_HOST``; defaults to this host's resolvable name with a
+    loopback fallback for single-host simulated clusters."""
+    env = os.environ.get("MXNET_TPU_PS_HOST")
+    if env:
+        return env
+    try:
+        name = socket.gethostname()
+        socket.getaddrinfo(name, None)
+        return name
+    except OSError:
+        return "127.0.0.1"
+
+
 class AsyncServer:
     """The async PS: owns weights, applies updates on arrival."""
 
-    def __init__(self, host="127.0.0.1", port=0):
+    def __init__(self, host="0.0.0.0", port=0):
         self._store = {}
         self._updater = None
         self._commands = []
@@ -103,8 +118,8 @@ class AsyncServer:
 
     @property
     def address(self):
-        host, port = self._tcp.server_address[:2]
-        return "%s:%d" % (host, port)
+        port = self._tcp.server_address[1]
+        return "%s:%d" % (_advertise_host(), port)
 
     def start(self):
         self._thread.start()
@@ -132,12 +147,15 @@ class AsyncServer:
                     return {"ok": False,
                             "err": "server optimizer not set — call "
                                    "set_optimizer() before push"}
-                self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
+                # validate everything BEFORE mutating: a partial update
+                # followed by a client retry would double-apply gradients
+                bad = [k for k, _ in msg["pairs"] if k not in self._store]
+                if bad:
+                    return {"ok": False, "err": "keys %r not init" % (bad,)}
                 for k, g in msg["pairs"]:
-                    if k not in self._store:
-                        return {"ok": False, "err": "key %r not init" % (k,)}
                     # update-on-push: no aggregation, no barrier
                     self._updater(k, g, self._store[k])
+                self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
                 return {"ok": True}
             if op == "pull":
                 # copy under the lock: handlers pickle the response after
