@@ -38,6 +38,16 @@ from .symbol import Symbol, _infer
 __all__ = ["Executor"]
 
 
+def _eval_node(node, args, auxs, rng, is_train):
+    """Evaluate one graph node — the single dispatch rule shared by the
+    eager walker and the placed segment jits, so their numerics can never
+    diverge (the MXTPU_PLACED_EAGER parity contract)."""
+    node_rng = (jax.random.fold_in(rng, node._id)
+                if node.op.needs_rng else None)
+    return node.op.apply(node.attrs, args, auxs,
+                         is_train=is_train, rng=node_rng)
+
+
 def _graph_fn(symbol: Symbol, node_device=None):
     """Build the pure function evaluating the symbol graph.
 
@@ -80,11 +90,8 @@ def _graph_fn(symbol: Symbol, node_device=None):
             if dev is not None:
                 ins = [jax.device_put(v, dev) for v in ins]
             n_args = len(op.input_names(node.attrs))
-            args, auxs = ins[:n_args], ins[n_args:]
-            node_rng = jax.random.fold_in(rng, node._id) if op.needs_rng else None
-            outs, aux_updates = op.apply(
-                node.attrs, args, auxs, is_train=is_train, rng=node_rng
-            )
+            outs, aux_updates = _eval_node(
+                node, ins[:n_args], ins[n_args:], rng, is_train)
             env[node._id] = outs
             for (aux_node, _), new_val in zip(node.inputs[n_args:], aux_updates):
                 new_aux[aux_node.name] = new_val
@@ -170,10 +177,8 @@ def _placed_graph_fn(nodes, out_entries, node_device):
                 n_args = len(node.op.input_names(node.attrs))
                 args = [env[(s._id, i)] for s, i in node.inputs[:n_args]]
                 auxs = [aux_env[s.name] for s, _ in node.inputs[n_args:]]
-                node_rng = (jax.random.fold_in(rng, node._id)
-                            if node.op.needs_rng else None)
-                outs, aux_updates = node.op.apply(
-                    node.attrs, args, auxs, is_train=is_train, rng=node_rng)
+                outs, aux_updates = _eval_node(node, args, auxs, rng,
+                                               is_train)
                 for oi, o in enumerate(outs):
                     env[(node._id, oi)] = o
                 for (aux_node, _), new_val in zip(node.inputs[n_args:],

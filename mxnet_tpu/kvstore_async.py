@@ -86,10 +86,21 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def _advertise_host():
-    """The address other hosts should dial: override via
-    ``MXNET_TPU_PS_HOST``; defaults to this host's resolvable name with a
-    loopback fallback for single-host simulated clusters."""
+def _default_bind_host():
+    """Loopback unless the operator explicitly opts into multi-host via
+    ``MXNET_TPU_PS_HOST``.  The wire protocol is pickle (same trust domain
+    as the jax.distributed coordination service — cluster-internal,
+    unauthenticated), so the listener must not face arbitrary networks by
+    default."""
+    return "0.0.0.0" if os.environ.get("MXNET_TPU_PS_HOST") else "127.0.0.1"
+
+
+def _advertise_host(bind_host):
+    """The address workers should dial for a server bound to
+    ``bind_host``: the bind host itself when it names an interface; for
+    wildcard binds, ``MXNET_TPU_PS_HOST`` or this host's resolvable name."""
+    if bind_host not in ("0.0.0.0", "", "::"):
+        return bind_host
     env = os.environ.get("MXNET_TPU_PS_HOST")
     if env:
         return env
@@ -104,7 +115,9 @@ def _advertise_host():
 class AsyncServer:
     """The async PS: owns weights, applies updates on arrival."""
 
-    def __init__(self, host="0.0.0.0", port=0):
+    def __init__(self, host=None, port=0):
+        host = host if host is not None else _default_bind_host()
+        self._bind_host = host
         self._store = {}
         self._updater = None
         self._commands = []
@@ -119,7 +132,7 @@ class AsyncServer:
     @property
     def address(self):
         port = self._tcp.server_address[1]
-        return "%s:%d" % (_advertise_host(), port)
+        return "%s:%d" % (_advertise_host(self._bind_host), port)
 
     def start(self):
         self._thread.start()
