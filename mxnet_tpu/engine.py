@@ -4,14 +4,20 @@ Parity: reference ``Engine::Get()->PushAsync/NewVariable/WaitForVar/
 WaitForAll`` (``include/mxnet/engine.h:75-250``); engine selection via env
 (``src/engine/engine.cc:13-39``, ``MXNET_ENGINE_TYPE`` → ``MXTPU_ENGINE_TYPE``).
 
-TPU framing: XLA/PJRT owns device async; this engine orders *host-side* work
-— record IO, decode, batch staging, checkpoint writes, host kvstore
-reductions — on C++ worker pools keyed by ``FnProperty`` (normal/io/copy,
-the per-device pool idea of ``threaded_engine_perdevice.cc:55-105`` at host
-scope).  Functions pushed here are Python callables executed on native
-threads (ctypes re-acquires the GIL per call, so pure-numpy/file work
-overlaps fully only when it releases the GIL — same caveat class as the
-reference's Python ``CustomOp`` callbacks).
+TPU framing: XLA/PJRT owns device async; this engine orders *host-side*
+work on C++ worker pools keyed by ``FnProperty`` (normal/io/copy, the
+per-device pool idea of ``threaded_engine_perdevice.cc:55-105`` at host
+scope).  Production consumers: ``io.PrefetchingIter`` batch staging (IO
+lane), ``model.save_checkpoint`` file writes (IO lane, with
+read-after-write vars consumed by ``load_checkpoint``), and single-process
+kvstore reduce/update ops (per-key write vars, ``pull`` waits).  Record
+decode runs on the native RecordLoader's own C++ threads
+(``native/src/recordio.cc``).  Functions pushed here are Python callables
+executed on native threads (ctypes re-acquires the GIL per call, so
+pure-numpy/file work overlaps fully only when it releases the GIL — same
+caveat class as the reference's Python ``CustomOp`` callbacks).
+``op_count()`` exposes the running op total so tests can assert the
+engine is load-bearing.
 
 Falls back to a synchronous in-process engine when the native library is
 unavailable (semantics of the reference ``NaiveEngine``).
@@ -55,15 +61,28 @@ _cb_seq = itertools.count(1)
 _CBTYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
 
+_tls = threading.local()
+
+
+def in_worker():
+    """True when the calling thread is an engine worker executing an op —
+    lets consumers avoid scheduling nested ops that would wait on the same
+    bounded pool (pool-starvation deadlock)."""
+    return getattr(_tls, "in_worker", False)
+
+
 @_CBTYPE
 def _run_cb(key):
     fn = _cb_registry.get(key)
     if fn is not None:
+        _tls.in_worker = True
         try:
             fn()
         except Exception:  # noqa: BLE001 — exceptions can't cross the C ABI
             import traceback
             traceback.print_exc()
+        finally:
+            _tls.in_worker = False
 
 
 @_CBTYPE
@@ -135,6 +154,13 @@ class _SerialEngine(object):
 
 _engine = None
 _engine_lock = threading.Lock()
+_pushed = 0
+
+
+def op_count():
+    """Total ops pushed through the engine this process (both backends) —
+    lets tests assert the engine is load-bearing, not ornamental."""
+    return _pushed
 
 
 def _get():
@@ -162,6 +188,8 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
          prop=FnProperty.NORMAL, name="opr"):
     """Push async host fn with read deps ``const_vars`` and write deps
     ``mutable_vars`` (parity: ``Engine::PushAsync``)."""
+    global _pushed
+    _pushed += 1
     _get().push(fn, const_vars, mutable_vars, priority, prop, name)
 
 

@@ -244,8 +244,16 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Threaded double-buffering prefetcher (parity: ``io.py:PrefetchingIter``,
-    the ``dmlc::ThreadedIter`` equivalent)."""
+    """Double-buffering prefetcher (parity: ``io.py:PrefetchingIter``, the
+    ``dmlc::ThreadedIter`` equivalent).
+
+    Each upstream fetch is an op pushed to the dependency engine's IO lane
+    with the slot's variable as its write dep (``engine.py`` →
+    ``native/src/engine.cc``): the engine's IO worker pool overlaps the
+    fetch with the main thread's device work, and ``wait_for_var`` is the
+    consume-side synchronization — the reference's PrefetcherIter structure
+    (``iter_prefetcher.h:129``) on the host engine instead of ad-hoc
+    threads."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -257,37 +265,42 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
+        from . import engine as _engine
+
+        self._engine = _engine
+        self._vars = [_engine.new_variable() for _ in range(self.n_iter)]
+        self.current_batch = None
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._push_all()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    def _push_fetch(self, i):
+        def fetch():
+            try:
+                self.next_batch[i] = self.iters[i].next()
+            except StopIteration:
+                self.next_batch[i] = None
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)
-        ]
-        for thread in self.prefetch_threads:
-            thread.start()
+        if self._engine.in_worker():
+            # nested prefetchers: running on the bounded IO pool already —
+            # scheduling another IO op and waiting on it could starve the
+            # pool, so degrade to a synchronous fetch
+            fetch()
+            return
+        self._engine.push(fetch, mutable_vars=[self._vars[i]],
+                          prop=self._engine.FnProperty.IO,
+                          name="prefetch%d" % i)
+
+    def _push_all(self):
+        for i in range(self.n_iter):
+            self._push_fetch(i)
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        try:
+            for v in self._vars:
+                self._engine.wait_for_var(v)
+                self._engine.delete_variable(v)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     @property
     def provide_data(self):
@@ -312,18 +325,15 @@ class PrefetchingIter(DataIter):
         ], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        for v in self._vars:
+            self._engine.wait_for_var(v)
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._push_all()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
+        for v in self._vars:
+            self._engine.wait_for_var(v)
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
@@ -339,10 +349,7 @@ class PrefetchingIter(DataIter):
             provide_data=self.provide_data,
             provide_label=self.provide_label,
         )
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._push_all()
         return True
 
     def next(self):

@@ -69,8 +69,19 @@ class KVStore(object):
         self._barrier_count = 0
         self._async = None   # AsyncClient for multi-process dist_async
         self._async_server = None
+        # per-key engine vars: single-process reduce/update ops run on the
+        # dependency engine so the optimizer application overlaps the
+        # caller's device work; pull() is the read-after-write wait
+        self._key_vars = {}
         if kind == "dist_async" and self.num_workers > 1:
             self._init_async()
+
+    def _key_var(self, k):
+        from . import engine
+
+        if k not in self._key_vars:
+            self._key_vars[k] = engine.new_variable()
+        return self._key_vars[k]
 
     def _init_async(self):
         from . import kvstore_async as ka
@@ -111,6 +122,10 @@ class KVStore(object):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
+            if k in self._key_vars:  # re-init: order after pending updates
+                from . import engine
+
+                engine.wait_for_var(self._key_vars[k])
             self._store[k] = vlist[0].copy()
         if self._async is not None:
             import numpy as _np
@@ -152,11 +167,34 @@ class KVStore(object):
                 pairs.append((_updater_key(k), _np.asarray(merged._data)))
                 continue
             if self._kind.startswith("dist"):
+                # collectives involve every process: run on the caller's
+                # thread, synchronously ordered
                 merged = self._allreduce(merged)
-            if self._updater is not None:
-                self._updater(_updater_key(k), merged, self._store[k])
-            else:
-                self._store[k] += merged
+                if self._updater is not None:
+                    self._updater(_updater_key(k), merged, self._store[k])
+                else:
+                    self._store[k] += merged
+                continue
+            # single-process: the update is host-side work — push it to the
+            # engine keyed by this entry's var (reference: kvstore updates
+            # are engine ops with the store array as the write dep).
+            # Snapshot the jax array NOW: it is immutable, but the caller's
+            # NDArray wrapper may be rebound (e.g. by the next backward)
+            # before the engine op runs.
+            from . import engine
+
+            grad_data = merged._data
+            grad_ctx = merged.context
+
+            def update(k=k, grad_data=grad_data, grad_ctx=grad_ctx):
+                g = NDArray(grad_data, grad_ctx)
+                if self._updater is not None:
+                    self._updater(_updater_key(k), g, self._store[k])
+                else:
+                    self._store[k] += g
+
+            engine.push(update, mutable_vars=[self._key_var(k)],
+                        name="kv_update")
         if pairs:
             self._async.push(pairs)
 
@@ -174,9 +212,13 @@ class KVStore(object):
                 for o in olist:
                     o._set_data(arr.astype(o.dtype))
             return
+        from . import engine
+
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
+            if k in self._key_vars:
+                engine.wait_for_var(self._key_vars[k])
             src = self._store[k]
             for o in olist:
                 o._set_data(src._data.astype(o.dtype))
@@ -255,12 +297,20 @@ class KVStore(object):
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
+        from . import engine
+
+        for v in self._key_vars.values():  # drain in-flight updates
+            engine.wait_for_var(v)
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
+        from . import engine
+
+        for v in self._key_vars.values():  # drain in-flight updates
+            engine.wait_for_var(v)
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 

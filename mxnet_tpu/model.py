@@ -25,21 +25,57 @@ BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
+# per-path write-ordering variables: checkpoint writes run on the engine's
+# IO lane (overlapping training), and any load of the same path becomes a
+# read-after-write dependency instead of a race
+_ckpt_vars = {}
+
+
+def _ckpt_var(path):
+    from . import engine
+
+    import os
+    key = os.path.abspath(path)
+    if key not in _ckpt_vars:
+        _ckpt_vars[key] = engine.new_variable()
+    return _ckpt_vars[key]
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save symbol + params (parity: ``model.py:save_checkpoint``)."""
+    """Save symbol + params (parity: ``model.py:save_checkpoint``).
+
+    The params snapshot is taken synchronously (so later in-place updates
+    can't corrupt it) but the file write runs on the dependency engine's
+    IO lane, overlapping the next training steps — the engine-ordered
+    checkpoint write of the reference (``NDArray::Save`` pushed with the
+    array vars as read deps)."""
+    from . import engine
+
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    # snapshot on the calling thread: device fetch + copy
+    arrays = {("arg:%s" % k): v.asnumpy() for k, v in arg_params.items()}
+    arrays.update({("aux:%s" % k): v.asnumpy()
+                   for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+    def write():
+        nd._save_npz(param_name, arrays, "dict")  # atomic temp+rename
+        logging.info("Saved checkpoint to \"%s\"", param_name)
+
+    engine.push(write, mutable_vars=[_ckpt_var(param_name)],
+                prop=engine.FnProperty.IO, name="ckpt_write")
 
 
 def load_checkpoint(prefix, epoch):
     """Load symbol + params (parity: ``model.py:load_checkpoint``)."""
+    from . import engine
+
     symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    # read-after-write ordering against any in-flight engine write
+    engine.wait_for_var(_ckpt_var(param_name))
+    save_dict = nd.load(param_name)
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

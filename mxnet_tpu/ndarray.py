@@ -373,6 +373,27 @@ def waitall():
 # ----------------------------------------------------------------------
 
 
+def _save_npz(fname, arrays, fmt):
+    """Single writer of the on-disk container (shared by :func:`save` and
+    the engine-deferred checkpoint write): atomic via temp-file + rename so
+    a crash mid-write can never leave a truncated file at the final path."""
+    import os
+    import tempfile
+
+    d = os.path.dirname(os.path.abspath(fname)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".mxtpu_save_", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:  # file object keeps exact name (no .npz)
+            _np.savez(f, __mx_format__=fmt, **arrays)
+        os.replace(tmp, fname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save(fname, data):
     """Save a list or str->NDArray dict (parity: ``mx.nd.save``)."""
     if isinstance(data, NDArray):
@@ -383,8 +404,7 @@ def save(fname, data):
     else:
         arrays = {"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
         fmt = "list"
-    with open(fname, "wb") as f:  # file object keeps the exact name (no .npz)
-        _np.savez(f, __mx_format__=fmt, **arrays)
+    _save_npz(fname, arrays, fmt)
 
 
 def load(fname):
