@@ -1,0 +1,359 @@
+"""Faster R-CNN end-to-end training slice (parity: reference
+``example/rcnn/`` — RPN + Proposal + ROIPooling + python ProposalTarget
+op + RCNN head, ``src/operator/contrib/proposal.cc``,
+``example/rcnn/rcnn/symbol/proposal_target.py``).
+
+Synthetic detection task: each image carries ONE axis-aligned solid
+rectangle whose fill intensity pattern encodes its class; the network
+must localize it (RPN + proposals) and classify the pooled region
+(RCNN head).  The whole two-stage detector trains as one Symbol graph:
+
+    backbone convs -> RPN conv -> {rpn_cls SoftmaxOutput,
+                                   rpn_bbox smooth_l1 (MakeLoss)}
+                     \\-> Proposal (static-shape TPU redesign)
+                          -> ProposalTarget (python CustomOp, host)
+                          -> ROIPooling -> FC -> rcnn_cls SoftmaxOutput
+
+    python examples/rcnn/train.py [--num-epochs 6] [--tpus 0]
+
+NB the ProposalTarget CustomOp lowers to host callbacks; tunneled dev
+backends may not support them — default context is cpu (real TPU
+runtimes do support host callbacks; pass --tpus 1 there).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_HERE)))
+
+def _want_tpu(argv):
+    for i, a in enumerate(argv):
+        if a == "--tpus" and i + 1 < len(argv):
+            return argv[i + 1] != "0"
+        if a.startswith("--tpus="):
+            return a.split("=", 1)[1] != "0"
+    return False
+
+
+if __name__ == "__main__" and not _want_tpu(sys.argv[1:]):
+    # the ProposalTarget CustomOp needs host callbacks; force the CPU
+    # platform BEFORE the first backend touch (tunneled dev backends lack
+    # send/recv callback support — real TPU runtimes have it; pass
+    # --tpus 1 there)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import mxnet_tpu as mx
+
+# ---- task geometry -------------------------------------------------------
+IM = 64                 # image side
+STRIDE = 8              # backbone downsampling
+FEAT = IM // STRIDE     # feature map side
+SCALES = (2.0, 4.0)     # anchor sides = STRIDE*scale = 16, 32 px
+RATIOS = (1.0,)
+K = len(SCALES) * len(RATIOS)
+A = FEAT * FEAT * K     # anchors per image
+POST_NMS = 8            # proposals kept per image (static shape)
+NUM_CLASSES = 3         # foreground classes; rcnn head adds background=0
+
+
+def _base_anchors():
+    """(K,4) anchors centered at (0,0) in x1,y1,x2,y2 (stride coords)."""
+    out = []
+    for s in SCALES:
+        for r in RATIOS:
+            side = STRIDE * s
+            w, h = side * np.sqrt(r), side / np.sqrt(r)
+            cx = cy = (STRIDE - 1) / 2.0
+            out.append([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                        cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)])
+    return np.array(out, np.float32)
+
+
+def _all_anchors():
+    base = _base_anchors()
+    shifts = np.arange(FEAT, dtype=np.float32) * STRIDE
+    sy, sx = np.meshgrid(shifts, shifts, indexing="ij")
+    shift = np.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    return (shift + base[None]).reshape(-1, 4)  # (A,4), HW-major then K
+
+
+def _iou(boxes, gt):
+    """IoU of (N,4) boxes vs one (4,) gt box."""
+    x1 = np.maximum(boxes[:, 0], gt[0])
+    y1 = np.maximum(boxes[:, 1], gt[1])
+    x2 = np.minimum(boxes[:, 2], gt[2])
+    y2 = np.minimum(boxes[:, 3], gt[3])
+    iw = np.maximum(x2 - x1 + 1, 0)
+    ih = np.maximum(y2 - y1 + 1, 0)
+    inter = iw * ih
+    ab = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+    ag = (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1)
+    return inter / (ab + ag - inter)
+
+
+def _bbox_transform(anchors, gt):
+    """Regression targets from anchors to gt (reference bbox_transform)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    ax = anchors[:, 0] + 0.5 * (aw - 1)
+    ay = anchors[:, 1] + 0.5 * (ah - 1)
+    gw = gt[2] - gt[0] + 1
+    gh = gt[3] - gt[1] + 1
+    gx = gt[0] + 0.5 * (gw - 1)
+    gy = gt[1] + 0.5 * (gh - 1)
+    return np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                     np.log(gw / aw), np.log(gh / ah)], axis=1)
+
+
+# ---- synthetic data ------------------------------------------------------
+
+def make_batch(rng, batch):
+    """Images with one class-coded rectangle + RPN training targets."""
+    anchors = _all_anchors()
+    imgs = rng.uniform(-0.2, 0.2, (batch, 3, IM, IM)).astype(np.float32)
+    gts = np.zeros((batch, 5), np.float32)       # [cls,x1,y1,x2,y2]
+    rpn_label = np.full((batch, A), -1, np.float32)
+    rpn_bbox_target = np.zeros((batch, A, 4), np.float32)
+    rpn_bbox_weight = np.zeros((batch, A, 4), np.float32)
+    for b in range(batch):
+        cls = rng.randint(1, NUM_CLASSES + 1)
+        side = rng.randint(14, 30)
+        x1 = rng.randint(2, IM - side - 2)
+        y1 = rng.randint(2, IM - side - 2)
+        gt = np.array([x1, y1, x1 + side, y1 + side], np.float32)
+        # class-coded fill: distinct per-channel intensities
+        fill = {1: (1.0, -1.0, -1.0), 2: (-1.0, 1.0, -1.0),
+                3: (-1.0, -1.0, 1.0)}[cls]
+        for c in range(3):
+            imgs[b, c, y1:y1 + side, x1:x1 + side] = fill[c]
+        gts[b] = [cls, gt[0], gt[1], gt[2], gt[3]]
+        iou = _iou(anchors, gt)
+        fg = iou >= 0.5
+        fg[np.argmax(iou)] = True
+        # balanced anchor sampling (reference AnchorLoader: 256 anchors,
+        # <=50% fg): training on every bg anchor drowns the handful of fg
+        # ones and the learned scores stop ranking anchors near the object
+        bg_pool = np.flatnonzero(~fg & (iou < 0.3))
+        n_bg = min(len(bg_pool), max(3 * int(fg.sum()), 24))
+        bg_sel = rng.choice(bg_pool, size=n_bg, replace=False)
+        rpn_label[b, bg_sel] = 0
+        rpn_label[b, fg] = 1
+        rpn_bbox_target[b, fg] = _bbox_transform(anchors[fg], gt)
+        rpn_bbox_weight[b, fg] = 1.0
+    # reorder anchor axis (HW-major,K) -> the head's (K,HW) layout used by
+    # the (B,2,A) reshape of rpn_cls_score and (B,K*4,H,W) bbox pred
+    perm = (np.arange(A).reshape(FEAT * FEAT, K).T).reshape(-1)
+    return (imgs, gts, rpn_label[:, perm],
+            rpn_bbox_target[:, perm].transpose(0, 2, 1).reshape(
+                batch, 4 * K if False else -1, FEAT, FEAT),
+            rpn_bbox_weight[:, perm].transpose(0, 2, 1).reshape(
+                batch, -1, FEAT, FEAT))
+
+
+# ---- ProposalTarget as a python CustomOp (reference proposal_target.py) --
+
+class ProposalTarget(mx.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()      # (B*POST,5)
+        gts = in_data[1].asnumpy()       # (B,5)
+        labels = np.zeros((rois.shape[0],), np.float32)
+        for i, roi in enumerate(rois):
+            gt = gts[int(roi[0])]
+            if _iou(roi[None, 1:5], gt[1:5])[0] >= 0.5:
+                labels[i] = gt[0]
+        self.assign(out_data[0], req[0], mx.nd.array(labels))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g in in_grad:
+            g[:] = 0.0
+
+
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["label"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [(in_shape[0][0],)], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTarget()
+
+
+# ---- the symbol ----------------------------------------------------------
+
+def get_symbol(batch):
+    data = mx.sym.Variable("data")
+    gt = mx.sym.Variable("gt_boxes")
+    rpn_label = mx.sym.Variable("rpn_label")
+    bbox_t = mx.sym.Variable("rpn_bbox_target")
+    bbox_w = mx.sym.Variable("rpn_bbox_weight")
+    im_info = mx.sym.Variable("im_info")
+
+    body = data
+    for i, f in enumerate((16, 32, 32)):
+        body = mx.sym.Convolution(body, num_filter=f, kernel=(3, 3),
+                                  stride=(2, 2), pad=(1, 1),
+                                  name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+
+    rpn = mx.sym.Activation(
+        mx.sym.Convolution(body, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                           name="rpn_conv"), act_type="relu")
+    rpn_cls_score = mx.sym.Convolution(rpn, num_filter=2 * K, kernel=(1, 1),
+                                       name="rpn_cls_score")
+    rpn_bbox_pred = mx.sym.Convolution(rpn, num_filter=4 * K, kernel=(1, 1),
+                                       name="rpn_bbox_pred")
+
+    # RPN classification over anchors (reference: reshape to (B,2,-1))
+    score_rs = mx.sym.reshape(rpn_cls_score, shape=(batch, 2, -1))
+    rpn_cls = mx.sym.SoftmaxOutput(score_rs, rpn_label, multi_output=True,
+                                   use_ignore=True, ignore_label=-1,
+                                   normalization="valid", name="rpn_cls")
+    # RPN box regression on fg anchors
+    bbox_l1 = mx.sym.smooth_l1(
+        mx.sym.broadcast_mul(bbox_w, rpn_bbox_pred - bbox_t), scalar=3.0)
+    rpn_bbox_loss = mx.sym.MakeLoss(mx.sym.sum(bbox_l1),
+                                    grad_scale=1.0 / (batch * 8),
+                                    name="rpn_bbox_loss")
+
+    # proposals from the (blocked-grad) RPN outputs
+    cls_act = mx.sym.SoftmaxActivation(mx.sym.BlockGrad(rpn_cls_score),
+                                       mode="channel")
+    from mxnet_tpu.contrib import sym as contrib_sym
+
+    rois = contrib_sym.Proposal(
+        cls_prob=cls_act, bbox_pred=mx.sym.BlockGrad(rpn_bbox_pred),
+        im_info=im_info, feature_stride=STRIDE, scales=SCALES,
+        ratios=RATIOS, rpn_pre_nms_top_n=64,
+        rpn_post_nms_top_n=POST_NMS, rpn_min_size=4, name="rois")
+
+    # host-side matching of proposals to gt (python CustomOp)
+    rcnn_label = mx.sym.Custom(rois, gt, op_type="proposal_target",
+                               name="rcnn_label")
+
+    pooled = mx.sym.ROIPooling(body, rois, pooled_size=(4, 4),
+                               spatial_scale=1.0 / STRIDE, name="roi_pool")
+    fc = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Flatten(pooled), num_hidden=64,
+                              name="rcnn_fc"), act_type="relu")
+    rcnn_cls = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(fc, num_hidden=NUM_CLASSES + 1,
+                              name="rcnn_score"),
+        mx.sym.BlockGrad(rcnn_label), name="rcnn_cls")
+
+    return mx.sym.Group([rpn_cls, rpn_bbox_loss, rcnn_cls,
+                         mx.sym.BlockGrad(rois),
+                         mx.sym.BlockGrad(rcnn_label)])
+
+
+def train(num_epochs=6, batch=8, ctx=None, lr=0.02, seed=0, log=True):
+    ctx = ctx or mx.cpu()
+    rng = np.random.RandomState(seed)
+    # initializers draw from the global numpy stream (reference behavior);
+    # pin it so the run is reproducible under any harness
+    np.random.seed(seed + 1)
+    sym = get_symbol(batch)
+    ex = sym.simple_bind(
+        ctx, data=(batch, 3, IM, IM), gt_boxes=(batch, 5),
+        rpn_label=(batch, A), rpn_bbox_target=(batch, 4 * K, FEAT, FEAT),
+        rpn_bbox_weight=(batch, 4 * K, FEAT, FEAT), im_info=(batch, 3),
+        grad_req={n: ("null" if n in ("data", "gt_boxes", "rpn_label",
+                                      "rpn_bbox_target", "rpn_bbox_weight",
+                                      "im_info") else "write")
+                  for n in sym.list_arguments()})
+    init = mx.initializer.Xavier(magnitude=2.0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "gt_boxes", "rpn_label", "rpn_bbox_target",
+                        "rpn_bbox_weight", "im_info"):
+            init(mx.initializer.InitDesc(name), arr)
+    opt = mx.optimizer.SGD(learning_rate=lr, momentum=0.9, wd=1e-4,
+                           rescale_grad=1.0 / batch,
+                           lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                               step=24 * 4, factor=0.5))
+    updater = mx.optimizer.get_updater(opt)
+    im_info = np.tile(np.array([IM, IM, 1.0], np.float32), (batch, 1))
+
+    stats = {}
+    for epoch in range(num_epochs):
+        rpn_hits = rpn_tot = rcnn_hits = rcnn_tot = fg_hits = fg_tot = 0
+        ious = []
+        for _ in range(24):
+            imgs, gts, rl, bt, bw = make_batch(rng, batch)
+            ex.arg_dict["data"][:] = imgs
+            ex.arg_dict["gt_boxes"][:] = gts
+            ex.arg_dict["rpn_label"][:] = rl
+            ex.arg_dict["rpn_bbox_target"][:] = bt
+            ex.arg_dict["rpn_bbox_weight"][:] = bw
+            ex.arg_dict["im_info"][:] = im_info
+            ex.forward(is_train=True)
+            ex.backward()
+            for i, name in enumerate(sorted(ex.grad_dict)):
+                g = ex.grad_dict[name]
+                if g is not None:
+                    updater(i, g, ex.arg_dict[name])
+            outs = [o.asnumpy() for o in ex.outputs]
+            rpn_prob, _, rcnn_prob, rois, rcnn_label = outs
+            pred = rpn_prob.argmax(axis=1).reshape(batch, A)
+            mask = rl >= 0
+            rpn_hits += int((pred[mask] == rl[mask]).sum())
+            rpn_tot += int(mask.sum())
+            rcnn_pred = rcnn_prob.argmax(axis=1)
+            rcnn_hits += int((rcnn_pred == rcnn_label).sum())
+            rcnn_tot += rcnn_label.size
+            fg = rcnn_label > 0
+            fg_hits += int((rcnn_pred[fg] == rcnn_label[fg]).sum())
+            fg_tot += int(fg.sum())
+            for b in range(batch):
+                sl = rois[rois[:, 0] == b]
+                if len(sl):
+                    ious.append(float(_iou(sl[:, 1:5], gts[b, 1:5]).max()))
+        stats = {"rpn_acc": rpn_hits / max(rpn_tot, 1),
+                 "rcnn_acc": rcnn_hits / max(rcnn_tot, 1),
+                 "fg_rois": fg_tot,
+                 "fg_acc": fg_hits / max(fg_tot, 1),
+                 "mean_best_iou": float(np.mean(ious)) if ious else 0.0}
+        if log:
+            logging.info("epoch %d: rpn_acc=%.3f rcnn_acc=%.3f "
+                         "fg_acc=%.3f/%d best_iou=%.3f",
+                         epoch, stats["rpn_acc"], stats["rcnn_acc"],
+                         stats["fg_acc"], stats["fg_rois"],
+                         stats["mean_best_iou"])
+    return stats
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="Faster R-CNN synthetic training")
+    p.add_argument("--num-epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--tpus", type=int, default=0)
+    args = p.parse_args()
+    ctx = mx.tpu(0) if args.tpus else mx.cpu()
+    stats = train(num_epochs=args.num_epochs, batch=args.batch_size,
+                  ctx=ctx, lr=args.lr)
+    print("final:", stats)
+    assert stats["rpn_acc"] > 0.85, stats
+    assert stats["mean_best_iou"] > 0.3, stats
+    assert stats["fg_rois"] > 0, stats  # ProposalTarget produced fg matches
+
+
+if __name__ == "__main__":
+    main()

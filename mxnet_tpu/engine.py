@@ -189,7 +189,8 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
     """Push async host fn with read deps ``const_vars`` and write deps
     ``mutable_vars`` (parity: ``Engine::PushAsync``)."""
     global _pushed
-    _pushed += 1
+    with _engine_lock:  # push may be called from worker threads too
+        _pushed += 1
     _get().push(fn, const_vars, mutable_vars, priority, prop, name)
 
 

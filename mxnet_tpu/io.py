@@ -271,6 +271,7 @@ class PrefetchingIter(DataIter):
         self._vars = [_engine.new_variable() for _ in range(self.n_iter)]
         self.current_batch = None
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._errors = [None for _ in range(self.n_iter)]
         self._push_all()
 
     def _push_fetch(self, i):
@@ -279,6 +280,11 @@ class PrefetchingIter(DataIter):
                 self.next_batch[i] = self.iters[i].next()
             except StopIteration:
                 self.next_batch[i] = None
+            except BaseException as exc:  # surface on the consumer side:
+                # leaving the previous batch in the slot would silently
+                # re-serve stale data forever
+                self.next_batch[i] = None
+                self._errors[i] = exc
 
         if self._engine.in_worker():
             # nested prefetchers: running on the bounded IO pool already —
@@ -334,6 +340,10 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for v in self._vars:
             self._engine.wait_for_var(v)
+        for i, exc in enumerate(self._errors):
+            if exc is not None:
+                self._errors[i] = None
+                raise exc
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"

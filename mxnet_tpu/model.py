@@ -29,6 +29,17 @@ BatchEndParam = namedtuple("BatchEndParams",
 # IO lane (overlapping training), and any load of the same path becomes a
 # read-after-write dependency instead of a race
 _ckpt_vars = {}
+# async write failures, surfaced at the next checkpoint interaction (the
+# engine callback cannot raise across the C ABI)
+_ckpt_errors = {}
+
+
+def _raise_pending_ckpt_error():
+    if _ckpt_errors:
+        path, exc = next(iter(_ckpt_errors.items()))
+        del _ckpt_errors[path]
+        raise IOError("async checkpoint write to %r failed: %s"
+                      % (path, exc)) from exc
 
 
 def _ckpt_var(path):
@@ -59,9 +70,14 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
                    for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
 
+    _raise_pending_ckpt_error()
+
     def write():
-        nd._save_npz(param_name, arrays, "dict")  # atomic temp+rename
-        logging.info("Saved checkpoint to \"%s\"", param_name)
+        try:
+            nd._save_npz(param_name, arrays, "dict")  # atomic temp+rename
+            logging.info("Saved checkpoint to \"%s\"", param_name)
+        except BaseException as exc:  # surfaced at the next save/load
+            _ckpt_errors[param_name] = exc
 
     engine.push(write, mutable_vars=[_ckpt_var(param_name)],
                 prop=engine.FnProperty.IO, name="ckpt_write")
@@ -75,6 +91,7 @@ def load_checkpoint(prefix, epoch):
     param_name = "%s-%04d.params" % (prefix, epoch)
     # read-after-write ordering against any in-flight engine write
     engine.wait_for_var(_ckpt_var(param_name))
+    _raise_pending_ckpt_error()
     save_dict = nd.load(param_name)
     arg_params = {}
     aux_params = {}
@@ -122,12 +139,18 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """Layer-priority push/pull (parity: ``model.py:86-110``)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """Layer-priority push/pull (parity: ``model.py:86-110``).
+
+    All pushes are issued before any pull so the engine-backed kvstore can
+    run per-key optimizer ops concurrently on its worker pool; each pull
+    then waits only on its own key's var (the reference overlaps exactly
+    this way via per-layer priorities)."""
+    live = [(index, pair) for index, pair in
+            enumerate(zip(param_arrays, grad_arrays))
+            if pair[1][0] is not None]
+    for index, (_arg_list, grad_list) in live:
         kvstore.push(index, grad_list, priority=-index)
+    for index, (arg_list, _grad_list) in live:
         kvstore.pull(index, arg_list, priority=-index)
 
 

@@ -879,7 +879,11 @@ def _roi_pooling(attrs, data, rois):
             wstart = x1 + (ix * w) // pw
             wend = x1 + ((ix + 1) * w + pw - 1) // pw
             mask = (ys >= hstart) & (ys < hend) & (xs >= wstart) & (xs < wend)
-            return jnp.max(jnp.where(mask, img, -jnp.inf), axis=(1, 2))
+            # empty cells (degenerate/clipped rois) are 0 like the
+            # reference (roi_pooling-inl.h is_empty), NOT -inf — an -inf
+            # output NaNs the backward and poisons the whole step
+            mx_val = jnp.max(jnp.where(mask, img, -jnp.inf), axis=(1, 2))
+            return jnp.where(jnp.isfinite(mx_val), mx_val, 0.0)
 
         cells = [[cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
         out = jnp.stack([jnp.stack(r, axis=-1) for r in cells], axis=-2)

@@ -65,6 +65,9 @@ class Optimizer(object):
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
+        import threading
+
+        self._count_lock = threading.Lock()
         self.clip_gradient = clip_gradient
         if param_idx2name is None:
             param_idx2name = {}
@@ -109,11 +112,29 @@ class Optimizer(object):
                     self.wd_mult[name] = float(attr[name]["__wd_mult__"])
         self.wd_mult.update(args_wd_mult)
 
+    def __getstate__(self):
+        # the count lock is not picklable; set_optimizer pickles optimizers
+        # to the (possibly remote) updater side
+        state = self.__dict__.copy()
+        state.pop("_count_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._count_lock = threading.Lock()
+
     def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
+        # engine-backed kvstores may run per-key updates on concurrent
+        # worker threads; the read-modify-writes must be atomic or the
+        # lr_scheduler sees a stale step count
+        with self._count_lock:
+            if index not in self._index_update_count:
+                self._index_update_count[index] = self.begin_num_update
+            self._index_update_count[index] += 1
+            self.num_update = max(self._index_update_count[index],
+                                  self.num_update)
 
     def _get_lr(self, index):
         if self.lr_scheduler is not None:

@@ -1,0 +1,49 @@
+"""Faster R-CNN end-to-end smoke gate (reference: ``example/rcnn/`` —
+RPN + Proposal + ROIPooling + python ProposalTarget CustomOp trained as
+one graph on synthetic data)."""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_example():
+    path = os.path.join(_REPO, "examples", "rcnn", "train.py")
+    spec = importlib.util.spec_from_file_location("rcnn_train_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rcnn_end_to_end_convergence_smoke():
+    m = _load_example()
+    stats = m.train(num_epochs=12, batch=8, lr=0.02, seed=0, log=False)
+    # RPN learns to separate fg/bg anchors
+    assert stats["rpn_acc"] > 0.85, stats
+    # proposals localize the object far above chance (random placement
+    # scores ~0.05 IoU; untrained ~0.1) — the exact value is float-rounding
+    # sensitive across XLA CPU device counts, hence the margin
+    assert stats["mean_best_iou"] > 0.2, stats
+    # ProposalTarget matched proposals to gt (the rcnn head sees fg rois)
+    assert stats["fg_rois"] > 0, stats
+
+
+def test_rcnn_roi_pooling_no_inf_on_degenerate_rois():
+    """Degenerate rois must pool to 0, not -inf (reference is_empty
+    semantics); -inf poisons the backward with NaN."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import registry
+
+    op = registry.get_op("ROIPooling")
+    data = jnp.asarray(np.random.RandomState(0).rand(1, 2, 8, 8)
+                       .astype(np.float32))
+    rois = jnp.asarray(np.array([[0, 3, 3, 3, 3],      # 1x1 roi
+                                 [0, 7.6, 7.6, 7.9, 7.9]],  # clipped edge
+                                np.float32))
+    out = op.fn({"pooled_size": (4, 4), "spatial_scale": 1.0}, data, rois)
+    assert bool(jnp.isfinite(out).all()), np.asarray(out)
