@@ -42,6 +42,17 @@ def _raise_pending_ckpt_error():
                       % (path, exc)) from exc
 
 
+def wait_for_checkpoint(param_path):
+    """Block until any in-flight engine write of ``param_path`` lands (and
+    surface its error).  Every consumer that opens a ``.params`` file
+    directly — rather than via :func:`load_checkpoint` — must call this
+    first (read-after-write ordering for the async checkpoint writes)."""
+    from . import engine
+
+    engine.wait_for_var(_ckpt_var(param_path))
+    _raise_pending_ckpt_error()
+
+
 def _ckpt_var(path):
     from . import engine
 
@@ -85,13 +96,9 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 def load_checkpoint(prefix, epoch):
     """Load symbol + params (parity: ``model.py:load_checkpoint``)."""
-    from . import engine
-
     symbol = sym.load("%s-symbol.json" % prefix)
     param_name = "%s-%04d.params" % (prefix, epoch)
-    # read-after-write ordering against any in-flight engine write
-    engine.wait_for_var(_ckpt_var(param_name))
-    _raise_pending_ckpt_error()
+    wait_for_checkpoint(param_name)
     save_dict = nd.load(param_name)
     arg_params = {}
     aux_params = {}

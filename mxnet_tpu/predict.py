@@ -133,9 +133,14 @@ class Predictor(object):
 def load(prefix, epoch, ctx=None, input_shapes=None):
     """Build a Predictor straight from ``save_checkpoint`` artifacts
     (``prefix-symbol.json`` + ``prefix-%04d.params``)."""
+    from . import model as _model
+
     with open("%s-symbol.json" % prefix) as f:
         symbol_json = f.read()
-    with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    # checkpoint writes are async engine ops: order this read after them
+    _model.wait_for_checkpoint(param_name)
+    with open(param_name, "rb") as f:
         param_bytes = f.read()
     return Predictor(symbol_json, param_bytes, ctx=ctx,
                      input_shapes=input_shapes)

@@ -118,6 +118,49 @@ MXTPU_DLL void mxtpu_loader_free(void *h);
 
 MXTPU_DLL void mxtpu_buf_free(char *p);
 
+/* ---------------- NDArray (host, C ABI) ----------------
+ * Minimal NDArray subset for C/C++ frontends (reference c_api.h
+ * MXNDArrayCreate/Free + data access; float32, host-resident — staging
+ * buffers come from the pooled storage manager).  Device arrays are the
+ * Python/PJRT layer's job; this is the deployment-side data container the
+ * predict API consumes. */
+
+typedef void *MXTPUNDArrayHandle;
+
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_ndarray_create(const int64_t *shape,
+                                                  int ndim);
+MXTPU_DLL float *mxtpu_ndarray_data(MXTPUNDArrayHandle h);
+MXTPU_DLL int mxtpu_ndarray_ndim(MXTPUNDArrayHandle h);
+MXTPU_DLL const int64_t *mxtpu_ndarray_shape(MXTPUNDArrayHandle h);
+MXTPU_DLL size_t mxtpu_ndarray_size(MXTPUNDArrayHandle h);
+MXTPU_DLL int mxtpu_ndarray_copy(MXTPUNDArrayHandle dst,
+                                 MXTPUNDArrayHandle src);   /* 0 ok */
+MXTPU_DLL void mxtpu_ndarray_free(MXTPUNDArrayHandle h);
+
+/* ---------------- predict ----------------
+ * Deployment C API over a `.mxtpu` exported artifact (reference
+ * include/mxnet/c_predict_api.h MXPredCreate/SetInput/Forward/
+ * GetOutputShape/GetOutput/Free).  Backed by the StableHLO artifact
+ * (deploy.py export_model) executed through an embedded CPython runtime —
+ * the TPU-native analogue of the reference's amalgamated predict-only
+ * build.  Link against libmxtpu_predict.so.  All errors return -1/NULL;
+ * mxtpu_pred_last_error() gives the message (thread-local). */
+
+typedef void *MXTPUPredHandle;
+
+MXTPU_DLL MXTPUPredHandle mxtpu_pred_create(const char *artifact_path);
+MXTPU_DLL int mxtpu_pred_num_inputs(MXTPUPredHandle h);
+MXTPU_DLL const char *mxtpu_pred_input_name(MXTPUPredHandle h, int idx);
+MXTPU_DLL int mxtpu_pred_set_input(MXTPUPredHandle h, const char *name,
+                                   MXTPUNDArrayHandle data);
+MXTPU_DLL int mxtpu_pred_forward(MXTPUPredHandle h);
+MXTPU_DLL int mxtpu_pred_num_outputs(MXTPUPredHandle h);
+/* Output i's array — owned by the handle, valid until the next forward
+ * or free; copy out via mxtpu_ndarray_copy if needed. */
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_pred_output(MXTPUPredHandle h, int idx);
+MXTPU_DLL void mxtpu_pred_free(MXTPUPredHandle h);
+MXTPU_DLL const char *mxtpu_pred_last_error(void);
+
 /* ---------------- misc ---------------- */
 MXTPU_DLL const char *mxtpu_version(void);
 

@@ -1,0 +1,96 @@
+/*!
+ * Header-only C++ predict frontend (reference cpp-package predictor over
+ * c_predict_api.h).  RAII over the mxtpu predict C ABI:
+ *
+ *   mxtpu::Predictor p("model-export.mxtpu");
+ *   p.SetInput("data", batch);           // std::vector<float>
+ *   auto out = p.Forward();              // vector<vector<float>>
+ */
+#ifndef MXTPU_PREDICT_HPP_
+#define MXTPU_PREDICT_HPP_
+
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+
+class NDArray {
+ public:
+  explicit NDArray(const std::vector<int64_t> &shape)
+      : h_(mxtpu_ndarray_create(shape.data(),
+                                static_cast<int>(shape.size()))) {
+    if (!h_) throw std::runtime_error("mxtpu_ndarray_create failed");
+  }
+  ~NDArray() { mxtpu_ndarray_free(h_); }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+
+  float *data() { return mxtpu_ndarray_data(h_); }
+  size_t size() const { return mxtpu_ndarray_size(h_); }
+  MXTPUNDArrayHandle handle() const { return h_; }
+
+ private:
+  MXTPUNDArrayHandle h_;
+};
+
+class Predictor {
+ public:
+  explicit Predictor(const std::string &artifact) {
+    h_ = mxtpu_pred_create(artifact.c_str());
+    if (!h_)
+      throw std::runtime_error(std::string("mxtpu_pred_create: ") +
+                               mxtpu_pred_last_error());
+  }
+  ~Predictor() { mxtpu_pred_free(h_); }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  std::vector<std::string> InputNames() const {
+    std::vector<std::string> out;
+    int n = mxtpu_pred_num_inputs(h_);
+    for (int i = 0; i < n; ++i) out.push_back(mxtpu_pred_input_name(h_, i));
+    return out;
+  }
+
+  void SetInput(const std::string &name, const std::vector<float> &vals,
+                const std::vector<int64_t> &shape) {
+    NDArray arr(shape);
+    if (arr.size() != vals.size())
+      throw std::runtime_error("SetInput: size mismatch for " + name);
+    std::copy(vals.begin(), vals.end(), arr.data());
+    if (mxtpu_pred_set_input(h_, name.c_str(), arr.handle()) != 0)
+      throw std::runtime_error(std::string("SetInput: ") +
+                               mxtpu_pred_last_error());
+  }
+
+  std::vector<std::vector<float>> Forward() {
+    if (mxtpu_pred_forward(h_) != 0)
+      throw std::runtime_error(std::string("Forward: ") +
+                               mxtpu_pred_last_error());
+    std::vector<std::vector<float>> outs;
+    int n = mxtpu_pred_num_outputs(h_);
+    for (int i = 0; i < n; ++i) {
+      MXTPUNDArrayHandle o = mxtpu_pred_output(h_, i);
+      const float *d = mxtpu_ndarray_data(o);
+      outs.emplace_back(d, d + mxtpu_ndarray_size(o));
+    }
+    return outs;
+  }
+
+  std::vector<int64_t> OutputShape(int idx) {
+    MXTPUNDArrayHandle o = mxtpu_pred_output(h_, idx);
+    if (!o) throw std::runtime_error("OutputShape: bad index");
+    const int64_t *s = mxtpu_ndarray_shape(o);
+    return std::vector<int64_t>(s, s + mxtpu_ndarray_ndim(o));
+  }
+
+ private:
+  MXTPUPredHandle h_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PREDICT_HPP_

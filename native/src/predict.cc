@@ -1,0 +1,355 @@
+/*!
+ * Predict C API + host NDArray (reference include/mxnet/c_predict_api.h
+ * MXPred* + c_api.h MXNDArray subset).
+ *
+ * Executes a `.mxtpu` exported artifact (StableHLO serialized by
+ * deploy.py:export_model) through an embedded CPython interpreter: the
+ * heavy lifting (StableHLO deserialize + XLA compile + run) is
+ * mxnet_tpu.deploy.ExportedModel; this file is the flat C ABI + the GIL /
+ * lifetime management that lets C, C++ and any FFI-capable language serve
+ * the model — the role the reference's amalgamation + MXPred API plays.
+ *
+ * Standalone (non-Python-host) processes must have mxnet_tpu importable
+ * (PYTHONPATH).  When loaded inside a Python process (ctypes), the
+ * existing interpreter is reused.
+ */
+#include "mxtpu/c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+/* ---------------- NDArray (host float32) ---------------- */
+
+namespace {
+
+struct NDArr {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+};
+
+NDArr *nd(MXTPUNDArrayHandle h) { return static_cast<NDArr *>(h); }
+
+thread_local std::string g_err;
+
+void set_err(const std::string &m) { g_err = m; }
+
+}  // namespace
+
+extern "C" {
+
+MXTPUNDArrayHandle mxtpu_ndarray_create(const int64_t *shape, int ndim) {
+  if (ndim < 0 || (ndim > 0 && shape == nullptr)) return nullptr;
+  NDArr *a = new NDArr();
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (shape[i] < 0) { delete a; return nullptr; }
+    a->shape.push_back(shape[i]);
+    n *= static_cast<size_t>(shape[i]);
+  }
+  a->data.assign(n, 0.0f);
+  return a;
+}
+
+float *mxtpu_ndarray_data(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->data.data() : nullptr;
+}
+
+int mxtpu_ndarray_ndim(MXTPUNDArrayHandle h) {
+  return h ? static_cast<int>(nd(h)->shape.size()) : -1;
+}
+
+const int64_t *mxtpu_ndarray_shape(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->shape.data() : nullptr;
+}
+
+size_t mxtpu_ndarray_size(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->data.size() : 0;
+}
+
+int mxtpu_ndarray_copy(MXTPUNDArrayHandle dst, MXTPUNDArrayHandle src) {
+  if (!dst || !src) return -1;
+  if (nd(dst)->data.size() != nd(src)->data.size()) return -1;
+  nd(dst)->shape = nd(src)->shape;
+  nd(dst)->data = nd(src)->data;
+  return 0;
+}
+
+void mxtpu_ndarray_free(MXTPUNDArrayHandle h) { delete nd(h); }
+
+}  // extern "C"
+
+/* ---------------- predict ---------------- */
+
+namespace {
+
+struct Pred {
+  PyObject *model = nullptr;                 // ExportedModel instance
+  std::vector<std::string> input_names;
+  std::vector<NDArr> inputs;                 // aligned with input_names
+  std::vector<bool> input_set;
+  std::vector<NDArr *> outputs;              // owned
+  ~Pred() {
+    for (NDArr *o : outputs) delete o;
+  }
+};
+
+Pred *pr(MXTPUPredHandle h) { return static_cast<Pred *>(h); }
+
+std::once_flag g_py_once;
+
+void ensure_python() {
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      /* The embedded interpreter lives for the process (no Finalize):
+       * handles may outlive any scoping we could do here. */
+      Py_InitializeEx(0);
+      /* Release the GIL acquired by initialization so PyGILState_Ensure
+       * works uniformly below. */
+      PyEval_SaveThread();
+    }
+  });
+}
+
+/* RAII GIL scope. */
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+std::string py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *u = PyUnicode_AsUTF8(s);
+      if (u) msg = u;           /* NULL on encode failure: keep default */
+      else PyErr_Clear();
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+/* numpy float32 array (a copy) from host buffer. */
+PyObject *np_from_buf(PyObject *np, const float *buf, size_t n,
+                      const std::vector<int64_t> &shape) {
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(buf)),
+      static_cast<Py_ssize_t>(n * sizeof(float)), PyBUF_READ);
+  if (!mv) return nullptr;
+  PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject *dims = PyTuple_New(static_cast<Py_ssize_t>(shape.size()));
+  for (size_t i = 0; i < shape.size(); ++i)
+    PyTuple_SET_ITEM(dims, i, PyLong_FromLongLong(shape[i]));
+  PyObject *arr = PyObject_CallMethod(flat, "reshape", "O", dims);
+  Py_DECREF(flat);
+  Py_DECREF(dims);
+  /* copy() detaches from the C buffer's lifetime */
+  if (arr) {
+    PyObject *copy = PyObject_CallMethod(arr, "copy", nullptr);
+    Py_DECREF(arr);
+    return copy;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *mxtpu_pred_last_error(void) { return g_err.c_str(); }
+
+MXTPUPredHandle mxtpu_pred_create(const char *artifact_path) {
+  if (!artifact_path) { set_err("null path"); return nullptr; }
+  ensure_python();
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.deploy");
+  if (!mod) { set_err("import mxnet_tpu.deploy: " + py_error()); return nullptr; }
+  PyObject *model = PyObject_CallMethod(mod, "load_exported", "s",
+                                        artifact_path);
+  Py_DECREF(mod);
+  if (!model) { set_err("load_exported: " + py_error()); return nullptr; }
+
+  Pred *p = new Pred();
+  p->model = model;
+  PyObject *names = PyObject_GetAttrString(model, "input_names");
+  PyObject *shapes = PyObject_GetAttrString(model, "input_shapes");
+  if (!names || !shapes || !PyList_Check(names)) {
+    Py_XDECREF(names);
+    Py_XDECREF(shapes);
+    set_err("artifact manifest missing input signature");
+    mxtpu_pred_free(p);
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_Size(names);
+  bool create_ok = true;
+  for (Py_ssize_t i = 0; create_ok && i < n; ++i) {
+    PyObject *nm = PyList_GetItem(names, i);  // borrowed
+    const char *name_c = PyUnicode_AsUTF8(nm);
+    PyObject *shp = name_c ? PyObject_GetItem(shapes, nm) : nullptr;
+    if (!name_c || !shp) {
+      set_err("artifact manifest: bad input entry: " + py_error());
+      create_ok = false;
+      Py_XDECREF(shp);
+      break;
+    }
+    NDArr arr;
+    size_t total = 1;
+    Py_ssize_t nd_ = PySequence_Size(shp);
+    for (Py_ssize_t d = 0; d < nd_; ++d) {
+      PyObject *it = PySequence_GetItem(shp, d);
+      int64_t v = it ? PyLong_AsLongLong(it) : -1;
+      Py_XDECREF(it);
+      if (v < 0) { create_ok = false; break; }
+      arr.shape.push_back(v);
+      total *= static_cast<size_t>(v);
+    }
+    Py_DECREF(shp);
+    if (!create_ok) {
+      set_err("artifact manifest: bad shape entry: " + py_error());
+      break;
+    }
+    arr.data.assign(total, 0.0f);
+    p->input_names.push_back(name_c);
+    p->inputs.push_back(std::move(arr));
+    p->input_set.push_back(false);
+  }
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (!create_ok) {
+    PyErr_Clear();
+    mxtpu_pred_free(p);
+    return nullptr;
+  }
+  return p;
+}
+
+int mxtpu_pred_num_inputs(MXTPUPredHandle h) {
+  return h ? static_cast<int>(pr(h)->input_names.size()) : -1;
+}
+
+const char *mxtpu_pred_input_name(MXTPUPredHandle h, int idx) {
+  if (!h || idx < 0 ||
+      idx >= static_cast<int>(pr(h)->input_names.size()))
+    return nullptr;
+  return pr(h)->input_names[static_cast<size_t>(idx)].c_str();
+}
+
+int mxtpu_pred_set_input(MXTPUPredHandle h, const char *name,
+                         MXTPUNDArrayHandle data) {
+  if (!h || !name || !data) { set_err("null argument"); return -1; }
+  Pred *p = pr(h);
+  for (size_t i = 0; i < p->input_names.size(); ++i) {
+    if (p->input_names[i] == name) {
+      if (nd(data)->data.size() != p->inputs[i].data.size()) {
+        set_err("input '" + std::string(name) + "' size mismatch");
+        return -1;
+      }
+      p->inputs[i].data = nd(data)->data;
+      p->input_set[i] = true;
+      return 0;
+    }
+  }
+  set_err("unknown input '" + std::string(name) + "'");
+  return -1;
+}
+
+int mxtpu_pred_forward(MXTPUPredHandle h) {
+  if (!h) { set_err("null handle"); return -1; }
+  Pred *p = pr(h);
+  for (size_t i = 0; i < p->input_names.size(); ++i) {
+    if (!p->input_set[i]) {
+      set_err("input '" + p->input_names[i] + "' not set");
+      return -1;
+    }
+  }
+  Gil gil;
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) { set_err("import numpy: " + py_error()); return -1; }
+  PyObject *kwargs = PyDict_New();
+  bool ok = true;
+  for (size_t i = 0; i < p->input_names.size(); ++i) {
+    PyObject *arr = np_from_buf(np, p->inputs[i].data.data(),
+                                p->inputs[i].data.size(),
+                                p->inputs[i].shape);
+    if (!arr) { ok = false; break; }
+    PyDict_SetItemString(kwargs, p->input_names[i].c_str(), arr);
+    Py_DECREF(arr);
+  }
+  PyObject *outs = nullptr;
+  if (ok) {
+    PyObject *empty = PyTuple_New(0);
+    outs = PyObject_Call(p->model, empty, kwargs);
+    Py_DECREF(empty);
+  }
+  Py_DECREF(kwargs);
+  Py_DECREF(np);
+  if (!outs) { set_err("forward: " + py_error()); return -1; }
+
+  for (NDArr *o : p->outputs) delete o;
+  p->outputs.clear();
+  Py_ssize_t n = PySequence_Size(outs);
+  for (Py_ssize_t i = 0; ok && i < n; ++i) {
+    PyObject *o = PySequence_GetItem(outs, i);
+    PyObject *f32 = o ? PyObject_CallMethod(o, "astype", "s", "float32")
+                      : nullptr;
+    PyObject *shp = f32 ? PyObject_GetAttrString(f32, "shape") : nullptr;
+    PyObject *bytes = f32 ? PyObject_CallMethod(f32, "tobytes", nullptr)
+                          : nullptr;
+    if (shp && bytes) {
+      NDArr *arr = new NDArr();
+      Py_ssize_t nd_ = PyTuple_Size(shp);
+      for (Py_ssize_t d = 0; d < nd_; ++d)
+        arr->shape.push_back(PyLong_AsLongLong(PyTuple_GetItem(shp, d)));
+      char *buf = nullptr;
+      Py_ssize_t blen = 0;
+      PyBytes_AsStringAndSize(bytes, &buf, &blen);
+      arr->data.resize(static_cast<size_t>(blen) / sizeof(float));
+      std::memcpy(arr->data.data(), buf, static_cast<size_t>(blen));
+      p->outputs.push_back(arr);
+    } else {
+      ok = false;
+    }
+    Py_XDECREF(bytes);
+    Py_XDECREF(shp);
+    Py_XDECREF(f32);
+    Py_XDECREF(o);
+  }
+  Py_DECREF(outs);
+  if (!ok) { set_err("output conversion: " + py_error()); return -1; }
+  return 0;
+}
+
+int mxtpu_pred_num_outputs(MXTPUPredHandle h) {
+  return h ? static_cast<int>(pr(h)->outputs.size()) : -1;
+}
+
+MXTPUNDArrayHandle mxtpu_pred_output(MXTPUPredHandle h, int idx) {
+  if (!h || idx < 0 || idx >= static_cast<int>(pr(h)->outputs.size()))
+    return nullptr;
+  return pr(h)->outputs[static_cast<size_t>(idx)];
+}
+
+void mxtpu_pred_free(MXTPUPredHandle h) {
+  if (!h) return;
+  Pred *p = pr(h);
+  if (p->model) {
+    Gil gil;
+    Py_DECREF(p->model);
+  }
+  delete p;
+}
+
+}  // extern "C"

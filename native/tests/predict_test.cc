@@ -1,0 +1,64 @@
+// C++ predict smoke test (reference tier: cpp-package predictor example +
+// tests/python/predict).  Usage:
+//   predict_test <artifact.mxtpu> <expected.txt>
+// expected.txt: first line = flat input values, second = expected output
+// values (written by the python side of the test), compared at 1e-4.
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <vector>
+
+#include "mxtpu/predict.hpp"
+
+static std::vector<float> parse_line(std::istream &in) {
+  std::string line;
+  std::getline(in, line);
+  std::istringstream ss(line);
+  std::vector<float> out;
+  float v;
+  while (ss >> v) out.push_back(v);
+  return out;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s artifact expected.txt\n", argv[0]);
+    return 2;
+  }
+  std::ifstream exp(argv[2]);
+  std::vector<float> input = parse_line(exp);
+  std::vector<float> want = parse_line(exp);
+  assert(!input.empty() && !want.empty());
+
+  mxtpu::Predictor pred(argv[1]);
+  auto names = pred.InputNames();
+  assert(names.size() == 1);
+  // shape comes from the artifact signature; flat size must match
+  pred.SetInput(names[0], input,
+                {static_cast<int64_t>(1),
+                 static_cast<int64_t>(input.size())});
+  auto outs = pred.Forward();
+  assert(!outs.empty());
+  const std::vector<float> &got = outs[0];
+  if (got.size() != want.size()) {
+    std::fprintf(stderr, "size mismatch: got %zu want %zu\n", got.size(),
+                 want.size());
+    return 1;
+  }
+  double max_err = 0.0;
+  for (size_t i = 0; i < got.size(); ++i)
+    max_err = std::max(max_err, static_cast<double>(
+                                    std::fabs(got[i] - want[i])));
+  if (max_err > 1e-4) {
+    std::fprintf(stderr, "max_err %g too large\n", max_err);
+    return 1;
+  }
+  // second forward with the same input must agree (handle reuse)
+  auto outs2 = pred.Forward();
+  assert(outs2[0] == got);
+  std::printf("predict_test: %zu outputs, max_err=%g — OK\n", got.size(),
+              max_err);
+  return 0;
+}

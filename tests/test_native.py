@@ -239,3 +239,45 @@ def test_engine_is_load_bearing(tmp_path):
     # read-after-write ordering: load sees the finished file
     symbol, args, auxs = mx.model.load_checkpoint(prefix, 1)
     assert "fc_weight" in args
+
+
+def test_c_predict_api(tmp_path):
+    """C ABI predict round-trip (reference c_predict_api.h MXPred* tier):
+    export a model, serve it from the C++ client, compare numerics."""
+    import subprocess
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import deploy
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(repo, "native", "build", "predict_test")
+    # always invoke make: it is incremental, and a stale binary would
+    # silently test code no longer in the tree
+    r = subprocess.run(["make", "-C", os.path.join(repo, "native"),
+                        "predict"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    # train-ish model: fixed params, deterministic outputs
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 6))],
+             label_shapes=[("softmax_label", (1,))])
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+    artifact = deploy.export_model(prefix, 0, {"data": (1, 6)})
+
+    x = np.linspace(-1, 1, 6, dtype=np.float32).reshape(1, 6)
+    want = deploy.load_exported(artifact)(data=x)[0].ravel()
+    expected = tmp_path / "expected.txt"
+    expected.write_text(
+        " ".join("%.8g" % float(v) for v in x.ravel()) + "\n" +
+        " ".join("%.8g" % float(v) for v in want) + "\n")
+
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    r = subprocess.run([binary, artifact, str(expected)],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "OK" in r.stdout, r.stdout
