@@ -584,7 +584,37 @@ class Executor:
                         new_aux, group2ctx=self.group2ctx)
 
     def set_monitor_callback(self, callback):
+        """Install ``callback(name, NDArray)`` invoked per interior output
+        by :meth:`run_monitor_capture` (parity: the reference's executor
+        monitor callback, ``graph_executor.cc:131 ExecuteMonCallback``)."""
         self._monitor_callback = callback
+
+    def run_monitor_capture(self, is_train=True):
+        """Re-run the graph interpreted (un-jitted) over the current inputs
+        and feed every interior output to the installed monitor callback.
+        The jitted step can't call back per-op; this is the observability
+        path ``mx.mon.Monitor`` drives (reference: bulk-exec disabled under
+        monitoring for per-op granularity)."""
+        if self._monitor_callback is None:
+            return
+        sym = self._symbol
+        args = {k: v._data for k, v in self.arg_dict.items()}
+        auxs = {k: v._data for k, v in self.aux_dict.items()}
+        env = {}
+        rng = self._call_rng(is_train)
+        for node in sym._topo():
+            if node.is_variable:
+                src = auxs if node.is_aux else args
+                env[node._id] = [src.get(node.name)]
+                continue
+            ins = [env[s._id][i] for s, i in node.inputs]
+            n_args = len(node.op.input_names(node.attrs))
+            outs, _ = _eval_node(node, ins[:n_args], ins[n_args:], rng,
+                                 is_train)
+            env[node._id] = outs
+            for i, o in enumerate(outs):
+                self._monitor_callback(node.output_name(i),
+                                       NDArray(o, self._ctx))
 
     def debug_str(self):
         lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]

@@ -13,7 +13,6 @@ from __future__ import annotations
 import logging
 import re
 
-from .ndarray import NDArray
 
 __all__ = ["Monitor"]
 
@@ -64,30 +63,9 @@ class Monitor(object):
         return res
 
     def _capture(self, exe):
-        """Interpreted re-run capturing every interior output."""
-        import jax
-
-        from . import random as _random
-
-        sym = exe._symbol
-        args = {k: v._data for k, v in exe.arg_dict.items()}
-        auxs = {k: v._data for k, v in exe.aux_dict.items()}
-        env = {}
-        rng = _random.next_key()
-        for node in sym._topo():
-            if node.is_variable:
-                src = auxs if node.is_aux else args
-                env[node._id] = [src.get(node.name)]
-                continue
-            op = node.op
-            ins = [env[s._id][i] for s, i in node.inputs]
-            n_args = len(op.input_names(node.attrs))
-            node_rng = jax.random.fold_in(rng, node._id) if op.needs_rng else None
-            outs, _ = op.apply(node.attrs, ins[:n_args], ins[n_args:],
-                               is_train=True, rng=node_rng)
-            env[node._id] = outs
-            for i, o in enumerate(outs):
-                self.stat_helper(node.output_name(i), NDArray(o, exe._ctx))
+        """Drive the executor's monitor-callback capture (the callback we
+        installed in :meth:`install` receives every interior output)."""
+        exe.run_monitor_capture()
 
     def toc_print(self):
         res = self.toc()

@@ -175,6 +175,16 @@ MXTPUPredHandle mxtpu_pred_create(const char *artifact_path) {
   if (!artifact_path) { set_err("null path"); return nullptr; }
   ensure_python();
   Gil gil;
+  /* Some PJRT plugins ignore the JAX_PLATFORMS env var; honor an explicit
+   * platform request programmatically before the first backend touch. */
+  if (const char *plat = getenv("MXTPU_PRED_PLATFORM")) {
+    std::string code =
+        "import jax\n"
+        "try:\n"
+        "    jax.config.update('jax_platforms', '" + std::string(plat) +
+        "')\nexcept Exception:\n    pass\n";
+    if (PyRun_SimpleString(code.c_str()) != 0) PyErr_Clear();
+  }
   PyObject *mod = PyImport_ImportModule("mxnet_tpu.deploy");
   if (!mod) { set_err("import mxnet_tpu.deploy: " + py_error()); return nullptr; }
   PyObject *model = PyObject_CallMethod(mod, "load_exported", "s",
@@ -189,7 +199,10 @@ MXTPUPredHandle mxtpu_pred_create(const char *artifact_path) {
   if (!names || !shapes || !PyList_Check(names)) {
     Py_XDECREF(names);
     Py_XDECREF(shapes);
-    set_err("artifact manifest missing input signature");
+    /* py_error() fetches (and thereby clears) any pending exception so a
+     * ctypes-hosted interpreter is not corrupted by this error path */
+    set_err("artifact manifest missing input signature: " + py_error());
+    PyErr_Clear();
     mxtpu_pred_free(p);
     return nullptr;
   }
@@ -253,8 +266,11 @@ int mxtpu_pred_set_input(MXTPUPredHandle h, const char *name,
   Pred *p = pr(h);
   for (size_t i = 0; i < p->input_names.size(); ++i) {
     if (p->input_names[i] == name) {
-      if (nd(data)->data.size() != p->inputs[i].data.size()) {
-        set_err("input '" + std::string(name) + "' size mismatch");
+      /* full shape check: a size-only check would silently reinterpret
+       * mis-shaped data in the manifest's layout */
+      if (nd(data)->shape != p->inputs[i].shape) {
+        set_err("input '" + std::string(name) +
+                "' shape mismatch vs exported signature");
         return -1;
       }
       p->inputs[i].data = nd(data)->data;
@@ -301,6 +317,12 @@ int mxtpu_pred_forward(MXTPUPredHandle h) {
   for (NDArr *o : p->outputs) delete o;
   p->outputs.clear();
   Py_ssize_t n = PySequence_Size(outs);
+  if (n < 0) {
+    Py_DECREF(outs);
+    set_err("model returned a non-sequence: " + py_error());
+    PyErr_Clear();
+    return -1;
+  }
   for (Py_ssize_t i = 0; ok && i < n; ++i) {
     PyObject *o = PySequence_GetItem(outs, i);
     PyObject *f32 = o ? PyObject_CallMethod(o, "astype", "s", "float32")

@@ -158,3 +158,27 @@ def test_executor_manager_guards():
     em.backward()
     em.update()
     assert len(em.grad_arrays) == len(em.param_arrays)
+
+
+def test_executor_monitor_callback_is_invoked():
+    """set_monitor_callback installs a callback that run_monitor_capture
+    actually drives (per interior output) — user-installable without
+    Monitor."""
+    import numpy as np
+
+    x = mx.sym.Variable("data")
+    y = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=3, name="fc"),
+                          act_type="relu", name="act")
+    ex = y.simple_bind(mx.cpu(), data=(2, 4), grad_req="null")
+    ex.arg_dict["data"][:] = np.ones((2, 4), np.float32)
+    ex.arg_dict["fc_weight"][:] = 0.1
+    ex.arg_dict["fc_bias"][:] = 0.0
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(
+        (name, float(arr.asnumpy().mean()))))
+    ex.run_monitor_capture()
+    names = [n for n, _ in seen]
+    assert any("fc" in n for n in names), names
+    assert any("act" in n for n in names), names
+    act_val = dict(seen)[[n for n in names if "act" in n][0]]
+    np.testing.assert_allclose(act_val, 0.4, rtol=1e-5)

@@ -250,12 +250,19 @@ def test_c_predict_api(tmp_path):
     import mxnet_tpu as mx
     from mxnet_tpu import deploy
 
+    import shutil
+    import sys as _sys
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     binary = os.path.join(repo, "native", "build", "predict_test")
     # always invoke make: it is incremental, and a stale binary would
-    # silently test code no longer in the tree
+    # silently test code no longer in the tree; PYTHON pins the embedded
+    # interpreter to the one running this test (venv-safe)
     r = subprocess.run(["make", "-C", os.path.join(repo, "native"),
-                        "predict"], capture_output=True, text=True)
+                        "predict", "PYTHON=%s" % _sys.executable],
+                       capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
 
     # train-ish model: fixed params, deterministic outputs
@@ -276,7 +283,9 @@ def test_c_predict_api(tmp_path):
         " ".join("%.8g" % float(v) for v in x.ravel()) + "\n" +
         " ".join("%.8g" % float(v) for v in want) + "\n")
 
-    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    prior = os.environ.get("PYTHONPATH")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_PRED_PLATFORM="cpu",
+               PYTHONPATH=repo + ((os.pathsep + prior) if prior else ""))
     r = subprocess.run([binary, artifact, str(expected)],
                        capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, (r.stdout, r.stderr)
