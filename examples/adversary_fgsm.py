@@ -1,0 +1,113 @@
+"""FGSM adversarial examples (parity: reference ``example/adversary/`` —
+train a small net, then perturb inputs along the sign of the input
+gradient and watch accuracy collapse).
+
+Exercises the ``inputs_need_grad`` executor path (gradients w.r.t. DATA,
+not params — the reference gets them from a bound executor the same way).
+
+    python examples/adversary_fgsm.py [--eps 0.3]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+
+def make_data(rng, n):
+    """4-class oriented gratings, 1x16x16 (small, conv-separable)."""
+    xs = np.zeros((n, 1, 16, 16), np.float32)
+    ys = rng.randint(0, 4, n)
+    yy, xx = np.mgrid[0:16, 0:16]
+    for i, c in enumerate(ys):
+        ang = np.pi / 4 * c + rng.uniform(-0.1, 0.1)
+        wave = np.sin(0.8 * (np.cos(ang) * xx + np.sin(ang) * yy)
+                      + rng.uniform(0, 2 * np.pi))
+        xs[i, 0] = 0.5 + 0.4 * wave + rng.normal(0, 0.05, (16, 16))
+    return xs, ys.astype(np.float32)
+
+
+def get_symbol():
+    d = mx.sym.Variable("data")
+    net = mx.sym.Convolution(d, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def run(eps=0.3, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    xs, ys = make_data(rng, 600)
+    xv, yv = make_data(rng, 200)
+    batch = 50
+
+    mod = mx.mod.Module(get_symbol(), context=mx.cpu())
+    it = mx.io.NDArrayIter(xs, ys, batch_size=batch, shuffle=True)
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+
+    # adversarial module: same params, inputs_need_grad=True
+    adv = mx.mod.Module(get_symbol(), context=mx.cpu())
+    adv.bind(data_shapes=[("data", (batch, 1, 16, 16))],
+             label_shapes=[("softmax_label", (batch,))],
+             for_training=True, inputs_need_grad=True)
+    args, auxs = mod.get_params()
+    adv.set_params(args, auxs)
+
+    def acc_of(x):
+        hits = tot = 0
+        for s in range(0, len(x), batch):
+            b = mx.io.DataBatch([mx.nd.array(x[s:s + batch])],
+                                [mx.nd.array(yv[s:s + batch])])
+            adv.forward(b, is_train=False)
+            pred = adv.get_outputs()[0].asnumpy().argmax(axis=1)
+            hits += int((pred == yv[s:s + batch]).sum())
+            tot += batch
+        return hits / tot
+
+    clean_acc = acc_of(xv)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx) at the TRUE label
+    x_adv = xv.copy()
+    for s in range(0, len(xv), batch):
+        b = mx.io.DataBatch([mx.nd.array(xv[s:s + batch])],
+                            [mx.nd.array(yv[s:s + batch])])
+        adv.forward(b, is_train=True)
+        adv.backward()
+        g = adv.get_input_grads()[0].asnumpy()
+        x_adv[s:s + batch] = xv[s:s + batch] + eps * np.sign(g)
+    adv_acc = acc_of(x_adv)
+
+    if log:
+        logging.info("clean_acc=%.3f adversarial_acc=%.3f (eps=%.2f)",
+                     clean_acc, adv_acc, eps)
+    return {"clean_acc": clean_acc, "adv_acc": adv_acc}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="FGSM adversarial examples")
+    p.add_argument("--eps", type=float, default=0.3)
+    args = p.parse_args()
+    stats = run(eps=args.eps)
+    print("final:", stats)
+    assert stats["clean_acc"] > 0.9, stats
+    assert stats["adv_acc"] < stats["clean_acc"] - 0.3, stats
+
+
+if __name__ == "__main__":
+    main()

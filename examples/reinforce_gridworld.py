@@ -1,0 +1,134 @@
+"""Policy-gradient RL (parity: reference ``example/reinforcement-learning/``
+— policy network trained with REINFORCE; no gym dependency, the
+environment is an in-file 5x5 gridworld).
+
+The agent starts at a random cell and must reach the goal corner within
+a step budget; the policy net (MLP over one-hot position) is trained
+with the REINFORCE gradient computed through ``mx.contrib.autograd``
+(the imperative tape — the surface the reference's RL examples drive).
+
+    python examples/reinforce_gridworld.py [--episodes 1500]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import autograd as ag
+
+N = 5              # grid side
+GOAL = (N - 1, N - 1)
+MAX_STEPS = 2 * N  # step budget per episode
+ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]  # up/down/left/right
+
+
+def _state_vec(pos):
+    v = np.zeros((1, N * N), np.float32)
+    v[0, pos[0] * N + pos[1]] = 1.0
+    return v
+
+
+def _step(pos, a):
+    dr, dc = ACTIONS[a]
+    nr = min(max(pos[0] + dr, 0), N - 1)
+    nc = min(max(pos[1] + dc, 0), N - 1)
+    return (nr, nc)
+
+
+class Policy:
+    """Two-layer softmax policy; params + grad buffers on the tape."""
+
+    def __init__(self, rng, hidden=32):
+        def mk(shape, scale):
+            return mx.nd.array(rng.randn(*shape).astype(np.float32) * scale)
+
+        self.params = [mk((N * N, hidden), 0.3), mk((1, hidden), 0.0),
+                       mk((hidden, len(ACTIONS)), 0.3),
+                       mk((1, len(ACTIONS)), 0.0)]
+        self.grads = [mx.nd.zeros(p.shape) for p in self.params]
+        ag.mark_variables(self.params, self.grads)
+
+    def logits(self, x):
+        w1, b1, w2, b2 = self.params
+        h = mx.nd.tanh(mx.nd.broadcast_add(mx.nd.dot(x, w1), b1))
+        return mx.nd.broadcast_add(mx.nd.dot(h, w2), b2)
+
+    def probs_np(self, x):
+        z = self.logits(mx.nd.array(x)).asnumpy()
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def sgd(self, lr):
+        for p, g in zip(self.params, self.grads):
+            p[:] = p.asnumpy() - lr * g.asnumpy()
+
+
+def run(episodes=1500, lr=0.05, gamma=0.95, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    pol = Policy(rng)
+    success_window = []
+    rate = 0.0
+    ep = 0
+
+    for ep in range(episodes):
+        pos = (rng.randint(N), rng.randint(N))
+        states, actions, rewards = [], [], []
+        for _ in range(MAX_STEPS):
+            sv = _state_vec(pos)
+            a = int(rng.choice(len(ACTIONS), p=pol.probs_np(sv)[0]))
+            nxt = _step(pos, a)
+            states.append(sv)
+            actions.append(a)
+            rewards.append(1.0 if nxt == GOAL else -0.02)
+            pos = nxt
+            if pos == GOAL:
+                break
+        success_window.append(1.0 if pos == GOAL else 0.0)
+
+        # discounted returns -> REINFORCE loss = -sum G_t log pi(a_t|s_t)
+        G, returns = 0.0, []
+        for r in reversed(rewards):
+            G = r + gamma * G
+            returns.append(G)
+        returns = np.array(returns[::-1], np.float32)
+        returns = returns - returns.mean()  # variance-reducing baseline
+
+        X = np.concatenate(states, axis=0)
+        weights = np.zeros((len(actions), len(ACTIONS)), np.float32)
+        weights[np.arange(len(actions)), actions] = returns
+
+        with ag.train_section():
+            z = pol.logits(mx.nd.array(X))
+            logp = mx.nd.log_softmax(z, axis=1)
+            loss = mx.nd.sum(-logp * mx.nd.array(weights))
+            ag.compute_gradient([loss])
+        pol.sgd(lr)
+
+        if len(success_window) >= 100:
+            rate = float(np.mean(success_window[-100:]))
+            if log and ep % 200 == 0:
+                logging.info("episode %d: success_rate(100)=%.2f", ep, rate)
+            if rate > 0.95:
+                break
+    return {"success_rate": rate, "episodes": ep + 1}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="REINFORCE gridworld")
+    p.add_argument("--episodes", type=int, default=1500)
+    args = p.parse_args()
+    stats = run(episodes=args.episodes)
+    print("final:", stats)
+    assert stats["success_rate"] > 0.9, stats
+
+
+if __name__ == "__main__":
+    main()

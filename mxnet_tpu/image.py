@@ -398,6 +398,10 @@ class ImageIter(DataIter):
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
+        # seeded stream for the python index-shuffle fallback (the native
+        # loader seeds its own chunk shuffle from the same kwarg)
+        self._shuffle_rng = (_pyrandom.Random(loader_seed) if loader_seed
+                            else _pyrandom) if path_imgrec else _pyrandom
         self.seq = self.imgidx
         self.num_parts = num_parts
         self.part_index = part_index
@@ -414,7 +418,7 @@ class ImageIter(DataIter):
 
     def reset(self):
         if self.shuffle and self.seq is not None:
-            _pyrandom.shuffle(self.seq)
+            self._shuffle_rng.shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
         if self._loader is not None:

@@ -474,6 +474,6 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         path_imgidx=path_imgidx, shuffle=shuffle, part_index=part_index,
         num_parts=num_parts, data_name=data_name, label_name=label_name,
         resize=resize, rand_crop=rand_crop, rand_mirror=rand_mirror,
-        mean=mean, std=std,
+        mean=mean, std=std, **kwargs,
     )
     return PrefetchingIter(inner)

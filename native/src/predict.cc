@@ -176,14 +176,20 @@ MXTPUPredHandle mxtpu_pred_create(const char *artifact_path) {
   ensure_python();
   Gil gil;
   /* Some PJRT plugins ignore the JAX_PLATFORMS env var; honor an explicit
-   * platform request programmatically before the first backend touch. */
+   * platform request programmatically before the first backend touch.
+   * The value is passed as DATA through the C API (never spliced into
+   * Python source). */
   if (const char *plat = getenv("MXTPU_PRED_PLATFORM")) {
-    std::string code =
-        "import jax\n"
-        "try:\n"
-        "    jax.config.update('jax_platforms', '" + std::string(plat) +
-        "')\nexcept Exception:\n    pass\n";
-    if (PyRun_SimpleString(code.c_str()) != 0) PyErr_Clear();
+    PyObject *jaxmod = PyImport_ImportModule("jax");
+    PyObject *cfg = jaxmod ? PyObject_GetAttrString(jaxmod, "config")
+                           : nullptr;
+    PyObject *res = cfg ? PyObject_CallMethod(cfg, "update", "ss",
+                                              "jax_platforms", plat)
+                        : nullptr;
+    if (!res) PyErr_Clear();  /* backend already up / older jax: best effort */
+    Py_XDECREF(res);
+    Py_XDECREF(cfg);
+    Py_XDECREF(jaxmod);
   }
   PyObject *mod = PyImport_ImportModule("mxnet_tpu.deploy");
   if (!mod) { set_err("import mxnet_tpu.deploy: " + py_error()); return nullptr; }

@@ -182,3 +182,40 @@ def test_executor_monitor_callback_is_invoked():
     assert any("act" in n for n in names), names
     act_val = dict(seen)[[n for n in names if "act" in n][0]]
     np.testing.assert_allclose(act_val, 0.4, rtol=1e-5)
+
+
+def test_fgsm_adversary_example():
+    """inputs_need_grad FGSM path (reference example/adversary tier):
+    adversarial accuracy collapses while clean accuracy stays high."""
+    import importlib.util
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "adversary_example", os.path.join(repo, "examples",
+                                          "adversary_fgsm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    stats = mod.run(log=False)
+    assert stats["clean_acc"] > 0.9, stats
+    assert stats["adv_acc"] < stats["clean_acc"] - 0.3, stats
+
+
+def test_reinforce_gridworld_example():
+    """REINFORCE via the imperative autograd tape (reference
+    example/reinforcement-learning tier): policy reaches >90% success."""
+    import importlib.util
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "reinforce_example", os.path.join(repo, "examples",
+                                          "reinforce_gridworld.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    stats = mod.run(episodes=1400, log=False)
+    assert stats["success_rate"] > 0.9, stats
