@@ -13,14 +13,31 @@ Import as ``import mxnet_tpu as mx`` — the namespace mirrors the reference's
 # MXNET_TPU_COORDINATOR and jax.distributed).
 import os as _os
 
+# Platform forcing: device plugins installed via site hooks can preset
+# jax_platforms at interpreter start and ignore the JAX_PLATFORMS env var,
+# so a subprocess that explicitly wants the CPU backend (tools, test
+# children, the C-API embedded interpreter) can block on a tunneled
+# accelerator it never asked for.  MXNET_TPU_PLATFORM is this package's
+# unambiguous override: when set, it wins over any preset (jax.config is
+# honored as long as no backend is up, and importing this package is
+# normally the first backend touch).  JAX_PLATFORMS is still mirrored when
+# nothing configured a platform at all.
+_plat = _os.environ.get("MXNET_TPU_PLATFORM")
+if _plat or _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    try:
+        if _plat:
+            _jax.config.update("jax_platforms", _plat)
+        elif _jax.config.jax_platforms is None:
+            _jax.config.update("jax_platforms",
+                               _os.environ["JAX_PLATFORMS"])
+    except Exception:  # backend already initialized by the host program
+        pass
+
 if _os.environ.get("MXNET_TPU_COORDINATOR"):
     import jax as _jax
 
-    # plugin platforms may ignore the env var; force via config so local
-    # simulated clusters (tools/launch.py default JAX_PLATFORMS=cpu) really
-    # land on the requested backend
-    if _os.environ.get("JAX_PLATFORMS"):
-        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
     _jax.distributed.initialize(
         _os.environ["MXNET_TPU_COORDINATOR"],
         int(_os.environ.get("MXNET_TPU_NUM_PROCS", "1")),

@@ -169,3 +169,9 @@ def grad(func, argnum=None):
         return grad_with_loss_func(*args)[0]
 
     return wrapped
+
+
+# reference-compat names: train()/test() scopes (the reference exposes both
+# spellings; ``with autograd.train():``)
+train = train_section
+test = test_section

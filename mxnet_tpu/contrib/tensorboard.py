@@ -1,0 +1,42 @@
+"""TensorBoard metric logging callback (parity: reference
+``python/mxnet/contrib/tensorboard.py:LogMetricsCallback`` — a batch-end
+callback pushing EvalMetric values to an event file).
+
+Backed by ``tensorboardX`` when available (pure-python event writer);
+gracefully degrades to a logging-only callback otherwise.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Batch/epoch-end callback writing metrics as TB scalars."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._step = 0
+        try:
+            from tensorboardX import SummaryWriter
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            logging.warning(
+                "tensorboardX not available; LogMetricsCallback will only "
+                "log to the console")
+            self.summary_writer = None
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value, self._step)
+            else:
+                logging.info("%s=%f", name, value)

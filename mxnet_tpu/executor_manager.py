@@ -122,3 +122,7 @@ class DataParallelExecutorManager(object):
         if pre_sliced:
             labels = [l for per_dev in labels for l in per_dev]
         self._module.update_metric(metric, labels)
+
+
+# the reference's executor_manager module also exposes the group class
+from .module.executor_group import DataParallelExecutorGroup  # noqa: E402

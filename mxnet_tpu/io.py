@@ -23,7 +23,8 @@ from .base import MXNetError, mx_dtype
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+           "MXDataIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -477,3 +478,53 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         mean=mean, std=std, **kwargs,
     )
     return PrefetchingIter(inner)
+
+
+class MXDataIter(DataIter):
+    """Wrapper giving a backend-provided iterator the DataIter protocol
+    (parity: ``io.py:MXDataIter`` — the reference wraps a C++ iterator
+    handle; here the 'handle' is any object with the DataIter protocol,
+    e.g. an iterator produced by the registered factory functions).  Kept
+    for user code that isinstance-checks or subclasses MXDataIter."""
+
+    def __init__(self, handle, data_name="data", label_name="softmax_label",
+                 **_):
+        super().__init__()
+        self.handle = handle
+        self._data_name = data_name
+        self._label_name = label_name
+
+    @property
+    def provide_data(self):
+        return self.handle.provide_data
+
+    @property
+    def provide_label(self):
+        return self.handle.provide_label
+
+    def reset(self):
+        self.handle.reset()
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return self._cur
+
+    def iter_next(self):
+        try:
+            self._cur = self.handle.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._cur.data
+
+    def getlabel(self):
+        return self._cur.label
+
+    def getindex(self):
+        return getattr(self._cur, "index", None)
+
+    def getpad(self):
+        return getattr(self._cur, "pad", 0)

@@ -1,0 +1,85 @@
+"""DataParallelExecutorGroup compatibility shim (parity: reference
+``python/mxnet/module/executor_group.py:DataParallelExecutorGroup``).
+
+The reference splits each batch across per-context executors by workload
+(``decide_slices``/``_split_input_slice``) and scatter/gathers manually.
+On TPU that whole mechanism is subsumed by GSPMD: ``Module`` binds ONE
+mesh-sharded executor and XLA does the slicing/reduction (see
+``module/module.py``).  This class keeps the constructor/method surface
+alive for user code that drives the group directly; it wraps the same
+single sharded executor the Module path uses.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..executor_manager import _split_input_slice  # reference helper
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+
+class DataParallelExecutorGroup(object):
+    """(parity: ``executor_group.py:DataParallelExecutorGroup``)"""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", **_):
+        from .module import Module
+
+        data_names = [d[0] if isinstance(d, (list, tuple)) else d.name
+                      for d in data_shapes]
+        label_names = [l[0] if isinstance(l, (list, tuple)) else l.name
+                       for l in (label_shapes or [])]
+        self._mod = Module(symbol, data_names=data_names,
+                           label_names=label_names, context=contexts,
+                           work_load_list=workload, logger=logger,
+                           fixed_param_names=fixed_param_names)
+        self._mod.bind(data_shapes=data_shapes, label_shapes=label_shapes,
+                       for_training=for_training,
+                       inputs_need_grad=inputs_need_grad,
+                       shared_module=getattr(shared_group, "_mod", None),
+                       grad_req=grad_req)
+        self.param_names = param_names
+        self.symbol = symbol
+
+    # -- reference surface (delegating to the sharded executor) --------
+    def forward(self, data_batch, is_train=None):
+        self._mod.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._mod.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._mod.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._mod.get_input_grads(merge_multi_context)
+
+    def set_params(self, arg_params, aux_params):
+        self._mod.set_params(arg_params, aux_params)
+
+    def get_params(self, arg_params=None, aux_params=None):
+        args, auxs = self._mod.get_params()
+        if arg_params is not None:
+            for k, v in args.items():
+                if k in arg_params:
+                    arg_params[k][:] = v
+        if aux_params is not None:
+            for k, v in auxs.items():
+                if k in aux_params:
+                    aux_params[k][:] = v
+        return args, auxs
+
+    def update_metric(self, eval_metric, labels):
+        self._mod.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        self._mod.install_monitor(mon)
+
+    @property
+    def grad_arrays(self):
+        ex = self._mod._exec
+        return [[ex.grad_dict[n]] for n in self.param_names or []
+                if ex.grad_dict.get(n) is not None]

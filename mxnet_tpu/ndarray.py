@@ -31,7 +31,9 @@ from .context import Context, current_context
 from .ops.registry import OP_REGISTRY, _ALIAS, get_op
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "concatenate", "load", "save", "imresize", "onehot_encode", "waitall"]
+           "concatenate", "load", "save", "imresize", "onehot_encode",
+           "waitall", "multiply", "subtract", "divide", "true_divide",
+           "moveaxis", "imdecode"]
 
 
 class NDArray:
@@ -544,3 +546,54 @@ def _init_module():
 
 
 # populated by mxnet_tpu/__init__ after all op modules import
+
+
+def multiply(lhs, rhs):
+    """Elementwise product (parity: ``ndarray.py:multiply``)."""
+    return lhs * rhs
+
+
+def subtract(lhs, rhs):
+    """Elementwise difference (parity: ``ndarray.py:subtract``)."""
+    return lhs - rhs
+
+
+def divide(lhs, rhs):
+    """Elementwise quotient (parity: ``ndarray.py:divide``)."""
+    return lhs / rhs
+
+
+true_divide = divide
+
+
+def moveaxis(tensor, source, destination):
+    """Move an axis to a new position (parity: ``ndarray.py:moveaxis``;
+    numpy axis semantics — out-of-range axes raise)."""
+    nd_ = tensor.ndim
+
+    def _norm(ax, name):
+        if not -nd_ <= ax < nd_:
+            raise ValueError("%s axis %d out of range for %d-d array"
+                             % (name, ax, nd_))
+        return ax + nd_ if ax < 0 else ax
+
+    src = _norm(source, "source")
+    dst = _norm(destination, "destination")
+    axes = list(range(nd_))
+    axes.insert(dst, axes.pop(src))
+    return NDArray(jnp.transpose(tensor._data, axes), tensor.context)
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an encoded image to NDArray (parity: ``ndarray.py:imdecode``).
+    Unsupported reference options raise rather than being silently
+    ignored; plain decodes delegate to the image package."""
+    if out is not None or index != 0 or tuple(clip_rect) != (0, 0, 0, 0) \
+            or channels != 3 or mean is not None:
+        raise MXNetError(
+            "imdecode: only plain 3-channel decodes are supported here; "
+            "use mx.image.imdecode + ndarray ops for crop/mean handling")
+    from . import image as _image
+
+    return array(_image.imdecode_bytes(str_img))

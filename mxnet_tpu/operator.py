@@ -192,3 +192,136 @@ def _register_custom_host_op():
 
 
 _register_custom_host_op()
+
+
+# ----------------------------------------------------------------------
+# legacy python-op API (reference operator.py:PythonOp/NumpyOp/NDArrayOp)
+# implemented as adapters over the CustomOp host
+# ----------------------------------------------------------------------
+
+import ctypes as _ctypes
+import itertools as _itertools
+
+c_int_p = _ctypes.POINTER(_ctypes.c_int)  # reference-compat ctypes alias
+
+_legacy_seq = _itertools.count()
+
+
+class PythonOp(object):
+    """Base class for legacy python operators (parity:
+    ``operator.py:PythonOp``).  ``get_symbol`` builds a CustomOp-backed
+    symbol delegating to this object's forward/backward/infer_shape."""
+
+    _ref_holder = []
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- adapter plumbing ----------------------------------------------
+    def _register_custom(self, numpy_arrays):
+        outer = self
+        op_type = "_legacy_python_op_%d" % next(_legacy_seq)
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                if numpy_arrays:
+                    import numpy as _np
+
+                    ins = [d.asnumpy() for d in in_data]
+                    # writable copies: asnumpy views of jax buffers are
+                    # read-only, and the legacy contract is in-place writes
+                    outs = [_np.array(d.asnumpy()) for d in out_data]
+                    outer.forward(in_data=ins, out_data=outs)
+                    from . import ndarray as nd
+
+                    for dst, r, val in zip(out_data, req, outs):
+                        self.assign(dst, r, nd.array(val))
+                else:
+                    outer.forward(in_data=in_data, out_data=out_data)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                if numpy_arrays:
+                    import numpy as _np
+
+                    ogs = [d.asnumpy() for d in out_grad]
+                    ins = [d.asnumpy() for d in in_data]
+                    outs = [d.asnumpy() for d in out_data]
+                    igs = [_np.array(d.asnumpy()) for d in in_grad]
+                    outer.backward(out_grad=ogs, in_data=ins, out_data=outs,
+                                   in_grad=igs)
+                    from . import ndarray as nd
+
+                    for dst, r, val in zip(in_grad, req, igs):
+                        self.assign(dst, r, nd.array(val))
+                else:
+                    outer.backward(out_grad=out_grad, in_data=in_data,
+                                   out_data=out_data, in_grad=in_grad)
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=outer.need_top_grad())
+
+            def list_arguments(self):
+                return outer.list_arguments()
+
+            def list_outputs(self):
+                return outer.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ishape, oshape = outer.infer_shape(in_shape)
+                return ishape, oshape, []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return _Adapter()
+
+        register(op_type)(_Prop)
+        PythonOp._ref_holder.append(self)
+        return op_type
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator (parity: ``operator.py:NumpyOp``): forward/
+    backward receive numpy arrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        op_type = self._register_custom(numpy_arrays=True)
+        return sym.Custom(*args, op_type=op_type, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator (parity: ``operator.py:NDArrayOp``):
+    forward/backward receive NDArrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        op_type = self._register_custom(numpy_arrays=False)
+        return sym.Custom(*args, op_type=op_type, **kwargs)

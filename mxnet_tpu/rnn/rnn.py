@@ -38,3 +38,13 @@ def do_rnn_checkpoint(cells, prefix, period=1):
             save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
 
     return _callback
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC"):
+    """Deprecated alias of ``cell.unroll`` (parity: ``rnn/rnn.py:rnn_unroll``)."""
+    import warnings
+
+    warnings.warn("rnn_unroll is deprecated. Please call cell.unroll directly.")
+    return cell.unroll(length=length, inputs=inputs, begin_state=begin_state,
+                       input_prefix=input_prefix, layout=layout)

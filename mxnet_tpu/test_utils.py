@@ -16,7 +16,10 @@ from .ndarray import NDArray, array
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_ndarray", "random_arrays",
            "numeric_grad", "check_numeric_gradient", "check_symbolic_forward",
-           "check_symbolic_backward", "check_consistency", "simple_forward"]
+           "check_symbolic_backward", "check_consistency", "simple_forward",
+           "get_rtol", "get_atol", "find_max_violation",
+           "almost_equal_ignore_nan", "assert_almost_equal_ignore_nan",
+           "np_reduce", "retry", "list_gpus", "set_env_var", "check_speed"]
 
 _DEFAULT_CTX = [None]
 
@@ -309,3 +312,146 @@ def simple_forward(sym_, ctx=None, is_train=False, **inputs):
     if len(outputs) == 1:
         outputs = outputs[0]
     return outputs
+
+
+def get_rtol(rtol=None):
+    """Default relative threshold for regression checks (parity:
+    ``test_utils.py:get_rtol``)."""
+    return 1e-5 if rtol is None else rtol
+
+
+def get_atol(atol=None):
+    """Default absolute threshold (parity: ``test_utils.py:get_atol``)."""
+    return 1e-20 if atol is None else atol
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """Index and magnitude of the worst tolerance violation (parity:
+    ``test_utils.py:find_max_violation``)."""
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    diff = _np.abs(a - b)
+    tol = atol + rtol * _np.abs(b)
+    violation = diff / (tol + 1e-20)
+    idx = _np.unravel_index(_np.argmax(violation), violation.shape)
+    return idx, float(_np.max(violation))
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Almost-equal with NaNs masked out of BOTH arrays (parity:
+    ``test_utils.py:almost_equal_ignore_nan``)."""
+    a, b = _np.copy(a), _np.copy(b)
+    mask = _np.logical_or(_np.isnan(a), _np.isnan(b))
+    a[mask] = 0
+    b[mask] = 0
+    return almost_equal(a, b, rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    """Assert variant of :func:`almost_equal_ignore_nan`."""
+    a, b = _np.copy(a), _np.copy(b)
+    mask = _np.logical_or(_np.isnan(a), _np.isnan(b))
+    a[mask] = 0
+    b[mask] = 0
+    assert_almost_equal(a, b, rtol, atol, names)
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reduce with list-axis + keepdims compatibility (parity:
+    ``test_utils.py:np_reduce``)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    axes = list(range(dat.ndim)) if axis is None else list(axis)
+    ret = dat
+    for i, ax in enumerate(sorted(axes)):
+        ret = numpy_reduce_func(ret, axis=ax - i)
+    if keepdims:
+        shape = list(dat.shape)
+        for ax in axes:
+            shape[ax] = 1
+        ret = ret.reshape(tuple(shape))
+    return ret
+
+
+def retry(n):
+    """Decorator retrying a stochastic test up to ``n`` times (parity:
+    ``test_utils.py:retry``)."""
+    assert n > 0
+
+    def decorate(f):
+        def wrapper(*args, **kwargs):
+            err = None
+            for _ in range(n):
+                try:
+                    f(*args, **kwargs)
+                    return
+                except AssertionError as e:
+                    err = e
+            raise err
+
+        return wrapper
+
+    return decorate
+
+
+def list_gpus():
+    """Accelerator device indices (parity: ``test_utils.py:list_gpus`` —
+    here the TPU/accelerator chips visible to jax)."""
+    import jax
+
+    try:
+        return [d.id for d in jax.devices() if d.platform != "cpu"]
+    except RuntimeError:
+        return []
+
+
+def set_env_var(key, val, default_val=""):
+    """Set an env var, returning the previous value (parity:
+    ``test_utils.py:set_env_var``)."""
+    import os
+
+    prev = os.environ.get(key, default_val)
+    os.environ[key] = str(val)
+    return prev
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Average seconds per forward(+backward) run of ``sym`` (parity:
+    ``test_utils.py:check_speed``)."""
+    import time
+
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        exe = sym.simple_bind(ctx, grad_req=grad_req, **kwargs)
+        location = {k: _np.random.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        exe = sym.simple_bind(ctx, grad_req=grad_req,
+                              **{k: v.shape for k, v in location.items()})
+    for name, value in location.items():
+        exe.arg_dict[name][:] = value
+
+    if typ == "whole":
+        def run():
+            exe.forward(is_train=True)
+            exe.backward()
+            return exe.grad_arrays
+    elif typ == "forward":
+        def run():
+            exe.forward(is_train=False)
+            return exe.outputs
+    else:
+        raise ValueError("typ can only be 'whole' or 'forward'")
+
+    import jax
+
+    jax.block_until_ready([o._data for o in run() if o is not None])  # warm
+    tic = time.time()
+    out = None
+    for _ in range(N):
+        out = run()
+    jax.block_until_ready([o._data for o in out if o is not None])
+    return (time.time() - tic) / N

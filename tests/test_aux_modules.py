@@ -219,3 +219,72 @@ def test_reinforce_gridworld_example():
     spec.loader.exec_module(mod)
     stats = mod.run(episodes=1400, log=False)
     assert stats["success_rate"] > 0.9, stats
+
+
+def test_frontend_parity_shims():
+    """New reference-parity surfaces resolve and behave: legacy NumpyOp
+    trains through a graph; MXDataIter wraps; executor_group shim binds;
+    nd aliases; test_utils helpers."""
+    import numpy as np
+    import mxnet_tpu.module.executor_group as eg
+    from mxnet_tpu import test_utils as tu
+
+    # nd aliases
+    a = mx.nd.array(np.array([2.0, 4.0], np.float32))
+    b = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(mx.nd.multiply(a, b).asnumpy(), [2, 8])
+    np.testing.assert_allclose(mx.nd.true_divide(a, b).asnumpy(), [2, 2])
+    m = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert mx.nd.moveaxis(m, 0, 2).shape == (3, 4, 2)
+
+    # test_utils helpers
+    assert tu.get_rtol(None) == 1e-5 and tu.get_atol(0.5) == 0.5
+    assert tu.almost_equal_ignore_nan(
+        np.array([1.0, np.nan]), np.array([1.0, 2.0]))
+    idx, v = tu.find_max_violation(np.array([1.0, 5.0]),
+                                   np.array([1.0, 1.0]))
+    assert idx == (1,)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(
+        tu.np_reduce(x, [0, 1], True, np.sum), x.sum(keepdims=True))
+
+    # legacy NumpyOp end-to-end
+    class Plus1(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] + 1.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0]
+
+    s = Plus1()(mx.sym.Variable("data"), name="p1")
+    ex = s.simple_bind(mx.cpu(), data=(2, 3), grad_req="write")
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, 2.0)
+
+    # MXDataIter wrapper
+    inner = mx.io.NDArrayIter(np.zeros((6, 2), np.float32),
+                              np.zeros((6,), np.float32), batch_size=3)
+    wrapped = mx.io.MXDataIter(inner)
+    assert wrapped.provide_data[0].shape == (3, 2)
+    assert wrapped.next().data[0].shape == (3, 2)
+
+    # executor_group shim
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    grp = eg.DataParallelExecutorGroup(
+        net, [mx.cpu()], None, [("data", (4, 3))],
+        [("softmax_label", (4,))], ["fc_weight", "fc_bias"],
+        for_training=True, inputs_need_grad=False)
+    grp._mod.init_params(mx.initializer.Xavier())
+    grp.forward(mx.io.DataBatch([mx.nd.array(np.ones((4, 3), np.float32))],
+                                [mx.nd.zeros((4,))]))
+    assert grp.get_outputs()[0].shape == (4, 2)
+
+    # callbacks
+    assert hasattr(mx.callback, "LogValidationMetricsCallback")
+    from mxnet_tpu.contrib import tensorboard as tb
+    assert hasattr(tb, "LogMetricsCallback")

@@ -44,6 +44,7 @@ def launch_local(args, cmd):
         # cluster of tests/nightly); --platform overrides, e.g. for a real
         # one-process-per-host TPU launch
         env["JAX_PLATFORMS"] = args.platform
+        env["MXNET_TPU_PLATFORM"] = args.platform  # wins over site-hook presets
         procs.append(subprocess.Popen(cmd, env=env))
     code = 0
     try:
