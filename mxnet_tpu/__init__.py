@@ -38,6 +38,16 @@ if _plat or _os.environ.get("JAX_PLATFORMS"):
 if _os.environ.get("MXNET_TPU_COORDINATOR"):
     import jax as _jax
 
+    # launcher contract: under the coordinator env, JAX_PLATFORMS is an
+    # EXPLICIT worker-platform request — force it via config even when a
+    # site hook preset a different platform (restores the pre-
+    # MXNET_TPU_PLATFORM behavior for external launchers)
+    if _os.environ.get("JAX_PLATFORMS") and not _plat:
+        try:
+            _jax.config.update("jax_platforms",
+                               _os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
     _jax.distributed.initialize(
         _os.environ["MXNET_TPU_COORDINATOR"],
         int(_os.environ.get("MXNET_TPU_NUM_PROCS", "1")),

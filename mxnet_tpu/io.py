@@ -527,4 +527,5 @@ class MXDataIter(DataIter):
         return getattr(self._cur, "index", None)
 
     def getpad(self):
-        return getattr(self._cur, "pad", 0)
+        pad = getattr(self._cur, "pad", None)
+        return 0 if pad is None else pad

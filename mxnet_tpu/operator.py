@@ -244,8 +244,14 @@ class PythonOp(object):
 
     # -- adapter plumbing ----------------------------------------------
     def _register_custom(self, numpy_arrays):
+        # one registration per instance: repeated get_symbol calls (per
+        # bucket/epoch loops) must not grow the registry unboundedly
+        cached = getattr(self, "_legacy_op_type", None)
+        if cached is not None:
+            return cached
         outer = self
         op_type = "_legacy_python_op_%d" % next(_legacy_seq)
+        self._legacy_op_type = op_type
 
         class _Adapter(CustomOp):
             def forward(self, is_train, req, in_data, out_data, aux):

@@ -1,0 +1,76 @@
+"""Fault-injection liveness worker (parity: ps-lite
+``get_num_dead_node`` + heartbeat timeout, reference
+``src/kvstore/kvstore_dist.h:160-165``).
+
+Launched as 2 local processes: rank 1 does a little work then EXITS
+(simulated worker death); rank 0 keeps training against the async PS and
+must observe ``num_dead_node`` flip from 0 to 1 once rank 1's heartbeats
+stop (MXNET_TPU_PS_DEAD_AFTER is set short by the pytest wrapper), while
+its own progress continues (no barrier = no hang on the dead peer).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    init_process_group()
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers >= 2
+
+    shape = (3, 3)
+    kv.init("w", mx.nd.ones(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    dead_after = float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
+
+    if rank != 0:
+        # do a couple of pushes, then die without any goodbye
+        for _ in range(3):
+            w = mx.nd.zeros(shape)
+            kv.pull("w", out=w)
+            kv.push("w", mx.nd.ones(shape) * 0.01)
+            time.sleep(0.1)
+        print("worker %d: dist_async liveness OK (exiting abruptly)" % rank,
+              flush=True)
+        os._exit(0)
+
+    # rank 0: wait until the peer has appeared, then watch it die
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if 1 in kv._async.stats()["workers"]:
+            break
+        time.sleep(0.05)
+    assert 1 in kv._async.stats()["workers"], "peer never registered"
+    assert kv.num_dead_node(0) == 0
+
+    # keep making progress while the peer dies; liveness must flip
+    flipped = False
+    deadline = time.time() + 30 + dead_after
+    while time.time() < deadline:
+        w = mx.nd.zeros(shape)
+        kv.pull("w", out=w)           # no barrier: never blocks on the dead
+        kv.push("w", mx.nd.ones(shape) * 0.01)
+        if kv.num_dead_node(0) >= 1:
+            flipped = True
+            break
+        time.sleep(0.2)
+    assert flipped, "num_dead_node never reported the dead worker"
+    print("worker 0: dist_async liveness OK (observed dead=%d)"
+          % kv.num_dead_node(0), flush=True)
+    # skip interpreter teardown: the coordination-service shutdown barrier
+    # would wait on the intentionally-dead peer
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

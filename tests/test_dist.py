@@ -11,9 +11,10 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=240):
+def _launch(n, script, timeout=240, extra_env=None):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("MXNET_TPU_", "XLA_FLAGS"))}
+    env.update(extra_env or {})
     return subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
          "-n", str(n), sys.executable, script],
@@ -21,7 +22,7 @@ def _launch(n, script, timeout=240):
         cwd=_REPO)
 
 
-def _launch_and_expect(n, script, marker, attempts=3):
+def _launch_and_expect(n, script, marker, attempts=3, extra_env=None):
     """Launch + assert all ranks print ``marker``.  Retries: on a loaded
     single-core box the 30 s gloo handshake occasionally times out; a
     genuine regression fails every attempt."""
@@ -29,7 +30,8 @@ def _launch_and_expect(n, script, marker, attempts=3):
 
     last = None
     for attempt in range(attempts):
-        r = _launch(n, os.path.join(_REPO, "tests", "dist", script))
+        r = _launch(n, os.path.join(_REPO, "tests", "dist", script),
+                    extra_env=extra_env)
         ok = [l for l in r.stdout.splitlines() if marker in l]
         if r.returncode == 0 and len(ok) == n:
             return
@@ -55,3 +57,11 @@ def test_dist_async_kvstore_via_launcher():
     # update-on-push, no barrier: worker step counts diverge yet training
     # converges; staleness asserted from the server's arrival counts
     _launch_and_expect(2, "dist_async_kvstore.py", "dist_async kvstore OK")
+
+
+def test_dist_async_liveness_detects_dead_worker():
+    # fault injection: rank 1 dies abruptly; rank 0 keeps training (no
+    # barrier) and num_dead_node flips via the missing heartbeats
+    _launch_and_expect(2, "dist_async_liveness.py",
+                       "dist_async liveness OK",
+                       extra_env={"MXNET_TPU_PS_DEAD_AFTER": "3"})
