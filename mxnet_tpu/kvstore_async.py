@@ -124,6 +124,10 @@ class AsyncServer:
         self._lock = threading.Lock()
         self._heartbeat = {}  # worker rank -> last contact time
         self._push_counts = {}  # worker rank -> pushes served
+        # at-most-once RPC dedup: rank -> (last seq, cached response) so a
+        # reconnecting worker retrying a request whose response was lost
+        # cannot double-apply a gradient (ps-lite resend semantics)
+        self._last_seq = {}
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
         self._thread = threading.Thread(
@@ -146,57 +150,68 @@ class AsyncServer:
     def dispatch(self, msg):
         op = msg["op"]
         rank = msg.get("rank", -1)
+        seq = msg.get("seq")
         with self._lock:
             self._heartbeat[rank] = time.time()
-            if op == "init":
-                # first writer wins (matches reference init-once semantics)
-                for k, v in msg["pairs"]:
-                    self._store.setdefault(k, _np.array(v, copy=True))
-                return {"ok": True}
-            if op == "push":
-                if self._updater is None:
-                    # the reference's async server runs the optimizer; a
-                    # raw-gradient += would be silent lr=-1 ascent
-                    return {"ok": False,
-                            "err": "server optimizer not set — call "
-                                   "set_optimizer() before push"}
-                # validate everything BEFORE mutating: a partial update
-                # followed by a client retry would double-apply gradients
-                bad = [k for k, _ in msg["pairs"] if k not in self._store]
-                if bad:
-                    return {"ok": False, "err": "keys %r not init" % (bad,)}
-                for k, g in msg["pairs"]:
-                    # update-on-push: no aggregation, no barrier
-                    self._updater(k, g, self._store[k])
-                self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
-                return {"ok": True}
-            if op == "pull":
-                # copy under the lock: handlers pickle the response after
-                # release, and push handlers mutate weights in place — a
-                # live reference could serialize a torn (mid-update) tensor
-                return {"ok": True,
-                        "vals": [None if self._store.get(k) is None
-                                 else _np.array(self._store[k])
-                                 for k in msg["keys"]]}
-            if op == "set_optimizer":
-                from . import optimizer as opt
+            if seq is not None:
+                last = self._last_seq.get(rank)
+                if last is not None and last[0] == seq:
+                    return last[1]  # duplicate of a completed request
+            resp = self._dispatch_locked(op, rank, msg)
+            if seq is not None:
+                self._last_seq[rank] = (seq, resp)
+            return resp
 
-                optimizer = pickle.loads(msg["optimizer"])
-                self._updater = _NumpyUpdater(opt.get_updater(optimizer))
-                return {"ok": True}
-            if op == "command":
-                # reference kController escape hatch: kept for inspection
-                self._commands.append((msg["head"], msg["body"]))
-                return {"ok": True}
-            if op == "heartbeat":
-                return {"ok": True}
-            if op == "stats":
-                now = time.time()
-                dead = [r for r, t in self._heartbeat.items()
-                        if now - t > _DEAD_AFTER_S]
-                return {"ok": True, "push_counts": dict(self._push_counts),
-                        "dead": dead, "workers": sorted(self._heartbeat)}
-            return {"ok": False, "err": "unknown op %r" % op}
+    def _dispatch_locked(self, op, rank, msg):
+        if op == "init":
+            # first writer wins (matches reference init-once semantics)
+            for k, v in msg["pairs"]:
+                self._store.setdefault(k, _np.array(v, copy=True))
+            return {"ok": True}
+        if op == "push":
+            if self._updater is None:
+                # the reference's async server runs the optimizer; a
+                # raw-gradient += would be silent lr=-1 ascent
+                return {"ok": False,
+                        "err": "server optimizer not set — call "
+                               "set_optimizer() before push"}
+            # validate everything BEFORE mutating: a partial update
+            # followed by a client retry would double-apply gradients
+            bad = [k for k, _ in msg["pairs"] if k not in self._store]
+            if bad:
+                return {"ok": False, "err": "keys %r not init" % (bad,)}
+            for k, g in msg["pairs"]:
+                # update-on-push: no aggregation, no barrier
+                self._updater(k, g, self._store[k])
+            self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
+            return {"ok": True}
+        if op == "pull":
+            # copy under the lock: handlers pickle the response after
+            # release, and push handlers mutate weights in place — a
+            # live reference could serialize a torn (mid-update) tensor
+            return {"ok": True,
+                    "vals": [None if self._store.get(k) is None
+                             else _np.array(self._store[k])
+                             for k in msg["keys"]]}
+        if op == "set_optimizer":
+            from . import optimizer as opt
+
+            optimizer = pickle.loads(msg["optimizer"])
+            self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+            return {"ok": True}
+        if op == "command":
+            # reference kController escape hatch: kept for inspection
+            self._commands.append((msg["head"], msg["body"]))
+            return {"ok": True}
+        if op == "heartbeat":
+            return {"ok": True}
+        if op == "stats":
+            now = time.time()
+            dead = [r for r, t in self._heartbeat.items()
+                    if now - t > _DEAD_AFTER_S]
+            return {"ok": True, "push_counts": dict(self._push_counts),
+                    "dead": dead, "workers": sorted(self._heartbeat)}
+        return {"ok": False, "err": "unknown op %r" % op}
 
 
 class _NumpyUpdater:
@@ -219,12 +234,22 @@ class AsyncClient:
 
     A daemon thread heartbeats independently of application pushes (the
     ps-lite model), so liveness is not conflated with push frequency — a
-    worker spending minutes in compute stays alive."""
+    worker spending minutes in compute stays alive.
+
+    Recovery (parity: ps-lite resend + ``Postoffice::is_recovery``): a
+    dropped connection is re-dialed transparently and the in-flight
+    request retried with the SAME sequence number; the server's
+    per-worker dedup returns the cached response if the first attempt
+    actually completed, so gradients are applied at most once."""
+
+    _RECONNECT_TRIES = 5
 
     def __init__(self, address, rank, heartbeat=True):
         host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
         self._rank = rank
-        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._seq = 0
+        self._sock = socket.create_connection(self._addr, timeout=60)
         self._lock = threading.Lock()
         if heartbeat:
             t = threading.Thread(target=self._heartbeat_loop,
@@ -238,13 +263,33 @@ class AsyncClient:
             try:
                 self._call({"op": "heartbeat"})
             except Exception:
-                return  # connection gone; process is exiting
+                return  # server gone for good; process is exiting
+
+    def _reconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = socket.create_connection(self._addr, timeout=60)
 
     def _call(self, msg):
         msg["rank"] = self._rank
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
+            self._seq += 1
+            msg["seq"] = self._seq
+            for attempt in range(self._RECONNECT_TRIES):
+                try:
+                    if attempt:  # re-dial failures count as attempts too
+                        self._reconnect()
+                    _send_msg(self._sock, msg)
+                    resp = _recv_msg(self._sock)
+                    break
+                except (EOFError, ConnectionError, socket.timeout,
+                        OSError):
+                    if attempt == self._RECONNECT_TRIES - 1:
+                        raise
+                    time.sleep(0.2 * (attempt + 1))
+                    # retry (same seq: the server dedups completed requests)
         if not resp.get("ok"):
             from .base import MXNetError
 
