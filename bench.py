@@ -34,9 +34,10 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "50" if platform == "tpu" else "3"))
 
     layout = os.environ.get("BENCH_LAYOUT", "NHWC" if platform == "tpu" else "NCHW")
+    stem = os.environ.get("BENCH_STEM", "conv7")  # "s2d" = space-to-depth
     sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
                             image_shape=(3, image, image), dtype="bfloat16",
-                            layout=layout)
+                            layout=layout, stem=stem)
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     tr = ShardedTrainer(
         sym, mesh,

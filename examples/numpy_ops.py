@@ -61,6 +61,9 @@ def main():
     parser.add_argument("--tpus", type=str, default=None)
     args = parser.parse_args()
 
+    # initializer + NDArrayIter shuffle draw from the global stream: pin it
+    # so the accuracy gate is deterministic
+    np.random.seed(1)
     rng = np.random.RandomState(0)
     centers = rng.randn(4, 8) * 3.0
     labels = rng.randint(0, 4, 400)

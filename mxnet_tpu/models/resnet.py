@@ -91,7 +91,7 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
            bottle_neck=True, num_group=1, bn_mom=BN_MOM, dtype="float32",
-           layout="NCHW"):
+           layout="NCHW", stem="conv7"):
     conv, bn, pool = _layer_fns(layout, bn_mom)
     data = sym.Variable("data")
     if dtype != "float32":
@@ -101,14 +101,40 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         data = sym.transpose(data, axes=(0, 2, 3, 1), name="to_nhwc")
     (nchannel, height, width) = image_shape
     data = bn(data=data, fix_gamma=True, name="bn_data")
+    if stem not in ("conv7", "s2d"):
+        raise ValueError("unknown stem %r (valid: 'conv7', 's2d')" % (stem,))
     if height <= 32:  # cifar-style stem
         body = conv(data=data, num_filter=filter_list[0],
                     kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                     no_bias=True, name="conv0")
-    else:  # imagenet stem
-        body = conv(data=data, num_filter=filter_list[0],
-                    kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                    no_bias=True, name="conv0")
+    else:
+        if stem == "s2d":
+            # space-to-depth stem (the MLPerf ResNet trick, NHWC-only):
+            # the 7x7/s2 conv is EXACTLY a 4x4/s1 conv on 2x2-blocked
+            # input with the kernel zero-padded to 8x8 — better MXU
+            # utilization for the 3-channel stem.  convert_stem_to_s2d()
+            # maps conv7 checkpoints onto this layout.
+            if layout != "NHWC":
+                raise ValueError("stem='s2d' requires layout='NHWC'")
+            if height % 2 or width % 2:
+                raise ValueError("stem='s2d' requires even image dims, "
+                                 "got %dx%d" % (height, width))
+            d = sym.reshape(data, shape=(-1, height // 2, 2, width // 2, 2,
+                                         nchannel))
+            d = sym.transpose(d, axes=(0, 1, 3, 2, 4, 5))
+            d = sym.reshape(d, shape=(-1, height // 2, width // 2,
+                                      4 * nchannel), name="s2d")
+            # conv taps cover block offsets -2..1 (the 8x8 kernel's front
+            # zero-row shifts the grid): asymmetric pad (2,1)
+            d = sym.Pad(d, mode="constant",
+                        pad_width=(0, 0, 2, 1, 2, 1, 0, 0))
+            body = conv(data=d, num_filter=filter_list[0], kernel=(4, 4),
+                        stride=(1, 1), pad=(0, 0), no_bias=True,
+                        name="conv0")
+        else:  # imagenet conv7 stem
+            body = conv(data=data, num_filter=filter_list[0],
+                        kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                        no_bias=True, name="conv0")
         body = bn(data=body, fix_gamma=False, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
         body = pool(data=body, kernel=(3, 3), stride=(2, 2),
@@ -134,6 +160,29 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     if dtype != "float32":
         fc1 = sym.Cast(data=fc1, dtype="float32")
     return sym.SoftmaxOutput(data=fc1, name="softmax")
+
+
+def convert_stem_to_s2d(arg_params):
+    """Map a standard-stem checkpoint's ``conv0_weight`` (OHWI
+    ``(F,7,7,C)``, NHWC graphs) onto the ``stem='s2d'`` layout
+    (``(F,4,4,4C)``) — numerically exact, so converted checkpoints score
+    identically."""
+    import numpy as _np
+
+    from .. import ndarray as _nd
+
+    out = dict(arg_params)
+    w = out["conv0_weight"].asnumpy()
+    if w.shape[1:3] == (4, 4):
+        return out  # already converted
+    F, kh, kw, C = w.shape
+    assert (kh, kw) == (7, 7), w.shape
+    w8 = _np.zeros((F, 8, 8, C), w.dtype)
+    w8[:, 1:, 1:] = w  # front zero-row/col aligns taps to the block grid
+    ws = w8.reshape(F, 4, 2, 4, 2, C).transpose(0, 1, 3, 2, 4, 5) \
+        .reshape(F, 4, 4, 4 * C)
+    out["conv0_weight"] = _nd.array(ws)
+    return out
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
@@ -178,4 +227,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
     return resnet(units=units, num_stages=num_stages, filter_list=filter_list,
                   num_classes=num_classes, image_shape=image_shape,
                   bottle_neck=bottle_neck, num_group=num_group, dtype=dtype,
-                  layout=layout)
+                  layout=layout, stem=kwargs.get("stem", "conv7"))

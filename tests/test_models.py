@@ -73,3 +73,36 @@ def test_lstm_lm_forward():
     exe.arg_dict["data"][:] = np.random.randint(0, 50, size=(4, 7))
     out = exe.forward(is_train=False)[0]
     assert out.shape == (4 * 7, 50)
+
+
+def test_resnet_s2d_stem_exact_equivalence():
+    """stem='s2d' (space-to-depth conv0) is numerically EXACT vs the
+    standard 7x7/s2 stem once conv0_weight is mapped with
+    convert_stem_to_s2d — whole-network forward parity."""
+    import numpy as np
+
+    from mxnet_tpu.models import resnet
+
+    shape = (2, 3, 64, 64)
+    std = resnet.get_symbol(num_classes=5, num_layers=18,
+                            image_shape=(3, 64, 64), layout="NHWC")
+    s2d = resnet.get_symbol(num_classes=5, num_layers=18,
+                            image_shape=(3, 64, 64), layout="NHWC",
+                            stem="s2d")
+    ex1 = std.simple_bind(mx.cpu(), data=shape, grad_req="null")
+    np.random.seed(0)
+    for name, arr in ex1.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.1
+    args2 = resnet.convert_stem_to_s2d(
+        {k: v for k, v in ex1.arg_dict.items() if k != "data"})
+    ex2 = s2d.simple_bind(mx.cpu(), data=shape, grad_req="null")
+    for name, arr in ex2.arg_dict.items():
+        if name != "data":
+            arr[:] = args2[name].asnumpy()
+    x = np.random.randn(*shape).astype(np.float32)
+    ex1.arg_dict["data"][:] = x
+    ex2.arg_dict["data"][:] = x
+    o1 = ex1.forward(is_train=False)[0].asnumpy()
+    o2 = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o2, o1, rtol=1e-4, atol=1e-5)
