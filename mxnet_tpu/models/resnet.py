@@ -103,7 +103,10 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     data = bn(data=data, fix_gamma=True, name="bn_data")
     if stem not in ("conv7", "s2d"):
         raise ValueError("unknown stem %r (valid: 'conv7', 's2d')" % (stem,))
-    if height <= 32:  # cifar-style stem
+    if height <= 32:  # cifar-style stem (3x3/s1: nothing for s2d to fold)
+        if stem != "conv7":
+            raise ValueError("stem=%r is not applicable to the cifar-style "
+                             "3x3 stem (height <= 32)" % (stem,))
         body = conv(data=data, num_filter=filter_list[0],
                     kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                     no_bias=True, name="conv0")
@@ -119,10 +122,13 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
             if height % 2 or width % 2:
                 raise ValueError("stem='s2d' requires even image dims, "
                                  "got %dx%d" % (height, width))
-            d = sym.reshape(data, shape=(-1, height // 2, 2, width // 2, 2,
+            # 0 = copy the batch dim: binding a different spatial size then
+            # fails the element-count check instead of silently reslicing
+            # the batch into garbage samples
+            d = sym.reshape(data, shape=(0, height // 2, 2, width // 2, 2,
                                          nchannel))
             d = sym.transpose(d, axes=(0, 1, 3, 2, 4, 5))
-            d = sym.reshape(d, shape=(-1, height // 2, width // 2,
+            d = sym.reshape(d, shape=(0, height // 2, width // 2,
                                       4 * nchannel), name="s2d")
             # conv taps cover block offsets -2..1 (the 8x8 kernel's front
             # zero-row shifts the grid): asymmetric pad (2,1)
