@@ -22,7 +22,7 @@ def _launch(n, script, timeout=240, extra_env=None):
         cwd=_REPO)
 
 
-def _launch_and_expect(n, script, marker, attempts=3, extra_env=None):
+def _launch_and_expect(n, script, marker, attempts=4, extra_env=None):
     """Launch + assert all ranks print ``marker``.  Retries: on a loaded
     single-core box the 30 s gloo handshake occasionally times out; a
     genuine regression fails every attempt."""
@@ -37,7 +37,7 @@ def _launch_and_expect(n, script, marker, attempts=3, extra_env=None):
             return
         last = r
         if attempt < attempts - 1:
-            time.sleep(5 * (attempt + 1))  # let the load spike drain
+            time.sleep(8 * (attempt + 1))  # let the load spike drain
     raise AssertionError(last.stdout + "\n" + last.stderr)
 
 
