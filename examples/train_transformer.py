@@ -1,0 +1,129 @@
+"""Train the decoder-only transformer LM — the long-context flagship
+(capability gap vs the 2017 reference: attention models + sequence
+parallelism; SURVEY.md §2.4).
+
+Synthetic corpus: a fixed repeating token pattern corrupted by uniform
+noise.  A competent LM drives perplexity down toward the corruption
+entropy; the gate asserts it gets well under the unigram baseline.
+
+Runs the TPU-first path end-to-end: ``ShardedTrainer`` over a mesh —
+``--mesh 2,2`` uses a dp×sp mesh (ring attention shards the sequence
+axis) on virtual devices, the same code that scales across real chips.
+
+    python examples/train_transformer.py [--steps 150] [--mesh 1,1]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _want_tpu(argv):
+    for i, a in enumerate(argv):
+        if a == "--tpus" and i + 1 < len(argv):
+            return argv[i + 1] != "0"
+        if a.startswith("--tpus="):
+            return a.split("=", 1)[1] != "0"
+    return False
+
+
+if __name__ == "__main__" and not _want_tpu(sys.argv[1:]):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import transformer  # noqa: E402
+from mxnet_tpu.parallel.trainer import ShardedTrainer  # noqa: E402
+
+VOCAB = 16
+PATTERN = [1, 5, 2, 9, 7, 3, 11, 4, 6, 14, 8, 12]  # period 12
+NOISE = 0.1
+
+
+def make_batch(rng, batch, seq_len):
+    """Token sequences following PATTERN with NOISE-rate corruption."""
+    data = np.zeros((batch, seq_len), np.int32)
+    labels = np.zeros((batch, seq_len), np.float32)
+    for b in range(batch):
+        phase = rng.randint(len(PATTERN))
+        seq = [PATTERN[(phase + t) % len(PATTERN)] for t in range(seq_len + 1)]
+        seq = np.array(seq)
+        noise = rng.rand(seq_len + 1) < NOISE
+        seq[noise] = rng.randint(0, VOCAB, int(noise.sum()))
+        data[b] = seq[:-1]
+        labels[b] = seq[1:]  # true next token of the corrupted stream
+    return data, labels
+
+
+def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
+          seed=0, log=True):
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    dp, sp = mesh_shape
+    devs = jax.devices()[:dp * sp]
+    assert len(devs) == dp * sp, "need %d devices" % (dp * sp)
+    mesh = Mesh(np.array(devs).reshape(dp, sp), ("data", "seq"))
+
+    sym = transformer.get_symbol(
+        num_classes=VOCAB, seq_len=seq_len, num_embed=64, num_heads=4,
+        num_layers=2, context_parallel_axis="seq" if sp > 1 else "")
+    tr = ShardedTrainer(
+        sym, mesh, data_shapes={"data": (batch, seq_len)},
+        label_shapes={"softmax_label": (batch, seq_len)},
+        type_dict={"data": "int32"},
+        learning_rate=lr, momentum=0.9,
+        rescale_grad=1.0 / (batch * seq_len))
+    params, moms, aux = tr.init(seed=seed)
+    step = tr.step_fn()
+    key = jax.random.PRNGKey(0)
+
+    ppl = float("inf")
+    for i in range(steps):
+        data, labels = make_batch(rng, batch, seq_len)
+        arrays = tr.place_batch({"data": data, "softmax_label": labels})
+        outs, params, moms, aux = step(params, moms, aux, arrays, key)
+        if (i + 1) % 25 == 0 or i == steps - 1:
+            probs = np.asarray(outs[0]).reshape(batch, seq_len, VOCAB)
+            idx = labels.astype(np.int64)
+            p = np.take_along_axis(probs, idx[..., None], axis=2)[..., 0]
+            ppl = float(np.exp(-np.mean(np.log(np.maximum(p, 1e-9)))))
+            if log:
+                logging.info("step %d: perplexity=%.2f (mesh=%s)",
+                             i + 1, ppl, dict(mesh.shape))
+    return {"perplexity": ppl}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="Transformer LM training")
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--mesh", type=str, default="1,1",
+                   help="dp,sp mesh shape (sp>1 = ring attention)")
+    p.add_argument("--tpus", type=int, default=0)
+    args = p.parse_args()
+    mesh_shape = tuple(int(x) for x in args.mesh.split(","))
+    stats = train(steps=args.steps, seq_len=args.seq_len,
+                  mesh_shape=mesh_shape)
+    print("final:", stats)
+    # unigram baseline over this corpus is ~VOCAB-ish for noise tokens and
+    # pattern entropy ~0; a working LM lands far below vocab-size ppl
+    assert stats["perplexity"] < 4.0, stats
+
+
+if __name__ == "__main__":
+    main()

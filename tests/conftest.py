@@ -25,3 +25,19 @@ def _seed():
     import mxnet_tpu as mx
 
     mx.random.seed(42)
+
+
+def load_example(name):
+    """Import an examples/ script as a module (shared by the example-gate
+    tests; registered in sys.modules so dataclass/pickle paths work)."""
+    import importlib.util
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "examples", name)
+    spec = importlib.util.spec_from_file_location(
+        "example_" + os.path.splitext(os.path.basename(name))[0], path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod

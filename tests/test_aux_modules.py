@@ -187,17 +187,9 @@ def test_executor_monitor_callback_is_invoked():
 def test_fgsm_adversary_example():
     """inputs_need_grad FGSM path (reference example/adversary tier):
     adversarial accuracy collapses while clean accuracy stays high."""
-    import importlib.util
-    import os
-    import sys
+    from conftest import load_example
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "adversary_example", os.path.join(repo, "examples",
-                                          "adversary_fgsm.py"))
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
+    mod = load_example("adversary_fgsm.py")
     stats = mod.run(log=False)
     assert stats["clean_acc"] > 0.9, stats
     assert stats["adv_acc"] < stats["clean_acc"] - 0.3, stats
@@ -206,17 +198,9 @@ def test_fgsm_adversary_example():
 def test_reinforce_gridworld_example():
     """REINFORCE via the imperative autograd tape (reference
     example/reinforcement-learning tier): policy reaches >90% success."""
-    import importlib.util
-    import os
-    import sys
+    from conftest import load_example
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "reinforce_example", os.path.join(repo, "examples",
-                                          "reinforce_gridworld.py"))
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
+    mod = load_example("reinforce_gridworld.py")
     stats = mod.run(episodes=1400, log=False)
     assert stats["success_rate"] > 0.9, stats
 

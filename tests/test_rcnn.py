@@ -2,24 +2,13 @@
 RPN + Proposal + ROIPooling + python ProposalTarget CustomOp trained as
 one graph on synthetic data)."""
 
-import importlib.util
 import os
-import sys
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _load_example():
-    path = os.path.join(_REPO, "examples", "rcnn", "train.py")
-    spec = importlib.util.spec_from_file_location("rcnn_train_example", path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+from conftest import load_example
 
 
 def test_rcnn_end_to_end_convergence_smoke():
-    m = _load_example()
+    m = load_example(os.path.join("rcnn", "train.py"))
     stats = m.train(num_epochs=12, batch=8, lr=0.02, seed=0, log=False)
     # RPN learns to separate fg/bg anchors
     assert stats["rpn_acc"] > 0.85, stats

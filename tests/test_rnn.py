@@ -113,15 +113,8 @@ def test_ctc_ocr_example_converges():
     """CTC sequence training end-to-end (reference example/warpctc tier):
     LSTM + ctc_loss on synthetic digit strips reaches high greedy-decoded
     sequence accuracy."""
-    import importlib.util
-    import os
-    import sys
+    from conftest import load_example
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(repo, "examples", "warpctc_ocr.py")
-    spec = importlib.util.spec_from_file_location("warpctc_ocr_example", path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
+    mod = load_example("warpctc_ocr.py")
     stats = mod.train(num_epochs=14, log=False, stop_acc=0.85)
     assert stats["seq_acc"] > 0.8, stats
