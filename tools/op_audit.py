@@ -79,6 +79,14 @@ def main():
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
+    # static audit, no device work: force the CPU platform so importing
+    # the package can't block on a tunneled accelerator backend
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     from mxnet_tpu.ops import registry
 
     ours = set(registry.OP_REGISTRY) | set(registry._ALIAS)
