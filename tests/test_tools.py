@@ -38,3 +38,40 @@ def test_bandwidth_smoke():
         capture_output=True, text=True, timeout=240, env=env, cwd=_REPO)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "h2d:" in r.stdout and "all-reduce" in r.stdout
+
+
+def test_bench_table_render_rules():
+    """Rendering rules for the perf-table artifact: None -> 'fail' (not
+    0.0), ratios only from real bf16 values (never the fp32 fallback),
+    and the alexnet latency footnote computed from the measured row."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_table_mod", os.path.join(repo, "tools", "bench_table.py"))
+    bt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bt)
+
+    infer = [
+        {"net": "resnet-50", "batch": 32, "float32": 1000.0,
+         "bfloat16": None},                      # bf16 failed
+        {"net": "alexnet", "batch": 32, "float32": 0.0, "bfloat16": 100.0},
+        {"net": "alexnet", "batch": 256, "float32": None,
+         "bfloat16": 19535.08},                  # 4.0x of 4883.77
+    ]
+    train = [{"net": "resnet-50", "batch": 32, "dtype": "bfloat16",
+              "img_s": None}]
+    out = bt.render(infer, train, "TestChip")
+    # failed bf16: no ratio from the fp32 fallback
+    row = [l for l in out.splitlines() if l.startswith("| resnet-50 | 32")][0]
+    assert "fail" in row and "—" in row and "1.4×" not in row
+    # real 0.0 renders as a number, not 'fail'
+    arow = [l for l in out.splitlines() if l.startswith("| alexnet | 32")][0]
+    assert "| 0.0 |" in arow
+    # footnote ratio computed from the measured batch-256 value
+    assert "4.0×" in out
+    # failed training row
+    trow = [l for l in out.splitlines()
+            if l.startswith("| resnet-50 | 32 | bfloat16")][0]
+    assert "fail" in trow
