@@ -45,11 +45,9 @@ def test_bench_table_render_rules():
     0.0), ratios only from real bf16 values (never the fp32 fallback),
     and the alexnet latency footnote computed from the measured row."""
     import importlib.util
-    import os
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "bench_table_mod", os.path.join(repo, "tools", "bench_table.py"))
+        "bench_table_mod", os.path.join(_REPO, "tools", "bench_table.py"))
     bt = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bt)
 
