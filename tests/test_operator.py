@@ -681,3 +681,22 @@ def test_gen_negative_binomial_alpha_zero():
     assert np.isfinite(s2).all()
     assert abs(s2[0].var() - 4.0) < 1.0          # Poisson lane
     assert s2[1].var() > 8.0                     # overdispersed lane
+
+
+def test_beyond_reference_unary_and_mod():
+    """Numeric coverage for the beyond-reference convenience ops."""
+    a = _rand(3, 4)
+    b = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.softsign(x), [a], [a / (1 + np.abs(a))],
+                           rtol=1e-5)
+    check_numeric_gradient(mx.sym.softsign(x), [a], rtol=5e-2, atol=1e-3)
+    check_symbolic_forward(mx.sym.reciprocal(y), [b], [1.0 / b], rtol=1e-5)
+    check_symbolic_forward(mx.sym.logical_not(x), [a],
+                           [(a == 0).astype(np.float32)])
+    check_symbolic_forward(mx.sym.broadcast_mod(x, y), {"x": np.abs(a) + 2,
+                                                        "y": b},
+                           [np.mod(np.abs(a) + 2, b)], rtol=1e-5)
+    # stack: symbol n-ary
+    s = mx.sym.stack(x, y, axis=1)
+    check_symbolic_forward(s, {"x": a, "y": b}, [np.stack([a, b], axis=1)])
