@@ -106,3 +106,55 @@ def test_resnet_s2d_stem_exact_equivalence():
     o1 = ex1.forward(is_train=False)[0].asnumpy()
     o2 = ex2.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(o2, o1, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_s2d_stem_backward_parity():
+    """Gradients w.r.t. the input match between stems (the transform is a
+    linear reparameterization of conv0, so d/d(data) is identical)."""
+    import numpy as np
+
+    from mxnet_tpu.models import resnet
+
+    shape = (2, 3, 64, 64)
+    kw = dict(num_classes=3, num_layers=18, image_shape=(3, 64, 64),
+              layout="NHWC")
+    std = resnet.get_symbol(**kw)
+    s2d = resnet.get_symbol(stem="s2d", **kw)
+    ex1 = std.simple_bind(mx.cpu(), data=shape,
+                          softmax_label=(2,), grad_req="write")
+    np.random.seed(3)
+    for name, arr in ex1.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.1
+    args2 = resnet.convert_stem_to_s2d(
+        {k: v for k, v in ex1.arg_dict.items()
+         if k not in ("data", "softmax_label")})
+    ex2 = s2d.simple_bind(mx.cpu(), data=shape,
+                          softmax_label=(2,), grad_req="write")
+    for name, arr in ex2.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = args2[name].asnumpy()
+    x = np.random.randn(*shape).astype(np.float32)
+    y = np.array([0.0, 2.0], np.float32)
+    for ex in (ex1, ex2):
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["softmax_label"][:] = y
+        ex.forward(is_train=True)
+        ex.backward()
+    # deeper-layer weight grads are stem-independent
+    for k in ("fc1_weight", "stage1_unit1_conv1_weight"):
+        np.testing.assert_allclose(ex2.grad_dict[k].asnumpy(),
+                                   ex1.grad_dict[k].asnumpy(),
+                                   rtol=1e-3, atol=1e-5)
+    # conv0 grads agree on the embedded 7x7 support; the zero-padded
+    # kernel slots are EXTRA trainable parameters in the s2d layout (they
+    # legitimately receive their own gradients)
+    g1 = {"conv0_weight": mx.nd.array(ex1.grad_dict["conv0_weight"].asnumpy())}
+    g1m = resnet.convert_stem_to_s2d(g1)["conv0_weight"].asnumpy()
+    ones = {"conv0_weight": mx.nd.array(
+        np.ones_like(ex1.grad_dict["conv0_weight"].asnumpy()))}
+    support = resnet.convert_stem_to_s2d(ones)["conv0_weight"] \
+        .asnumpy().astype(bool)
+    g2 = ex2.grad_dict["conv0_weight"].asnumpy()
+    np.testing.assert_allclose(g2[support], g1m[support], rtol=1e-3,
+                               atol=1e-5)
