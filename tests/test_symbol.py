@@ -132,3 +132,27 @@ def test_load_json_legacy_variants():
     out = ex.forward()[0].asnumpy()
     assert out.shape == (2, 4)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_s2d_resnet_json_roundtrip():
+    """The s2d stem graph (Pad + 0-code reshapes) survives JSON
+    serialization and produces identical outputs after reload."""
+    import numpy as np
+
+    from mxnet_tpu.models import resnet
+
+    sym = resnet.get_symbol(num_classes=3, num_layers=18,
+                            image_shape=(3, 64, 64), layout="NHWC",
+                            stem="s2d")
+    sym2 = mx.sym.load_json(sym.tojson())
+    assert sym2.list_arguments() == sym.list_arguments()
+    ex1 = sym.simple_bind(mx.cpu(), data=(1, 3, 64, 64), grad_req="null")
+    ex2 = sym2.simple_bind(mx.cpu(), data=(1, 3, 64, 64), grad_req="null")
+    np.random.seed(5)
+    for k, v1 in ex1.arg_dict.items():
+        val = np.random.randn(*v1.shape).astype(np.float32) * 0.1
+        v1[:] = val
+        ex2.arg_dict[k][:] = val
+    o1 = ex1.forward(is_train=False)[0].asnumpy()
+    o2 = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(o1, o2)
