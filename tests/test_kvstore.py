@@ -126,3 +126,19 @@ def test_async_client_reconnect_and_dedup():
         np.testing.assert_allclose(np.asarray(w4), np.asarray(w3))
     finally:
         srv.stop()
+
+
+def test_async_ps_host_selection(monkeypatch):
+    """Bind/advertise policy: loopback by default (pickle wire protocol
+    must not face arbitrary networks); 0.0.0.0 + routable advertise only
+    under explicit MXNET_TPU_PS_HOST; named binds advertise themselves."""
+    from mxnet_tpu import kvstore_async as ka
+
+    monkeypatch.delenv("MXNET_TPU_PS_HOST", raising=False)
+    assert ka._default_bind_host() == "127.0.0.1"
+    assert ka._advertise_host("127.0.0.1") == "127.0.0.1"
+    assert ka._advertise_host("10.0.0.7") == "10.0.0.7"
+
+    monkeypatch.setenv("MXNET_TPU_PS_HOST", "worker-0.cluster")
+    assert ka._default_bind_host() == "0.0.0.0"
+    assert ka._advertise_host("0.0.0.0") == "worker-0.cluster"
