@@ -270,39 +270,44 @@ def CastAug():
     return aug
 
 
+# Standard ImageNet statistics (the values every framework shares).
+_IMAGENET_PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+_IMAGENET_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]])
+_IMAGENET_RGB_MEAN = np.array([123.68, 116.28, 103.53])
+_IMAGENET_RGB_STD = np.array([58.395, 57.12, 57.375])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, inter_method=2):
     """Create the standard augmenter list (parity: ``image.py:CreateAugmenter``)."""
-    auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
-    crop_size = (data_shape[2], data_shape[1])
+    out_wh = (data_shape[2], data_shape[1])
     if rand_resize:
         assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, interp=inter_method))
-    elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        crop = RandomSizedCropAug(out_wh, interp=inter_method)
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
-    if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
-    if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
-    if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        crop = (RandomCropAug if rand_crop else CenterCropAug)(out_wh,
+                                                               inter_method)
+    want_jitter = bool(brightness or contrast or saturation)
+    stages = [
+        ResizeAug(resize, inter_method) if resize > 0 else None,
+        crop,
+        HorizontalFlipAug(0.5) if rand_mirror else None,
+        CastAug(),
+        ColorJitterAug(brightness, contrast, saturation) if want_jitter
+        else None,
+        LightingAug(pca_noise, _IMAGENET_PCA_EIGVAL, _IMAGENET_PCA_EIGVEC)
+        if pca_noise > 0 else None,
+    ]
     if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
+        mean = _IMAGENET_RGB_MEAN
     if std is True:
-        std = np.array([58.395, 57.12, 57.375])
+        std = _IMAGENET_RGB_STD
     if mean is not None and getattr(mean, "shape", None):
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        stages.append(ColorNormalizeAug(mean, std))
+    return [s for s in stages if s is not None]
 
 
 class ImageIter(DataIter):

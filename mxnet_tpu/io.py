@@ -202,7 +202,14 @@ class NDArrayIter(DataIter):
 
 
 class ResizeIter(DataIter):
-    """Resize another iterator to ``size`` batches/epoch (parity: ``ResizeIter``)."""
+    """Clamp/extend another iterator to ``size`` batches per epoch
+    (parity: ``io.py:ResizeIter``).
+
+    When the wrapped iterator runs dry mid-epoch it is restarted, so
+    ``size`` may exceed its natural length."""
+
+    _MIRRORED = ("provide_data", "provide_label", "batch_size",
+                 "default_bucket_key")
 
     def __init__(self, data_iter, size, reset_internal=True):
         super().__init__()
@@ -211,23 +218,26 @@ class ResizeIter(DataIter):
         self.reset_internal = reset_internal
         self.cur = 0
         self.current_batch = None
-        self.provide_data = data_iter.provide_data
-        self.provide_label = data_iter.provide_label
-        self.batch_size = data_iter.batch_size
+        for attr in self._MIRRORED:
+            if hasattr(data_iter, attr):
+                setattr(self, attr, getattr(data_iter, attr))
 
     def reset(self):
         self.cur = 0
         if self.reset_internal:
             self.data_iter.reset()
 
-    def iter_next(self):
-        if self.cur == self.size:
-            return False
+    def _pull_wrapping(self):
         try:
-            self.current_batch = self.data_iter.next()
+            return self.data_iter.next()
         except StopIteration:
             self.data_iter.reset()
-            self.current_batch = self.data_iter.next()
+            return self.data_iter.next()
+
+    def iter_next(self):
+        if self.cur >= self.size:
+            return False
+        self.current_batch = self._pull_wrapping()
         self.cur += 1
         return True
 

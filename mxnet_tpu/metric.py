@@ -1,4 +1,11 @@
-"""Evaluation metrics (parity: reference ``python/mxnet/metric.py:22-364``)."""
+"""Evaluation metrics (parity: reference ``python/mxnet/metric.py:22-364``).
+
+Implementations are vectorized numpy rather than the reference's
+per-sample loops; numeric results match.  The reference's
+``CompositeEvalMetric.get_metric`` bug (``return ValueError`` instead of
+``raise``, ref ``metric.py:105``) is fixed here: out-of-range indices
+raise.
+"""
 
 from __future__ import annotations
 
@@ -6,7 +13,7 @@ import math
 
 import numpy
 
-from .base import numeric_types, string_types
+from .base import string_types
 from .ndarray import NDArray
 
 __all__ = [
@@ -17,15 +24,24 @@ __all__ = [
 
 
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    got = (len(labels), len(preds)) if shape == 0 else (labels.shape, preds.shape)
+    if got[0] != got[1]:
         raise ValueError(
-            "Shape of labels %s does not match shape of predictions %s"
-            % (label_shape, pred_shape)
+            "Shape of labels %s does not match shape of predictions %s" % got
         )
+
+
+def _as_numpy(x):
+    """Materialize one label/pred entry as a numpy array."""
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
+
+
+def _paired(labels, preds, check=True):
+    """Yield (label, pred) numpy pairs, length-checked once up front."""
+    if check:
+        check_label_shapes(labels, preds)
+    for label, pred in zip(labels, preds):
+        yield _as_numpy(label), _as_numpy(pred)
 
 
 class EvalMetric(object):
@@ -47,25 +63,23 @@ class EvalMetric(object):
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
 
+    @staticmethod
+    def _ratio(total, count):
+        return total / count if count != 0 else float("nan")
+
     def get(self):
         if self.num is None:
-            if self.num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.sum_metric / self.num_inst)
-        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
-        values = [
-            x / y if y != 0 else float("nan")
-            for x, y in zip(self.sum_metric, self.num_inst)
-        ]
-        return (names, values)
+            return (self.name, self._ratio(self.sum_metric, self.num_inst))
+        return (
+            ["%s_%d" % (self.name, i) for i in range(self.num)],
+            [self._ratio(x, y) for x, y in zip(self.sum_metric, self.num_inst)],
+        )
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
@@ -76,44 +90,35 @@ class CompositeEvalMetric(EvalMetric):
 
     def __init__(self, metrics=None, **kwargs):
         super().__init__("composite", **kwargs)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(m) if isinstance(m, str) else m for m in metrics]
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric) if isinstance(metric, str) else metric)
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
+        # ref metric.py:105 RETURNS the ValueError; fixed to raise.
+        if not 0 <= index < len(self.metrics):
+            raise ValueError("Metric index {} is out of range 0 and {}".format(
                 index, len(self.metrics)))
+        return self.metrics[index]
 
     def update(self, labels, preds):
         for metric in self.metrics:
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
 
     def get(self):
-        names = []
-        results = []
+        names, results = [], []
         for metric in self.metrics:
-            result = metric.get()
-            name = result[0]
+            name, value = metric.get()
             if isinstance(name, string_types):
-                name = [name]
-                result = [result[1]]
-            else:
-                result = result[1]
+                name, value = [name], [value]
             names.extend(name)
-            results.extend(result)
+            results.extend(value)
         return (names, results)
 
 
@@ -123,16 +128,14 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred = pred_label.asnumpy()
+        for label, pred in _paired(labels, preds):
             if pred.shape != label.shape:
-                pred = numpy.argmax(pred, axis=self.axis)
-            pred = pred.astype("int32")
-            label = label.asnumpy().astype("int32")
+                pred = pred.argmax(axis=self.axis)
             check_label_shapes(label, pred)
-            self.sum_metric += (pred.flat == label.flat).sum()
-            self.num_inst += len(pred.flat)
+            hits = numpy.equal(pred.astype("int32").ravel(),
+                               label.astype("int32").ravel())
+            self.sum_metric += int(hits.sum())
+            self.num_inst += hits.size
 
 
 class TopKAccuracy(EvalMetric):
@@ -143,24 +146,20 @@ class TopKAccuracy(EvalMetric):
         self.name += "_%d" % self.top_k
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label = label.asnumpy().astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat == label.flat
-                    ).sum()
-            self.num_inst += num_samples
+        for label, pred in _paired(labels, preds):
+            assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+            check_label_shapes(label, pred)
+            truth = label.astype("int32")
+            if pred.ndim == 1:
+                hit = numpy.equal(pred.astype("int32"), truth)
+            else:
+                k = min(self.top_k, pred.shape[1])
+                # membership in the unordered top-k set — equivalent to
+                # the reference's walk over the k last argsort columns
+                top = numpy.argpartition(pred.astype("float32"), -k, axis=1)[:, -k:]
+                hit = numpy.any(top == truth.reshape(-1, 1), axis=1)
+            self.sum_metric += int(hit.sum())
+            self.num_inst += hit.shape[0]
 
 
 class F1(EvalMetric):
@@ -168,35 +167,19 @@ class F1(EvalMetric):
         super().__init__("f1")
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
+        for label, pred in _paired(labels, preds):
             check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
+            truth = label.astype("int32").ravel()
+            if numpy.unique(truth).size > 2:
                 raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.0
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.0
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.0
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.0
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.0
+            guess = pred.argmax(axis=1)
+            tp = int(numpy.sum((guess == 1) & (truth == 1)))
+            fp = int(numpy.sum((guess == 1) & (truth == 0)))
+            fn = int(numpy.sum((guess == 0) & (truth == 1)))
+            precision = tp / (tp + fp) if tp + fp else 0.0
+            recall = tp / (tp + fn) if tp + fn else 0.0
             if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.0
-            self.sum_metric += f1_score
+                self.sum_metric += 2 * precision * recall / (precision + recall)
             self.num_inst += 1
 
 
@@ -210,26 +193,22 @@ class Perplexity(EvalMetric):
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            assert label.size == pred.size / pred.shape[-1], (
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            )
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[
-                numpy.arange(label.size), label
-            ]
+        for label, pred in _paired(labels, preds, check=False):
+            if self.axis not in (-1, pred.ndim - 1):
+                pred = numpy.moveaxis(pred, self.axis, -1)
+            flat = pred.reshape(-1, pred.shape[-1])
+            idx = label.ravel().astype("int32")
+            assert idx.size == flat.shape[0], (
+                "shape mismatch: %s vs. %s" % (label.shape, pred.shape))
+            picked = flat[numpy.arange(idx.size), idx]
+            count = idx.size
             if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                probs = probs * (1 - ignore) + ignore
-                num -= int(ignore.sum())
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        self.sum_metric += loss
-        self.num_inst += num
+                keep = idx != self.ignore_label
+                picked = numpy.where(keep, picked, 1.0)
+                count -= int(numpy.sum(~keep))
+            self.sum_metric -= float(
+                numpy.sum(numpy.log(numpy.maximum(1e-10, picked))))
+            self.num_inst += count
 
     def get(self):
         if self.num_inst == 0:
@@ -237,49 +216,39 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
-class MAE(EvalMetric):
+class _PerBatchRegression(EvalMetric):
+    """Shared shape-normalization for the elementwise regression metrics."""
+
+    def update(self, labels, preds):
+        for label, pred in _paired(labels, preds):
+            if label.ndim == 1:
+                label = label.reshape(-1, 1)
+            self.sum_metric += self._score(label, pred)
+            self.num_inst += 1
+
+
+class MAE(_PerBatchRegression):
     def __init__(self):
         super().__init__("mae")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return float(numpy.mean(numpy.abs(label - pred)))
 
 
-class MSE(EvalMetric):
+class MSE(_PerBatchRegression):
     def __init__(self):
         super().__init__("mse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return float(numpy.mean(numpy.square(label - pred)))
 
 
-class RMSE(EvalMetric):
+class RMSE(_PerBatchRegression):
     def __init__(self):
         super().__init__("rmse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return float(numpy.sqrt(numpy.mean(numpy.square(label - pred))))
 
 
 class CrossEntropy(EvalMetric):
@@ -288,15 +257,12 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+        for label, pred in _paired(labels, preds):
+            idx = label.ravel().astype("int64")
+            assert idx.shape[0] == pred.shape[0]
+            picked = pred[numpy.arange(idx.size), idx]
+            self.sum_metric += float(numpy.sum(-numpy.log(picked + self.eps)))
+            self.num_inst += idx.size
 
 
 class Loss(EvalMetric):
@@ -327,7 +293,7 @@ class CustomMetric(EvalMetric):
     def __init__(self, feval, name=None, allow_extra_outputs=False):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name)
         self._feval = feval
@@ -336,17 +302,11 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+        for label, pred in zip(labels, preds):
+            out = self._feval(_as_numpy(label), _as_numpy(pred))
+            total, count = out if isinstance(out, tuple) else (out, 1)
+            self.sum_metric += total
+            self.num_inst += count
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
@@ -357,6 +317,20 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
 
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+_METRIC_REGISTRY = {
+    "acc": Accuracy,
+    "accuracy": Accuracy,
+    "ce": CrossEntropy,
+    "f1": F1,
+    "mae": MAE,
+    "mse": MSE,
+    "rmse": RMSE,
+    "top_k_accuracy": TopKAccuracy,
+    "perplexity": Perplexity,
+    "loss": Loss,
+}
 
 
 def create(metric, **kwargs):
@@ -370,20 +344,8 @@ def create(metric, **kwargs):
         for child in metric:
             composite.add(create(child, **kwargs))
         return composite
-    metrics = {
-        "acc": Accuracy,
-        "accuracy": Accuracy,
-        "ce": CrossEntropy,
-        "f1": F1,
-        "mae": MAE,
-        "mse": MSE,
-        "rmse": RMSE,
-        "top_k_accuracy": TopKAccuracy,
-        "perplexity": Perplexity,
-        "loss": Loss,
-    }
     try:
-        return metrics[metric.lower()](**kwargs)
+        return _METRIC_REGISTRY[metric.lower()](**kwargs)
     except Exception:
         raise ValueError("Metric must be either callable or in {}".format(
-            sorted(metrics)))
+            sorted(_METRIC_REGISTRY)))

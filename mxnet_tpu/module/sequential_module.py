@@ -87,21 +87,20 @@ class SequentialModule(BaseModule):
                                allow_missing=allow_missing,
                                force_init=force_init)
 
-        def _check_name(known_names, new_names, modules, i):
-            """Make sure the names are unique for each module."""
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " + (
-                    "name %r in layer %d (%s) is already used in layer %d (%s)."
-                    % (name, i, type(modules[i]), known_names[name],
-                       type(modules[known_names[name]])))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        # no two layers may claim the same parameter or aux-state name
+        owner = {"arg": {}, "aux": {}}
+        for idx, module in enumerate(self._modules):
+            args, auxs = module.get_params()
+            for kind, names in (("arg", args), ("aux", auxs)):
+                seen = owner[kind]
+                for name in names:
+                    if name in seen:
+                        raise AssertionError(
+                            "Duplicated parameter names: name %r in layer "
+                            "%d (%s) is already used in layer %d (%s)."
+                            % (name, idx, type(module), seen[name],
+                               type(self._modules[seen[name]])))
+                    seen[name] = idx
         self.params_initialized = True
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -116,40 +115,31 @@ class SequentialModule(BaseModule):
         assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
 
         self.binded = True
-        self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        # which layers consume labels; none -> the chain has no label inputs
+        takes_labels = [bool(meta.get(SequentialModule.META_TAKE_LABELS))
+                        for meta in self._metas]
+        self._label_shapes = label_shapes if any(takes_labels) else None
 
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
-
+        chained_shapes = list(data_shapes)
+        for idx, (module, meta) in enumerate(zip(self._modules, self._metas)):
             if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-
-            # the output of the previous module is the data of the next module
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+                names = module.data_names
+                assert len(names) == len(chained_shapes)
+                chained_shapes = [(name, shape) for name, (_, shape)
+                                  in zip(names, chained_shapes)]
+            module.bind(
+                data_shapes=chained_shapes,
+                label_shapes=label_shapes if takes_labels[idx] else None,
+                for_training=for_training,
+                # interior layers must produce input grads to keep the
+                # backward chain flowing even when the caller needs none
+                inputs_need_grad=bool(inputs_need_grad
+                                      or (for_training and idx > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            # each layer's outputs feed the next layer's data slots
+            chained_shapes = module.output_shapes
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

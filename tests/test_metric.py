@@ -1,0 +1,130 @@
+"""Numeric tests for mxnet_tpu.metric (parity: reference metric.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, dtype=np.float32))
+
+
+def test_accuracy_argmax_and_direct():
+    m = mx.metric.Accuracy()
+    preds = _nd([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = _nd([1, 1, 1])
+    m.update([labels], [preds])
+    assert m.get() == ("accuracy", pytest.approx(2.0 / 3.0))
+    # same-shape path: pred already label-shaped
+    m2 = mx.metric.Accuracy()
+    m2.update([_nd([1, 0, 1])], [_nd([1, 1, 1])])
+    assert m2.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_top_k_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    preds = _nd([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1], [0.05, 0.05, 0.9]])
+    labels = _nd([2, 2, 2])  # in-top2 for rows 0 and 2 only
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+    with pytest.raises(AssertionError):
+        mx.metric.TopKAccuracy(top_k=1)
+
+
+def test_f1_binary():
+    m = mx.metric.F1()
+    # guesses: 1,1,0,0 ; truth: 1,0,1,0 -> tp=1 fp=1 fn=1 -> p=r=f1=0.5
+    preds = _nd([[0.2, 0.8], [0.3, 0.7], [0.9, 0.1], [0.6, 0.4]])
+    m.update([_nd([1, 0, 1, 0])], [preds])
+    assert m.get()[1] == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        mx.metric.F1().update([_nd([0, 1, 2])], [_nd([[1, 0], [0, 1], [1, 0]])])
+
+
+def test_perplexity_matches_manual_nll():
+    probs = np.array([[0.5, 0.25, 0.25], [0.1, 0.8, 0.1]], dtype=np.float32)
+    labels = np.array([0, 1], dtype=np.float32)
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([_nd(labels)], [_nd(probs)])
+    expect = np.exp(-(np.log(0.5) + np.log(0.8)) / 2.0)
+    assert m.get()[1] == pytest.approx(expect, rel=1e-5)
+    # ignored labels contribute nothing to loss or count
+    mi = mx.metric.Perplexity(ignore_label=1)
+    mi.update([_nd(labels)], [_nd(probs)])
+    assert mi.get()[1] == pytest.approx(np.exp(-np.log(0.5)), rel=1e-5)
+
+
+def test_accuracy_batch_mismatch_raises():
+    m = mx.metric.Accuracy()
+    with pytest.raises(ValueError):
+        m.update([_nd([1, 0, 1])], [_nd([[0.1, 0.9]])])
+
+
+def test_perplexity_nonlast_axis():
+    # class axis 1 of (N, C, T): must match moving the axis to the back
+    probs = np.zeros((1, 3, 4), dtype=np.float32)
+    probs[0, 1, :] = 1.0
+    labels = np.ones((1, 4), dtype=np.float32)
+    m = mx.metric.Perplexity(ignore_label=None, axis=1)
+    m.update([_nd(labels)], [_nd(probs)])
+    assert m.get()[1] == pytest.approx(1.0, rel=1e-5)
+
+
+def test_regression_metrics():
+    label, pred = _nd([1.0, 2.0, 3.0]), _nd([[1.5], [2.0], [2.0]])
+    mae = mx.metric.MAE(); mae.update([label], [pred])
+    mse = mx.metric.MSE(); mse.update([label], [pred])
+    rmse = mx.metric.RMSE(); rmse.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(0.5)
+    assert mse.get()[1] == pytest.approx((0.25 + 0 + 1) / 3.0)
+    assert rmse.get()[1] == pytest.approx(np.sqrt((0.25 + 0 + 1) / 3.0))
+
+
+def test_cross_entropy():
+    m = mx.metric.CrossEntropy()
+    probs = _nd([[0.5, 0.5], [0.9, 0.1]])
+    m.update([_nd([0, 0])], [probs])
+    assert m.get()[1] == pytest.approx(-(np.log(0.5) + np.log(0.9)) / 2, rel=1e-5)
+
+
+def test_composite_get_metric_raises_out_of_range():
+    # the reference RETURNS the ValueError (ref metric.py:105); we raise
+    comp = mx.metric.CompositeEvalMetric(metrics=["acc", "mse"])
+    assert isinstance(comp.get_metric(0), mx.metric.Accuracy)
+    with pytest.raises(ValueError):
+        comp.get_metric(99)
+    with pytest.raises(ValueError):
+        comp.get_metric(-1)
+
+
+def test_composite_update_and_names():
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add("acc")
+    comp.add(mx.metric.MAE())
+    preds = _nd([[0.1, 0.9], [0.8, 0.2]])
+    comp.update([_nd([1, 1])], [preds])
+    names, values = comp.get()
+    assert names == ["accuracy", "mae"]
+    assert values[0] == pytest.approx(0.5)
+
+
+def test_custom_metric_and_np_wrapper():
+    def sq_err(label, pred):
+        return float(np.sum((label - pred.ravel()) ** 2)), label.size
+
+    m = mx.metric.np(sq_err)
+    m.update([_nd([1.0, 2.0])], [_nd([[1.0], [4.0]])])
+    assert m.get()[1] == pytest.approx(2.0)
+    # non-tuple return counts one instance per call
+    m2 = mx.metric.CustomMetric(lambda l, p: 3.0, name="const")
+    m2.update([_nd([0.0])], [_nd([0.0])])
+    assert m2.get() == ("const", 3.0)
+
+
+def test_create_and_empty_get():
+    assert isinstance(mx.metric.create("rmse"), mx.metric.RMSE)
+    assert isinstance(mx.metric.create(["acc", "ce"]), mx.metric.CompositeEvalMetric)
+    with pytest.raises(ValueError):
+        mx.metric.create("no_such_metric")
+    assert np.isnan(mx.metric.Accuracy().get()[1])

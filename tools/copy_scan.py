@@ -1,0 +1,68 @@
+"""Largest-verbatim-block scan of repo sources vs the reference python tree.
+
+For each repo file given (or the round-2 flagged set by default), find the
+longest run of consecutive identical non-blank lines (whitespace-stripped)
+against every reference python/mxnet/*.py file, and report runs >= the
+threshold (default 12, the judge's bar).
+"""
+
+import sys
+from pathlib import Path
+
+REF = Path("/root/reference/python/mxnet")
+REPO = Path(__file__).resolve().parent.parent
+
+FLAGGED = [
+    "mxnet_tpu/metric.py",
+    "mxnet_tpu/io.py",
+    "mxnet_tpu/module/sequential_module.py",
+    "mxnet_tpu/image.py",
+]
+
+
+def lines(path):
+    out = []
+    for ln in path.read_text(errors="replace").splitlines():
+        s = ln.strip()
+        if s:
+            out.append(s)
+    return out
+
+
+def longest_common_run(a, b):
+    # classic O(n*m) DP on run lengths, small files so fine
+    best, best_i, best_j = 0, -1, -1
+    prev = [0] * (len(b) + 1)
+    for i, av in enumerate(a):
+        cur = [0] * (len(b) + 1)
+        for j, bv in enumerate(b):
+            if av == bv:
+                cur[j + 1] = prev[j] + 1
+                if cur[j + 1] > best:
+                    best, best_i, best_j = cur[j + 1], i, j
+        prev = cur
+    return best, best_i, best_j
+
+
+def main():
+    targets = sys.argv[1:] or FLAGGED
+    thresh = 12
+    bad = False
+    for rel in targets:
+        src = lines(REPO / rel)
+        worst = (0, None, -1, -1)
+        for ref in sorted(REF.rglob("*.py")):
+            run, i, j = longest_common_run(src, lines(ref))
+            if run > worst[0]:
+                worst = (run, ref, i, j)
+        run, ref, i, j = worst
+        status = "FAIL" if run >= thresh else "ok"
+        if run >= thresh:
+            bad = True
+        print(f"{status}  {rel}: longest verbatim run {run} lines "
+              f"(vs {ref and ref.relative_to(REF)}, ending repo-nonblank-line {i})")
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
