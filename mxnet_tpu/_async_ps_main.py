@@ -1,0 +1,37 @@
+"""Server-process entry point for ``tools/launch.py -s N`` (parity: the
+reference's ``DMLC_ROLE=server`` processes running
+``KVStoreDistServer::Run``, ``src/kvstore/kvstore_dist_server.h``).
+
+The launcher hands this process its port/identity/secret via env
+(``MXNET_TPU_SERVER_PORT``, ``MXNET_TPU_SERVER_ID``,
+``MXNET_TPU_PS_SECRET``) — the dmlc tracker env contract.  The process
+serves until a worker sends the ``shutdown`` op or the launcher reaps it
+after the workers exit.
+"""
+
+import logging
+import os
+
+from .kvstore_async import AsyncServer
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    port = int(os.environ.get("MXNET_TPU_SERVER_PORT", "0"))
+    server_id = int(os.environ.get("MXNET_TPU_SERVER_ID", "0"))
+    server = AsyncServer(port=port, server_id=server_id).start()
+    addr_file = os.environ.get("MXNET_TPU_SERVER_ADDR_FILE")
+    if addr_file:
+        # port 0 = kernel-assigned (no probe-then-bind race); report the
+        # actual address to the launcher atomically
+        tmp = addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(server.address)
+        os.replace(tmp, addr_file)
+    logging.info("async PS shard %d serving on %s", server_id, server.address)
+    server.wait_shutdown()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -283,6 +283,7 @@ class PrefetchingIter(DataIter):
         self.current_batch = None
         self.next_batch = [None for _ in range(self.n_iter)]
         self._errors = [None for _ in range(self.n_iter)]
+        self._poisoned = False
         self._push_all()
 
     def _push_fetch(self, i):
@@ -344,16 +345,26 @@ class PrefetchingIter(DataIter):
     def reset(self):
         for v in self._vars:
             self._engine.wait_for_var(v)
+        # recovery point after a surfaced upstream error: the upstream
+        # reset + fresh fetches below leave every slot consistent again
+        self._poisoned = False
+        self._errors = [None for _ in range(self.n_iter)]
         for i in self.iters:
             i.reset()
         self._push_all()
 
     def iter_next(self):
+        if self._poisoned:
+            raise RuntimeError(
+                "PrefetchingIter previously surfaced an upstream error; "
+                "its slots are undefined — call reset() to recover "
+                "(a bare retry would mimic a clean end-of-epoch)")
         for v in self._vars:
             self._engine.wait_for_var(v)
         for i, exc in enumerate(self._errors):
             if exc is not None:
                 self._errors[i] = None
+                self._poisoned = True
                 raise exc
         if self.next_batch[0] is None:
             for i in self.next_batch:

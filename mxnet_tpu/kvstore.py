@@ -84,17 +84,27 @@ class KVStore(object):
         return self._key_vars[k]
 
     def _init_async(self):
+        import os
+
         from . import kvstore_async as ka
 
+        addrs_env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS")
+        if addrs_env:
+            # launcher-provided server processes (`launch.py -s N`): keys
+            # shard across them, big arrays stripe (kvstore_dist.h:269-300)
+            self._async = ka.ServerGroup(addrs_env.split(","), self.rank)
+            return
+        # degenerate single-server layout: a thread inside rank 0
         if self.rank == 0:
             self._async_server = ka.AsyncServer().start()
-            ka.publish_address(self._async_server.address)
-        addr = ka.lookup_address()
+            ka.publish_address(self._async_server.address,
+                               self._async_server.secret)
+        addr, secret = ka.lookup_address()
         if addr is None:
             raise MXNetError(
                 "dist_async needs the jax.distributed coordination service "
-                "(or MXNET_TPU_ASYNC_PS_ADDR) to discover the server")
-        self._async = ka.AsyncClient(addr, self.rank)
+                "(or MXNET_TPU_ASYNC_PS_ADDR/_ADDRS) to discover servers")
+        self._async = ka.ServerGroup([addr], self.rank, secret=secret)
 
     # -- identity ------------------------------------------------------
     @property
@@ -204,7 +214,11 @@ class KVStore(object):
         if self._async is not None:
             import jax.numpy as jnp
 
-            vals = self._async.pull([_updater_key(k) for k in keys])
+            # out shapes make stripe routing deterministic even for keys
+            # this worker never initialized itself (pull-only workers)
+            vals = self._async.pull(
+                [_updater_key(k) for k in keys],
+                shapes=[tuple(olist[0].shape) for olist in outs])
             for k, v, olist in zip(keys, vals, outs):
                 if v is None:
                     raise MXNetError("key %s has not been initialized" % k)

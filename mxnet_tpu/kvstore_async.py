@@ -1,6 +1,6 @@
 """Asynchronous parameter server for ``dist_async`` (parity: reference
 ``src/kvstore/kvstore_dist_server.h:136-205`` async ``DataHandle`` +
-``kvstore.cc:32``).
+``kvstore.cc:32`` + multi-server key layout ``kvstore_dist.h:269-300``).
 
 Observable semantics match the reference's async mode:
 
@@ -10,45 +10,153 @@ Observable semantics match the reference's async mode:
   compound) updates that slow workers haven't contributed to yet
   (bounded-by-nothing staleness, exactly ps-lite's behavior).
 * **server-side optimizer** — ``set_optimizer`` pickles the optimizer to
-  the server (reference ``kvstore.py:226`` / ``kSetOptimizer``), which owns
-  the authoritative weights.
+  every server (reference ``kvstore.py:226`` / ``kSetOptimizer``), which
+  owns the authoritative weights.
 * **pull-anytime** — a pull returns the server's current weight, however
   stale the puller is.
+* **multi-server topology** — keys are sharded across N servers by hash
+  (reference ``EncodeKey``), and big arrays are **striped**: split into N
+  contiguous flat chunks, one per server, so no single server carries a
+  whole embedding table (reference ``kvstore_dist.h:44`` ``bigarray_bound_``
+  + ``:269-300``).  ``tools/launch.py -s N`` starts real server processes;
+  without it, a thread inside rank-0 hosts a single server (the TPU-native
+  degenerate layout — sync mode needs no host data plane at all).
 
-Topology: the server runs as a thread inside the rank-0 process (the
-TPU-native layout — reduction for *sync* mode rides XLA collectives, so
-only async mode needs a host data plane, and a dedicated thread on the
-coordinator host replaces ps-lite's separate server processes).  Workers
-discover the address through the jax.distributed coordination KV store;
-a ``DMLC_ROLE=server`` process (legacy launch contract) also works: it
-hosts the server loop and exits with the job.
-
-Wire format: length-prefixed pickles over TCP — the host data plane the
-reference implements with ZMQ SArrays.  Tensors cross as numpy; the TPU
-never blocks on this path (grads are fetched to host before push, the
-same D2H the reference does for its CPU-side PS).
+Wire format (hardened, round-3): length-framed **JSON header + raw tensor
+buffers** — nothing on the data path is executable, unlike pickle.  Tensor
+byte-lengths are derived from dtype+shape, so a corrupt header cannot
+over-read.  The ONE pickle left is the ``set_optimizer`` payload (the
+reference ships a pickled optimizer too); it is gated by an HMAC-SHA256
+with a per-job shared secret carried over the same trusted channel as the
+server address (launcher env / jax.distributed coordination KV), so a bare
+TCP connection cannot inject code.  Message size is capped
+(``MXNET_TPU_PS_MAX_MSG_MB``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmaclib
+import json as _json
 import os
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
 import threading
 import time
+import zlib
 
 import numpy as _np
 
-__all__ = ["AsyncServer", "AsyncClient", "publish_address", "lookup_address"]
+__all__ = ["AsyncServer", "AsyncClient", "ServerGroup",
+           "publish_address", "lookup_address"]
 
 _KV_KEY = "mxtpu_async_ps_addr"
 _DEAD_AFTER_S = float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
+_MAX_MSG = int(os.environ.get("MXNET_TPU_PS_MAX_MSG_MB", "1024")) << 20
+# ops whose effect is not idempotent: dedup must cache their responses so
+# a retry is answered from cache, never re-applied.  pulls/stats re-execute.
+_MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command"})
+
+
+# -- wire codec: JSON header + raw buffers, nothing executable -----------
+
+def _wire_key(k):
+    """Keys on the wire are JSON values; tuple stripe keys ride as lists."""
+    return list(k) if isinstance(k, tuple) else k
+
+
+def _unwire_key(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+def _encode_msg(msg):
+    """Serialize a message dict.  Tensors (under ``pairs``/``vals``) and
+    the opaque ``optimizer`` bytes become appended raw buffers; everything
+    else must be JSON-safe."""
+    header = {}
+    blobs = []
+
+    def tensor_ref(v):
+        if v is None:
+            return None
+        arr = _np.ascontiguousarray(v)
+        blobs.append(arr.tobytes())
+        return {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+    for field, value in msg.items():
+        if field == "pairs":
+            header[field] = [[_wire_key(k), tensor_ref(v)] for k, v in value]
+        elif field == "vals":
+            header[field] = [tensor_ref(v) for v in value]
+        elif field == "keys":
+            header[field] = [_wire_key(k) for k in value]
+        elif field == "optimizer":
+            raw = bytes(value)
+            blobs.append(raw)
+            header[field] = {"rawlen": len(raw)}
+        else:
+            header[field] = value
+    hdr = _json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return b"".join([struct.pack("<I", len(hdr)), hdr] + blobs)
+
+
+def _decode_msg(payload):
+    """Inverse of :func:`_encode_msg`.  Buffer lengths come from
+    dtype+shape (or the recorded rawlen), never from attacker-elastic
+    framing."""
+    (hdr_len,) = struct.unpack_from("<I", payload, 0)
+    header = _json.loads(payload[4:4 + hdr_len].decode("utf-8"))
+    cursor = [4 + hdr_len]
+
+    def take(n):
+        start = cursor[0]
+        if start + n > len(payload):
+            raise ValueError("truncated message")
+        cursor[0] = start + n
+        return payload[start:start + n]
+
+    def tensor_of(ref):
+        if ref is None:
+            return None
+        dtype = _np.dtype(ref["dtype"])
+        shape = tuple(int(d) for d in ref["shape"])
+        count = 1
+        for d in shape:
+            count *= d
+        raw = take(count * dtype.itemsize)
+        return _np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+    msg = {}
+    for field, value in header.items():
+        if field == "pairs":
+            msg[field] = [(_unwire_key(k), tensor_of(ref)) for k, ref in value]
+        elif field == "vals":
+            msg[field] = [tensor_of(ref) for ref in value]
+        elif field == "keys":
+            msg[field] = [_unwire_key(k) for k in value]
+        elif field == "optimizer":
+            msg[field] = take(int(value["rawlen"]))
+        else:
+            msg[field] = value
+    return msg
+
+
+class _MessageTooBig(ValueError):
+    pass
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _encode_msg(obj)
+    if len(payload) > _MAX_MSG:
+        # refuse locally: the peer would cut the connection mid-frame and
+        # a blind retry would just resend the same oversized message
+        raise _MessageTooBig(
+            "message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB=%d — "
+            "raise the cap or shrink/stripe the arrays"
+            % (len(payload), _MAX_MSG >> 20))
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -60,13 +168,20 @@ def _recv_msg(sock):
             raise EOFError("peer closed")
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
+    if n > _MAX_MSG:
+        raise ValueError("message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB"
+                         % n)
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise EOFError("peer closed mid-message")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _decode_msg(bytes(buf))
+
+
+def _optimizer_mac(secret, raw):
+    return _hmaclib.new(secret.encode("utf-8"), raw, hashlib.sha256).hexdigest()
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -77,7 +192,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 msg = _recv_msg(self.request)
                 resp = srv.dispatch(msg)
                 _send_msg(self.request, resp)
-        except (EOFError, ConnectionError):
+        except (EOFError, ConnectionError, ValueError):
             return
 
 
@@ -88,10 +203,8 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 def _default_bind_host():
     """Loopback unless the operator explicitly opts into multi-host via
-    ``MXNET_TPU_PS_HOST``.  The wire protocol is pickle (same trust domain
-    as the jax.distributed coordination service — cluster-internal,
-    unauthenticated), so the listener must not face arbitrary networks by
-    default."""
+    ``MXNET_TPU_PS_HOST``: even with the non-executable wire format the
+    listener should not face arbitrary networks by default."""
     return "0.0.0.0" if os.environ.get("MXNET_TPU_PS_HOST") else "127.0.0.1"
 
 
@@ -113,21 +226,30 @@ def _advertise_host(bind_host):
 
 
 class AsyncServer:
-    """The async PS: owns weights, applies updates on arrival."""
+    """One async PS shard: owns its keys' weights, applies updates on
+    arrival.  ``server_id`` identifies the shard in a multi-server group."""
 
-    def __init__(self, host=None, port=0):
+    def __init__(self, host=None, port=0, secret=None, server_id=0):
         host = host if host is not None else _default_bind_host()
         self._bind_host = host
+        self.server_id = server_id
+        # per-job shared secret gating the one executable payload
+        # (set_optimizer pickle); generated fresh unless the job hands one
+        # out (launcher env / coordination KV)
+        self.secret = secret or os.environ.get("MXNET_TPU_PS_SECRET") \
+            or _secrets.token_hex(16)
         self._store = {}
         self._updater = None
         self._commands = []
         self._lock = threading.Lock()
         self._heartbeat = {}  # worker rank -> last contact time
         self._push_counts = {}  # worker rank -> pushes served
-        # at-most-once RPC dedup: rank -> (last seq, cached response) so a
-        # reconnecting worker retrying a request whose response was lost
-        # cannot double-apply a gradient (ps-lite resend semantics)
+        # at-most-once RPC dedup for MUTATING ops only: rank -> (last seq,
+        # cached response).  Pulls are idempotent and re-execute on retry,
+        # so the server never retains a full response copy of the weights
+        # per worker (round-2 advisor finding).
         self._last_seq = {}
+        self._shutdown = threading.Event()
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
         self._thread = threading.Thread(
@@ -146,19 +268,25 @@ class AsyncServer:
         self._tcp.shutdown()
         self._tcp.server_close()
 
+    def wait_shutdown(self):
+        """Block until a worker sends the ``shutdown`` op (server-process
+        main loop)."""
+        self._shutdown.wait()
+
     # -- message dispatch (runs on handler threads) --------------------
     def dispatch(self, msg):
         op = msg["op"]
         rank = msg.get("rank", -1)
         seq = msg.get("seq")
+        dedup = seq is not None and op in _MUTATING_OPS
         with self._lock:
             self._heartbeat[rank] = time.time()
-            if seq is not None:
+            if dedup:
                 last = self._last_seq.get(rank)
                 if last is not None and last[0] == seq:
                     return last[1]  # duplicate of a completed request
             resp = self._dispatch_locked(op, rank, msg)
-            if seq is not None:
+            if dedup:
                 self._last_seq[rank] = (seq, resp)
             return resp
 
@@ -186,7 +314,7 @@ class AsyncServer:
             self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
             return {"ok": True}
         if op == "pull":
-            # copy under the lock: handlers pickle the response after
+            # copy under the lock: handlers serialize the response after
             # release, and push handlers mutate weights in place — a
             # live reference could serialize a torn (mid-update) tensor
             return {"ok": True,
@@ -194,9 +322,18 @@ class AsyncServer:
                              else _np.array(self._store[k])
                              for k in msg["keys"]]}
         if op == "set_optimizer":
+            raw = msg["optimizer"]
+            mac = msg.get("mac", "")
+            if not _hmaclib.compare_digest(
+                    mac, _optimizer_mac(self.secret, raw)):
+                return {"ok": False,
+                        "err": "set_optimizer rejected: bad or missing "
+                               "HMAC (the optimizer payload is the one "
+                               "pickled message and requires the per-job "
+                               "secret)"}
             from . import optimizer as opt
 
-            optimizer = pickle.loads(msg["optimizer"])
+            optimizer = pickle.loads(raw)
             self._updater = _NumpyUpdater(opt.get_updater(optimizer))
             return {"ok": True}
         if op == "command":
@@ -205,12 +342,18 @@ class AsyncServer:
             return {"ok": True}
         if op == "heartbeat":
             return {"ok": True}
+        if op == "shutdown":
+            self._shutdown.set()
+            return {"ok": True}
         if op == "stats":
             now = time.time()
             dead = [r for r, t in self._heartbeat.items()
                     if now - t > _DEAD_AFTER_S]
-            return {"ok": True, "push_counts": dict(self._push_counts),
-                    "dead": dead, "workers": sorted(self._heartbeat)}
+            return {"ok": True, "server_id": self.server_id,
+                    "push_counts": [[r, c] for r, c
+                                    in sorted(self._push_counts.items())],
+                    "dead": dead, "workers": sorted(self._heartbeat),
+                    "keys": sorted((repr(k) for k in self._store))}
         return {"ok": False, "err": "unknown op %r" % op}
 
 
@@ -224,13 +367,16 @@ class _NumpyUpdater:
         from .ndarray import NDArray
         import jax.numpy as jnp
 
+        # stripe chunks of one base key must keep distinct optimizer
+        # state: the updater keys its state dict by this value
+        state_key = repr(key) if isinstance(key, tuple) else key
         w = NDArray(jnp.asarray(weight))
-        self._updater(key, NDArray(jnp.asarray(grad)), w)
+        self._updater(state_key, NDArray(jnp.asarray(grad)), w)
         weight[...] = _np.asarray(w._data)
 
 
 class AsyncClient:
-    """Worker-side connection to the async PS.
+    """Worker-side connection to ONE async PS shard.
 
     A daemon thread heartbeats independently of application pushes (the
     ps-lite model), so liveness is not conflated with push frequency — a
@@ -244,12 +390,14 @@ class AsyncClient:
 
     _RECONNECT_TRIES = 5
 
-    def __init__(self, address, rank, heartbeat=True):
+    def __init__(self, address, rank, heartbeat=True, secret=None,
+                 dial_timeout=60):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._rank = rank
+        self._secret = secret or os.environ.get("MXNET_TPU_PS_SECRET")
         self._seq = 0
-        self._sock = socket.create_connection(self._addr, timeout=60)
+        self._sock = self._dial(dial_timeout)
         self._lock = threading.Lock()
         if heartbeat:
             t = threading.Thread(target=self._heartbeat_loop,
@@ -264,6 +412,18 @@ class AsyncClient:
                 self._call({"op": "heartbeat"})
             except Exception:
                 return  # server gone for good; process is exiting
+
+    def _dial(self, timeout_s):
+        """Connect with patience: launcher-spawned server processes may
+        still be importing when the first worker dials."""
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                return socket.create_connection(self._addr, timeout=60)
+            except (ConnectionError, OSError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.3)
 
     def _reconnect(self):
         try:
@@ -284,6 +444,13 @@ class AsyncClient:
                     _send_msg(self._sock, msg)
                     resp = _recv_msg(self._sock)
                     break
+                except _MessageTooBig:
+                    raise  # deterministic; retrying resends the same bytes
+                except ValueError:
+                    # corrupt/oversize frame from the peer: the socket may
+                    # be desynchronized mid-payload — never reuse it
+                    self._reconnect()
+                    raise
                 except (EOFError, ConnectionError, socket.timeout,
                         OSError):
                     if attempt == self._RECONNECT_TRIES - 1:
@@ -306,32 +473,200 @@ class AsyncClient:
         return self._call({"op": "pull", "keys": keys})["vals"]
 
     def set_optimizer(self, pickled):
-        self._call({"op": "set_optimizer", "optimizer": pickled})
+        if not self._secret:
+            from .base import MXNetError
+
+            raise MXNetError(
+                "set_optimizer needs the per-job PS secret (launcher env "
+                "MXNET_TPU_PS_SECRET or coordination-KV discovery)")
+        self._call({"op": "set_optimizer", "optimizer": pickled,
+                    "mac": _optimizer_mac(self._secret, pickled)})
 
     def command(self, head, body):
         self._call({"op": "command", "head": head, "body": body})
 
+    def shutdown(self):
+        self._call({"op": "shutdown"})
+
     def stats(self):
-        return self._call({"op": "stats"})
+        resp = self._call({"op": "stats"})
+        resp["push_counts"] = {r: c for r, c in resp.get("push_counts", [])}
+        return resp
+
+
+class ServerGroup:
+    """Worker-side router over N PS shards (parity: the multi-server key
+    layout of ``kvstore_dist.h:269-300``).
+
+    * normal keys → one server by stable hash (``EncodeKey`` analog);
+    * arrays with ``size >= bigarray_bound`` → striped into N contiguous
+      flat chunks, chunk *i* on server *i* (``bigarray_bound_`` analog,
+      env ``MXNET_KVSTORE_BIGARRAY_BOUND``, default 1e6 elements);
+    * presents the same init/push/pull/stats surface as one client.
+    """
+
+    def __init__(self, addresses, rank, heartbeat=True, secret=None,
+                 bigarray_bound=None):
+        self._clients = [AsyncClient(a, rank, heartbeat=heartbeat,
+                                     secret=secret)
+                         for a in addresses]
+        self._n = len(self._clients)
+        # NOTE: the bound decides routing, so it must agree across all
+        # worker processes (the launcher exports one env for the job) —
+        # exactly the reference's bigarray_bound_ contract
+        self._bound = int(bigarray_bound if bigarray_bound is not None
+                          else os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                              "1000000"))
+        self._striped = {}  # base key -> (shape, n_chunks)
+
+    def _fanout(self, thunks):
+        """Run shard requests CONCURRENTLY (each client has its own
+        socket+lock); one blocking RTT per server in sequence would make
+        PS latency grow linearly with -s N.  Returns results in order."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if len(thunks) <= 1:
+            return [t() for t in thunks]
+        with ThreadPoolExecutor(max_workers=len(thunks)) as pool:
+            return [f.result() for f in [pool.submit(t) for t in thunks]]
+
+    @property
+    def num_servers(self):
+        return self._n
+
+    def server_of(self, key):
+        """Stable shard assignment for a non-striped key."""
+        return zlib.crc32(repr(key).encode("utf-8")) % self._n
+
+    def _split(self, key, arr):
+        """[(server, wire_key, chunk), ...] for one (key, value) pair."""
+        arr = _np.asarray(arr)
+        if self._n > 1 and arr.size >= self._bound:
+            self._striped[key] = (arr.shape, self._n)
+            chunks = _np.array_split(arr.ravel(), self._n)
+            return [(i, ("stripe", key, i), c)
+                    for i, c in enumerate(chunks)]
+        return [(self.server_of(key), key, arr)]
+
+    def _scatter(self, pairs):
+        per_server = {}
+        for key, value in pairs:
+            for server, wire_key, chunk in self._split(key, value):
+                per_server.setdefault(server, []).append((wire_key, chunk))
+        return per_server
+
+    def init(self, pairs):
+        self._fanout([lambda s=s, p=p: self._clients[s].init(p)
+                      for s, p in self._scatter(pairs).items()])
+
+    def push(self, pairs):
+        self._fanout([lambda s=s, p=p: self._clients[s].push(p)
+                      for s, p in self._scatter(pairs).items()])
+
+    def pull(self, keys, shapes=None):
+        """``shapes`` (per-key tuples, e.g. the out buffers' shapes) makes
+        routing deterministic for keys this worker never initialized
+        itself: striping is a pure function of element count and the
+        job-wide bound, so a pull-only worker computes the same layout
+        the initializing worker did."""
+        # plan: striped keys fan out to all servers; plain keys to one
+        requests = {}  # server -> [wire keys]
+        slots = []     # per key: ("plain", server, idx) | ("striped", [...])
+        for pos, key in enumerate(keys):
+            striped = key in self._striped
+            if not striped and shapes is not None and self._n > 1:
+                count = 1
+                for d in shapes[pos]:
+                    count *= int(d)
+                if count >= self._bound:
+                    self._striped[key] = (tuple(shapes[pos]), self._n)
+                    striped = True
+            if striped:
+                parts = []
+                for i in range(self._striped[key][1]):
+                    wire = ("stripe", key, i)
+                    requests.setdefault(i, [])
+                    parts.append((i, len(requests[i])))
+                    requests[i].append(wire)
+                slots.append(("striped", key, parts))
+            else:
+                server = self.server_of(key)
+                requests.setdefault(server, [])
+                slots.append(("plain", server, len(requests[server])))
+                requests[server].append(key)
+        ordered = sorted(requests)
+        resp_list = self._fanout(
+            [lambda s=s: self._clients[s].pull(requests[s])
+             for s in ordered])
+        responses = dict(zip(ordered, resp_list))
+        out = []
+        for slot in slots:
+            if slot[0] == "plain":
+                _, server, idx = slot
+                out.append(responses[server][idx])
+            else:
+                _, key, parts = slot
+                chunks = [responses[s][i] for s, i in parts]
+                if any(c is None for c in chunks):
+                    out.append(None)
+                else:
+                    shape = self._striped[key][0]
+                    out.append(_np.concatenate(chunks).reshape(shape))
+        return out
+
+    def set_optimizer(self, pickled):
+        self._fanout([lambda c=c: c.set_optimizer(pickled)
+                      for c in self._clients])
+
+    def command(self, head, body):
+        self._fanout([lambda c=c: c.command(head, body)
+                      for c in self._clients])
+
+    def shutdown(self):
+        self._fanout([lambda c=c: c.shutdown() for c in self._clients])
+
+    def stats(self):
+        """Aggregate across shards; ``per_server`` keeps the raw shard
+        stats (key placement etc.) observable."""
+        per_server = self._fanout([lambda c=c: c.stats()
+                                   for c in self._clients])
+        push_counts = {}
+        dead, workers = set(), set()
+        for s in per_server:
+            for r, c in s["push_counts"].items():
+                push_counts[r] = push_counts.get(r, 0) + c
+            dead.update(s.get("dead", []))
+            workers.update(s.get("workers", []))
+        return {"ok": True, "push_counts": push_counts,
+                "dead": sorted(dead), "workers": sorted(workers),
+                "per_server": per_server}
 
 
 # -- address discovery over the jax.distributed coordination KV ---------
 
-def publish_address(address):
+def publish_address(address, secret=None):
     from jax._src import distributed
 
     client = distributed.global_state.client
     if client is not None:
-        client.key_value_set(_KV_KEY, address)
+        record = _json.dumps({"addr": address, "secret": secret})
+        client.key_value_set(_KV_KEY, record)
 
 
 def lookup_address(timeout_s=60):
+    """Returns (address, secret) — secret may be None (env-provided
+    addresses carry no secret; MXNET_TPU_PS_SECRET supplies it)."""
     env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDR")
     if env:
-        return env
+        return env, os.environ.get("MXNET_TPU_PS_SECRET")
     from jax._src import distributed
 
     client = distributed.global_state.client
     if client is None:
-        return None
-    return client.blocking_key_value_get(_KV_KEY, int(timeout_s * 1000))
+        return None, None
+    record = client.blocking_key_value_get(_KV_KEY, int(timeout_s * 1000))
+    try:
+        parsed = _json.loads(record)
+        return parsed["addr"], parsed.get("secret")
+    except (ValueError, KeyError, TypeError):
+        return record, None  # legacy bare-address record

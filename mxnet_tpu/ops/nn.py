@@ -371,17 +371,22 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var, is_train=Fals
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     use_batch = is_train and not attrs["use_global_stats"]
     if use_batch:
-        # single-pass stats: E[x] and E[x^2] reduce in ONE fused read of the
-        # activation (XLA fuses sibling reductions over the same operand),
-        # halving BN-stat HBM traffic vs the two-pass mean->var form.  fp32
-        # accumulation keeps E[x^2]-E[x]^2 cancellation benign for
-        # BN-scale inputs (conv outputs are near zero-mean).
-        x32 = data.astype(jnp.float32)
+        # SHIFTED single-pass stats: reduce E[x-s] and E[(x-s)^2] in ONE
+        # fused read of the activation (XLA fuses sibling reductions over
+        # the same operand), halving BN-stat HBM traffic vs the two-pass
+        # mean->var form.  The shift s = running mean (free, per-channel,
+        # tracks the true mean after warm-up) bounds the catastrophic
+        # cancellation E[x^2]-E[x]^2 suffers when mean^2 >> var — e.g.
+        # un-centered uint8-range inputs.  s is stop_gradient'd and exact
+        # algebra: mean = s + E[x-s], var = E[(x-s)^2] - E[x-s]^2.
+        shift = jax.lax.stop_gradient(moving_mean.astype(jnp.float32))
+        xs = data.astype(jnp.float32) - shift.reshape(bshape)
         n = 1.0
         for i in axes:
             n *= data.shape[i]
-        mean = jnp.sum(x32, axis=axes) / n
-        var = jnp.sum(jnp.square(x32), axis=axes) / n - jnp.square(mean)
+        d_mean = jnp.sum(xs, axis=axes) / n
+        mean = shift + d_mean
+        var = jnp.sum(jnp.square(xs), axis=axes) / n - jnp.square(d_mean)
         var = jnp.maximum(var, 0.0)
         new_mm = mom * moving_mean + (1 - mom) * jax.lax.stop_gradient(mean)
         new_mv = mom * moving_var + (1 - mom) * jax.lax.stop_gradient(var)

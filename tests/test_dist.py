@@ -11,29 +11,36 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=240, extra_env=None):
+def _launch(n, script, timeout=240, extra_env=None, servers=0):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("MXNET_TPU_", "XLA_FLAGS"))}
     env.update(extra_env or {})
-    return subprocess.run(
-        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
-         "-n", str(n), sys.executable, script],
-        capture_output=True, text=True, timeout=timeout, env=env,
-        cwd=_REPO)
+    argv = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+            "-n", str(n)]
+    if servers:
+        argv += ["-s", str(servers)]
+    argv += [sys.executable, script]
+    return subprocess.run(argv, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=_REPO)
 
 
-def _launch_and_expect(n, script, marker, attempts=4, extra_env=None):
+def _launch_and_expect(n, script, marker, attempts=4, extra_env=None,
+                       servers=0):
     """Launch + assert all ranks print ``marker``.  Retries: on a loaded
     single-core box the 30 s gloo handshake occasionally times out; a
-    genuine regression fails every attempt."""
+    genuine regression fails every attempt.  Attempts used are printed so
+    a creeping flake (passes needing >1 attempt) is visible in CI logs."""
     import time
 
     last = None
     for attempt in range(attempts):
         r = _launch(n, os.path.join(_REPO, "tests", "dist", script),
-                    extra_env=extra_env)
+                    extra_env=extra_env, servers=servers)
         ok = [l for l in r.stdout.splitlines() if marker in l]
         if r.returncode == 0 and len(ok) == n:
+            if attempt > 0:
+                print("WARNING: %s needed %d launch attempts (gloo "
+                      "handshake contention?)" % (script, attempt + 1))
             return
         last = r
         if attempt < attempts - 1:
@@ -57,6 +64,14 @@ def test_dist_async_kvstore_via_launcher():
     # update-on-push, no barrier: worker step counts diverge yet training
     # converges; staleness asserted from the server's arrival counts
     _launch_and_expect(2, "dist_async_kvstore.py", "dist_async kvstore OK")
+
+
+def test_dist_async_multiserver_via_launcher():
+    # real `-s 2` server processes: keys shard by hash across both, the
+    # big array stripes one chunk per server, training still converges
+    _launch_and_expect(4, "dist_async_multiserver.py",
+                       "dist_async multiserver OK", servers=2,
+                       extra_env={"MXNET_TPU_PS_DEAD_AFTER": "60"})
 
 
 def test_dist_async_liveness_detects_dead_worker():

@@ -75,6 +75,44 @@ def test_prefetching_iter():
     assert_almost_equal(got, data)
 
 
+def test_prefetching_iter_poisons_after_upstream_error():
+    """An upstream exception must not look like a clean end-of-epoch on
+    retry (advisor r2): after surfacing it, iter_next raises until
+    reset() re-establishes consistent slots."""
+    import pytest
+
+    class Exploding(mx.io.DataIter):
+        def __init__(self):
+            super().__init__()
+            self._inner = mx.io.NDArrayIter(
+                np.zeros((12, 2), np.float32), batch_size=4)
+            self.provide_data = self._inner.provide_data
+            self.provide_label = self._inner.provide_label
+            self.batch_size = 4
+            self._count = 0
+
+        def reset(self):
+            self._count = 0
+            self._inner.reset()
+
+        def next(self):
+            self._count += 1
+            if self._count == 2:
+                raise IOError("decode failed")
+            return self._inner.next()
+
+    it = mx.io.PrefetchingIter(Exploding())
+    assert it.iter_next()  # batch 1 fine
+    with pytest.raises(IOError):
+        it.iter_next()  # surfaced upstream error
+    with pytest.raises(RuntimeError, match="reset"):
+        it.iter_next()  # poisoned: a bare retry must NOT look clean
+    it.reset()  # recovery point
+    assert it.iter_next()
+    with pytest.raises(IOError):  # upstream explodes again at batch 2
+        it.iter_next()
+
+
 def test_csv_iter():
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "data.csv")

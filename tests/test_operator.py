@@ -404,6 +404,14 @@ def test_batchnorm_singlepass_offset_stats():
     ref_var = a.astype(np.float64).transpose(1, 0, 2, 3).reshape(4, -1).var(axis=1)
     got_var = ex.aux_dict["bn_moving_var"].asnumpy()
     assert np.allclose(got_var, ref_var, rtol=5e-2), (got_var, ref_var)
+    # round 3: the single pass is SHIFTED by the running mean.  After the
+    # first forward (momentum=0) the running mean IS the batch mean, so
+    # the second pass reduces E[(x-mean)^2] directly — cancellation gone,
+    # variance fp32-tight even at mean:var ratio 1e4 (advisor r2 finding)
+    ex.forward(is_train=True)
+    ex.outputs[0].asnumpy()  # train-mode forward is deferred; materialize
+    got_var2 = ex.aux_dict["bn_moving_var"].asnumpy()
+    assert np.allclose(got_var2, ref_var, rtol=1e-3), (got_var2, ref_var)
 
 
 def test_activation_types():

@@ -32,8 +32,67 @@ def _free_port():
     return port
 
 
+def launch_servers(args):
+    """Start ``-s N`` parameter-server shard processes (the reference's
+    ``DMLC_ROLE=server`` topology, ``kvstore_dist_server.h``).  Returns
+    (server procs, env entries workers need to find them).
+
+    Each server binds port 0 and reports its actual address through a
+    file — the launcher never pre-allocates ports, so there is no
+    probe-then-bind race with other jobs on the host."""
+    import secrets
+    import tempfile
+    import time
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    secret = secrets.token_hex(16)
+    addr_dir = tempfile.mkdtemp(prefix="mxtpu_ps_")
+    procs, addr_files = [], []
+    for i in range(args.num_servers):
+        addr_file = os.path.join(addr_dir, "server_%d.addr" % i)
+        addr_files.append(addr_file)
+        env = dict(os.environ)
+        # servers are host-side: never let one grab (or hang on) a chip
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MXNET_TPU_PLATFORM"] = "cpu"
+        env["MXNET_TPU_SERVER_PORT"] = "0"
+        env["MXNET_TPU_SERVER_ADDR_FILE"] = addr_file
+        env["MXNET_TPU_SERVER_ID"] = str(i)
+        env["MXNET_TPU_NUM_SERVERS"] = str(args.num_servers)
+        env["MXNET_TPU_PS_SECRET"] = secret
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu._async_ps_main"], env=env))
+    addrs = []
+    deadline = time.time() + 90
+    for i, addr_file in enumerate(addr_files):
+        while True:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    addr = f.read().strip()
+                if addr:
+                    addrs.append(addr)
+                    break
+            if procs[i].poll() is not None:
+                raise RuntimeError("PS server %d exited rc=%d before "
+                                   "binding" % (i, procs[i].returncode))
+            if time.time() > deadline:
+                raise RuntimeError("PS server %d did not report an address "
+                                   "within 90s" % i)
+            time.sleep(0.1)
+    worker_env = {
+        "MXNET_TPU_ASYNC_PS_ADDRS": ",".join(addrs),
+        "MXNET_TPU_NUM_SERVERS": str(args.num_servers),
+        "MXNET_TPU_PS_SECRET": secret,
+    }
+    return procs, worker_env
+
+
 def launch_local(args, cmd):
     coordinator = "127.0.0.1:%d" % _free_port()
+    server_procs, server_env = ([], {})
+    if args.num_servers > 0:
+        server_procs, server_env = launch_servers(args)
     procs = []
     for i in range(args.num_workers):
         env = dict(os.environ)
@@ -45,6 +104,7 @@ def launch_local(args, cmd):
         # one-process-per-host TPU launch
         env["JAX_PLATFORMS"] = args.platform
         env["MXNET_TPU_PLATFORM"] = args.platform  # wins over site-hook presets
+        env.update(server_env)
         procs.append(subprocess.Popen(cmd, env=env))
     code = 0
     try:
@@ -55,24 +115,59 @@ def launch_local(args, cmd):
         for p in procs:
             p.send_signal(signal.SIGTERM)
         code = 1
+    finally:
+        for p in server_procs:  # servers live for the workers' lifetime
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in server_procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
     return code
 
 
 def launch_ssh(args, cmd):
+    import secrets
+
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     assert len(hosts) >= args.num_workers, "hostfile too small"
     coordinator = "%s:%d" % (hosts[0], args.port or _free_port())
     procs = []
+    server_env = ""
+    if args.num_servers > 0:
+        # remote servers bind operator-chosen ports (no addr-file channel
+        # across hosts): server i on hosts[i % len], port base + i
+        secret = secrets.token_hex(16)
+        placements = [(hosts[i % len(hosts)], args.server_port_base + i)
+                      for i in range(args.num_servers)]
+        for i, (host, port) in enumerate(placements):
+            env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
+                   "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
+                   "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_SECRET=%s "
+                   "MXNET_TPU_PS_HOST=%s"
+                   % (port, i, args.num_servers, secret, host))
+            remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
+                os.getcwd(), env, sys.executable)
+            procs.append(subprocess.Popen(["ssh", host, remote]))
+        server_env = ("MXNET_TPU_ASYNC_PS_ADDRS=%s MXNET_TPU_PS_SECRET=%s "
+                      "MXNET_TPU_NUM_SERVERS=%d "
+                      % (",".join("%s:%d" % p for p in placements),
+                         secret, args.num_servers))
+    workers = []
     for i in range(args.num_workers):
         env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
-               "MXNET_TPU_PROC_ID=%d" % (coordinator, args.num_workers, i))
+               "MXNET_TPU_PROC_ID=%d %s"
+               % (coordinator, args.num_workers, i, server_env))
         remote = "cd %s && %s %s" % (os.getcwd(), env, " ".join(cmd))
-        procs.append(subprocess.Popen(["ssh", hosts[i], remote]))
+        workers.append(subprocess.Popen(["ssh", hosts[i], remote]))
     code = 0
-    for p in procs:
+    for p in workers:
         p.wait()
         code = code or p.returncode
+    for p in procs:  # reap server shells once the workers are done
+        p.terminate()
     return code
 
 
@@ -81,6 +176,13 @@ def main():
         description="launch a distributed job",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="parameter-server shard processes (dist_async "
+                             "multi-server topology; 0 = rank-0 hosts one "
+                             "server thread)")
+    parser.add_argument("--server-port-base", type=int, default=9700,
+                        help="first PS port for --launcher ssh (server i "
+                             "listens on base+i; local mode self-assigns)")
     parser.add_argument("--launcher", choices=["local", "ssh"],
                         default="local")
     parser.add_argument("-H", "--hostfile", type=str, default=None)
