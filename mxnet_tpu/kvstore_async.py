@@ -191,7 +191,12 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 msg = _recv_msg(self.request)
                 resp = srv.dispatch(msg)
-                _send_msg(self.request, resp)
+                try:
+                    _send_msg(self.request, resp)
+                except _MessageTooBig as exc:
+                    # tell the client WHY instead of dying mid-frame (a
+                    # bare cut would read as 'peer closed' after retries)
+                    _send_msg(self.request, {"ok": False, "err": str(exc)})
         except (EOFError, ConnectionError, ValueError):
             return
 
@@ -518,17 +523,21 @@ class ServerGroup:
                           else os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
                                               "1000000"))
         self._striped = {}  # base key -> (shape, n_chunks)
+        self._pool = None  # lazy persistent fan-out pool (hot path)
 
     def _fanout(self, thunks):
         """Run shard requests CONCURRENTLY (each client has its own
         socket+lock); one blocking RTT per server in sequence would make
-        PS latency grow linearly with -s N.  Returns results in order."""
-        from concurrent.futures import ThreadPoolExecutor
-
+        PS latency grow linearly with -s N.  Returns results in order.
+        The pool is persistent: push/pull run per training step."""
         if len(thunks) <= 1:
             return [t() for t in thunks]
-        with ThreadPoolExecutor(max_workers=len(thunks)) as pool:
-            return [f.result() for f in [pool.submit(t) for t in thunks]]
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._n, thread_name_prefix="mxtpu-ps-fanout")
+        return [f.result() for f in [self._pool.submit(t) for t in thunks]]
 
     @property
     def num_servers(self):

@@ -161,6 +161,90 @@ MXTPU_DLL MXTPUNDArrayHandle mxtpu_pred_output(MXTPUPredHandle h, int idx);
 MXTPU_DLL void mxtpu_pred_free(MXTPUPredHandle h);
 MXTPU_DLL const char *mxtpu_pred_last_error(void);
 
+/* ---------------- full C API: Symbol / Executor / KVStore / DataIter
+ *
+ * Parity: reference include/mxnet/c_api.h — MXSymbolCreateFromJSON (:645),
+ * MXExecutorBindEX (:1066), MXKVStoreCreate (:1207), MXDataIterCreateIter
+ * (:1292).  Every frontend binds this flat ABI (the reference's core
+ * architectural contract); the implementation reuses the embedded-CPython
+ * runtime built for predict, so symbol composition / executor binding /
+ * kvstore semantics are exactly the TPU-native core's.
+ *
+ * Handles are opaque int64 ids (0 = error).  Free with mxtpu_handle_free.
+ * Functions returning char* give malloc'd strings (free via
+ * mxtpu_buf_free); functions returning MXTPUNDArrayHandle give OWNED host
+ * arrays (free via mxtpu_ndarray_free).  On error: 0/-1/NULL +
+ * mxtpu_capi_last_error() (thread-local).  Link libmxtpu_predict.so. */
+
+typedef int64_t MXTPUHandle;
+
+MXTPU_DLL int mxtpu_handle_free(MXTPUHandle h);
+MXTPU_DLL const char *mxtpu_capi_last_error(void);
+
+/* Symbol.  kwargs_json: operator parameters as a JSON object, e.g.
+ * "{\"num_hidden\": 128}".  Compose wires named inputs into an atomic
+ * symbol in place (reference MXSymbolCreateAtomicSymbol + MXSymbolCompose
+ * two-step). */
+MXTPU_DLL MXTPUHandle mxtpu_sym_create_variable(const char *name);
+MXTPU_DLL MXTPUHandle mxtpu_sym_create_atomic(const char *op_name,
+                                              const char *kwargs_json);
+MXTPU_DLL int mxtpu_sym_compose(MXTPUHandle sym, const char *name,
+                                int n_args, const char **arg_names,
+                                const MXTPUHandle *args);
+MXTPU_DLL MXTPUHandle mxtpu_sym_from_json(const char *json);
+MXTPU_DLL char *mxtpu_sym_to_json(MXTPUHandle sym);
+/* which: "arguments" | "outputs" | "auxiliary_states"; returns a JSON
+ * array of names. */
+MXTPU_DLL char *mxtpu_sym_list(MXTPUHandle sym, const char *which);
+/* shapes_json: {"data": [64,1,28,28], ...} -> JSON
+ * {"arg": [...], "out": [...], "aux": [...]} (reference
+ * MXSymbolInferShape). */
+MXTPU_DLL char *mxtpu_sym_infer_shape(MXTPUHandle sym,
+                                      const char *shapes_json);
+
+/* Executor (reference MXExecutorSimpleBind/Forward/Backward tier).
+ * kind: "arg" | "grad" | "aux". */
+MXTPU_DLL MXTPUHandle mxtpu_executor_simple_bind(MXTPUHandle sym,
+                                                 const char *shapes_json,
+                                                 const char *grad_req);
+MXTPU_DLL int mxtpu_executor_forward(MXTPUHandle ex, int is_train);
+MXTPU_DLL int mxtpu_executor_backward(MXTPUHandle ex);
+MXTPU_DLL int mxtpu_executor_num_outputs(MXTPUHandle ex);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_executor_output(MXTPUHandle ex, int idx);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_executor_get_array(MXTPUHandle ex,
+                                                      const char *kind,
+                                                      const char *name);
+MXTPU_DLL int mxtpu_executor_set_array(MXTPUHandle ex, const char *kind,
+                                       const char *name,
+                                       MXTPUNDArrayHandle val);
+
+/* KVStore (reference MXKVStoreCreate/Init/Push/Pull/SetOptimizer tier;
+ * server-side-optimizer semantics included). */
+MXTPU_DLL MXTPUHandle mxtpu_kvstore_create(const char *type);
+MXTPU_DLL int mxtpu_kvstore_init(MXTPUHandle kv, const char *key,
+                                 MXTPUNDArrayHandle val);
+MXTPU_DLL int mxtpu_kvstore_push(MXTPUHandle kv, const char *key,
+                                 MXTPUNDArrayHandle grad);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_kvstore_pull(MXTPUHandle kv,
+                                                const char *key,
+                                                const int64_t *shape,
+                                                int ndim);
+MXTPU_DLL int mxtpu_kvstore_set_optimizer(MXTPUHandle kv, const char *name,
+                                          const char *kwargs_json);
+MXTPU_DLL int mxtpu_kvstore_rank(MXTPUHandle kv);
+MXTPU_DLL int mxtpu_kvstore_num_workers(MXTPUHandle kv);
+
+/* DataIter (reference MXDataIterCreateIter tier): registry name +
+ * JSON kwargs, e.g. mxtpu_dataiter_create("CSVIter",
+ * "{\"data_csv\": \"x.csv\", \"data_shape\": [784], \"batch_size\": 32}").
+ * next: 1 = batch ready, 0 = epoch end, -1 = error. */
+MXTPU_DLL MXTPUHandle mxtpu_dataiter_create(const char *type,
+                                            const char *kwargs_json);
+MXTPU_DLL int mxtpu_dataiter_next(MXTPUHandle it);
+MXTPU_DLL int mxtpu_dataiter_reset(MXTPUHandle it);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_dataiter_data(MXTPUHandle it);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_dataiter_label(MXTPUHandle it);
+
 /* ---------------- misc ---------------- */
 MXTPU_DLL const char *mxtpu_version(void);
 

@@ -1,0 +1,407 @@
+/*!
+ * Full C API: Symbol / Executor / KVStore / DataIter (parity: reference
+ * include/mxnet/c_api.h — MXSymbolCreateFromJSON :645, MXExecutorBindEX
+ * :1066, MXKVStoreCreate :1207, MXDataIterCreateIter :1292).
+ *
+ * Architecture: every frontend binds this flat ABI, the reference's core
+ * contract.  The implementation reuses the embedded-CPython runtime built
+ * for predict (deploy tier): each C call crosses into
+ * mxnet_tpu._capi_bridge with primitive-only arguments (int64 handles,
+ * UTF-8 strings, raw float32 buffers), so the C++ layer stays a thin
+ * marshalling shim while symbol composition, executor binding and the
+ * kvstore run in the same TPU-native core the Python frontend uses.
+ */
+#include "mxtpu/c_api.h"
+
+#ifndef PY_SSIZE_T_CLEAN
+#define PY_SSIZE_T_CLEAN
+#endif
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embed_py.h"
+
+using mxtpu_capi::Gil;
+using mxtpu_capi::NDArr;
+using mxtpu_capi::ensure_python;
+using mxtpu_capi::nd;
+using mxtpu_capi::py_error;
+using mxtpu_capi::set_err;
+
+namespace {
+
+/* The bridge module, imported once under the GIL. */
+PyObject *bridge() {
+  static PyObject *mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("mxnet_tpu._capi_bridge");
+    if (!mod) set_err("import mxnet_tpu._capi_bridge: " + py_error());
+  }
+  return mod;
+}
+
+/* Result converters: every bridge call funnels through exactly one. */
+
+int64_t as_handle(PyObject *r) {
+  if (!r) { set_err(py_error()); return 0; }
+  int64_t h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (h <= 0 || PyErr_Occurred()) { set_err(py_error()); return 0; }
+  return h;
+}
+
+int as_status(PyObject *r) {
+  if (!r) { set_err(py_error()); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int as_int(PyObject *r) {
+  if (!r) { set_err(py_error()); return -1; }
+  long long v = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) { set_err(py_error()); return -1; }
+  return static_cast<int>(v);
+}
+
+/* malloc'd copy (caller frees via mxtpu_buf_free). */
+char *as_cstr(PyObject *r) {
+  if (!r) { set_err(py_error()); return nullptr; }
+  const char *u = PyUnicode_AsUTF8(r);
+  char *out = u ? strdup(u) : nullptr;
+  if (!u) set_err(py_error());
+  Py_DECREF(r);
+  return out;
+}
+
+/* (shape_list, float32_bytes) -> owned NDArr handle. */
+MXTPUNDArrayHandle as_ndarray(PyObject *r) {
+  if (!r) { set_err(py_error()); return nullptr; }
+  PyObject *shape = PyTuple_Check(r) && PyTuple_Size(r) == 2
+                        ? PyTuple_GetItem(r, 0) : nullptr;
+  PyObject *bytes = shape ? PyTuple_GetItem(r, 1) : nullptr;
+  if (!shape || !bytes || !PyList_Check(shape) || !PyBytes_Check(bytes)) {
+    set_err("bridge returned malformed (shape, bytes) pair");
+    Py_DECREF(r);
+    return nullptr;
+  }
+  NDArr *arr = new NDArr();
+  for (Py_ssize_t i = 0; i < PyList_Size(shape); ++i)
+    arr->shape.push_back(PyLong_AsLongLong(PyList_GetItem(shape, i)));
+  char *buf = nullptr;
+  Py_ssize_t blen = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  arr->data.resize(static_cast<size_t>(blen) / sizeof(float));
+  std::memcpy(arr->data.data(), buf, static_cast<size_t>(blen));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_err(py_error());
+    delete arr;
+    return nullptr;
+  }
+  return arr;
+}
+
+/* Python int list from an NDArr's shape. */
+PyObject *shape_list(const NDArr *arr) {
+  PyObject *list = PyList_New(static_cast<Py_ssize_t>(arr->shape.size()));
+  for (size_t i = 0; i < arr->shape.size(); ++i)
+    PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i),
+                    PyLong_FromLongLong(arr->shape[i]));
+  return list;
+}
+
+/* Call bridge.<fn>(handle, key, shape, raw) — the NDArr-passing shape
+ * shared by kvstore init/push and executor_set_array. */
+int call_with_array(const char *fn, int64_t handle, const char *key,
+                    const char *kind, MXTPUNDArrayHandle val) {
+  if (!key || !val) { set_err("null argument"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  NDArr *arr = nd(val);
+  PyObject *shape = shape_list(arr);
+  PyObject *r;
+  if (kind) {
+    r = PyObject_CallMethod(
+        bridge(), fn, "LssOy#", static_cast<long long>(handle), kind, key,
+        shape, reinterpret_cast<const char *>(arr->data.data()),
+        static_cast<Py_ssize_t>(arr->data.size() * sizeof(float)));
+  } else {
+    r = PyObject_CallMethod(
+        bridge(), fn, "LsOy#", static_cast<long long>(handle), key, shape,
+        reinterpret_cast<const char *>(arr->data.data()),
+        static_cast<Py_ssize_t>(arr->data.size() * sizeof(float)));
+  }
+  Py_DECREF(shape);
+  return as_status(r);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *mxtpu_capi_last_error(void) { return mxtpu_capi::last_err(); }
+
+int mxtpu_handle_free(MXTPUHandle h) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "free", "L",
+                                       static_cast<long long>(h)));
+}
+
+/* ---------------- Symbol ---------------- */
+
+MXTPUHandle mxtpu_sym_create_variable(const char *name) {
+  if (!name) { set_err("null name"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "sym_create_variable",
+                                       "s", name));
+}
+
+MXTPUHandle mxtpu_sym_create_atomic(const char *op_name,
+                                    const char *kwargs_json) {
+  if (!op_name) { set_err("null op name"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "sym_create_atomic", "ss",
+                                       op_name,
+                                       kwargs_json ? kwargs_json : ""));
+}
+
+int mxtpu_sym_compose(MXTPUHandle sym, const char *name, int n_args,
+                      const char **arg_names, const MXTPUHandle *args) {
+  if (n_args < 0 || (n_args > 0 && (!arg_names || !args))) {
+    set_err("bad compose arguments");
+    return -1;
+  }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  PyObject *names = PyList_New(n_args);
+  PyObject *handles = PyList_New(n_args);
+  for (int i = 0; i < n_args; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(arg_names[i]));
+    PyList_SET_ITEM(handles, i, PyLong_FromLongLong(args[i]));
+  }
+  PyObject *r = PyObject_CallMethod(bridge(), "sym_compose", "LsOO",
+                                    static_cast<long long>(sym),
+                                    name ? name : "", names, handles);
+  Py_DECREF(names);
+  Py_DECREF(handles);
+  return as_status(r);
+}
+
+MXTPUHandle mxtpu_sym_from_json(const char *json) {
+  if (!json) { set_err("null json"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "sym_from_json", "s", json));
+}
+
+char *mxtpu_sym_to_json(MXTPUHandle sym) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_cstr(PyObject_CallMethod(bridge(), "sym_to_json", "L",
+                                     static_cast<long long>(sym)));
+}
+
+char *mxtpu_sym_list(MXTPUHandle sym, const char *which) {
+  if (!which) { set_err("null listing kind"); return nullptr; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_cstr(PyObject_CallMethod(bridge(), "sym_list", "Ls",
+                                     static_cast<long long>(sym), which));
+}
+
+char *mxtpu_sym_infer_shape(MXTPUHandle sym, const char *shapes_json) {
+  if (!shapes_json) { set_err("null shapes"); return nullptr; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_cstr(PyObject_CallMethod(bridge(), "sym_infer_shape", "Ls",
+                                     static_cast<long long>(sym),
+                                     shapes_json));
+}
+
+/* ---------------- Executor ---------------- */
+
+MXTPUHandle mxtpu_executor_simple_bind(MXTPUHandle sym,
+                                       const char *shapes_json,
+                                       const char *grad_req) {
+  if (!shapes_json) { set_err("null shapes"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "executor_simple_bind",
+                                       "Lss", static_cast<long long>(sym),
+                                       shapes_json,
+                                       grad_req ? grad_req : "write"));
+}
+
+int mxtpu_executor_forward(MXTPUHandle ex, int is_train) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "executor_forward", "Li",
+                                       static_cast<long long>(ex), is_train));
+}
+
+int mxtpu_executor_backward(MXTPUHandle ex) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "executor_backward", "L",
+                                       static_cast<long long>(ex)));
+}
+
+int mxtpu_executor_num_outputs(MXTPUHandle ex) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_int(PyObject_CallMethod(bridge(), "executor_num_outputs", "L",
+                                    static_cast<long long>(ex)));
+}
+
+MXTPUNDArrayHandle mxtpu_executor_output(MXTPUHandle ex, int idx) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_ndarray(PyObject_CallMethod(bridge(), "executor_output", "Li",
+                                        static_cast<long long>(ex), idx));
+}
+
+MXTPUNDArrayHandle mxtpu_executor_get_array(MXTPUHandle ex, const char *kind,
+                                            const char *name) {
+  if (!kind || !name) { set_err("null argument"); return nullptr; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_ndarray(PyObject_CallMethod(bridge(), "executor_get_array",
+                                        "Lss", static_cast<long long>(ex),
+                                        kind, name));
+}
+
+int mxtpu_executor_set_array(MXTPUHandle ex, const char *kind,
+                             const char *name, MXTPUNDArrayHandle val) {
+  if (!kind) { set_err("null kind"); return -1; }
+  return call_with_array("executor_set_array", ex, name, kind, val);
+}
+
+/* ---------------- KVStore ---------------- */
+
+MXTPUHandle mxtpu_kvstore_create(const char *type) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "kvstore_create", "s",
+                                       type ? type : "local"));
+}
+
+int mxtpu_kvstore_init(MXTPUHandle kv, const char *key,
+                       MXTPUNDArrayHandle val) {
+  return call_with_array("kvstore_init", kv, key, nullptr, val);
+}
+
+int mxtpu_kvstore_push(MXTPUHandle kv, const char *key,
+                       MXTPUNDArrayHandle grad) {
+  return call_with_array("kvstore_push", kv, key, nullptr, grad);
+}
+
+MXTPUNDArrayHandle mxtpu_kvstore_pull(MXTPUHandle kv, const char *key,
+                                      const int64_t *shape, int ndim) {
+  if (!key || (ndim > 0 && !shape)) { set_err("null argument"); return nullptr; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  PyObject *dims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(dims, i, PyLong_FromLongLong(shape[i]));
+  PyObject *r = PyObject_CallMethod(bridge(), "kvstore_pull", "LsO",
+                                    static_cast<long long>(kv), key, dims);
+  Py_DECREF(dims);
+  return as_ndarray(r);
+}
+
+int mxtpu_kvstore_set_optimizer(MXTPUHandle kv, const char *name,
+                                const char *kwargs_json) {
+  if (!name) { set_err("null optimizer name"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "kvstore_set_optimizer",
+                                       "Lss", static_cast<long long>(kv),
+                                       name, kwargs_json ? kwargs_json : ""));
+}
+
+int mxtpu_kvstore_rank(MXTPUHandle kv) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_int(PyObject_CallMethod(bridge(), "kvstore_rank", "L",
+                                    static_cast<long long>(kv)));
+}
+
+int mxtpu_kvstore_num_workers(MXTPUHandle kv) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_int(PyObject_CallMethod(bridge(), "kvstore_num_workers", "L",
+                                    static_cast<long long>(kv)));
+}
+
+/* ---------------- DataIter ---------------- */
+
+MXTPUHandle mxtpu_dataiter_create(const char *type, const char *kwargs_json) {
+  if (!type) { set_err("null iterator type"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  return as_handle(PyObject_CallMethod(bridge(), "dataiter_create", "ss",
+                                       type, kwargs_json ? kwargs_json : ""));
+}
+
+int mxtpu_dataiter_next(MXTPUHandle it) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_int(PyObject_CallMethod(bridge(), "dataiter_next", "L",
+                                    static_cast<long long>(it)));
+}
+
+int mxtpu_dataiter_reset(MXTPUHandle it) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "dataiter_reset", "L",
+                                       static_cast<long long>(it)));
+}
+
+MXTPUNDArrayHandle mxtpu_dataiter_data(MXTPUHandle it) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_ndarray(PyObject_CallMethod(bridge(), "dataiter_data", "L",
+                                        static_cast<long long>(it)));
+}
+
+MXTPUNDArrayHandle mxtpu_dataiter_label(MXTPUHandle it) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_ndarray(PyObject_CallMethod(bridge(), "dataiter_label", "L",
+                                        static_cast<long long>(it)));
+}
+
+}  // extern "C"

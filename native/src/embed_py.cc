@@ -1,0 +1,47 @@
+/*! Definitions for the shared embedded-CPython plumbing (see embed_py.h). */
+#include "embed_py.h"
+
+#include <mutex>
+
+namespace mxtpu_capi {
+
+namespace {
+thread_local std::string g_err;
+std::once_flag g_py_once;
+}  // namespace
+
+void ensure_python() {
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      /* Release the GIL acquired by initialization so PyGILState_Ensure
+       * works uniformly afterwards. */
+      PyEval_SaveThread();
+    }
+  });
+}
+
+std::string py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *u = PyUnicode_AsUTF8(s);
+      if (u) msg = u; /* NULL on encode failure: keep default */
+      else PyErr_Clear();
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+void set_err(const std::string &m) { g_err = m; }
+
+const char *last_err() { return g_err.c_str(); }
+
+}  // namespace mxtpu_capi

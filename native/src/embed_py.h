@@ -1,0 +1,49 @@
+/*!
+ * Shared embedded-CPython plumbing for the C ABI (predict + full C API).
+ * The reference's c_api.cc/c_predict_api.cc sit on the same engine
+ * internals; here both sit on the same embedded interpreter + host
+ * NDArray container.
+ */
+#ifndef MXTPU_EMBED_PY_H_
+#define MXTPU_EMBED_PY_H_
+
+#ifndef PY_SSIZE_T_CLEAN
+#define PY_SSIZE_T_CLEAN  /* Py_ssize_t lengths for '#' formats */
+#endif
+#include <Python.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace mxtpu_capi {
+
+/* Host float32 NDArray backing MXTPUNDArrayHandle. */
+struct NDArr {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+};
+
+inline NDArr *nd(void *h) { return static_cast<NDArr *>(h); }
+
+/* Initialize the process-lifetime interpreter exactly once (no Finalize:
+ * handles may outlive any scope). */
+void ensure_python();
+
+/* Fetch-and-clear the pending Python exception as text. */
+std::string py_error();
+
+/* Thread-local last-error slot shared by the predict and full C APIs. */
+void set_err(const std::string &m);
+const char *last_err();
+
+/* RAII GIL scope. */
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace mxtpu_capi
+
+#endif  /* MXTPU_EMBED_PY_H_ */

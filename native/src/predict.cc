@@ -15,6 +15,9 @@
  */
 #include "mxtpu/c_api.h"
 
+#ifndef PY_SSIZE_T_CLEAN
+#define PY_SSIZE_T_CLEAN
+#endif
 #include <Python.h>
 
 #include <cstring>
@@ -22,22 +25,16 @@
 #include <string>
 #include <vector>
 
+#include "embed_py.h"
+
 /* ---------------- NDArray (host float32) ---------------- */
 
-namespace {
-
-struct NDArr {
-  std::vector<int64_t> shape;
-  std::vector<float> data;
-};
-
-NDArr *nd(MXTPUNDArrayHandle h) { return static_cast<NDArr *>(h); }
-
-thread_local std::string g_err;
-
-void set_err(const std::string &m) { g_err = m; }
-
-}  // namespace
+using mxtpu_capi::Gil;
+using mxtpu_capi::NDArr;
+using mxtpu_capi::ensure_python;
+using mxtpu_capi::nd;
+using mxtpu_capi::py_error;
+using mxtpu_capi::set_err;
 
 extern "C" {
 
@@ -99,47 +96,6 @@ struct Pred {
 
 Pred *pr(MXTPUPredHandle h) { return static_cast<Pred *>(h); }
 
-std::once_flag g_py_once;
-
-void ensure_python() {
-  std::call_once(g_py_once, [] {
-    if (!Py_IsInitialized()) {
-      /* The embedded interpreter lives for the process (no Finalize):
-       * handles may outlive any scoping we could do here. */
-      Py_InitializeEx(0);
-      /* Release the GIL acquired by initialization so PyGILState_Ensure
-       * works uniformly below. */
-      PyEval_SaveThread();
-    }
-  });
-}
-
-/* RAII GIL scope. */
-struct Gil {
-  PyGILState_STATE st;
-  Gil() { st = PyGILState_Ensure(); }
-  ~Gil() { PyGILState_Release(st); }
-};
-
-std::string py_error() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    PyObject *s = PyObject_Str(value);
-    if (s) {
-      const char *u = PyUnicode_AsUTF8(s);
-      if (u) msg = u;           /* NULL on encode failure: keep default */
-      else PyErr_Clear();
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  return msg;
-}
-
 /* numpy float32 array (a copy) from host buffer. */
 PyObject *np_from_buf(PyObject *np, const float *buf, size_t n,
                       const std::vector<int64_t> &shape) {
@@ -169,7 +125,7 @@ PyObject *np_from_buf(PyObject *np, const float *buf, size_t n,
 
 extern "C" {
 
-const char *mxtpu_pred_last_error(void) { return g_err.c_str(); }
+const char *mxtpu_pred_last_error(void) { return mxtpu_capi::last_err(); }
 
 MXTPUPredHandle mxtpu_pred_create(const char *artifact_path) {
   if (!artifact_path) { set_err("null path"); return nullptr; }

@@ -1,0 +1,214 @@
+/*
+ * LeNet/MNIST training through the C ABI ONLY (no Python in this file):
+ * symbol composition, executor bind/forward/backward, kvstore
+ * init/push/pull with a server-side optimizer, and a DataIter — the
+ * reference's "every frontend binds the C API" architectural contract
+ * (include/mxnet/c_api.h MXSymbol / MXExecutor / MXKVStore / MXDataIter
+ * tiers), exercised by tests/test_native.py::test_c_api_trains_lenet.
+ *
+ * Usage: train_capi_test <images.idx> <labels.idx> <epochs> <batch>
+ * Prints "C_API_TRAIN acc=<final accuracy>"; exit 0 iff acc >= 0.9.
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define N_PARAMS 8
+static const char *kParams[N_PARAMS] = {
+    "c1_weight", "c1_bias", "c2_weight", "c2_bias",
+    "f1_weight", "f1_bias", "f2_weight", "f2_bias"};
+
+static void die(const char *what) {
+  fprintf(stderr, "FATAL %s: %s\n", what, mxtpu_capi_last_error());
+  exit(1);
+}
+
+/* xorshift PRNG: deterministic init without libc rand() differences */
+static uint64_t rng_state = 0x9E3779B97F4A7C15ull;
+static float frand(void) {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return (float)((rng_state >> 11) * (1.0 / 9007199254740992.0));
+}
+
+/* One composed layer: atomic op + wire the data input. */
+static MXTPUHandle layer(const char *op, const char *kwargs,
+                         const char *name, MXTPUHandle input) {
+  MXTPUHandle h = mxtpu_sym_create_atomic(op, kwargs);
+  if (!h) die(op);
+  const char *arg_names[1] = {"data"};
+  MXTPUHandle args[1] = {input};
+  if (mxtpu_sym_compose(h, name, 1, arg_names, args) != 0) die(op);
+  return h;
+}
+
+static MXTPUHandle build_lenet(void) {
+  MXTPUHandle data = mxtpu_sym_create_variable("data");
+  if (!data) die("variable");
+  MXTPUHandle x = layer("Convolution",
+                        "{\"kernel\": [5, 5], \"num_filter\": 8}", "c1", data);
+  x = layer("Activation", "{\"act_type\": \"tanh\"}", "a1", x);
+  x = layer("Pooling",
+            "{\"kernel\": [2, 2], \"stride\": [2, 2], \"pool_type\": \"max\"}",
+            "p1", x);
+  x = layer("Convolution",
+            "{\"kernel\": [5, 5], \"num_filter\": 16}", "c2", x);
+  x = layer("Activation", "{\"act_type\": \"tanh\"}", "a2", x);
+  x = layer("Pooling",
+            "{\"kernel\": [2, 2], \"stride\": [2, 2], \"pool_type\": \"max\"}",
+            "p2", x);
+  x = layer("Flatten", "{}", "fl", x);
+  x = layer("FullyConnected", "{\"num_hidden\": 64}", "f1", x);
+  x = layer("Activation", "{\"act_type\": \"tanh\"}", "a3", x);
+  x = layer("FullyConnected", "{\"num_hidden\": 10}", "f2", x);
+  x = layer("SoftmaxOutput", "{}", "softmax", x);
+  return x;
+}
+
+/* Scaled-uniform init (Xavier-style) computed client-side: weights in
+ * [-s, s] with s = sqrt(3 / fan_in); biases zero. */
+static void init_params(MXTPUHandle ex, MXTPUHandle kv) {
+  for (int p = 0; p < N_PARAMS; ++p) {
+    MXTPUNDArrayHandle arr = mxtpu_executor_get_array(ex, "arg", kParams[p]);
+    if (!arr) die("get arg");
+    float *buf = mxtpu_ndarray_data(arr);
+    size_t n = mxtpu_ndarray_size(arr);
+    const int64_t *shape = mxtpu_ndarray_shape(arr);
+    int is_bias = strstr(kParams[p], "bias") != NULL;
+    float scale = 0.f;
+    if (!is_bias) {
+      size_t fan_in = n / (size_t)shape[0];
+      scale = (float)sqrt(3.0 / (double)fan_in);
+    }
+    for (size_t i = 0; i < n; ++i)
+      buf[i] = is_bias ? 0.f : (2.f * frand() - 1.f) * scale;
+    if (mxtpu_executor_set_array(ex, "arg", kParams[p], arr) != 0)
+      die("set arg");
+    if (mxtpu_kvstore_init(kv, kParams[p], arr) != 0) die("kv init");
+    mxtpu_ndarray_free(arr);
+  }
+}
+
+/* Push grads, pull updated weights back into the executor. */
+static void kv_step(MXTPUHandle ex, MXTPUHandle kv) {
+  for (int p = 0; p < N_PARAMS; ++p) {
+    MXTPUNDArrayHandle grad = mxtpu_executor_get_array(ex, "grad", kParams[p]);
+    if (!grad) die("get grad");
+    if (mxtpu_kvstore_push(kv, kParams[p], grad) != 0) die("kv push");
+    MXTPUNDArrayHandle w =
+        mxtpu_kvstore_pull(kv, kParams[p], mxtpu_ndarray_shape(grad),
+                           mxtpu_ndarray_ndim(grad));
+    if (!w) die("kv pull");
+    if (mxtpu_executor_set_array(ex, "arg", kParams[p], w) != 0)
+      die("set weight");
+    mxtpu_ndarray_free(grad);
+    mxtpu_ndarray_free(w);
+  }
+}
+
+static double accuracy(MXTPUHandle ex, MXTPUHandle it, int batch) {
+  long correct = 0, total = 0;
+  if (mxtpu_dataiter_reset(it) != 0) die("reset");
+  int rc;
+  while ((rc = mxtpu_dataiter_next(it)) == 1) {
+    MXTPUNDArrayHandle data = mxtpu_dataiter_data(it);
+    MXTPUNDArrayHandle label = mxtpu_dataiter_label(it);
+    if (!data || !label) die("batch");
+    if (mxtpu_executor_set_array(ex, "arg", "data", data) != 0) die("set data");
+    if (mxtpu_executor_forward(ex, 0) != 0) die("eval forward");
+    MXTPUNDArrayHandle probs = mxtpu_executor_output(ex, 0);
+    if (!probs) die("output");
+    const float *pbuf = mxtpu_ndarray_data(probs);
+    const float *lbuf = mxtpu_ndarray_data(label);
+    for (int i = 0; i < batch; ++i) {
+      int best = 0;
+      for (int c = 1; c < 10; ++c)
+        if (pbuf[i * 10 + c] > pbuf[i * 10 + best]) best = c;
+      correct += (best == (int)lbuf[i]);
+      ++total;
+    }
+    mxtpu_ndarray_free(probs);
+    mxtpu_ndarray_free(data);
+    mxtpu_ndarray_free(label);
+  }
+  if (rc < 0) die("iter");
+  return total ? (double)correct / (double)total : 0.0;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 5) {
+    fprintf(stderr, "usage: %s images.idx labels.idx epochs batch\n", argv[0]);
+    return 2;
+  }
+  const char *images = argv[1], *labels = argv[2];
+  int epochs = atoi(argv[3]), batch = atoi(argv[4]);
+
+  MXTPUHandle net = build_lenet();
+
+  char shapes[256];
+  snprintf(shapes, sizeof shapes,
+           "{\"data\": [%d, 1, 28, 28], \"softmax_label\": [%d]}",
+           batch, batch);
+  MXTPUHandle ex = mxtpu_executor_simple_bind(net, shapes, "write");
+  if (!ex) die("bind");
+
+  /* symbol listings round-trip (MXSymbolListArguments parity) */
+  char *args_json = mxtpu_sym_list(net, "arguments");
+  if (!args_json || !strstr(args_json, "c1_weight")) die("sym_list");
+  mxtpu_buf_free(args_json);
+  char *json = mxtpu_sym_to_json(net);
+  MXTPUHandle reloaded = mxtpu_sym_from_json(json);
+  if (!reloaded) die("from_json");
+  mxtpu_buf_free(json);
+  mxtpu_handle_free(reloaded);
+
+  MXTPUHandle kv = mxtpu_kvstore_create("local");
+  if (!kv) die("kvstore");
+  char optjson[128];
+  snprintf(optjson, sizeof optjson,
+           "{\"learning_rate\": 0.1, \"momentum\": 0.9, "
+           "\"rescale_grad\": %.8f}", 1.0 / (double)batch);
+  if (mxtpu_kvstore_set_optimizer(kv, "sgd", optjson) != 0) die("optimizer");
+  init_params(ex, kv);
+
+  char iterjson[512];
+  snprintf(iterjson, sizeof iterjson,
+           "{\"image\": \"%s\", \"label\": \"%s\", \"batch_size\": %d, "
+           "\"shuffle\": true, \"seed\": 7}", images, labels, batch);
+  MXTPUHandle it = mxtpu_dataiter_create("MNISTIter", iterjson);
+  if (!it) die("dataiter");
+
+  for (int e = 0; e < epochs; ++e) {
+    if (mxtpu_dataiter_reset(it) != 0) die("reset");
+    int rc;
+    while ((rc = mxtpu_dataiter_next(it)) == 1) {
+      MXTPUNDArrayHandle data = mxtpu_dataiter_data(it);
+      MXTPUNDArrayHandle label = mxtpu_dataiter_label(it);
+      if (!data || !label) die("batch");
+      if (mxtpu_executor_set_array(ex, "arg", "data", data) != 0 ||
+          mxtpu_executor_set_array(ex, "arg", "softmax_label", label) != 0)
+        die("set batch");
+      if (mxtpu_executor_forward(ex, 1) != 0) die("forward");
+      if (mxtpu_executor_backward(ex) != 0) die("backward");
+      kv_step(ex, kv);
+      mxtpu_ndarray_free(data);
+      mxtpu_ndarray_free(label);
+    }
+    if (rc < 0) die("iter");
+    printf("epoch %d: train-acc=%.4f\n", e, accuracy(ex, it, batch));
+    fflush(stdout);
+  }
+
+  double acc = accuracy(ex, it, batch);
+  printf("C_API_TRAIN acc=%.4f\n", acc);
+  mxtpu_handle_free(it);
+  mxtpu_handle_free(kv);
+  mxtpu_handle_free(ex);
+  mxtpu_handle_free(net);
+  return acc >= 0.9 ? 0 : 1;
+}

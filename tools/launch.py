@@ -65,21 +65,28 @@ def launch_servers(args):
             [sys.executable, "-m", "mxnet_tpu._async_ps_main"], env=env))
     addrs = []
     deadline = time.time() + 90
-    for i, addr_file in enumerate(addr_files):
-        while True:
-            if os.path.exists(addr_file):
-                with open(addr_file) as f:
-                    addr = f.read().strip()
-                if addr:
-                    addrs.append(addr)
-                    break
-            if procs[i].poll() is not None:
-                raise RuntimeError("PS server %d exited rc=%d before "
-                                   "binding" % (i, procs[i].returncode))
-            if time.time() > deadline:
-                raise RuntimeError("PS server %d did not report an address "
-                                   "within 90s" % i)
-            time.sleep(0.1)
+    try:
+        for i, addr_file in enumerate(addr_files):
+            while True:
+                if os.path.exists(addr_file):
+                    with open(addr_file) as f:
+                        addr = f.read().strip()
+                    if addr:
+                        addrs.append(addr)
+                        break
+                if procs[i].poll() is not None:
+                    raise RuntimeError("PS server %d exited rc=%d before "
+                                       "binding" % (i, procs[i].returncode))
+                if time.time() > deadline:
+                    raise RuntimeError("PS server %d did not report an "
+                                       "address within 90s" % i)
+                time.sleep(0.1)
+    except Exception:
+        # don't orphan the shards that DID start
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
     worker_env = {
         "MXNET_TPU_ASYNC_PS_ADDRS": ",".join(addrs),
         "MXNET_TPU_NUM_SERVERS": str(args.num_servers),
@@ -127,6 +134,19 @@ def launch_local(args, cmd):
     return code
 
 
+def _ssh_with_secret(host, remote_cmd, secret):
+    """Run a remote command with MXNET_TPU_PS_SECRET delivered on STDIN —
+    never on the command line, where any local user could read it from
+    /proc/<pid>/cmdline and forge the set_optimizer HMAC."""
+    wrapped = ("IFS= read -r MXNET_TPU_PS_SECRET; "
+               "export MXNET_TPU_PS_SECRET; " + remote_cmd)
+    proc = subprocess.Popen(["ssh", host, wrapped], stdin=subprocess.PIPE,
+                            text=True)
+    proc.stdin.write(secret + "\n")
+    proc.stdin.close()
+    return proc
+
+
 def launch_ssh(args, cmd):
     import secrets
 
@@ -136,32 +156,33 @@ def launch_ssh(args, cmd):
     coordinator = "%s:%d" % (hosts[0], args.port or _free_port())
     procs = []
     server_env = ""
+    secret = secrets.token_hex(16) if args.num_servers > 0 else ""
     if args.num_servers > 0:
         # remote servers bind operator-chosen ports (no addr-file channel
         # across hosts): server i on hosts[i % len], port base + i
-        secret = secrets.token_hex(16)
         placements = [(hosts[i % len(hosts)], args.server_port_base + i)
                       for i in range(args.num_servers)]
         for i, (host, port) in enumerate(placements):
             env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
                    "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
-                   "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_SECRET=%s "
-                   "MXNET_TPU_PS_HOST=%s"
-                   % (port, i, args.num_servers, secret, host))
+                   "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s"
+                   % (port, i, args.num_servers, host))
             remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
                 os.getcwd(), env, sys.executable)
-            procs.append(subprocess.Popen(["ssh", host, remote]))
-        server_env = ("MXNET_TPU_ASYNC_PS_ADDRS=%s MXNET_TPU_PS_SECRET=%s "
-                      "MXNET_TPU_NUM_SERVERS=%d "
+            procs.append(_ssh_with_secret(host, remote, secret))
+        server_env = ("MXNET_TPU_ASYNC_PS_ADDRS=%s MXNET_TPU_NUM_SERVERS=%d "
                       % (",".join("%s:%d" % p for p in placements),
-                         secret, args.num_servers))
+                         args.num_servers))
     workers = []
     for i in range(args.num_workers):
         env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
                "MXNET_TPU_PROC_ID=%d %s"
                % (coordinator, args.num_workers, i, server_env))
         remote = "cd %s && %s %s" % (os.getcwd(), env, " ".join(cmd))
-        workers.append(subprocess.Popen(["ssh", hosts[i], remote]))
+        if secret:
+            workers.append(_ssh_with_secret(hosts[i], remote, secret))
+        else:
+            workers.append(subprocess.Popen(["ssh", hosts[i], remote]))
     code = 0
     for p in workers:
         p.wait()
