@@ -124,7 +124,8 @@ class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (parity: ``io.py:NDArrayIter``)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
-                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
@@ -132,7 +133,8 @@ class NDArrayIter(DataIter):
 
         if shuffle:
             idx = _np.arange(self.num_data)
-            _np.random.shuffle(idx)
+            (_np.random if seed is None
+             else _np.random.RandomState(seed)).shuffle(idx)
             self.data = [(k, v[idx]) for k, v in self.data]
             self.label = [(k, v[idx]) for k, v in self.label]
         if last_batch_handle == "discard":
@@ -445,7 +447,7 @@ class MNISTIter(NDArrayIter):
         if input_shape is not None:
             img = img.reshape((img.shape[0],) + tuple(input_shape))
         super().__init__(img, lab, batch_size=batch_size, shuffle=shuffle,
-                         last_batch_handle="discard")
+                         last_batch_handle="discard", seed=seed)
 
 
 class CSVIter(NDArrayIter):

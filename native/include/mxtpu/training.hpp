@@ -1,0 +1,501 @@
+/*!
+ * Header-only C++ TRAINING frontend (parity: reference ``cpp-package/``
+ * — Symbol composition op.h, Executor executor.h, Optimizer optimizer.h,
+ * KVStore kvstore.h, MXDataIter io.h, and the FeedForward fit loop of
+ * model.h — 57 files collapsed onto the flat mxtpu C ABI, which the
+ * reference's cpp-package likewise builds on c_api.h).
+ *
+ *   using namespace mxtpu::train;
+ *   Symbol net = SoftmaxOutput("softmax",
+ *       FullyConnected("fc", Symbol::Variable("data"), 10));
+ *   FeedForward model(net, {{"data", {32, 784}}, {"softmax_label", {32}}});
+ *   KVStore kv("local");
+ *   kv.SetOptimizer("sgd", "{\"learning_rate\": 0.1}");
+ *   model.Fit(train_iter, kv, /*epochs=*/5);
+ *   double acc = model.Score(eval_iter);
+ *
+ * Everything throws mxtpu::train::Error carrying mxtpu_capi_last_error().
+ */
+#ifndef MXTPU_TRAINING_HPP_
+#define MXTPU_TRAINING_HPP_
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <locale>
+#include <random>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+namespace train {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what)
+      : std::runtime_error(what + ": " + mxtpu_capi_last_error()) {}
+};
+
+/* ---------- small JSON helpers (names are C identifiers; values are
+ * numbers/identifier-strings — no escaping needed) ---------- */
+
+inline std::string ShapeJSON(const std::vector<int64_t> &shape) {
+  std::string out = "[";
+  for (size_t i = 0; i < shape.size(); ++i)
+    out += (i ? ", " : "") + std::to_string(shape[i]);
+  return out + "]";
+}
+
+inline std::string ShapesJSON(
+    const std::map<std::string, std::vector<int64_t>> &shapes) {
+  std::string out = "{";
+  bool first = true;
+  for (const auto &kv : shapes) {
+    out += (first ? "" : ", ");
+    out += "\"" + kv.first + "\": " + ShapeJSON(kv.second);
+    first = false;
+  }
+  return out + "}";
+}
+
+/* Parse a flat JSON array of strings: ["a", "b"] (sym_list output). */
+inline std::vector<std::string> ParseStringArray(const std::string &json) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while ((i = json.find('"', i)) != std::string::npos) {
+    size_t j = json.find('"', i + 1);
+    if (j == std::string::npos) break;
+    out.push_back(json.substr(i + 1, j - i - 1));
+    i = j + 1;
+  }
+  return out;
+}
+
+/* ---------- NDArray: owned host float32 tensor ---------- */
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(const std::vector<int64_t> &shape)
+      : h_(mxtpu_ndarray_create(shape.data(), static_cast<int>(shape.size())),
+           mxtpu_ndarray_free) {
+    if (!h_) throw Error("ndarray_create");
+  }
+  /* Adopt an owned handle from the C API (may be NULL -> throws). */
+  static NDArray Adopt(MXTPUNDArrayHandle h, const char *what) {
+    if (!h) throw Error(what);
+    NDArray a;
+    a.h_.reset(h, mxtpu_ndarray_free);
+    return a;
+  }
+
+  float *data() { return mxtpu_ndarray_data(h_.get()); }
+  const float *data() const { return mxtpu_ndarray_data(h_.get()); }
+  size_t size() const { return mxtpu_ndarray_size(h_.get()); }
+  std::vector<int64_t> shape() const {
+    const int64_t *s = mxtpu_ndarray_shape(h_.get());
+    return {s, s + mxtpu_ndarray_ndim(h_.get())};
+  }
+  MXTPUNDArrayHandle handle() const { return h_.get(); }
+  explicit operator bool() const { return static_cast<bool>(h_); }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+/* ---------- handle base: Symbol / Executor / KVStore / DataIter ---------- */
+
+namespace detail {
+struct HandleOwner {
+  explicit HandleOwner(MXTPUHandle h) : h(h) {}
+  ~HandleOwner() {
+    if (h) mxtpu_handle_free(h);
+  }
+  MXTPUHandle h;
+};
+inline std::shared_ptr<HandleOwner> own(MXTPUHandle h, const char *what) {
+  if (!h) throw Error(what);
+  return std::make_shared<HandleOwner>(h);
+}
+}  // namespace detail
+
+/* ---------- Symbol ---------- */
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string &name) {
+    return Symbol(detail::own(mxtpu_sym_create_variable(name.c_str()),
+                              "sym_create_variable"));
+  }
+
+  /* Atomic create + compose in one step (the C ABI's two-phase contract,
+   * reference MXSymbolCreateAtomicSymbol + MXSymbolCompose). */
+  static Symbol Op(const std::string &op, const std::string &kwargs_json,
+                   const std::string &name,
+                   const std::vector<std::pair<std::string, Symbol>> &inputs) {
+    MXTPUHandle h = mxtpu_sym_create_atomic(op.c_str(), kwargs_json.c_str());
+    if (!h) throw Error("sym_create_atomic " + op);
+    std::vector<const char *> names;
+    std::vector<MXTPUHandle> handles;
+    for (const auto &kv : inputs) {
+      names.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    if (mxtpu_sym_compose(h, name.c_str(), static_cast<int>(names.size()),
+                          names.data(), handles.data()) != 0) {
+      mxtpu_handle_free(h);
+      throw Error("sym_compose " + op);
+    }
+    return Symbol(detail::own(h, "sym_compose"));
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    return Symbol(detail::own(mxtpu_sym_from_json(json.c_str()),
+                              "sym_from_json"));
+  }
+
+  std::string ToJSON() const {
+    char *s = mxtpu_sym_to_json(handle());
+    if (!s) throw Error("sym_to_json");
+    std::string out(s);
+    mxtpu_buf_free(s);
+    return out;
+  }
+
+  std::vector<std::string> List(const std::string &which) const {
+    char *s = mxtpu_sym_list(handle(), which.c_str());
+    if (!s) throw Error("sym_list " + which);
+    std::string json(s);
+    mxtpu_buf_free(s);
+    return ParseStringArray(json);
+  }
+  std::vector<std::string> ListArguments() const { return List("arguments"); }
+  std::vector<std::string> ListOutputs() const { return List("outputs"); }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return List("auxiliary_states");
+  }
+
+  MXTPUHandle handle() const { return owner_ ? owner_->h : 0; }
+  explicit operator bool() const { return static_cast<bool>(owner_); }
+
+ private:
+  explicit Symbol(std::shared_ptr<detail::HandleOwner> o)
+      : owner_(std::move(o)) {}
+  std::shared_ptr<detail::HandleOwner> owner_;
+};
+
+/* ---------- symbolic ops (cpp-package op.h subset) ---------- */
+
+inline Symbol Convolution(const std::string &name, Symbol data,
+                          std::pair<int, int> kernel, int num_filter,
+                          std::pair<int, int> stride = {1, 1},
+                          std::pair<int, int> pad = {0, 0}) {
+  char kw[192];
+  std::snprintf(kw, sizeof kw,
+                "{\"kernel\": [%d, %d], \"num_filter\": %d, "
+                "\"stride\": [%d, %d], \"pad\": [%d, %d]}",
+                kernel.first, kernel.second, num_filter, stride.first,
+                stride.second, pad.first, pad.second);
+  return Symbol::Op("Convolution", kw, name, {{"data", data}});
+}
+
+inline Symbol FullyConnected(const std::string &name, Symbol data,
+                             int num_hidden) {
+  return Symbol::Op("FullyConnected",
+                    "{\"num_hidden\": " + std::to_string(num_hidden) + "}",
+                    name, {{"data", data}});
+}
+
+inline Symbol Activation(const std::string &name, Symbol data,
+                         const std::string &act_type) {
+  return Symbol::Op("Activation", "{\"act_type\": \"" + act_type + "\"}",
+                    name, {{"data", data}});
+}
+
+inline Symbol Pooling(const std::string &name, Symbol data,
+                      std::pair<int, int> kernel,
+                      const std::string &pool_type = "max",
+                      std::pair<int, int> stride = {1, 1}) {
+  char kw[160];
+  std::snprintf(kw, sizeof kw,
+                "{\"kernel\": [%d, %d], \"stride\": [%d, %d], "
+                "\"pool_type\": \"%s\"}",
+                kernel.first, kernel.second, stride.first, stride.second,
+                pool_type.c_str());
+  return Symbol::Op("Pooling", kw, name, {{"data", data}});
+}
+
+inline Symbol Flatten(const std::string &name, Symbol data) {
+  return Symbol::Op("Flatten", "{}", name, {{"data", data}});
+}
+
+/* Locale-independent double formatting (std::to_string honors
+ * LC_NUMERIC: a comma decimal point would break the JSON). */
+inline std::string NumJSON(double v) {
+  std::ostringstream os;
+  os.imbue(std::locale::classic());
+  os << v;
+  return os.str();
+}
+
+inline Symbol Dropout(const std::string &name, Symbol data, double p) {
+  return Symbol::Op("Dropout", "{\"p\": " + NumJSON(p) + "}", name,
+                    {{"data", data}});
+}
+
+inline Symbol BatchNorm(const std::string &name, Symbol data) {
+  return Symbol::Op("BatchNorm", "{}", name, {{"data", data}});
+}
+
+inline Symbol SoftmaxOutput(const std::string &name, Symbol data) {
+  return Symbol::Op("SoftmaxOutput", "{}", name, {{"data", data}});
+}
+
+/* ---------- Executor ---------- */
+
+class Executor {
+ public:
+  Executor(const Symbol &sym,
+           const std::map<std::string, std::vector<int64_t>> &shapes,
+           const std::string &grad_req = "write")
+      : owner_(detail::own(
+            mxtpu_executor_simple_bind(sym.handle(),
+                                       ShapesJSON(shapes).c_str(),
+                                       grad_req.c_str()),
+            "executor_simple_bind")) {}
+
+  void Forward(bool is_train) {
+    if (mxtpu_executor_forward(owner_->h, is_train ? 1 : 0) != 0)
+      throw Error("executor_forward");
+  }
+  void Backward() {
+    if (mxtpu_executor_backward(owner_->h) != 0)
+      throw Error("executor_backward");
+  }
+  int NumOutputs() const {
+    int n = mxtpu_executor_num_outputs(owner_->h);
+    if (n < 0) throw Error("executor_num_outputs");
+    return n;
+  }
+  NDArray Output(int idx) const {
+    return NDArray::Adopt(mxtpu_executor_output(owner_->h, idx),
+                          "executor_output");
+  }
+  NDArray GetArg(const std::string &name) const { return Get("arg", name); }
+  NDArray GetGrad(const std::string &name) const { return Get("grad", name); }
+  NDArray GetAux(const std::string &name) const { return Get("aux", name); }
+  void SetArg(const std::string &name, const NDArray &value) {
+    Set("arg", name, value);
+  }
+  void SetAux(const std::string &name, const NDArray &value) {
+    Set("aux", name, value);
+  }
+
+ private:
+  NDArray Get(const char *kind, const std::string &name) const {
+    return NDArray::Adopt(
+        mxtpu_executor_get_array(owner_->h, kind, name.c_str()),
+        "executor_get_array");
+  }
+  void Set(const char *kind, const std::string &name, const NDArray &value) {
+    if (mxtpu_executor_set_array(owner_->h, kind, name.c_str(),
+                                 value.handle()) != 0)
+      throw Error("executor_set_array " + name);
+  }
+  std::shared_ptr<detail::HandleOwner> owner_;
+};
+
+/* ---------- KVStore (server-side optimizer, reference kvstore.h) ------- */
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local")
+      : owner_(detail::own(mxtpu_kvstore_create(type.c_str()),
+                           "kvstore_create")) {}
+
+  void Init(const std::string &key, const NDArray &value) {
+    if (mxtpu_kvstore_init(owner_->h, key.c_str(), value.handle()) != 0)
+      throw Error("kvstore_init " + key);
+  }
+  void Push(const std::string &key, const NDArray &grad) {
+    if (mxtpu_kvstore_push(owner_->h, key.c_str(), grad.handle()) != 0)
+      throw Error("kvstore_push " + key);
+  }
+  NDArray Pull(const std::string &key, const std::vector<int64_t> &shape) {
+    return NDArray::Adopt(
+        mxtpu_kvstore_pull(owner_->h, key.c_str(), shape.data(),
+                           static_cast<int>(shape.size())),
+        "kvstore_pull");
+  }
+  void SetOptimizer(const std::string &name, const std::string &kwargs_json) {
+    if (mxtpu_kvstore_set_optimizer(owner_->h, name.c_str(),
+                                    kwargs_json.c_str()) != 0)
+      throw Error("kvstore_set_optimizer");
+  }
+  int Rank() const { return mxtpu_kvstore_rank(owner_->h); }
+  int NumWorkers() const { return mxtpu_kvstore_num_workers(owner_->h); }
+
+ private:
+  std::shared_ptr<detail::HandleOwner> owner_;
+};
+
+/* ---------- DataIter (reference io.h MXDataIter) ---------- */
+
+class DataIter {
+ public:
+  DataIter(const std::string &type, const std::string &kwargs_json)
+      : owner_(detail::own(
+            mxtpu_dataiter_create(type.c_str(), kwargs_json.c_str()),
+            "dataiter_create")) {}
+
+  bool Next() {
+    int rc = mxtpu_dataiter_next(owner_->h);
+    if (rc < 0) throw Error("dataiter_next");
+    return rc == 1;
+  }
+  void Reset() {
+    if (mxtpu_dataiter_reset(owner_->h) != 0) throw Error("dataiter_reset");
+  }
+  NDArray Data() {
+    return NDArray::Adopt(mxtpu_dataiter_data(owner_->h), "dataiter_data");
+  }
+  NDArray Label() {
+    return NDArray::Adopt(mxtpu_dataiter_label(owner_->h), "dataiter_label");
+  }
+
+ private:
+  std::shared_ptr<detail::HandleOwner> owner_;
+};
+
+/* ---------- Initializer (reference initializer.h Xavier) ---------- */
+
+class Xavier {
+ public:
+  explicit Xavier(uint32_t seed = 0) : rng_(seed) {}
+
+  /* In-place init: weights uniform in [-sqrt(3/fan_in), +]; biases/beta
+   * zero; gamma/moving_var one (BN conventions). */
+  void operator()(const std::string &name, NDArray *arr) {
+    float *buf = arr->data();
+    size_t n = arr->size();
+    auto ends_with = [&](const char *suf) {
+      size_t l = std::strlen(suf);
+      return name.size() >= l && name.compare(name.size() - l, l, suf) == 0;
+    };
+    if (ends_with("bias") || ends_with("beta") || ends_with("moving_mean")) {
+      std::fill(buf, buf + n, 0.f);
+    } else if (ends_with("gamma") || ends_with("moving_var")) {
+      std::fill(buf, buf + n, 1.f);
+    } else {
+      int64_t lead = arr->shape().empty() ? 1 : arr->shape()[0];
+      float scale = std::sqrt(3.0f / (static_cast<float>(n) /
+                                      static_cast<float>(lead)));
+      std::uniform_real_distribution<float> u(-scale, scale);
+      for (size_t i = 0; i < n; ++i) buf[i] = u(rng_);
+    }
+  }
+
+ private:
+  std::mt19937 rng_;
+};
+
+/* ---------- FeedForward fit loop (reference model.h / cpp-package) ----- */
+
+class FeedForward {
+ public:
+  /* data_name/label_name follow the reference's defaults. */
+  FeedForward(Symbol net, std::map<std::string, std::vector<int64_t>> shapes,
+              const std::string &data_name = "data",
+              const std::string &label_name = "softmax_label")
+      : net_(std::move(net)),
+        ex_(net_, shapes),
+        data_name_(data_name),
+        label_name_(label_name) {
+    for (const std::string &arg : net_.ListArguments())
+      if (arg != data_name_ && arg != label_name_) params_.push_back(arg);
+  }
+
+  Executor &executor() { return ex_; }
+
+  void InitParams(KVStore &kv, uint32_t seed = 0) {
+    Xavier init(seed);
+    for (const std::string &p : params_) {
+      NDArray arr = ex_.GetArg(p);
+      init(p, &arr);
+      ex_.SetArg(p, arr);
+      kv.Init(p, arr);
+    }
+  }
+
+  /* One epoch of update-through-kvstore training (push grad, pull back
+   * the server-updated weight — the reference's data-parallel loop). */
+  void FitEpoch(DataIter &train, KVStore &kv) {
+    train.Reset();
+    while (train.Next()) {
+      NDArray data = train.Data(), label = train.Label();
+      ex_.SetArg(data_name_, data);
+      ex_.SetArg(label_name_, label);
+      ex_.Forward(true);
+      ex_.Backward();
+      for (const std::string &p : params_) {
+        NDArray grad = ex_.GetGrad(p);
+        kv.Push(p, grad);
+        ex_.SetArg(p, kv.Pull(p, grad.shape()));
+      }
+    }
+  }
+
+  void Fit(DataIter &train, KVStore &kv, int epochs, uint32_t seed = 0) {
+    InitParams(kv, seed);
+    for (int e = 0; e < epochs; ++e) FitEpoch(train, kv);
+  }
+
+  /* argmax(prob) accuracy over the iterator (reference Accuracy metric). */
+  double Score(DataIter &eval) {
+    long correct = 0, total = 0;
+    eval.Reset();
+    while (eval.Next()) {
+      NDArray data = eval.Data(), label = eval.Label();
+      ex_.SetArg(data_name_, data);
+      ex_.Forward(false);
+      NDArray probs = ex_.Output(0);
+      std::vector<int64_t> shape = probs.shape();
+      if (shape.size() != 2)
+        throw std::runtime_error(
+            "Score expects a (batch, classes) output; got ndim=" +
+            std::to_string(shape.size()));
+      int64_t batch = shape[0], classes = shape[1];
+      const float *p = probs.data();
+      const float *l = label.data();
+      for (int64_t i = 0; i < batch; ++i) {
+        const float *row = p + i * classes;
+        int64_t best = std::max_element(row, row + classes) - row;
+        correct += (best == static_cast<int64_t>(l[i]));
+        ++total;
+      }
+    }
+    return total ? static_cast<double>(correct) / total : 0.0;
+  }
+
+ private:
+  Symbol net_;
+  Executor ex_;
+  std::string data_name_, label_name_;
+  std::vector<std::string> params_;
+};
+
+}  // namespace train
+}  // namespace mxtpu
+
+#endif  // MXTPU_TRAINING_HPP_

@@ -349,3 +349,45 @@ def test_c_api_trains_lenet(tmp_path):
     assert line, r.stdout
     acc = float(line[0].split("acc=")[1])
     assert acc >= 0.9, r.stdout
+
+
+def test_cpp_frontend_trains_lenet(tmp_path):
+    """The header-only C++ TRAINING frontend (cpp-package parity:
+    Symbol/Executor/KVStore/DataIter + FeedForward fit loop over the C
+    ABI): compile examples/cpp/train_lenet.cpp and converge on synthetic
+    MNIST."""
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(["make", "-C", os.path.join(repo, "native"),
+                        "cpp_train", "PYTHON=%s" % _sys.executable],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    rng = np.random.RandomState(5)
+    n = 512
+    labels = rng.randint(0, 10, n)
+    images = rng.randint(0, 40, (n, 28, 28))
+    for i, c in enumerate(labels):
+        row, col = (c // 2) * 5 + 1, (c % 2) * 13 + 2
+        images[i, row:row + 10, col:col + 10] += 180
+    _write_idx(tmp_path / "img.idx", images.clip(0, 255))
+    _write_idx(tmp_path / "lab.idx", labels)
+
+    binary = os.path.join(repo, "native", "build", "train_lenet")
+    prior = os.environ.get("PYTHONPATH")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_PLATFORM="cpu",
+               PYTHONPATH=repo + ((os.pathsep + prior) if prior else ""))
+    r = subprocess.run([binary, str(tmp_path / "img.idx"),
+                        str(tmp_path / "lab.idx"), "3", "32"],
+                       capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    line = [l for l in r.stdout.splitlines() if l.startswith("CPP_TRAIN")]
+    assert line, r.stdout
+    assert float(line[0].split("acc=")[1]) >= 0.9, r.stdout
