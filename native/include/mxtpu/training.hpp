@@ -11,7 +11,7 @@
  *   FeedForward model(net, {{"data", {32, 784}}, {"softmax_label", {32}}});
  *   KVStore kv("local");
  *   kv.SetOptimizer("sgd", "{\"learning_rate\": 0.1}");
- *   model.Fit(train_iter, kv, /*epochs=*/5);
+ *   model.Fit(train_iter, kv, 5);          // 5 epochs
  *   double acc = model.Score(eval_iter);
  *
  * Everything throws mxtpu::train::Error carrying mxtpu_capi_last_error().
