@@ -16,7 +16,8 @@ import os
 import subprocess
 import threading
 
-__all__ = ["lib", "available", "RecordLoader", "buf_to_bytes"]
+__all__ = ["lib", "available", "RecordLoader", "DecodeLoader",
+           "buf_to_bytes"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -73,6 +74,16 @@ def _configure(lib):
         c.POINTER(c.c_size_t)]
     lib.mxtpu_loader_reset.argtypes = [c.c_void_p]
     lib.mxtpu_loader_free.argtypes = [c.c_void_p]
+    lib.mxtpu_decode_loader_create.restype = c.c_void_p
+    lib.mxtpu_decode_loader_create.argtypes = [
+        c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_uint, c.c_int, c.c_int,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int]
+    lib.mxtpu_decode_loader_next_batch.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_ubyte), c.POINTER(c.c_float)]
+    lib.mxtpu_decode_loader_skipped.restype = c.c_long
+    lib.mxtpu_decode_loader_skipped.argtypes = [c.c_void_p]
+    lib.mxtpu_decode_loader_reset.argtypes = [c.c_void_p]
+    lib.mxtpu_decode_loader_free.argtypes = [c.c_void_p]
     lib.mxtpu_buf_free.argtypes = [c.POINTER(c.c_char)]
     lib.mxtpu_version.restype = c.c_char_p
     return lib
@@ -182,6 +193,64 @@ class RecordLoader(object):
     def close(self):
         if getattr(self, "_h", None):
             self._lib.mxtpu_loader_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DecodeLoader(object):
+    """Parallel JPEG decode + augment pipeline (native
+    ``mxtpu_decode_loader_*``; the reference's OMP decode inside
+    ``iter_image_recordio_2.cc:104-112,296``).  Worker threads decode
+    libjpeg (DCT-scaled), resize, crop and mirror OFF the GIL; Python
+    receives finished uint8 HWC batches with one memcpy."""
+
+    def __init__(self, path, out_h, out_w, part_index=0, num_parts=1,
+                 shuffle=False, seed=0, queue_size=256, shuffle_chunk=1024,
+                 n_workers=None, resize_shorter=0, rand_crop=False,
+                 rand_mirror=False):
+        self._lib = lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if n_workers is None:
+            n_workers = max(1, (os.cpu_count() or 1) - 1)
+        self._h = self._lib.mxtpu_decode_loader_create(
+            path.encode(), part_index, num_parts, int(shuffle), seed,
+            queue_size, shuffle_chunk, n_workers, out_h, out_w,
+            resize_shorter, int(rand_crop), int(rand_mirror))
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+        self._hw = (out_h, out_w)
+
+    def next_batch(self, max_n):
+        """(data uint8 (n, H, W, 3), labels float32 (n,)) or None at
+        epoch end."""
+        import numpy as np
+
+        h, w = self._hw
+        data = np.empty((max_n, h, w, 3), dtype=np.uint8)
+        labels = np.empty((max_n,), dtype=np.float32)
+        n = self._lib.mxtpu_decode_loader_next_batch(
+            self._h, max_n,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n <= 0:
+            return None
+        return data[:n], labels[:n]
+
+    def skipped(self):
+        return int(self._lib.mxtpu_decode_loader_skipped(self._h))
+
+    def reset(self):
+        self._lib.mxtpu_decode_loader_reset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_decode_loader_free(self._h)
             self._h = None
 
     def __del__(self):

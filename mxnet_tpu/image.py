@@ -322,12 +322,24 @@ class ImageIter(DataIter):
         super().__init__()
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         self._loader = None
-        if path_imgrec:
+        self._decode = None
+        self._decode_meanstd = None
+        loader_seed = int(kwargs.pop("seed", 0) or 0) if path_imgrec else 0
+        if path_imgrec and self._try_native_decode(
+                batch_size, data_shape, path_imgrec, path_imgidx,
+                path_imglist, imglist, aug_list, shuffle, part_index,
+                num_parts, loader_seed, kwargs, label_width):
+            # native parallel decode path engaged: record reading, JPEG
+            # decode, resize, crop and mirror all run in C++ worker
+            # threads (reference iter_image_recordio_2.cc:104-112,296);
+            # Python only normalizes + transposes finished batches
+            self.imgrec = None
+            self.imgidx = None
+        elif path_imgrec:
             from . import _native
             from .recordio import MXIndexedRecordIO, MXRecordIO
 
             logging.info("loading recordio %s...", path_imgrec)
-            loader_seed = int(kwargs.pop("seed", 0) or 0)
             if path_imgidx:
                 self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
                 self.imgidx = list(self.imgrec.keys)
@@ -414,12 +426,105 @@ class ImageIter(DataIter):
             # worker sharding (parity: InputSplit by worker)
             n = len(self.seq) // num_parts
             self.seq = self.seq[part_index * n : (part_index + 1) * n]
-        if aug_list is None:
+        if self._decode is not None:
+            self.auglist = []  # augs run inside the native pipeline
+        elif aug_list is None:
             self.auglist = CreateAugmenter(data_shape, **kwargs)
         else:
             self.auglist = aug_list
         self.cur = 0
         self.reset()
+
+    # standard-aug kwargs the native decode pipeline implements itself
+    _NATIVE_AUG_KEYS = {"resize", "rand_crop", "rand_mirror", "mean", "std"}
+
+    def _try_native_decode(self, batch_size, data_shape, path_imgrec,
+                           path_imgidx, path_imglist, imglist, aug_list,
+                           shuffle, part_index, num_parts, seed, kwargs,
+                           label_width):
+        """Engage the C++ decode worker pool when the configuration is the
+        standard train/eval pipeline over a JPEG RecordIO file.  Falls
+        back (returns False) for .idx/list inputs, custom aug lists,
+        multi-float labels, non-JPEG payloads, or
+        MXTPU_NO_NATIVE_DECODE=1."""
+        from . import _native
+
+        if (os.environ.get("MXTPU_NO_NATIVE_DECODE")
+                or not _native.available()
+                or path_imgidx or path_imglist or isinstance(imglist, list)
+                or aug_list is not None
+                or label_width > 1  # native carries one label float
+                or not set(kwargs) <= self._NATIVE_AUG_KEYS
+                or len(data_shape) != 3 or data_shape[0] != 3):
+            return False
+        # probe the first record: the native path decodes JPEG only
+        from .recordio import MXRecordIO, unpack
+
+        try:
+            probe = MXRecordIO(path_imgrec, "r")
+            rec = probe.read()
+            probe.close()
+            _, img = unpack(rec)
+            if img[:2] != b"\xff\xd8":
+                return False
+        except Exception:
+            return False
+        mean, std = kwargs.get("mean"), kwargs.get("std")
+        if mean is True:
+            mean = _IMAGENET_RGB_MEAN
+        if std is True:
+            std = _IMAGENET_RGB_STD
+        # EXACTLY CreateAugmenter's gate: normalization runs only when
+        # mean is a shaped array (std rides along) — the native path must
+        # not diverge numerically from the python fallback
+        if mean is not None and getattr(mean, "shape", None):
+            self._decode_meanstd = (
+                np.asarray(mean, np.float32),
+                None if std is None else np.asarray(std, np.float32))
+        else:
+            self._decode_meanstd = (None, None)
+        workers = int(os.environ.get("MXTPU_DECODE_WORKERS", "0")) or None
+        self._decode = _native.DecodeLoader(
+            path_imgrec, out_h=data_shape[1], out_w=data_shape[2],
+            part_index=part_index, num_parts=num_parts, shuffle=shuffle,
+            seed=seed, n_workers=workers,
+            resize_shorter=int(kwargs.get("resize", 0) or 0),
+            rand_crop=bool(kwargs.get("rand_crop")),
+            rand_mirror=bool(kwargs.get("rand_mirror")))
+        self._decode_fresh = True  # workers already running: first
+        return True                # reset() must not restart them
+
+    def _next_native(self):
+        """Assemble one batch from the decode pipeline (pads the final
+        short batch like the python path)."""
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        chunks, labels, have = [], [], 0
+        while have < batch_size:
+            got = self._decode.next_batch(batch_size - have)
+            if got is None:
+                break
+            chunks.append(got[0])
+            labels.append(got[1])
+            have += got[0].shape[0]
+        if not have:
+            raise StopIteration
+        data = np.concatenate(chunks).astype(np.float32)
+        mean, std = self._decode_meanstd
+        if mean is not None:
+            data -= mean
+            if std is not None:
+                data /= std
+        data = data.transpose(0, 3, 1, 2)  # HWC -> CHW
+        batch_label = np.concatenate(labels)
+        if have < batch_size:  # pad only the final short batch
+            pad_data = np.zeros((batch_size, c, h, w), np.float32)
+            pad_data[:have] = data
+            pad_label = np.zeros((batch_size,), np.float32)
+            pad_label[:have] = batch_label
+            data, batch_label = pad_data, pad_label
+        return DataBatch([array(np.ascontiguousarray(data))],
+                         [array(batch_label)], batch_size - have)
 
     def reset(self):
         if self.shuffle and self.seq is not None:
@@ -428,6 +533,11 @@ class ImageIter(DataIter):
             self.imgrec.reset()
         if self._loader is not None:
             self._loader.reset()
+        if self._decode is not None:
+            if getattr(self, "_decode_fresh", False):
+                self._decode_fresh = False  # pool is already primed
+            else:
+                self._decode.reset()
         self.cur = 0
 
     def next_sample(self):
@@ -461,6 +571,8 @@ class ImageIter(DataIter):
         return header.label, img
 
     def next(self):
+        if self._decode is not None:
+            return self._next_native()
         batch_size = self.batch_size
         c, h, w = self.data_shape
         batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)

@@ -118,6 +118,29 @@ MXTPU_DLL void mxtpu_loader_free(void *h);
 
 MXTPU_DLL void mxtpu_buf_free(char *p);
 
+/* Parallel JPEG decode + augment pipeline over the threaded loader
+ * (reference iter_image_recordio_2.cc:104-112 OMP decode): n_workers
+ * threads decode (libjpeg, DCT-scaled), bilinear-resize (shorter edge =
+ * resize_shorter, 0 = only as needed to crop), crop out_h x out_w
+ * (random iff rand_crop, else center), mirror with p=0.5 iff
+ * rand_mirror.  Samples are uint8 HWC RGB.  Non-JPEG/corrupt records are
+ * skipped and counted. */
+MXTPU_DLL void *mxtpu_decode_loader_create(const char *path, int part_index,
+                                           int num_parts, int shuffle,
+                                           unsigned seed, int queue_size,
+                                           int shuffle_chunk, int n_workers,
+                                           int out_h, int out_w,
+                                           int resize_shorter, int rand_crop,
+                                           int rand_mirror);
+/* Fill data (max_n*out_h*out_w*3 bytes) + labels (max_n floats); returns
+ * #samples, 0 = epoch end. */
+MXTPU_DLL int mxtpu_decode_loader_next_batch(void *h, int max_n,
+                                             unsigned char *data,
+                                             float *labels);
+MXTPU_DLL long mxtpu_decode_loader_skipped(void *h);
+MXTPU_DLL void mxtpu_decode_loader_reset(void *h);
+MXTPU_DLL void mxtpu_decode_loader_free(void *h);
+
 /* ---------------- NDArray (host, C ABI) ----------------
  * Minimal NDArray subset for C/C++ frontends (reference c_api.h
  * MXNDArrayCreate/Free + data access; float32, host-resident — staging

@@ -1,0 +1,75 @@
+"""Measure the image input pipeline's decode throughput (native C++
+decode workers vs the Python/PIL path).
+
+Writes a synthetic JPEG RecordIO file and times full epochs through
+ImageIter at 224x224 with the standard train augs.  The native path's
+workers are set by MXTPU_DECODE_WORKERS (default: cores-1).
+
+    python tools/decode_bench.py [--n 1024] [--workers 1 2 4]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def write_rec(path, n, hw):
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,)).astype(np.uint8)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 1000), i, 0),
+                              mx.image.imencode(img, ".jpg", quality=90)))
+    w.close()
+
+
+def run_epoch(rec, batch=128):
+    import mxnet_tpu as mx
+
+    it = mx.image.ImageIter(batch_size=batch, data_shape=(3, 224, 224),
+                            path_imgrec=rec, rand_crop=True,
+                            rand_mirror=True, resize=256)
+    mode = "native" if it._decode is not None else "python"
+    t0 = time.perf_counter()
+    total = sum(b.data[0].shape[0] - b.pad for b in it)
+    dt = time.perf_counter() - t0
+    return mode, total, dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--hw", type=int, nargs=2, default=[480, 360],
+                    help="source image size (ImageNet-ish)")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--workers", type=int, nargs="*", default=None)
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="mxtpu_decode_bench_")
+    rec = os.path.join(tmp, "bench.rec")
+    write_rec(rec, args.n, tuple(args.hw))
+
+    for workers in (args.workers or [0]):
+        if workers:
+            os.environ["MXTPU_DECODE_WORKERS"] = str(workers)
+        mode, total, dt = run_epoch(rec, args.batch)
+        print("%s workers=%s: %d imgs in %.2fs = %.0f img/s"
+              % (mode, workers or "auto", total, dt, total / dt))
+
+    os.environ["MXTPU_NO_NATIVE_DECODE"] = "1"
+    mode, total, dt = run_epoch(rec, args.batch)
+    print("%s (PIL baseline): %d imgs in %.2fs = %.0f img/s"
+          % (mode, total, dt, total / dt))
+
+
+if __name__ == "__main__":
+    main()
