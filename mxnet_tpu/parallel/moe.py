@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_ffn", "init_moe_params", "router_top1"]
+__all__ = ["moe_ffn", "init_moe_params", "router_top1", "router_topk"]
 
 
 def router_top1(logits, capacity):
@@ -42,6 +42,50 @@ def router_top1(logits, capacity):
     return dispatch, combine, aux_loss
 
 
+def router_topk(logits, capacity, k=2):
+    """GShard top-k router (k=2 is the GShard paper's setting; k=1
+    reduces exactly to :func:`router_top1`'s assignment).
+
+    logits (T, E) → dispatch (T, E, C) multi-hot (up to k slots per
+    token), combine (T, E, C) gate-weighted with gates renormalized over
+    the k selected experts, aux load-balancing loss (scalar, computed
+    from the primary assignment as in GShard).  Buffer positions fill in
+    rank-major order: all rank-0 assignments land before any rank-1
+    assignment, each in token order; tokens past a full expert buffer are
+    dropped for that rank (standard capacity semantics)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    onehots, gates = [], []
+    masked = probs
+    for _ in range(k):
+        expert = jnp.argmax(masked, axis=-1)
+        onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
+        onehots.append(onehot)
+        gates.append(jnp.sum(probs * onehot, axis=-1))
+        masked = masked * (1.0 - onehot)
+    denom = sum(gates) + 1e-9
+    gates = [g / denom for g in gates]
+
+    dispatch = jnp.zeros((T, E, capacity), logits.dtype)
+    combine = jnp.zeros((T, E, capacity), logits.dtype)
+    filled = jnp.zeros((E,), logits.dtype)  # slots used by earlier ranks
+    for onehot, gate in zip(onehots, gates):
+        pos = jnp.cumsum(onehot, axis=0) - onehot + filled[None, :]  # (T,E)
+        filled = filled + jnp.sum(onehot, axis=0)
+        pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)     # (T,)
+        keep = (pos_t < capacity).astype(logits.dtype)
+        d = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
+            pos_t, capacity, dtype=logits.dtype)[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+    # GShard aux loss on the primary (rank-0) assignment
+    density = jnp.mean(onehots[0], axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+    return dispatch, combine, aux_loss
+
+
 def init_moe_params(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
     k1, k2, k3 = jax.random.split(rng, 3)
     s1 = (2.0 / d_model) ** 0.5
@@ -55,21 +99,29 @@ def init_moe_params(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
 
 
 def moe_ffn(params, x, *, capacity_factor=2.0, expert_axis="expert",
-            mesh=None):
+            mesh=None, top_k=1):
     """Expert-parallel FFN:  x (B, S, d) → (B, S, d), plus aux loss.
 
-    Inside jit over a mesh with an ``expert`` axis, the sharding constraints
-    below make GSPMD all-to-all the (E, C, d) expert buffers onto the expert
-    axis, run each expert's matmuls on its own devices, and all-to-all back.
-    Without a mesh (or without the axis) it's a plain dense MoE — same math,
-    no collectives, so unit tests can diff the two paths.
+    ``top_k=1`` routes Switch-style (:func:`router_top1`); ``top_k=2`` is
+    the GShard setting (:func:`router_topk`).  Inside jit over a mesh
+    with an ``expert`` axis, the sharding constraints below make GSPMD
+    all-to-all the (E, C, d) expert buffers onto the expert axis, run
+    each expert's matmuls on its own devices, and all-to-all back.
+    Without a mesh (or without the axis) it's a plain dense MoE — same
+    math, no collectives, so unit tests can diff the two paths.
     """
     B, S, d = x.shape
     E = params["w1"].shape[0]
     tokens = x.reshape(B * S, d)
-    capacity = max(int(capacity_factor * B * S / E), 1)
+    # GShard capacity scales with k: k assignments per token need k times
+    # the slot supply for the same headroom (capacity_factor keeps one
+    # meaning across top_k settings)
+    capacity = max(int(top_k * capacity_factor * B * S / E), 1)
     logits = tokens @ params["router"]
-    dispatch, combine, aux_loss = router_top1(logits, capacity)
+    if top_k == 1:
+        dispatch, combine, aux_loss = router_top1(logits, capacity)
+    else:
+        dispatch, combine, aux_loss = router_topk(logits, capacity, k=top_k)
     # (T,E,C) x (T,d) → expert buffers (E,C,d)
     buf = jnp.einsum("tec,td->ecd", dispatch, tokens)
     if mesh is not None and expert_axis in mesh.axis_names:

@@ -32,7 +32,8 @@ _CHECK_KW = ("check_vma" if "check_vma"
              in _inspect.signature(shard_map).parameters else "check_rep")
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_stage_params", "PipelinedTrainer"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b", "stack_stage_params",
+           "PipelinedTrainer"]
 
 
 def stack_stage_params(stage_params_list):
@@ -91,14 +92,142 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh: Mesh,
         acc = lax.psum(jnp.where(stage_idx == S - 1, acc, 0.0), axis)
         return acc.reshape(B, *x.shape[1:])
 
-    pspec = jax.tree_util.tree_map(
-        lambda _: P(axis), stacked_params,
-        is_leaf=lambda l: isinstance(l, jnp.ndarray))
+    pspec = _stage_pspec(stacked_params, axis)
     in_specs = (pspec, P())
     # other mesh axes (e.g. data) stay unmapped: this helper owns only pipe
     return shard_map(
         per_device, mesh=mesh, in_specs=in_specs, out_specs=P(),
         **{_CHECK_KW: False})(stacked_params, x)
+
+
+def _takes_stage_idx(stage_fn):
+    """True iff stage_fn's third POSITIONAL, NO-DEFAULT parameter exists —
+    the opt-in signature ``stage_fn(params, x, stage_idx)``.  Parameters
+    with defaults / keyword-only / *args do NOT opt in (a traced int
+    landing in e.g. ``train=True`` would silently change behavior)."""
+    try:
+        sig = _inspect.signature(stage_fn)
+    except (TypeError, ValueError):
+        return False
+    positional = [p for p in sig.parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(positional) >= 3 and positional[2].default is _inspect.Parameter.empty
+
+
+def _stage_call(stage_fn, params, x, stage_idx):
+    """Invoke stage_fn, passing stage_idx iff its signature opts in —
+    heterogeneous pipelines condition behavior on the stage index (the
+    SPMD-compatible form of non-homogeneous stages: one program, uniform
+    param container, per-stage routing inside)."""
+    if _takes_stage_idx(stage_fn):
+        return stage_fn(params, x, stage_idx)
+    return stage_fn(params, x)
+
+
+def _stage_pspec(stacked_params, axis):
+    """PartitionSpec tree sharding the leading stage axis over ``axis``."""
+    return jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params,
+        is_leaf=lambda l: isinstance(l, jnp.ndarray))
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
+                        mesh: Mesh, n_microbatch: int, axis: str = "pipe"):
+    """One training step with the **1F1B schedule** (PipeDream-flush):
+    returns ``(mean_loss, grads)`` where grads matches ``stacked_params``.
+
+    Differences vs differentiating :func:`pipeline_apply` (GPipe):
+
+    * **Bounded activation memory.**  Stage ``s`` holds at most
+      ``2*(S-s)-1`` live microbatch inputs (≤ 2S), independent of the
+      microbatch count M — GPipe's scan residuals grow with M.  Backward
+      recomputes the stage forward from the saved INPUT (the standard TPU
+      remat tradeoff: ~1 extra stage-forward per microbatch).
+    * **Explicit schedule.**  Tick ``t``: stage ``s`` forwards microbatch
+      ``t - s`` and backwards microbatch ``t - (2S-1-s)`` (each when in
+      range), so steady state interleaves one-forward-one-backward.
+      Total ticks = M + 2S - 1.
+    * **Heterogeneous stages** via an optional third ``stage_idx`` arg to
+      ``stage_fn`` (embedding/head behavior per stage); activations must
+      keep one shape (ring rotation), parameters one stacked container —
+      the SPMD form of non-homogeneity.
+
+    ``loss_fn(y_mb, target_mb) -> scalar`` is applied at the last stage;
+    its mean over microbatches is returned.
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    assert B % n_microbatch == 0, "batch must divide into microbatches"
+    M = n_microbatch
+    mb = B // M
+    n_ticks = M + 2 * S - 1
+    window = 2 * S  # ring slots for saved inputs; live span < window
+
+    def per_device(params, xs, tgt):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        s_idx = lax.axis_index(axis)
+        xs = xs.reshape(M, mb, *xs.shape[1:])
+        tgt = tgt.reshape(M, mb, *tgt.shape[1:])
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+        last = s_idx == S - 1
+
+        def tick(carry, t):
+            act_in, grad_in, saved, gacc, loss_acc = carry
+
+            # ---------- forward lane: microbatch t - s ----------
+            m_f = t - s_idx
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            m_f = jnp.clip(m_f, 0, M - 1)
+            x_in = jnp.where(s_idx == 0, xs[m_f], act_in)
+            y = _stage_call(stage_fn, params, x_in, s_idx)
+            slot_f = m_f % window
+            saved = saved.at[slot_f].set(
+                jnp.where(fwd_valid, x_in, saved[slot_f]))
+
+            # ---------- backward lane: microbatch t - (2S-1-s) --------
+            m_b = t - (2 * S - 1 - s_idx)
+            bwd_valid = (m_b >= 0) & (m_b < M)
+            m_b = jnp.clip(m_b, 0, M - 1)
+            x_saved = saved[m_b % window]
+            # recompute the stage forward from the saved input; the last
+            # stage seeds the chain with the loss gradient of its output
+            y_re, vjp = jax.vjp(
+                lambda p, xi: _stage_call(stage_fn, p, xi, s_idx),
+                params, x_saved)
+            mb_loss, g_seed = jax.value_and_grad(
+                lambda yy: loss_fn(yy, tgt[m_b]))(y_re)
+            g_eff = jnp.where(last, g_seed, grad_in)
+            dp, dx = vjp(g_eff)
+            # where (not multiply): warm-up/cool-down recomputes run on
+            # garbage inputs whose grads may be NaN, and 0*NaN = NaN
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(bwd_valid, g,
+                                           jnp.zeros_like(g)), gacc, dp)
+            loss_acc = loss_acc + jnp.where(bwd_valid & last, mb_loss, 0.0)
+
+            # ---------- ring rotations ----------
+            act_out = lax.ppermute(y, axis, fwd_perm)
+            grad_out = lax.ppermute(dx, axis, bwd_perm)
+            return (act_out, grad_out, saved, gacc, loss_acc), None
+
+        zeros_mb = jnp.zeros((mb,) + xs.shape[2:], x.dtype)
+        init = (zeros_mb, zeros_mb,
+                jnp.zeros((window, mb) + xs.shape[2:], x.dtype),
+                jax.tree_util.tree_map(jnp.zeros_like, params),
+                jnp.zeros((), jnp.float32))
+        (_, _, _, gacc, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks))
+        loss = lax.psum(loss_acc, axis) / M
+        # grads of mean-over-microbatches loss: accumulated per-mb grads / M;
+        # re-add the stage axis so out_specs P(axis) rebuilds the stack
+        return loss, jax.tree_util.tree_map(lambda g: g[None] / M, gacc)
+
+    pspec = _stage_pspec(stacked_params, axis)
+    return shard_map(
+        per_device, mesh=mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec), **{_CHECK_KW: False})(
+            stacked_params, x, target)
 
 
 class PipelinedTrainer:

@@ -275,3 +275,183 @@ def test_moe_symbol_op_sharded():
          "w2": jnp.asarray(w2)}, jnp.asarray(x))
     np.testing.assert_allclose(out_op[0].asnumpy(), np.asarray(fn_out),
                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------- 1F1B schedule (round 3) ----------------
+
+def _mse(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def test_1f1b_matches_direct_grads():
+    """pipeline_train_1f1b's (loss, grads) must equal directly
+    differentiating the sequential composition with the same
+    per-microbatch loss mean."""
+    mesh = _pipe_mesh(4)
+    rng = jax.random.PRNGKey(3)
+    d, B, M = 4, 8, 4
+    stages = _make_stages(rng, 4, d)
+    x = jax.random.normal(rng, (B, d))
+    target = jax.random.normal(jax.random.fold_in(rng, 11), (B, d))
+    stacked = pipeline.stack_stage_params(stages)
+
+    def direct(p):
+        mbs = x.reshape(M, B // M, d)
+        tgts = target.reshape(M, B // M, d)
+        total = 0.0
+        for i in range(M):
+            y = mbs[i]
+            for s in range(4):
+                y = _stage_fn(jax.tree_util.tree_map(lambda a: a[s], p), y)
+            total = total + _mse(y, tgts[i])
+        return total / M
+
+    want_loss, want_grads = jax.value_and_grad(direct)(stacked)
+    got_loss, got_grads = pipeline.pipeline_train_1f1b(
+        _stage_fn, _mse, stacked, x, target, mesh=mesh, n_microbatch=M)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=1e-5)
+    for wl, gl in zip(jax.tree_util.tree_leaves(want_grads),
+                      jax.tree_util.tree_leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_matches_gpipe_path():
+    """Same gradients as differentiating the GPipe pipeline_apply — the
+    two schedules are numerically interchangeable."""
+    mesh = _pipe_mesh(4)
+    rng = jax.random.PRNGKey(4)
+    d, B, M = 4, 12, 6
+    stages = _make_stages(rng, 4, d)
+    x = jax.random.normal(rng, (B, d))
+    target = jax.random.normal(jax.random.fold_in(rng, 13), (B, d))
+    stacked = pipeline.stack_stage_params(stages)
+
+    def gpipe_loss(p):
+        y = pipeline.pipeline_apply(_stage_fn, p, x, mesh=mesh,
+                                    n_microbatch=M)
+        # same per-microbatch loss mean as the 1F1B schedule applies
+        yy = y.reshape(M, B // M, d)
+        tt = target.reshape(M, B // M, d)
+        return jnp.mean(jax.vmap(_mse)(yy, tt))
+
+    want_loss, want_grads = jax.value_and_grad(gpipe_loss)(stacked)
+    got_loss, got_grads = pipeline.pipeline_train_1f1b(
+        _stage_fn, _mse, stacked, x, target, mesh=mesh, n_microbatch=M)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=1e-5)
+    for wl, gl in zip(jax.tree_util.tree_leaves(want_grads),
+                      jax.tree_util.tree_leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_heterogeneous_stages():
+    """stage_idx-conditioned behavior (the SPMD form of non-homogeneous
+    stages): first stage scales, last stage shifts; parity vs direct."""
+    mesh = _pipe_mesh(4)
+    rng = jax.random.PRNGKey(5)
+    d, B, M = 4, 8, 4
+    stages = _make_stages(rng, 4, d)
+    x = jax.random.normal(rng, (B, d))
+    target = jax.random.normal(jax.random.fold_in(rng, 17), (B, d))
+    stacked = pipeline.stack_stage_params(stages)
+
+    def het_stage(params, xin, stage_idx):
+        y = jnp.tanh(xin @ params["w"] + params["b"])
+        y = jnp.where(stage_idx == 0, 2.0 * y, y)     # "embed" stage
+        return jnp.where(stage_idx == 3, y + 1.0, y)  # "head" stage
+
+    def direct(p):
+        mbs = x.reshape(M, B // M, d)
+        tgts = target.reshape(M, B // M, d)
+        total = 0.0
+        for i in range(M):
+            y = mbs[i]
+            for s in range(4):
+                y = het_stage(jax.tree_util.tree_map(lambda a: a[s], p),
+                              y, jnp.int32(s))
+            total = total + _mse(y, tgts[i])
+        return total / M
+
+    want_loss, want_grads = jax.value_and_grad(direct)(stacked)
+    got_loss, got_grads = pipeline.pipeline_train_1f1b(
+        het_stage, _mse, stacked, x, target, mesh=mesh, n_microbatch=M)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=1e-5)
+    for wl, gl in zip(jax.tree_util.tree_leaves(want_grads),
+                      jax.tree_util.tree_leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ---------------- top-k routing (round 3) ----------------
+
+def test_router_topk_k1_matches_top1():
+    rng = jax.random.PRNGKey(6)
+    logits = jax.random.normal(rng, (24, 4))
+    d1, c1, a1 = moe.router_top1(logits, capacity=8)
+    dk, ck, ak = moe.router_topk(logits, capacity=8, k=1)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(dk), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(ak), rtol=1e-6)
+    # k=1 gates renormalize to 1.0 at the chosen slot, top1's carry probs
+    np.testing.assert_allclose(np.asarray(jnp.sum(ck, axis=(1, 2))),
+                               np.ones(24), rtol=1e-5)
+
+
+def test_router_top2_properties():
+    rng = jax.random.PRNGKey(7)
+    T, E, C = 32, 4, 32  # capacity = T: drops impossible at any skew
+    logits = jax.random.normal(rng, (T, E))
+    dispatch, combine, aux = moe.router_topk(logits, capacity=C, k=2)
+    d = np.asarray(dispatch)
+    # every token lands exactly 2 slots, in 2 DIFFERENT experts
+    np.testing.assert_allclose(d.sum(axis=(1, 2)), 2.0)
+    assert (d.sum(axis=2) <= 1.0 + 1e-6).all()
+    # each expert buffer slot holds at most one token
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+    # gates renormalized over the two picks
+    np.testing.assert_allclose(np.asarray(combine).sum(axis=(1, 2)),
+                               np.ones(T), rtol=1e-5)
+    # the two picks are the true top-2 experts by probability
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    want = np.sort(np.argsort(-probs, axis=1)[:, :2], axis=1)
+    got = np.sort(np.argwhere(d.sum(axis=2) > 0.5)[:, 1].reshape(T, 2),
+                  axis=1)
+    np.testing.assert_array_equal(got, want)
+    assert float(aux) > 0
+
+
+def test_router_top2_capacity_drops():
+    # all tokens prefer expert 0: only `capacity` rank-0 assignments stay
+    logits = jnp.tile(jnp.array([[4.0, 2.0, 0.0, -2.0]]), (10, 1))
+    dispatch, _, _ = moe.router_topk(logits, capacity=3, k=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 3.0  # expert 0 full at capacity
+    assert d[:, 1].sum() == 3.0  # second choice fills expert 1 likewise
+    # dropped tokens simply lose that slot
+    assert d.sum() == 6.0
+
+
+def test_moe_ffn_top2_mesh_matches_dense():
+    devs = jax.devices()[:4]
+    if len(devs) < 4:
+        pytest.skip("need 4 devices")
+    mesh = Mesh(np.array(devs), ("expert",))
+    rng = jax.random.PRNGKey(8)
+    params = moe.init_moe_params(rng, d_model=8, d_hidden=16, num_experts=4)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 16, 8))
+
+    dense_out, dense_aux = moe.moe_ffn(params, x, top_k=2)
+
+    @jax.jit
+    def sharded(p, xx):
+        return moe.moe_ffn(p, xx, mesh=mesh, top_k=2)
+
+    with mesh:
+        out, aux = sharded(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_out),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(aux), np.asarray(dense_aux),
+                               rtol=1e-5)
