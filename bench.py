@@ -18,6 +18,88 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 181.53  # ResNet-50 train, batch 32, 1x P100
+# bf16 peak of one TPU v5e chip; override via BENCH_PEAK_TFLOPS for other
+# accelerators (used only for the MFU diagnostic, not the headline metric)
+PEAK_TFLOPS_V5E = 197.0
+
+
+def _sync_leaf(tree):
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return np.asarray(jax.numpy.ravel(leaf)[0])
+
+
+def transformer_main():
+    """Transformer-LM training throughput (the Pallas flash-attention
+    path) + MFU.  Select with BENCH_MODEL=transformer; prints the same
+    one-line JSON contract."""
+    import time
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "2048" if on_tpu else "128"))
+    d_model = int(os.environ.get("BENCH_DMODEL", "1024" if on_tpu else "64"))
+    layers = int(os.environ.get("BENCH_LAYERS", "12" if on_tpu else "2"))
+    heads = d_model // 64
+    vocab = 32000 if on_tpu else 256
+    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "3"))
+
+    sym = transformer.get_symbol(
+        num_classes=vocab, seq_len=seq, num_embed=d_model,
+        num_heads=heads, num_layers=layers, dtype="bfloat16" if on_tpu
+        else "float32")
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
+    tr = ShardedTrainer(
+        sym, mesh, data_shapes={"data": (batch, seq)},
+        label_shapes={"softmax_label": (batch, seq)},
+        type_dict={"data": "int32"}, learning_rate=1e-3, momentum=0.9,
+        rescale_grad=1.0 / (batch * seq))
+    params, moms, aux = tr.init(seed=0)
+    rng = np.random.RandomState(0)
+    arrays = tr.place_batch({
+        "data": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "softmax_label": rng.randint(0, vocab, (batch, seq))
+        .astype(np.float32),
+    })
+    step = tr.step_fn()
+    key = jax.random.PRNGKey(0)
+
+    outs, params, moms, aux = step(params, moms, aux, arrays, key)
+    _sync_leaf(outs)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        outs, params, moms, aux = step(params, moms, aux, arrays, key)
+    _sync_leaf(outs)
+    dt = time.perf_counter() - t0
+
+    tokens_s = batch * seq * steps / dt
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # PaLM-appendix accounting: train FLOPs/token = 6N + 12*L*T*d_model
+    # (the attention quadratic term), N = parameter count
+    flops_per_token = 6.0 * n_params + 12.0 * layers * seq * d_model
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                PEAK_TFLOPS_V5E)) * 1e12
+    mfu = tokens_s * flops_per_token / peak
+    print(json.dumps({
+        "metric": "transformer_lm_train_throughput" if on_tpu
+                  else "transformer_lm_cpu_smoke_throughput",
+        "value": round(tokens_s, 1), "unit": "tokens/s",
+        "vs_baseline": 0.0,  # the 2017 reference has no transformer
+        "mfu": round(mfu, 4), "n_params": n_params,
+        "config": {"batch": batch, "seq": seq, "d_model": d_model,
+                   "layers": layers},
+    }))
 
 
 def main():
@@ -26,6 +108,10 @@ def main():
     from jax.sharding import Mesh
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    if os.environ.get("BENCH_MODEL") == "transformer":
+        transformer_main()
+        return
 
     platform = jax.devices()[0].platform
     batch = int(os.environ.get("BENCH_BATCH", "128" if platform == "tpu" else "8"))

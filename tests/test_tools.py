@@ -73,3 +73,19 @@ def test_bench_table_render_rules():
     trow = [l for l in out.splitlines()
             if l.startswith("| resnet-50 | 32 | bfloat16")][0]
     assert "fail" in trow
+
+
+def test_bench_table_render_transformer_row():
+    import tools.bench_table as bt
+
+    lm = {"metric": "transformer_lm_train_throughput", "value": 25000.0,
+          "unit": "tokens/s", "mfu": 0.42, "n_params": 151000000,
+          "config": {"batch": 8, "seq": 2048, "d_model": 1024,
+                     "layers": 12}}
+    out = bt.render([], [], "TestChip", lm_row=lm)
+    assert "Transformer LM training" in out
+    assert "| 12L d1024 (151M params, Pallas flash attention) "
+    assert "| 8 | 2048 | 25000 | 42.0% |" in out
+    # absent/failed row: section omitted, table still renders
+    out2 = bt.render([], [], "TestChip", lm_row={"error": "boom"})
+    assert "Transformer LM" not in out2
