@@ -242,11 +242,21 @@ def _probe_accelerator(timeout_s):
     return "error", "probe rc=%s" % rc
 
 
+def _metric_names():
+    """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_MODEL") == "transformer":
+        return ("transformer_lm_train_throughput",
+                "transformer_lm_cpu_smoke_throughput", "tokens/s")
+    return ("resnet50_train_throughput", "resnet8_cpu_smoke_throughput",
+            "img/s")
+
+
 def _emit_tunnel_down(reason):
     verified = _last_driver_verified()
+    metric, _, unit = _metric_names()
     print(json.dumps({
-        "metric": "resnet50_train_throughput", "value": 0.0,
-        "unit": "img/s", "vs_baseline": 0.0,
+        "metric": metric, "value": 0.0,
+        "unit": unit, "vs_baseline": 0.0,
         "tunnel_down": True,
         "last_driver_verified": verified,
         "last_driver_verified_vs_baseline": round(
@@ -307,11 +317,11 @@ def _guarded_main():
             detail = err[-1] if err else "rc=%d" % rc
     except Exception as exc:  # spawn failure etc. — still emit a line
         detail = repr(exc)
-    metric = ("resnet8_cpu_smoke_throughput" if on_cpu
-              else "resnet50_train_throughput")
+    tpu_metric, cpu_metric, unit = _metric_names()
     print(json.dumps({
-        "metric": metric, "value": 0.0, "unit": "img/s",
-        "vs_baseline": 0.0, "error": (detail or "unknown")[:300],
+        "metric": cpu_metric if on_cpu else tpu_metric, "value": 0.0,
+        "unit": unit, "vs_baseline": 0.0,
+        "error": (detail or "unknown")[:300],
     }))
 
 

@@ -84,8 +84,11 @@ def test_bench_table_render_transformer_row():
                      "layers": 12}}
     out = bt.render([], [], "TestChip", lm_row=lm)
     assert "Transformer LM training" in out
-    assert "| 12L d1024 (151M params, Pallas flash attention) "
+    assert "| 12L d1024 (151M params, Pallas flash attention) " in out
     assert "| 8 | 2048 | 25000 | 42.0% |" in out
     # absent/failed row: section omitted, table still renders
     out2 = bt.render([], [], "TestChip", lm_row={"error": "boom"})
     assert "Transformer LM" not in out2
+    # a silent CPU fallback must NOT pose as a TPU capture
+    cpu = dict(lm, metric="transformer_lm_cpu_smoke_throughput")
+    assert "Transformer LM" not in bt.render([], [], "TestChip", lm_row=cpu)
