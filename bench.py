@@ -252,18 +252,20 @@ def _metric_names():
 
 
 def _emit_tunnel_down(reason):
-    verified = _last_driver_verified()
     metric, _, unit = _metric_names()
-    print(json.dumps({
+    row = {
         "metric": metric, "value": 0.0,
         "unit": unit, "vs_baseline": 0.0,
         "tunnel_down": True,
-        "last_driver_verified": verified,
-        "last_driver_verified_vs_baseline": round(
-            verified / BASELINE_IMG_S, 3),
         "error": "accelerator unreachable (%s); not a perf regression"
                  % reason,
-    }))
+    }
+    if unit == "img/s":  # the driver-verified record is a ResNet capture
+        verified = _last_driver_verified()
+        row["last_driver_verified"] = verified
+        row["last_driver_verified_vs_baseline"] = round(
+            verified / BASELINE_IMG_S, 3)
+    print(json.dumps(row))
 
 
 def _guarded_main():

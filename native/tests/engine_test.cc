@@ -11,7 +11,9 @@
 #include <cassert>
 #include <cstdio>
 #include <cstdlib>
+#include <chrono>
 #include <random>
+#include <thread>
 #include <vector>
 
 #include "mxtpu/c_api.h"
@@ -87,6 +89,57 @@ int main() {
   for (auto v : vars) mxtpu_wait_for_var(v);
   for (auto v : vars) mxtpu_var_delete(v);
   mxtpu_wait_all();
+
+  // concurrent WaitForVar + delete-while-pending — the reference's
+  // trickiest path (threaded_engine.cc CompleteWriteDependency: the var
+  // must stay alive until every queued request, including waiters pushed
+  // before the delete, has drained; only then may it free).
+  // NaiveEngine runs ops inline on the pushing thread: the spinning gate
+  // op would deadlock, and the concurrency being tested doesn't exist.
+  for (int round = 0; mxtpu_engine_type() == 0 && round < 25; ++round) {
+    MXTPUVarHandle v = mxtpu_var_new();
+    std::atomic<int> gate{0}, chain_run{0};
+    struct GateParam {
+      std::atomic<int> *gate, *run;
+    };
+    auto gate_fn = +[](void *p) {
+      auto *gp = static_cast<GateParam *>(p);
+      while (gp->gate->load() == 0) {
+      }  // hold the queue open until the main thread releases
+      gp->run->fetch_add(1);
+    };
+    auto bump_fn = +[](void *p) {
+      static_cast<GateParam *>(p)->run->fetch_add(1);
+    };
+    auto del_fn = +[](void *p) { delete static_cast<GateParam *>(p); };
+    mxtpu_push(gate_fn, new GateParam{&gate, &chain_run}, del_fn, nullptr,
+               0, &v, 1, 0, 0, "gate");
+    for (int i = 0; i < 7; ++i)
+      mxtpu_push(bump_fn, new GateParam{&gate, &chain_run}, del_fn, nullptr,
+                 0, &v, 1, 0, 0, "chain");
+    // waiters enqueue read requests behind the (blocked) writer chain
+    std::vector<std::thread> waiters;
+    for (int i = 0; i < 4; ++i)
+      waiters.emplace_back([v] { mxtpu_wait_for_var(v); });
+    // delete lands while 8 writers + 4 waiters are pending
+    mxtpu_var_delete(v);
+    // deterministic, not sleep-based: while the gate op spins NOTHING can
+    // drain, so pending == 8 chain + 1 delete + 4 waiter ops exactly when
+    // every waiter's request is queued — only then release the gate (a
+    // straggler pushing after the drain would touch a freed var)
+    while (mxtpu_engine_pending() < 13) {
+      std::this_thread::yield();
+    }
+    gate.store(1);
+    for (auto &t : waiters) t.join();
+    mxtpu_wait_all();
+    if (chain_run.load() != 8) {
+      std::fprintf(stderr,
+                   "round %d: chain ran %d/8 ops after delete-while-"
+                   "pending\n", round, chain_run.load());
+      return 1;
+    }
+  }
 
   // storage pool reuse (reference tests/cpp/storage_test.cc tier)
   void *p1 = mxtpu_storage_alloc(1 << 16);
