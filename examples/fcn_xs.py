@@ -1,0 +1,127 @@
+"""Fully convolutional segmentation (parity: reference
+``example/fcn-xs/`` — FCN-32s/16s/8s: a conv backbone, 1x1 score heads,
+Deconvolution upsampling, Crop to input size, skip-connection fusion,
+and per-pixel multi-class softmax).
+
+Synthetic scenes (no-egress fallback): images containing axis-aligned
+bright squares and dark disks on a noisy background; 3 pixel classes
+(background / square / disk).  The gate scores mean pixel accuracy and
+foreground IoU — the skip-fused "16s-style" head must out-resolve the
+coarse "32s-style" one... at this miniature scale we assert absolute
+quality instead: pixel accuracy and IoU bars.
+
+    python examples/fcn_xs.py
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+HW = 32
+CLASSES = 3
+
+
+def make_data(rng, n):
+    xs = rng.normal(0.0, 0.08, (n, 1, HW, HW)).astype(np.float32)
+    ys = np.zeros((n, HW, HW), np.float32)
+    yy, xx = np.mgrid[0:HW, 0:HW]
+    for i in range(n):
+        for _ in range(2):  # two squares
+            r, c = rng.randint(2, HW - 10, 2)
+            s = rng.randint(5, 9)
+            xs[i, 0, r:r + s, c:c + s] += 0.8
+            ys[i, r:r + s, c:c + s] = 1
+        for _ in range(2):  # two disks
+            r, c = rng.randint(8, HW - 8, 2)
+            rad = rng.randint(3, 6)
+            mask = (yy - r) ** 2 + (xx - c) ** 2 <= rad ** 2
+            xs[i, 0][mask] -= 0.8
+            ys[i][mask] = 2
+    return xs, ys
+
+
+def get_symbol():
+    data = mx.sym.Variable("data")
+    # backbone: two pooling stages (the /4 analog of VGG's /32)
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        data, num_filter=12, kernel=(3, 3), pad=(1, 1), name="c1"),
+        act_type="relu")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Activation(mx.sym.Convolution(
+        p1, num_filter=24, kernel=(3, 3), pad=(1, 1), name="c2"),
+        act_type="relu")
+    p2 = mx.sym.Pooling(c2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c3 = mx.sym.Activation(mx.sym.Convolution(
+        p2, num_filter=32, kernel=(3, 3), pad=(1, 1), name="c3"),
+        act_type="relu")
+
+    # coarse score head at /4, upsampled x4 (the "32s" path)
+    score4 = mx.sym.Convolution(c3, num_filter=CLASSES, kernel=(1, 1),
+                                name="score4")
+    up4 = mx.sym.Deconvolution(score4, kernel=(8, 8), stride=(4, 4),
+                               pad=(2, 2), num_filter=CLASSES,
+                               name="up4")
+    # skip fusion: /2 features scored and upsampled x2, then summed
+    # (the FCN-16s recipe: fuse a finer stride's scores)
+    score2 = mx.sym.Convolution(p1, num_filter=CLASSES, kernel=(1, 1),
+                                name="score2")
+    up2 = mx.sym.Deconvolution(score2, kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=CLASSES, name="up2")
+    fused = mx.sym.Crop(up4, up2) + up2
+    # per-pixel softmax over the class channel
+    return mx.sym.SoftmaxOutput(fused, multi_output=True, name="softmax")
+
+
+def run(epochs=8, batch=8, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    xs, ys = make_data(rng, 160)
+    xv, yv = make_data(rng, 40)
+
+    mod = mx.mod.Module(get_symbol(), context=mx.cpu())
+    it = mx.io.NDArrayIter(xs, ys, batch_size=batch, shuffle=True, seed=3)
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.initializer.Xavier())
+
+    mod_p = mx.mod.Module(get_symbol(), context=mx.cpu())
+    mod_p.bind(data_shapes=[("data", (len(xv), 1, HW, HW))],
+               for_training=False)
+    mod_p.set_params(*mod.get_params())
+    from mxnet_tpu.io import DataBatch
+
+    mod_p.forward(DataBatch([mx.nd.array(xv)], None))
+    pred = mod_p.get_outputs()[0].asnumpy().argmax(axis=1)  # (n, HW, HW)
+
+    pix_acc = float((pred == yv).mean())
+    ious = []
+    for c in range(1, CLASSES):
+        inter = ((pred == c) & (yv == c)).sum()
+        union = ((pred == c) | (yv == c)).sum()
+        ious.append(inter / max(union, 1))
+    miou = float(np.mean(ious))
+    if log:
+        logging.info("pixel acc=%.3f, fg mIoU=%.3f", pix_acc, miou)
+    return {"pix_acc": pix_acc, "fg_miou": miou}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+    stats = run(epochs=args.epochs)
+    print("fcn_xs: pix_acc=%.3f fg_mIoU=%.3f"
+          % (stats["pix_acc"], stats["fg_miou"]))
+
+
+if __name__ == "__main__":
+    main()

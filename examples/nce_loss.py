@@ -1,0 +1,112 @@
+"""Noise-contrastive estimation (parity: reference ``example/nce-loss/``
+— train a next-token model scoring only k noise samples per step instead
+of a full-vocab softmax).
+
+TPU-first formulation: the sampled-candidate scores are one batched
+embedding gather + dot product (static shapes: k negatives per
+positive), and the binary NCE objective is built from graph ops — no
+custom C++ op as in the reference.  Evaluation ranks the FULL vocabulary
+with the trained embeddings, proving the sampled objective learned the
+same structure the softmax would.
+
+    python examples/nce_loss.py [--steps 400]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+VOCAB = 120
+DIM = 24
+K_NOISE = 8
+# deterministic bigram language: token t is followed by (t*7+3) % VOCAB
+def _next_tok(t):
+    return (t * 7 + 3) % VOCAB
+
+
+def make_batch(rng, batch):
+    ctx_tok = rng.randint(0, VOCAB, batch)
+    pos = np.array([_next_tok(t) for t in ctx_tok])
+    noise = rng.randint(0, VOCAB, (batch, K_NOISE))
+    return (ctx_tok.astype(np.float32), pos.astype(np.float32),
+            noise.astype(np.float32))
+
+
+def get_symbol():
+    ctx_tok = mx.sym.Variable("data")             # (B,)
+    cand = mx.sym.Variable("cand")                # (B, 1+K) pos first
+    label = mx.sym.Variable("softmax_label")      # (B, 1+K) 1/0 targets
+    in_emb = mx.sym.Embedding(ctx_tok, input_dim=VOCAB, output_dim=DIM,
+                              name="in_embed")       # (B, DIM)
+    out_emb = mx.sym.Embedding(cand, input_dim=VOCAB, output_dim=DIM,
+                               name="out_embed")     # (B, 1+K, DIM)
+    # score each candidate against the context vector: batched dot
+    scores = mx.sym.batch_dot(out_emb, mx.sym.Reshape(in_emb,
+                                                      shape=(-1, DIM, 1)))
+    scores = mx.sym.Reshape(scores, shape=(-1, 1 + K_NOISE))
+    # binary NCE loss: -[y log σ(s) + (1-y) log σ(-s)]
+    return mx.sym.LogisticRegressionOutput(scores, label, name="nce")
+
+
+def full_vocab_rank(mod, batch):
+    """Rank every vocab token as continuation of each context; return
+    mean reciprocal rank of the true next token."""
+    in_w = mod.get_params()[0]["in_embed_weight"].asnumpy()
+    out_w = mod.get_params()[0]["out_embed_weight"].asnumpy()
+    ctx_tok = np.arange(VOCAB)
+    scores = in_w[ctx_tok] @ out_w.T                 # (VOCAB, VOCAB)
+    truth = np.array([_next_tok(t) for t in ctx_tok])
+    order = np.argsort(-scores, axis=1)
+    ranks = np.array([np.where(order[i] == truth[i])[0][0] + 1
+                      for i in range(VOCAB)])
+    return float(np.mean(1.0 / ranks))
+
+
+def run(steps=400, batch=64, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    mod = mx.mod.Module(get_symbol(), context=mx.cpu(),
+                        data_names=("data", "cand"))
+    mod.bind(data_shapes=[("data", (batch,)),
+                          ("cand", (batch, 1 + K_NOISE))],
+             label_shapes=[("softmax_label", (batch, 1 + K_NOISE))])
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 5e-3})
+    labels = np.zeros((batch, 1 + K_NOISE), np.float32)
+    labels[:, 0] = 1.0
+    from mxnet_tpu.io import DataBatch
+
+    for i in range(steps):
+        ctx_tok, pos, noise = make_batch(rng, batch)
+        cand = np.concatenate([pos[:, None], noise], axis=1)
+        mod.forward(DataBatch([mx.nd.array(ctx_tok), mx.nd.array(cand)],
+                              [mx.nd.array(labels)]), is_train=True)
+        mod.backward()
+        mod.update()
+        if log and (i + 1) % 100 == 0:
+            logging.info("step %d: mrr=%.3f", i + 1,
+                         full_vocab_rank(mod, batch))
+    return {"mrr": full_vocab_rank(mod, batch)}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+    stats = run(steps=args.steps)
+    print("nce_loss: full-vocab MRR=%.3f (random would be ~%.3f)"
+          % (stats["mrr"], np.log(VOCAB) / VOCAB))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Neural style transfer (parity: reference ``example/neural-style/`` —
+optimize the INPUT image so shallow-layer Gram matrices match a style
+image while deeper features match a content image; the reference drives
+a pretrained VGG through an executor with ``inputs_need_grad``).
+
+No-egress fallback: a fixed-weight random conv pyramid replaces VGG
+(style transfer needs only a translation-covariant feature extractor —
+random shallow convs carry texture statistics well), and the
+style/content images are synthetic textures.  The mechanics are
+identical: gradients flow to the DATA, not the params.
+
+    python examples/neural_style.py [--steps 60]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+HW = 32
+
+
+def make_style(rng):
+    """Diagonal stripe texture."""
+    yy, xx = np.mgrid[0:HW, 0:HW]
+    img = 0.5 + 0.5 * np.sin(0.9 * (xx + yy))
+    return (img + 0.02 * rng.randn(HW, HW)).astype(np.float32)[None, None]
+
+
+def make_content(rng):
+    """A bright centered square."""
+    img = np.full((HW, HW), 0.2, np.float32)
+    img[10:22, 10:22] = 0.9
+    return (img + 0.02 * rng.randn(HW, HW)).astype(np.float32)[None, None]
+
+
+def feature_symbol():
+    """Two-level conv feature pyramid; Gram of level 1 = style statistic,
+    level 2 activations = content statistic."""
+    data = mx.sym.Variable("data")
+    f1 = mx.sym.Activation(mx.sym.Convolution(
+        data, num_filter=8, kernel=(3, 3), pad=(1, 1), name="f1"),
+        act_type="relu")
+    f2 = mx.sym.Activation(mx.sym.Convolution(
+        f1, num_filter=16, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+        name="f2"), act_type="relu")
+    return mx.sym.Group([f1, f2])
+
+
+def _bind_extractor():
+    mod = mx.mod.Module(feature_symbol(), context=mx.cpu(),
+                        label_names=())
+    mod.bind(data_shapes=[("data", (1, 1, HW, HW))], for_training=True,
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=2.0))
+    return mod
+
+
+def _gram(f):
+    c = f.shape[1]
+    flat = f.reshape(c, -1)
+    return flat @ flat.T / flat.shape[1]
+
+
+def run(steps=100, style_weight=10.0, lr=1.0, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    mod = _bind_extractor()
+    from mxnet_tpu.io import DataBatch
+
+    def features(img):
+        mod.forward(DataBatch([mx.nd.array(img)], None), is_train=True)
+        return [o.asnumpy() for o in mod.get_outputs()]
+
+    style_f1 = _gram(features(make_style(rng))[0])
+    content_f2 = features(make_content(rng))[1]
+
+    img = rng.uniform(0.3, 0.7, (1, 1, HW, HW)).astype(np.float32)
+    losses = []
+    for i in range(steps):
+        f1, f2 = features(img)
+        g1 = _gram(f1)
+        # d/dF of ||G - G*||^2 where G = F F^T / n: both product terms
+        # contribute (G symmetric), so 4 (G - G*) F / n
+        c1 = f1.shape[1]
+        flat1 = f1.reshape(c1, -1)
+        dgram = 4.0 * (g1 - style_f1) @ flat1 / flat1.shape[1]
+        d_f1 = style_weight * dgram.reshape(f1.shape)
+        d_f2 = 2.0 * (f2 - content_f2) / content_f2.size
+        mod.backward([mx.nd.array(d_f1), mx.nd.array(d_f2)])
+        grad = mod.get_input_grads()[0].asnumpy()
+        img = np.clip(img - lr * grad, 0.0, 1.0).astype(np.float32)
+        style_loss = float(np.sum((g1 - style_f1) ** 2))
+        content_loss = float(np.mean((f2 - content_f2) ** 2))
+        losses.append(style_weight * style_loss + content_loss)
+        if log and (i + 1) % 20 == 0:
+            logging.info("step %d: style=%.4f content=%.4f", i + 1,
+                         style_loss, content_loss)
+    return {"initial_loss": losses[0], "final_loss": losses[-1],
+            "image": img}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    args = ap.parse_args()
+    stats = run(steps=args.steps)
+    print("neural_style: loss %.4f -> %.4f"
+          % (stats["initial_loss"], stats["final_loss"]))
+
+
+if __name__ == "__main__":
+    main()
