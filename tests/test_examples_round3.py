@@ -54,3 +54,20 @@ def test_neural_style_example():
     mod = load_example("neural_style.py")
     stats = mod.run(steps=100, log=False)
     assert stats["final_loss"] < 0.5 * stats["initial_loss"], stats
+
+
+def test_bi_lstm_sort_example():
+    """Bidirectional LSTM emits the sorted sequence (per-position order
+    statistics need whole-sequence context)."""
+    mod = load_example("bi_lstm_sort.py")
+    stats = mod.run(epochs=15, log=False)
+    assert stats["elem_acc"] > 0.85, stats
+
+
+def test_svm_mnist_example():
+    """SVMOutput heads (both hinge forms) are drop-in replacements for
+    softmax on the same trunk."""
+    mod = load_example("svm_mnist.py")
+    accs = mod.run(epochs=6, log=False)
+    for name, acc in accs.items():
+        assert acc > 0.9, accs
