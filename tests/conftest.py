@@ -27,6 +27,19 @@ def _seed():
     mx.random.seed(42)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compiler_state():
+    """Drop jit caches between test modules to bound memory growth.
+
+    NOTE: this alone did NOT stop the XLA:CPU backend-compiler segfault
+    seen around the ~300th test when the heavy example gates compiled
+    in-process — that needed true subprocess isolation (see
+    test_examples_round3.py).  Kept as hygiene: it caps live-executable
+    memory across the rest of the suite at a small recompile cost."""
+    yield
+    jax.clear_caches()
+
+
 def load_example(name):
     """Import an examples/ script as a module (shared by the example-gate
     tests; registered in sys.modules so dataclass/pickle paths work)."""
