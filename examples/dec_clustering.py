@@ -25,29 +25,43 @@ sys.path.insert(0, os.path.dirname(_HERE))
 
 import mxnet_tpu as mx
 
-DIM, K, EMBED = 32, 4, 4
+DIM, SIGNAL_DIM, K, EMBED = 32, 16, 4, 4
 
 
 def make_data(rng, n):
     """K well-separated latent clusters, then a fixed nonlinear fold that
     entangles them in observation space."""
     labels = rng.randint(0, K, n)
-    centers = np.eye(K, 6) * 4.0
-    z = centers[labels] + rng.randn(n, 6) * 0.45
-    w = np.linspace(-1.5, 1.5, 6 * DIM).reshape(6, DIM)
-    x = np.sin(z @ w) + 0.05 * rng.randn(n, DIM)
-    return x.astype(np.float32), labels
+    centers = np.eye(K, 6) * 2.5
+    z = centers[labels] + rng.randn(n, 6) * 0.4
+    # fixed FULL-RANK mixing (a fixed seed, not the data rng: the map is
+    # part of the problem definition), folded gently: injective (args
+    # stay within one sine arch) but curved enough to distort distances
+    w = np.random.RandomState(7).randn(6, SIGNAL_DIM) * 0.35
+    signal = np.sin(z @ w) + 0.05 * rng.randn(n, SIGNAL_DIM)
+    # high-variance UNSTRUCTURED nuisance dims: they swamp raw-space
+    # Euclidean distances, but a bottleneck AE cannot reconstruct pure
+    # noise and so filters it out of the embedding — the DEC story
+    nuisance = rng.randn(n, DIM - SIGNAL_DIM) * 1.6
+    return np.concatenate([signal, nuisance], 1).astype(np.float32), labels
 
 
-def _kmeans(x, k, rng, iters=50):
-    centroids = x[rng.choice(len(x), k, replace=False)]
-    for _ in range(iters):
-        d = ((x[:, None] - centroids[None]) ** 2).sum(-1)
-        assign = d.argmin(1)
-        for j in range(k):
-            if (assign == j).any():
-                centroids[j] = x[assign == j].mean(0)
-    return assign, centroids
+def _kmeans(x, k, rng, iters=50, restarts=5):
+    """Best-of-N restarts by inertia (an honest baseline: a single bad
+    init would understate k-means)."""
+    best = None
+    for _ in range(restarts):
+        centroids = x[rng.choice(len(x), k, replace=False)]
+        for _ in range(iters):
+            d = ((x[:, None] - centroids[None]) ** 2).sum(-1)
+            assign = d.argmin(1)
+            for j in range(k):
+                if (assign == j).any():
+                    centroids[j] = x[assign == j].mean(0)
+        inertia = float(((x - centroids[assign]) ** 2).sum())
+        if best is None or inertia < best[0]:
+            best = (inertia, assign, centroids)
+    return best[1], best[2]
 
 
 def cluster_accuracy(assign, labels, k):
@@ -62,10 +76,10 @@ def cluster_accuracy(assign, labels, k):
 def _ae_modules(batch):
     data = mx.sym.Variable("data")
     enc = mx.sym.Activation(mx.sym.FullyConnected(
-        data, num_hidden=24, name="enc0"), act_type="relu")
+        data, num_hidden=48, name="enc0"), act_type="relu")
     code = mx.sym.FullyConnected(enc, num_hidden=EMBED, name="enc1")
     dec = mx.sym.Activation(mx.sym.FullyConnected(
-        code, num_hidden=24, name="dec0"), act_type="relu")
+        code, num_hidden=48, name="dec0"), act_type="relu")
     recon = mx.sym.FullyConnected(dec, num_hidden=DIM, name="dec1")
     ae = mx.sym.LinearRegressionOutput(recon,
                                        mx.sym.Variable("softmax_label"))
@@ -82,7 +96,7 @@ def _encode(code_sym, params, x):
     return mod.get_outputs()[0].asnumpy()
 
 
-def run(pretrain_epochs=25, refine_steps=60, seed=0, log=True):
+def run(pretrain_epochs=45, refine_steps=60, seed=0, log=True):
     rng = np.random.RandomState(seed)
     np.random.seed(seed + 1)
     x, labels = make_data(rng, 600)
@@ -105,6 +119,7 @@ def run(pretrain_epochs=25, refine_steps=60, seed=0, log=True):
     # jointly, the DEC recipe) ----
     z = _encode(code_sym, params, x)
     assign, centroids = _kmeans(z, K, rng)
+    init_acc = cluster_accuracy(assign, labels, K)
 
     import jax
     import jax.numpy as jnp
@@ -123,40 +138,47 @@ def run(pretrain_epochs=25, refine_steps=60, seed=0, log=True):
         q = 1.0 / (1.0 + d2)  # Student-t, alpha=1
         return q / jnp.sum(q, 1, keepdims=True)
 
-    @jax.jit
-    def step(st):
+    def target(st):
+        # sharpened target P from the current soft assignment (the DEC
+        # self-training target, held FIXED between refresh intervals —
+        # refreshing every step can lock in early mistakes)
         q = soft_assign(st)
-        f = jnp.sum(q, 0)
-        p = (q ** 2 / f)
-        p = jax.lax.stop_gradient(p / jnp.sum(p, 1, keepdims=True))
+        p = q ** 2 / jnp.sum(q, 0)
+        return p / jnp.sum(p, 1, keepdims=True)
 
+    @jax.jit
+    def step(st, p):
         def kl(st_):
             qq = soft_assign(st_)
-            return jnp.sum(p * jnp.log(p / (qq + 1e-12) + 1e-12))
+            return jnp.mean(jnp.sum(p * jnp.log(p / (qq + 1e-12) + 1e-12),
+                                    axis=1))
 
         loss, g = jax.value_and_grad(kl)(st)
         return loss, jax.tree_util.tree_map(
-            lambda w, gg: w - 0.02 * gg, st, g)
+            lambda w, gg: w - 0.5 * gg, st, g)
 
+    p = target(state)
     for i in range(refine_steps):
-        loss, state = step(state)
+        if i and i % 10 == 0:
+            p = target(state)  # periodic target refresh (DEC interval)
+        loss, state = step(state, p)
         if log and (i + 1) % 20 == 0:
             logging.info("refine step %d: KL=%.4f", i + 1, float(loss))
 
     q = np.asarray(soft_assign(state))
     dec_acc = cluster_accuracy(q.argmax(1), labels, K)
     if log:
-        logging.info("cluster acc: raw-kmeans=%.3f DEC=%.3f",
-                     raw_acc, dec_acc)
-    return {"raw_acc": raw_acc, "dec_acc": dec_acc}
+        logging.info("cluster acc: raw-kmeans=%.3f embed-init=%.3f "
+                     "DEC=%.3f", raw_acc, init_acc, dec_acc)
+    return {"raw_acc": raw_acc, "init_acc": init_acc, "dec_acc": dec_acc}
 
 
 def main():
     logging.basicConfig(level=logging.INFO)
     argparse.ArgumentParser().parse_args()
     stats = run()
-    print("dec_clustering: raw-kmeans=%.3f DEC=%.3f"
-          % (stats["raw_acc"], stats["dec_acc"]))
+    print("dec_clustering: raw-kmeans=%.3f embed-init=%.3f DEC=%.3f"
+          % (stats["raw_acc"], stats["init_acc"], stats["dec_acc"]))
 
 
 if __name__ == "__main__":

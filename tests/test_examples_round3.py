@@ -102,3 +102,14 @@ def test_svm_mnist_example():
     accs = _run_example("svm_mnist.py", "epochs=6, log=False")
     for name, acc in accs.items():
         assert acc > 0.9, accs
+
+
+def test_dec_clustering_example():
+    """DEC recipe (AE pretrain -> k-means centroid init -> KL(P||Q)
+    refinement): the learned embedding clusters data whose raw Euclidean
+    structure is swamped by nuisance variance, and refinement improves
+    on its own k-means init."""
+    stats = _run_example("dec_clustering.py", "log=False")
+    assert stats["dec_acc"] > stats["raw_acc"] + 0.3, stats
+    assert stats["dec_acc"] >= stats["init_acc"] - 0.02, stats
+    assert stats["dec_acc"] > 0.7, stats
