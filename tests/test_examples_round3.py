@@ -113,3 +113,12 @@ def test_dec_clustering_example():
     assert stats["dec_acc"] > stats["raw_acc"] + 0.3, stats
     assert stats["dec_acc"] >= stats["init_acc"] - 0.02, stats
     assert stats["dec_acc"] > 0.7, stats
+
+
+def test_recommender_mf_example():
+    """Matrix-factorization recommender: learned embeddings beat the
+    global-mean and per-item-mean baselines by a wide margin."""
+    stats = _run_example("recommender_mf.py",
+                         "epochs=10, batch=128, log=False")
+    assert stats["rmse"] < 0.7 * stats["rmse_item"], stats
+    assert stats["rmse"] < 1.0, stats
