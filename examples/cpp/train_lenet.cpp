@@ -5,7 +5,9 @@
  * predict).  Built by ``make -C native cpp_train``; driven by
  * ``tests/test_native.py::test_cpp_frontend_trains_lenet``.
  *
- * Usage: train_lenet <images.idx> <labels.idx> <epochs> <batch>
+ * Usage: train_lenet <images.idx> <labels.idx> <epochs> <batch> [prefix]
+ * With [prefix]: saves a Python-compatible checkpoint
+ * (prefix-symbol.json + prefix-0001.params) after training.
  * Prints "CPP_TRAIN acc=<accuracy>"; exit 0 iff acc >= 0.9.
  */
 #include <cstdio>
@@ -32,8 +34,9 @@ static Symbol LeNet() {
 }
 
 int main(int argc, char **argv) {
-  if (argc != 5) {
-    std::fprintf(stderr, "usage: %s images.idx labels.idx epochs batch\n",
+  if (argc != 5 && argc != 6) {
+    std::fprintf(stderr,
+                 "usage: %s images.idx labels.idx epochs batch [prefix]\n",
                  argv[0]);
     return 2;
   }
@@ -73,6 +76,12 @@ int main(int argc, char **argv) {
       acc = model.Score(train);
       std::printf("epoch %d: train-acc=%.4f\n", e, acc);
       std::fflush(stdout);
+    }
+    if (argc == 6) {
+      // Python-compatible checkpoint: the test reloads it with
+      // mx.model.load_checkpoint and checks prediction parity
+      model.SaveCheckpoint(argv[5], 1);
+      std::printf("saved checkpoint %s\n", argv[5]);
     }
     std::printf("CPP_TRAIN acc=%.4f\n", acc);
     return acc >= 0.9 ? 0 : 1;

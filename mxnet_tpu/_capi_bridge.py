@@ -267,3 +267,42 @@ def dataiter_label(it_handle):
 
 def dataiter_pad(it_handle):
     return int(_get(it_handle).getpad() or 0)
+
+
+def executor_save_checkpoint(ex_handle, sym_handle, prefix, epoch):
+    """Write the Python-compatible two-file checkpoint (reference
+    save_checkpoint format: prefix-symbol.json + prefix-%04d.params with
+    arg:/aux: prefixed names) from an executor's current state — a
+    C/C++-trained model loads straight into mx.model.load_checkpoint."""
+    mx = _mx()
+    ex = _get(ex_handle)
+    sym = _get(sym_handle)
+    # data/label inputs are not parameters: exclude them, like Module does
+    data_like = {name for name in sym.list_arguments()
+                 if name == "data" or name.endswith("_label")}
+    args = {k: v for k, v in ex.arg_dict.items()
+            if v is not None and k not in data_like}
+    auxs = {k: v for k, v in ex.aux_dict.items() if v is not None}
+    mx.model.save_checkpoint(prefix, int(epoch), sym, args, auxs)
+    # the params write rides the engine's IO lane; wait_for_checkpoint is
+    # the documented read-after-write barrier (model.py) — nd.waitall only
+    # syncs the device, not engine IO
+    mx.model.wait_for_checkpoint("%s-%04d.params" % (prefix, int(epoch)))
+    return 0
+
+
+def executor_load_params(ex_handle, path):
+    """Load a .params file (arg:/aux: prefixed) into a bound executor."""
+    mx = _mx()
+    ex = _get(ex_handle)
+    mx.model.wait_for_checkpoint(path)  # read-after-IO-lane-write barrier
+    for key, value in mx.nd.load(path).items():
+        kind, _, name = key.partition(":")
+        if not name or kind not in ("arg", "aux"):
+            raise ValueError(
+                "%s: key %r is not the checkpoint format (expected "
+                "'arg:<name>' or 'aux:<name>' entries)" % (path, key))
+        d = ex.arg_dict if kind == "arg" else ex.aux_dict
+        if name in d and d[name] is not None:
+            d[name][:] = value
+    return 0

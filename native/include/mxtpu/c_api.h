@@ -240,6 +240,16 @@ MXTPU_DLL MXTPUNDArrayHandle mxtpu_executor_get_array(MXTPUHandle ex,
 MXTPU_DLL int mxtpu_executor_set_array(MXTPUHandle ex, const char *kind,
                                        const char *name,
                                        MXTPUNDArrayHandle val);
+/* Python-compatible two-file checkpoint (reference save_checkpoint:
+ * prefix-symbol.json + prefix-%04d.params, arg:/aux: prefixed) from a
+ * bound executor's state — a C/C++-trained model loads straight into
+ * the Python frontend, and vice versa.  Inputs are excluded from the
+ * params file by NAME CONVENTION: arguments called "data" or ending in
+ * "_label" (the reference's data/label naming) are treated as inputs;
+ * use those names for your input variables or prune the file yourself. */
+MXTPU_DLL int mxtpu_executor_save_checkpoint(MXTPUHandle ex, MXTPUHandle sym,
+                                             const char *prefix, int epoch);
+MXTPU_DLL int mxtpu_executor_load_params(MXTPUHandle ex, const char *path);
 
 /* KVStore (reference MXKVStoreCreate/Init/Push/Pull/SetOptimizer tier;
  * server-side-optimizer semantics included). */

@@ -301,6 +301,18 @@ class Executor {
   void SetAux(const std::string &name, const NDArray &value) {
     Set("aux", name, value);
   }
+  /* Python-compatible checkpoint (prefix-symbol.json + prefix-NNNN.params):
+   * models round-trip between this frontend and mx.model.load_checkpoint. */
+  void SaveCheckpoint(const Symbol &sym, const std::string &prefix,
+                      int epoch) {
+    if (mxtpu_executor_save_checkpoint(owner_->h, sym.handle(),
+                                       prefix.c_str(), epoch) != 0)
+      throw Error("executor_save_checkpoint");
+  }
+  void LoadParams(const std::string &params_path) {
+    if (mxtpu_executor_load_params(owner_->h, params_path.c_str()) != 0)
+      throw Error("executor_load_params");
+  }
 
  private:
   NDArray Get(const char *kind, const std::string &name) const {
@@ -427,6 +439,11 @@ class FeedForward {
   }
 
   Executor &executor() { return ex_; }
+  const Symbol &symbol() const { return net_; }
+
+  void SaveCheckpoint(const std::string &prefix, int epoch) {
+    ex_.SaveCheckpoint(net_, prefix, epoch);
+  }
 
   void InitParams(KVStore &kv, uint32_t seed = 0) {
     Xavier init(seed);

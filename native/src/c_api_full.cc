@@ -299,6 +299,28 @@ int mxtpu_executor_set_array(MXTPUHandle ex, const char *kind,
   return call_with_array("executor_set_array", ex, name, kind, val);
 }
 
+int mxtpu_executor_save_checkpoint(MXTPUHandle ex, MXTPUHandle sym,
+                                   const char *prefix, int epoch) {
+  if (!prefix) { set_err("null prefix"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(
+      bridge(), "executor_save_checkpoint", "LLsi",
+      static_cast<long long>(ex), static_cast<long long>(sym), prefix,
+      epoch));
+}
+
+int mxtpu_executor_load_params(MXTPUHandle ex, const char *path) {
+  if (!path) { set_err("null path"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "executor_load_params",
+                                       "Ls", static_cast<long long>(ex),
+                                       path));
+}
+
 /* ---------------- KVStore ---------------- */
 
 MXTPUHandle mxtpu_kvstore_create(const char *type) {

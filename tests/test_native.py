@@ -384,10 +384,34 @@ def test_cpp_frontend_trains_lenet(tmp_path):
     prior = os.environ.get("PYTHONPATH")
     env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_PLATFORM="cpu",
                PYTHONPATH=repo + ((os.pathsep + prior) if prior else ""))
+    prefix = str(tmp_path / "cppmodel")
     r = subprocess.run([binary, str(tmp_path / "img.idx"),
-                        str(tmp_path / "lab.idx"), "3", "32"],
+                        str(tmp_path / "lab.idx"), "3", "32", prefix],
                        capture_output=True, text=True, env=env, timeout=600)
     assert r.returncode == 0, (r.stdout, r.stderr)
     line = [l for l in r.stdout.splitlines() if l.startswith("CPP_TRAIN")]
     assert line, r.stdout
-    assert float(line[0].split("acc=")[1]) >= 0.9, r.stdout
+    cpp_acc = float(line[0].split("acc=")[1])
+    assert cpp_acc >= 0.9, r.stdout
+
+    # cross-frontend round-trip: the C++-trained checkpoint loads into
+    # the PYTHON frontend and scores the same data at the same accuracy
+    import mxnet_tpu as mx
+
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 1)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (32, 1, 28, 28))], for_training=False)
+    mod.set_params(args, auxs)
+    it = mx.io.MNISTIter(image=str(tmp_path / "img.idx"),
+                         label=str(tmp_path / "lab.idx"), batch_size=32,
+                         shuffle=False)
+    correct = total = 0
+    for b in it:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        truth = b.label[0].asnumpy().astype(np.int64)
+        n = 32 - b.pad
+        correct += int((pred[:n] == truth[:n]).sum())
+        total += n
+    py_acc = correct / total
+    assert abs(py_acc - cpp_acc) < 0.05, (py_acc, cpp_acc)
