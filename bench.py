@@ -120,7 +120,13 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "50" if platform == "tpu" else "3"))
 
     layout = os.environ.get("BENCH_LAYOUT", "NHWC" if platform == "tpu" else "NCHW")
-    stem = os.environ.get("BENCH_STEM", "conv7")  # "s2d" = space-to-depth
+    # space-to-depth stem measured faster on the real chip (2872.76 vs
+    # 2755.92 img/s, 2026-07-31 driver-era A/B) — default for the TPU
+    # path; the CPU smoke uses the 28px cifar-style stem where s2d does
+    # not apply
+    stem = os.environ.get(
+        "BENCH_STEM",
+        "s2d" if platform == "tpu" and layout == "NHWC" else "conv7")
     sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
                             image_shape=(3, image, image), dtype="bfloat16",
                             layout=layout, stem=stem)

@@ -1,0 +1,284 @@
+"""Stochastic depth (parity: reference ``example/stochastic-depth/`` —
+``sd_module.py`` StochasticDepthModule + ``sd_mnist.py`` harness).
+
+A residual block whose compute branch is randomly disabled per batch
+during training (probability ``death_rate``) and replaced by its
+expectation at eval time.  The reference implements this as a
+``BaseModule`` composition: compute branch and skip branch are separate
+Modules, with a host-side random gate deciding per batch whether the
+compute branch runs.  That architecture is *already* TPU-idiomatic —
+the gate is data-independent host control flow choosing between two
+separately-jitted graphs, so no data-dependent branching ever enters a
+traced computation; we keep it, expressed over this framework's Module
+API (each branch is a whole-graph fused jit).
+
+Differences from the reference, by design:
+
+- the per-batch random stream is a seeded generator drawn once per
+  forward (the reference refills a pool of ``np.random.rand`` samples;
+  same distribution, reproducible here),
+- eval-time expectation scales the compute branch by ``1 - death_rate``
+  exactly as the reference does (``sd_module.py`` ``forward``),
+- the chain is assembled with ``SequentialModule(auto_wiring=True)``
+  as in ``sd_mnist.py``.
+
+Synthetic oriented-grating digits stand in for MNIST (no-egress env).
+
+    python examples/stochastic_depth.py
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+
+class StochasticDepthModule(mx.mod.BaseModule):
+    """Two-branch residual module with a random per-batch gate.
+
+    ``symbol_compute`` is the residual (compute) branch; ``symbol_skip``
+    the shortcut (identity when None).  During training the compute
+    branch is executed with probability ``1 - death_rate`` and its
+    output added to the skip path; at eval it always runs, scaled by
+    ``1 - death_rate`` (the survival expectation).
+    """
+
+    def __init__(self, symbol_compute, symbol_skip=None,
+                 data_names=("data",), label_names=None, logger=logging,
+                 context=None, death_rate=0.0, seed=0):
+        super().__init__(logger=logger)
+        context = context if context is not None else mx.cpu()
+        self._compute = mx.mod.Module(
+            symbol_compute, data_names=data_names,
+            label_names=label_names, logger=logger, context=context)
+        self._skip = None
+        if symbol_skip is not None:
+            self._skip = mx.mod.Module(
+                symbol_skip, data_names=data_names,
+                label_names=label_names, logger=logger, context=context)
+        self._open_rate = 1.0 - death_rate
+        self._gate_open = True
+        self._rng = np.random.RandomState(seed)
+        self._outputs = None
+        self._input_grads = None
+        self.gate_history = []  # per-train-batch gate record (for tests)
+
+    # ---- shape/name plumbing: the compute branch is authoritative ----
+    @property
+    def data_names(self):
+        return self._compute.data_names
+
+    @property
+    def output_names(self):
+        return self._compute.output_names
+
+    @property
+    def data_shapes(self):
+        return self._compute.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._compute.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._compute.output_shapes
+
+    def get_params(self):
+        arg, aux = self._compute.get_params()
+        if self._skip is not None:
+            arg, aux = dict(arg), dict(aux)
+            skip_arg, skip_aux = self._skip.get_params()
+            if set(arg) & set(skip_arg):
+                raise ValueError("branches must not share parameter names")
+            arg.update(skip_arg)
+            aux.update(skip_aux)
+        return arg, aux
+
+    def init_params(self, *args, **kwargs):
+        self._compute.init_params(*args, **kwargs)
+        if self._skip is not None:
+            self._skip.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def bind(self, *args, **kwargs):
+        self._compute.bind(*args, **kwargs)
+        if self._skip is not None:
+            self._skip.bind(*args, **kwargs)
+        self.binded = True
+        self.inputs_need_grad = self._compute.inputs_need_grad
+
+    def init_optimizer(self, *args, **kwargs):
+        self._compute.init_optimizer(*args, **kwargs)
+        if self._skip is not None:
+            self._skip.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self._compute.for_training
+
+        if self._skip is not None:
+            self._skip.forward(data_batch, is_train=is_train)
+            self._outputs = [o.copy() for o in self._skip.get_outputs()]
+        else:
+            self._outputs = [d.copy() for d in data_batch.data]
+
+        if is_train:
+            self._gate_open = bool(self._rng.rand() < self._open_rate)
+            self.gate_history.append(self._gate_open)
+            if self._gate_open:
+                self._compute.forward(data_batch, is_train=True)
+                for out, comp in zip(self._outputs,
+                                     self._compute.get_outputs()):
+                    out += comp
+        else:
+            # eval: expectation over the gate
+            self._compute.forward(data_batch, is_train=False)
+            for out, comp in zip(self._outputs, self._compute.get_outputs()):
+                out += self._open_rate * comp
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def backward(self, out_grads=None):
+        if self._skip is not None:
+            self._skip.backward(out_grads=out_grads)
+            self._input_grads = [g.copy()
+                                 for g in self._skip.get_input_grads()]
+        else:
+            self._input_grads = [g.copy() for g in out_grads]
+
+        if self._gate_open:
+            self._compute.backward(out_grads=out_grads)
+            for mine, comp in zip(self._input_grads,
+                                  self._compute.get_input_grads()):
+                mine += comp
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._input_grads
+
+    def update(self):
+        # a closed gate means the compute branch's grad arrays still hold
+        # the previous open batch's gradients — applying them would repeat
+        # a stale update, so only step the branch that actually ran
+        if self._gate_open:
+            self._compute.update()
+        if self._skip is not None:
+            self._skip.update()
+
+    def update_metric(self, eval_metric, labels):
+        pass  # interior residual block: no labels
+
+    def install_monitor(self, mon):
+        self._compute.install_monitor(mon)
+        if self._skip is not None:
+            self._skip.install_monitor(mon)
+
+
+def _conv_bn(name, data, num_filter, with_relu, stride=(1, 1)):
+    net = mx.sym.Convolution(data, name=name, num_filter=num_filter,
+                             kernel=(3, 3), stride=stride, pad=(1, 1),
+                             no_bias=True)
+    net = mx.sym.BatchNorm(net, name=name + "_bn", fix_gamma=False,
+                           momentum=0.9, eps=2e-5)
+    if with_relu:
+        net = mx.sym.Activation(net, name=name + "_relu", act_type="relu")
+    return net
+
+
+def build_chain(num_blocks=2, death_rates=(0.3, 0.3), num_filter=8,
+                num_classes=4, context=None, seed=0):
+    """sd_mnist.py topology: stem conv module, then N stochastic-depth
+    residual blocks, then the relu+flatten+softmax head, chained with
+    auto-wiring."""
+    context = context if context is not None else mx.cpu()
+    stem = _conv_bn("conv0", mx.sym.Variable("data"), num_filter,
+                    with_relu=True)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(stem, label_names=None, context=context))
+
+    sd_blocks = []
+    for i in range(num_blocks):
+        body = _conv_bn("blk%d_conv0" % i, mx.sym.Variable("data_%d" % i),
+                        num_filter, with_relu=True)
+        body = _conv_bn("blk%d_conv1" % i, body, num_filter,
+                        with_relu=False)
+        blk = StochasticDepthModule(
+            body, data_names=["data_%d" % i], context=context,
+            death_rate=death_rates[i], seed=seed + 101 * i)
+        sd_blocks.append(blk)
+        seq.add(blk, auto_wiring=True)
+
+    head_in = mx.sym.Variable("data_final")
+    head = mx.sym.Activation(head_in, act_type="relu")
+    head = mx.sym.FullyConnected(mx.sym.Flatten(head),
+                                 num_hidden=num_classes)
+    head = mx.sym.SoftmaxOutput(head, name="softmax")
+    seq.add(mx.mod.Module(head, data_names=["data_final"], context=context),
+            auto_wiring=True, take_labels=True)
+    return seq, sd_blocks
+
+
+def make_data(rng, n, side=16, num_classes=4):
+    xs = np.zeros((n, 1, side, side), np.float32)
+    ys = rng.randint(0, num_classes, n)
+    yy, xx = np.mgrid[0:side, 0:side]
+    for i, c in enumerate(ys):
+        ang = np.pi / num_classes * c + rng.uniform(-0.08, 0.08)
+        wave = np.sin(0.9 * (np.cos(ang) * xx + np.sin(ang) * yy)
+                      + rng.uniform(0, 2 * np.pi))
+        xs[i, 0] = 0.5 + 0.4 * wave + rng.normal(0, 0.05, (side, side))
+    return xs, ys.astype(np.float32)
+
+
+def run(epochs=8, batch=50, death_rate=0.3, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    xs, ys = make_data(rng, 600)
+    xv, yv = make_data(rng, 200)
+
+    seq, blocks = build_chain(death_rates=(death_rate, death_rate),
+                              seed=seed)
+    train = mx.io.NDArrayIter({"data": xs}, {"softmax_label": ys},
+                              batch_size=batch, shuffle=False)
+    val = mx.io.NDArrayIter({"data": xv}, {"softmax_label": yv},
+                            batch_size=batch, shuffle=False)
+    metric = mx.metric.Accuracy()
+    seq.fit(train, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    metric.reset()
+    seq.score(val, metric)
+    _, val_acc = metric.get()
+
+    gates = np.concatenate([np.asarray(b.gate_history, bool)
+                            for b in blocks])
+    closed_frac = 1.0 - gates.mean() if gates.size else 0.0
+    if log:
+        logging.info("val_acc=%.3f gate_closed_frac=%.3f",
+                     val_acc, closed_frac)
+    return {"val_acc": val_acc, "closed_frac": closed_frac,
+            "n_gate_draws": float(gates.size)}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--death-rate", type=float, default=0.3)
+    args = p.parse_args()
+    stats = run(epochs=args.epochs, death_rate=args.death_rate)
+    print("stochastic_depth: val_acc=%.3f closed_frac=%.3f"
+          % (stats["val_acc"], stats["closed_frac"]))
+
+
+if __name__ == "__main__":
+    main()

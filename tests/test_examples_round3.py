@@ -122,3 +122,17 @@ def test_recommender_mf_example():
                          "epochs=10, batch=128, log=False")
     assert stats["rmse"] < 0.7 * stats["rmse_item"], stats
     assert stats["rmse"] < 1.0, stats
+
+
+def test_stochastic_depth_example():
+    """StochasticDepthModule (BaseModule composition with a host-side
+    per-batch gate over two jitted branches): the gated chain still
+    converges, the gate actually closes at ~death_rate during training,
+    and eval uses the deterministic expectation path."""
+    stats = _run_example("stochastic_depth.py",
+                         "epochs=8, death_rate=0.3, log=False")
+    assert stats["val_acc"] > 0.9, stats
+    # 2 blocks x 8 epochs x 12 batches = 192 draws; Bernoulli(0.3)
+    # mean is within ~3 sigma bounds below
+    assert 0.15 < stats["closed_frac"] < 0.45, stats
+    assert stats["n_gate_draws"] >= 150, stats
