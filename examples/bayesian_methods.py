@@ -1,0 +1,362 @@
+"""Bayesian methods (parity: reference ``example/bayesian-methods/`` —
+``algos.py`` step_SGLD / step_HMC / step_DistilledSGLD + ``bdk_demo.py``
+harnesses).
+
+Three samplers over this framework's Symbol/Executor stack:
+
+1. **SGLD** on the classic Welling–Teh mixture posterior (the
+   reference's ``synthetic_grad`` problem): minibatch gradients of the
+   negative log posterior plus Gaussian injection noise.  The reference
+   differentiates by hand on the host; here the posterior IS a Symbol
+   (slice/exp/broadcast ops into a MakeLoss head) and each SGLD step is
+   the executor's fused fwd+bwd jit — the TPU-idiomatic restatement.
+2. **HMC** with a full Metropolis accept/reject on a small regression
+   net (reference ``step_HMC``): leapfrog over executor gradients, the
+   potential read from the bound loss head.
+3. **Distilled SGLD** (Bayesian Dark Knowledge, reference
+   ``step_DistilledSGLD``): an SGLD teacher's posterior-predictive
+   ensemble distilled into a point student by cross-entropy on soft
+   targets (log_softmax * teacher-probs MakeLoss head — the reference's
+   ``classification_student_grad`` expressed as a graph).
+
+Host-side loops drive jitted steps; no data-dependent control flow is
+traced (the accept/reject branch is a host decision between device
+arrays), so every gradient is one fused XLA computation.
+
+    python examples/bayesian_methods.py
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx
+
+# ---------------------------------------------------------------- SGLD
+
+SIGMA1, SIGMA2, SIGMAX = 1.4142135, 1.0, 1.4142135  # Welling-Teh setup
+THETA_TRUE = (0.0, 1.0)
+MODES = np.array([[0.0, 1.0], [1.0, -1.0]])
+
+
+def mixture_nlp_symbol(n_total, batch):
+    """Negative log posterior of the 2-component mixture as a Symbol.
+
+    x ~ 0.5 N(th1, SIGMAX^2) + 0.5 N(th1+th2, SIGMAX^2),
+    th1 ~ N(0, SIGMA1^2), th2 ~ N(0, SIGMA2^2).  Minibatch likelihood is
+    rescaled by N/n exactly as the reference's ``rescale_grad``.
+    """
+    theta = mx.sym.Variable("theta")            # shape (2,)
+    x = mx.sym.Variable("data")                 # shape (batch,)
+    th1 = mx.sym.reshape(mx.sym.slice_axis(theta, axis=0, begin=0, end=1),
+                         shape=(1,))
+    th2 = mx.sym.reshape(mx.sym.slice_axis(theta, axis=0, begin=1, end=2),
+                         shape=(1,))
+    vx = SIGMAX ** 2
+    d1 = mx.sym.broadcast_sub(x, th1)
+    d2 = mx.sym.broadcast_sub(x, mx.sym.broadcast_add(th1, th2))
+    comp = (mx.sym.exp(-mx.sym.square(d1) / (2 * vx))
+            + mx.sym.exp(-mx.sym.square(d2) / (2 * vx)))
+    loglik = mx.sym.sum(mx.sym.log(0.5 * comp + 1e-12))
+    prior = (mx.sym.sum(mx.sym.square(th1)) / (2 * SIGMA1 ** 2)
+             + mx.sym.sum(mx.sym.square(th2)) / (2 * SIGMA2 ** 2))
+    nlp = -(float(n_total) / batch) * loglik + prior
+    return mx.sym.MakeLoss(mx.sym.reshape(nlp, shape=(1,)))
+
+
+def run_sgld(n_data=100, batch=10, n_steps=8000, burn_in=2000, seed=0,
+             ctx=None):
+    """SGLD over the mixture posterior; returns post-burn-in samples."""
+    ctx = ctx if ctx is not None else mx.cpu()
+    rng = np.random.RandomState(seed)
+    comp = rng.rand(n_data) < 0.5
+    xs = np.where(comp, rng.normal(THETA_TRUE[0], SIGMAX, n_data),
+                  rng.normal(THETA_TRUE[0] + THETA_TRUE[1], SIGMAX,
+                             n_data)).astype(np.float32)
+
+    sym = mixture_nlp_symbol(n_data, batch)
+    exe = sym.simple_bind(ctx=ctx, grad_req="write",
+                          theta=(2,), data=(batch,))
+    theta = np.asarray(rng.normal(0, 1, 2), np.float32)
+    # polynomial step-size decay a(b+t)^-gamma from the SGLD paper /
+    # reference SGLD scheduler
+    a, b, gamma = 0.05, 230.0, 0.55
+
+    samples = np.zeros((n_steps, 2), np.float32)
+    for t in range(n_steps):
+        eps = a * (b + t) ** (-gamma)
+        idx = rng.randint(0, n_data, batch)
+        exe.arg_dict["theta"][:] = theta
+        exe.arg_dict["data"][:] = xs[idx]
+        exe.forward(is_train=True)
+        exe.backward()
+        grad = exe.grad_dict["theta"].asnumpy()
+        theta = (theta - 0.5 * eps * grad
+                 + rng.normal(0, np.sqrt(eps), 2)).astype(np.float32)
+        samples[t] = theta
+    return samples[burn_in:]
+
+
+# ----------------------------------------------------------------- HMC
+
+def regression_symbol(num_hidden=8):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=num_hidden, name="reg_fc1"), act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=1, name="reg_fc2")
+    label = mx.sym.Variable("reg_label")
+    # potential-energy head: noise_precision/2 * sum (f - y)^2
+    return net, mx.sym.MakeLoss(mx.sym.reshape(
+        mx.sym.sum(mx.sym.square(net - label)), shape=(1,)))
+
+
+def run_hmc(n_data=40, n_samples=150, leapfrog=12, eps=1.5e-2,
+            noise_precision=25.0, prior_precision=1.0, seed=0, ctx=None):
+    """HMC posterior sampling of all net weights (reference step_HMC).
+
+    Potential U = noise_precision/2 * ||f(X)-y||^2
+                + prior_precision/2 * ||w||^2; each leapfrog gradient is
+    one fused fwd+bwd; accept/reject on the host.
+    Returns (acc_rate, predictive_rmse, xs, ys).
+    """
+    ctx = ctx if ctx is not None else mx.cpu()
+    rng = np.random.RandomState(seed)
+    xs = np.linspace(-1, 1, n_data).astype(np.float32)[:, None]
+    ys = (np.sin(2.5 * xs) + rng.normal(0, 0.2, xs.shape)).astype(np.float32)
+
+    _, loss_sym = regression_symbol()
+    exe = loss_sym.simple_bind(ctx=ctx, grad_req="write",
+                               data=(n_data, 1), reg_label=(n_data, 1))
+    pnames = [n for n in exe.arg_dict if n not in ("data", "reg_label")]
+    for n in pnames:
+        exe.arg_dict[n][:] = rng.normal(0, 0.3, exe.arg_dict[n].shape)
+    exe.arg_dict["data"][:] = xs
+    exe.arg_dict["reg_label"][:] = ys
+
+    def potential(params):
+        for n in pnames:
+            exe.arg_dict[n][:] = params[n]
+        exe.forward(is_train=False)
+        sq = float(exe.outputs[0].asnumpy()[0])
+        pri = sum(float((p ** 2).sum()) for p in params.values())
+        return 0.5 * noise_precision * sq + 0.5 * prior_precision * pri
+
+    def grad_of(params):
+        for n in pnames:
+            exe.arg_dict[n][:] = params[n]
+        exe.forward(is_train=True)
+        exe.backward()
+        g = {}
+        for n in pnames:
+            g[n] = (0.5 * noise_precision
+                    * exe.grad_dict[n].asnumpy()  # d/dw sum sq  (x2 inside)
+                    + prior_precision * params[n])
+        return g
+
+    params = {n: exe.arg_dict[n].asnumpy().copy() for n in pnames}
+    accepted, preds = 0, []
+    for it in range(n_samples):
+        mom = {n: rng.normal(0, 1, params[n].shape) for n in pnames}
+        u0 = potential(params)
+        k0 = sum(0.5 * (m ** 2).sum() for m in mom.values())
+        new = {n: v.copy() for n, v in params.items()}
+        g = grad_of(new)
+        for n in pnames:
+            mom[n] -= 0.5 * eps * g[n]
+        for step in range(leapfrog):
+            for n in pnames:
+                new[n] = (new[n] + eps * mom[n]).astype(np.float32)
+            g = grad_of(new)
+            scale = 0.5 if step == leapfrog - 1 else 1.0
+            for n in pnames:
+                mom[n] -= scale * eps * g[n]
+        u1 = potential(new)
+        k1 = sum(0.5 * (m ** 2).sum() for m in mom.values())
+        if rng.rand() < np.exp(min(0.0, (u0 + k0) - (u1 + k1))):
+            params = new
+            accepted += 1
+        preds.append({n: params[n].copy() for n in pnames})
+
+    # posterior predictive mean over the second half of the chain
+    net_sym, _ = regression_symbol()
+    pexe = net_sym.simple_bind(ctx=ctx, grad_req="null", data=(n_data, 1))
+    pexe.arg_dict["data"][:] = xs
+    acc = np.zeros((n_data, 1), np.float64)
+    kept = preds[len(preds) // 2:]
+    for p in kept:
+        for n in pnames:
+            pexe.arg_dict[n][:] = p[n]
+        pexe.forward(is_train=False)
+        acc += pexe.outputs[0].asnumpy()
+    mean_pred = acc / len(kept)
+    rmse = float(np.sqrt(((mean_pred - np.sin(2.5 * xs)) ** 2).mean()))
+    return accepted / float(n_samples), rmse
+
+
+# ----------------------------------------------------- Distilled SGLD
+
+def _classifier_symbol(prefix, num_hidden, num_classes, soft_label=False):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=num_hidden, name=prefix + "_fc1"),
+        act_type="relu")
+    logits = mx.sym.FullyConnected(net, num_hidden=num_classes,
+                                   name=prefix + "_fc2")
+    if not soft_label:
+        return mx.sym.SoftmaxOutput(logits, name="softmax")
+    # distillation head: CE against teacher soft targets
+    soft = mx.sym.Variable("soft_label")
+    ce = -mx.sym.mean(mx.sym.sum(mx.sym.BlockGrad(soft)
+                                 * mx.sym.log_softmax(logits, axis=-1),
+                                 axis=1))
+    return logits, mx.sym.MakeLoss(mx.sym.reshape(ce, shape=(1,)))
+
+
+def run_distilled_sgld(n_data=600, batch=60, n_steps=1200, burn_in=400,
+                       thin=40, seed=0, ctx=None, log=True):
+    """SGLD teacher ensemble -> soft-target student (reference
+    step_DistilledSGLD).  Returns (teacher_acc, student_acc)."""
+    ctx = ctx if ctx is not None else mx.cpu()
+    rng = np.random.RandomState(seed)
+    # train and held-out sets share the same class centers
+    centers = rng.randn(4, 8) * 2.2
+    ys_i = rng.randint(0, 4, n_data)
+    xs = (centers[ys_i] + rng.randn(n_data, 8)).astype(np.float32)
+    ys = ys_i.astype(np.float32)
+    vr = np.random.RandomState(seed + 2)
+    yv = vr.randint(0, 4, 300)
+    xv = (centers[yv] + vr.randn(300, 8)).astype(np.float32)
+
+    teacher = mx.mod.Module(_classifier_symbol("teacher", 32, 4),
+                            context=ctx)
+    teacher.bind(data_shapes=[("data", (batch, 8))],
+                 label_shapes=[("softmax_label", (batch,))])
+    teacher.init_params(mx.initializer.Xavier())
+    # SGLD over the teacher: prior precision folded into wd
+    # SoftmaxOutput default normalization sums per-sample grads, so the
+    # full-data-scale gradient is (N/batch) x minibatch sum; SGLD step
+    # sizes must then be ~1/N-scale to keep lr/2 * grad small
+    teacher.init_optimizer(optimizer="sgld", optimizer_params={
+        "learning_rate": 2e-4, "wd": 1e-2,
+        "rescale_grad": float(n_data) / batch})
+
+    from mxnet_tpu.io import DataBatch
+    ensemble = []  # posterior-predictive probs on the val set
+    val_mod = mx.mod.Module(_classifier_symbol("teacher", 32, 4),
+                            context=ctx)
+    val_mod.bind(data_shapes=[("data", (300, 8))], for_training=False,
+                 label_shapes=None)
+    val_mod.init_params(mx.initializer.Xavier())
+    train_probs_acc = np.zeros((n_data, 4), np.float64)
+    n_acc = 0
+    full_mod = mx.mod.Module(_classifier_symbol("teacher", 32, 4),
+                             context=ctx)
+    full_mod.bind(data_shapes=[("data", (n_data, 8))], for_training=False,
+                  label_shapes=None)
+    full_mod.init_params(mx.initializer.Xavier())
+
+    for t in range(n_steps):
+        idx = rng.randint(0, n_data, batch)
+        teacher.forward(DataBatch(
+            data=[mx.nd.array(xs[idx], ctx=ctx)],
+            label=[mx.nd.array(ys[idx], ctx=ctx)]), is_train=True)
+        teacher.backward()
+        teacher.update()
+        if t >= burn_in and (t - burn_in) % thin == 0:
+            arg, aux = teacher.get_params()
+            val_mod.set_params(arg, aux)
+            val_mod.forward(DataBatch(
+                data=[mx.nd.array(xv, ctx=ctx)], label=None),
+                is_train=False)
+            ensemble.append(val_mod.get_outputs()[0].asnumpy())
+            full_mod.set_params(arg, aux)
+            full_mod.forward(DataBatch(
+                data=[mx.nd.array(xs, ctx=ctx)], label=None),
+                is_train=False)
+            train_probs_acc += full_mod.get_outputs()[0].asnumpy()
+            n_acc += 1
+
+    teacher_probs = np.mean(ensemble, axis=0)
+    teacher_acc = float((teacher_probs.argmax(1) == yv).mean())
+    soft_targets = (train_probs_acc / max(n_acc, 1)).astype(np.float32)
+
+    # student: point network on soft targets
+    _, student_loss = _classifier_symbol("student", 32, 4,
+                                         soft_label=True)
+    sexe = student_loss.simple_bind(ctx=ctx, grad_req="write",
+                                    data=(batch, 8),
+                                    soft_label=(batch, 4))
+    srng = np.random.RandomState(seed + 3)
+    opt_state = {}
+    lr = 0.05
+    for n, arr in sexe.arg_dict.items():
+        if n not in ("data", "soft_label"):
+            arr[:] = srng.normal(0, 0.2, arr.shape)
+    for t in range(800):
+        idx = srng.randint(0, n_data, batch)
+        sexe.arg_dict["data"][:] = xs[idx]
+        sexe.arg_dict["soft_label"][:] = soft_targets[idx]
+        sexe.forward(is_train=True)
+        sexe.backward()
+        for n in sexe.arg_dict:
+            if n in ("data", "soft_label"):
+                continue
+            g = sexe.grad_dict[n].asnumpy()
+            m = opt_state.setdefault(n, np.zeros_like(g))
+            m[:] = 0.9 * m + g
+            sexe.arg_dict[n][:] = sexe.arg_dict[n].asnumpy() - lr * m
+
+    slogits, _ = _classifier_symbol("student", 32, 4, soft_label=True)
+    pexe = slogits.simple_bind(ctx=ctx, grad_req="null", data=(300, 8))
+    for n in pexe.arg_dict:
+        if n != "data":
+            pexe.arg_dict[n][:] = sexe.arg_dict[n].asnumpy()
+    pexe.arg_dict["data"][:] = xv
+    pexe.forward(is_train=False)
+    student_acc = float(
+        (pexe.outputs[0].asnumpy().argmax(1) == yv).mean())
+    if log:
+        logging.info("teacher ensemble acc=%.3f student acc=%.3f",
+                     teacher_acc, student_acc)
+    return teacher_acc, student_acc
+
+
+# ----------------------------------------------------------------- run
+
+def run(sgld_steps=8000, hmc_samples=150, distill_steps=1200, seed=0,
+        log=True):
+    samples = run_sgld(n_steps=sgld_steps, seed=seed)
+    dists = np.sqrt(((samples[:, None, :] - MODES[None]) ** 2).sum(-1))
+    near_mode = float((dists.min(1) < 0.6).mean())
+    spread = float(samples.var(0).mean())
+    acc_rate, rmse = run_hmc(n_samples=hmc_samples, seed=seed)
+    teacher_acc, student_acc = run_distilled_sgld(
+        n_steps=distill_steps, seed=seed, log=log)
+    if log:
+        logging.info("SGLD near-mode frac=%.3f spread=%.4f | HMC "
+                     "accept=%.2f rmse=%.3f", near_mode, spread,
+                     acc_rate, rmse)
+    return {"sgld_near_mode": near_mode, "sgld_spread": spread,
+            "hmc_accept": acc_rate, "hmc_rmse": rmse,
+            "teacher_acc": teacher_acc, "student_acc": student_acc}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--sgld-steps", type=int, default=8000)
+    p.add_argument("--hmc-samples", type=int, default=150)
+    args = p.parse_args()
+    stats = run(sgld_steps=args.sgld_steps, hmc_samples=args.hmc_samples)
+    print("bayesian_methods:",
+          " ".join("%s=%.3f" % kv for kv in sorted(stats.items())))
+
+
+if __name__ == "__main__":
+    main()

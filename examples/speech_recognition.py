@@ -1,0 +1,180 @@
+"""Speech recognition (parity: reference ``example/speech_recognition/``
+— ``arch_deepspeech.py``: conv front-end over spectrograms, a
+bidirectional GRU stack, per-frame FC, warp-CTC loss; scored by CER in
+``stt_metric.py``).
+
+A miniature DeepSpeech-2 on synthetic utterances (no-egress stand-in
+for LibriSpeech): each "phoneme" token excites a characteristic
+frequency band plus a harmonic for a random 4-6 frame duration, so the
+net must both localize tokens in time (CTC alignment) and classify
+their spectral signature (conv + BiGRU).  The loss is the built-in
+``ctc_loss`` (log-space scan; the reference vendors warp-ctc), and the
+gate is greedy-decoded character error rate (edit distance / label
+length), exactly the reference's ``stt_metric.py`` accounting.
+
+    python examples/speech_recognition.py [--num-epochs 10]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+if __name__ == "__main__":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import mxnet_tpu as mx
+
+FREQ = 16          # spectrogram bins
+T = 40             # frames per utterance
+LEN = 4            # tokens per utterance
+N_TOK = 5          # token alphabet 1..5 (0 = CTC blank)
+N_CLASS = N_TOK + 1
+
+
+def make_batch(rng, batch):
+    """Spectrograms (batch, T, FREQ) + labels (batch, LEN)."""
+    spec = rng.uniform(0, 0.2, (batch, T, FREQ)).astype(np.float32)
+    labels = np.zeros((batch, LEN), np.float32)
+    for b in range(batch):
+        toks = rng.randint(0, N_TOK, LEN)
+        labels[b] = toks + 1
+        t = rng.randint(1, 4)
+        for tok in toks:
+            dur = rng.randint(4, 7)
+            f0 = 1 + 2 * tok            # fundamental band per token
+            end = min(t + dur, T)
+            spec[b, t:end, f0:f0 + 2] += rng.uniform(0.8, 1.2)
+            if f0 + 6 < FREQ:           # harmonic
+                spec[b, t:end, f0 + 5:f0 + 7] += rng.uniform(0.3, 0.6)
+            t = end + rng.randint(0, 2)
+            if t >= T - 4:
+                break
+    return spec, labels
+
+
+def get_symbol(num_filter=8, num_hidden=24):
+    """Conv front-end -> BiGRU -> per-frame FC -> CTC (+ scores head)."""
+    data = mx.sym.Variable("data")              # (B, T, FREQ)
+    label = mx.sym.Variable("label")            # (B, LEN)
+    img = mx.sym.reshape(data, shape=(-1, 1, T, FREQ))
+    net = mx.sym.Convolution(img, num_filter=num_filter, kernel=(5, 3),
+                             pad=(2, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, num_filter=num_filter, kernel=(5, 3),
+                             pad=(2, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    # (B, C, T, F) -> (B, T, C*F): time stays a sequence axis
+    seq = mx.sym.reshape(mx.sym.transpose(net, axes=(0, 2, 1, 3)),
+                         shape=(-1, T, num_filter * FREQ))
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.GRUCell(num_hidden=num_hidden, prefix="gru_f_"),
+        mx.rnn.GRUCell(num_hidden=num_hidden, prefix="gru_b_"))
+    outputs, _ = bi.unroll(T, inputs=seq, layout="NTC",
+                           merge_outputs=True)   # (B, T, 2H)
+    flat = mx.sym.reshape(outputs, shape=(-1, 2 * num_hidden))
+    pred = mx.sym.FullyConnected(flat, num_hidden=N_CLASS, name="cls")
+    pred = mx.sym.transpose(
+        mx.sym.reshape(pred, shape=(-1, T, N_CLASS)),
+        axes=(1, 0, 2))                          # (T, B, C)
+    loss = mx.sym.MakeLoss(mx.sym.mean(
+        mx.contrib.sym.ctc_loss(pred, label)), name="ctc")
+    return mx.sym.Group([loss, mx.sym.BlockGrad(pred, name="scores")])
+
+
+def greedy_decode(post):
+    """(T,B,C) scores -> sequences (collapse repeats, drop blanks)."""
+    ids = post.argmax(axis=2)
+    out = []
+    for b in range(ids.shape[1]):
+        seq, prev = [], -1
+        for t in range(ids.shape[0]):
+            c = int(ids[t, b])
+            if c != prev and c != 0:
+                seq.append(c)
+            prev = c
+        out.append(seq)
+    return out
+
+
+def edit_distance(a, b):
+    """Levenshtein distance (the reference CER's core)."""
+    dp = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return dp[len(b)]
+
+
+def train(num_epochs=10, batch=32, lr=4e-3, seed=0, ctx=None, log=True,
+          stop_cer=None):
+    ctx = ctx or mx.cpu()
+    rng = np.random.RandomState(seed)
+    np.random.seed(seed + 1)
+    sym = get_symbol()
+    ex = sym.simple_bind(
+        ctx, data=(batch, T, FREQ), label=(batch, LEN),
+        grad_req={n: ("null" if n in ("data", "label") else "write")
+                  for n in sym.list_arguments()})
+    init = mx.initializer.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            init(mx.initializer.InitDesc(name), arr)
+    opt = mx.optimizer.Adam(learning_rate=lr)
+    updater = mx.optimizer.get_updater(opt)
+
+    cer = 1.0
+    for epoch in range(num_epochs):
+        edits = chars = 0
+        losses = []
+        for _ in range(20):
+            spec, labels = make_batch(rng, batch)
+            ex.arg_dict["data"][:] = spec
+            ex.arg_dict["label"][:] = labels
+            ex.forward(is_train=True)
+            ex.backward()
+            for i, name in enumerate(sorted(ex.grad_dict)):
+                g = ex.grad_dict[name]
+                if g is not None:
+                    updater(i, g, ex.arg_dict[name])
+            outs = [o.asnumpy() for o in ex.outputs]
+            losses.append(float(outs[0].mean()))
+            for dec, want in zip(greedy_decode(outs[1]),
+                                 labels.astype(int).tolist()):
+                edits += edit_distance(dec, want)
+                chars += len(want)
+        cer = edits / max(chars, 1)
+        if log:
+            logging.info("epoch %d: ctc_loss=%.3f cer=%.3f",
+                         epoch, float(np.mean(losses)), cer)
+        if stop_cer is not None and cer <= stop_cer:
+            break
+    return {"cer": cer}
+
+
+run = train  # gate-harness entry point
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="mini DeepSpeech CTC")
+    p.add_argument("--num-epochs", type=int, default=10)
+    args = p.parse_args()
+    stats = train(num_epochs=args.num_epochs)
+    print("final: cer=%.3f" % stats["cer"])
+
+
+if __name__ == "__main__":
+    main()

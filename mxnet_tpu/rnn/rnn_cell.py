@@ -141,8 +141,11 @@ class BaseRNNCell(object):
             output, states = self(inputs[i], states)
             outputs.append(output)
         if merge_outputs:
-            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
+            # stack along the layout's time axis (reference
+            # _normalize_sequence: axis = layout.find('T'))
+            axis = layout.find("T")
+            outputs = [symbol.expand_dims(i, axis=axis) for i in outputs]
+            outputs = symbol.Concat(*outputs, dim=axis)
         return outputs, states
 
 
@@ -680,7 +683,8 @@ class BidirectionalCell(BaseRNNCell):
             for i, (l_o, r_o) in enumerate(zip(l_outputs, reversed(r_outputs)))
         ]
         if merge_outputs:
-            outputs = [symbol.expand_dims(i, axis=1) for i in outputs]
-            outputs = symbol.Concat(*outputs, dim=1)
+            axis = layout.find("T")
+            outputs = [symbol.expand_dims(i, axis=axis) for i in outputs]
+            outputs = symbol.Concat(*outputs, dim=axis)
         states = l_states + r_states
         return outputs, states

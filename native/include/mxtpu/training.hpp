@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <functional>
 #include <map>
 #include <memory>
 #include <locale>
@@ -262,6 +263,48 @@ inline Symbol SoftmaxOutput(const std::string &name, Symbol data) {
   return Symbol::Op("SoftmaxOutput", "{}", name, {{"data", data}});
 }
 
+inline Symbol Reshape(const std::string &name, Symbol data,
+                      const std::vector<int64_t> &shape) {
+  return Symbol::Op("Reshape", "{\"shape\": " + ShapeJSON(shape) + "}",
+                    name, {{"data", data}});
+}
+
+inline Symbol SliceAxis(const std::string &name, Symbol data, int axis,
+                        int begin, int end) {
+  char kw[96];
+  std::snprintf(kw, sizeof kw,
+                "{\"axis\": %d, \"begin\": %d, \"end\": %d}", axis, begin,
+                end);
+  return Symbol::Op("slice_axis", kw, name, {{"data", data}});
+}
+
+inline Symbol Add(const std::string &name, Symbol lhs, Symbol rhs) {
+  return Symbol::Op("broadcast_add", "{}", name,
+                    {{"lhs", lhs}, {"rhs", rhs}});
+}
+
+/* Embedding / FullyConnected with EXPLICIT weight symbols: pass the same
+ * weight Variables into several instantiations to share parameters —
+ * how per-bucket graphs of a BucketingModel keep one parameter set
+ * (reference bucketing.md: all buckets share the master's arrays). */
+inline Symbol Embedding(const std::string &name, Symbol data, Symbol weight,
+                        int input_dim, int output_dim) {
+  char kw[96];
+  std::snprintf(kw, sizeof kw,
+                "{\"input_dim\": %d, \"output_dim\": %d}", input_dim,
+                output_dim);
+  return Symbol::Op("Embedding", kw, name,
+                    {{"data", data}, {"weight", weight}});
+}
+
+inline Symbol FullyConnected(const std::string &name, Symbol data,
+                             Symbol weight, Symbol bias, int num_hidden) {
+  return Symbol::Op("FullyConnected",
+                    "{\"num_hidden\": " + std::to_string(num_hidden) + "}",
+                    name,
+                    {{"data", data}, {"weight", weight}, {"bias", bias}});
+}
+
 /* ---------- Executor ---------- */
 
 class Executor {
@@ -422,6 +465,39 @@ class Xavier {
   std::mt19937 rng_;
 };
 
+/* ---------- shared trainer helpers ---------- */
+
+/* Xavier-init `params` of `ex` and seed the kvstore with them. */
+inline void InitParamsInto(Executor &ex, const std::vector<std::string> &params,
+                           KVStore &kv, uint32_t seed) {
+  Xavier init(seed);
+  for (const std::string &p : params) {
+    NDArray arr = ex.GetArg(p);
+    init(p, &arr);
+    ex.SetArg(p, arr);
+    kv.Init(p, arr);
+  }
+}
+
+/* argmax accuracy of a (batch, classes) probability output. */
+inline double ArgmaxAccuracy(const NDArray &probs, const NDArray &label) {
+  std::vector<int64_t> shape = probs.shape();
+  if (shape.size() != 2)
+    throw std::runtime_error(
+        "accuracy expects a (batch, classes) output; got ndim=" +
+        std::to_string(shape.size()));
+  int64_t batch = shape[0], classes = shape[1];
+  const float *p = probs.data();
+  const float *l = label.data();
+  long correct = 0;
+  for (int64_t i = 0; i < batch; ++i) {
+    const float *row = p + i * classes;
+    int64_t best = std::max_element(row, row + classes) - row;
+    correct += (best == static_cast<int64_t>(l[i]));
+  }
+  return batch ? static_cast<double>(correct) / batch : 0.0;
+}
+
 /* ---------- FeedForward fit loop (reference model.h / cpp-package) ----- */
 
 class FeedForward {
@@ -446,13 +522,7 @@ class FeedForward {
   }
 
   void InitParams(KVStore &kv, uint32_t seed = 0) {
-    Xavier init(seed);
-    for (const std::string &p : params_) {
-      NDArray arr = ex_.GetArg(p);
-      init(p, &arr);
-      ex_.SetArg(p, arr);
-      kv.Init(p, arr);
-    }
+    InitParamsInto(ex_, params_, kv, seed);
   }
 
   /* One epoch of update-through-kvstore training (push grad, pull back
@@ -480,29 +550,17 @@ class FeedForward {
 
   /* argmax(prob) accuracy over the iterator (reference Accuracy metric). */
   double Score(DataIter &eval) {
-    long correct = 0, total = 0;
+    double acc_sum = 0.0;
+    long batches = 0;
     eval.Reset();
     while (eval.Next()) {
       NDArray data = eval.Data(), label = eval.Label();
       ex_.SetArg(data_name_, data);
       ex_.Forward(false);
-      NDArray probs = ex_.Output(0);
-      std::vector<int64_t> shape = probs.shape();
-      if (shape.size() != 2)
-        throw std::runtime_error(
-            "Score expects a (batch, classes) output; got ndim=" +
-            std::to_string(shape.size()));
-      int64_t batch = shape[0], classes = shape[1];
-      const float *p = probs.data();
-      const float *l = label.data();
-      for (int64_t i = 0; i < batch; ++i) {
-        const float *row = p + i * classes;
-        int64_t best = std::max_element(row, row + classes) - row;
-        correct += (best == static_cast<int64_t>(l[i]));
-        ++total;
-      }
+      acc_sum += ArgmaxAccuracy(ex_.Output(0), label);
+      ++batches;
     }
-    return total ? static_cast<double>(correct) / total : 0.0;
+    return batches ? acc_sum / batches : 0.0;
   }
 
  private:
@@ -510,6 +568,100 @@ class FeedForward {
   Executor ex_;
   std::string data_name_, label_name_;
   std::vector<std::string> params_;
+};
+
+/* ---------- BucketingModel: variable-length training ----------
+ *
+ * cpp-package had no bucketing; this is the BucketingModule analog
+ * (reference python/mxnet/module/bucketing_module.py + bucketing.md)
+ * for the C++ frontend.  `sym_gen(bucket_key)` builds the graph for one
+ * sequence length; executors are created lazily per bucket and CACHED.
+ * Parameter sharing across buckets goes through the kvstore: weights
+ * are authoritative in the store (exactly the reference's
+ * update-on-kvstore data-parallel contract), every bucket pulls fresh
+ * weights before its forward, so no master-executor array aliasing is
+ * needed — the TPU-idiomatic restatement of shared executor memory.
+ */
+class BucketingModel {
+ public:
+  using SymGen = std::function<Symbol(int)>;
+  using ShapeGen =
+      std::function<std::map<std::string, std::vector<int64_t>>(int)>;
+
+  BucketingModel(SymGen sym_gen, ShapeGen shape_gen, int default_bucket_key,
+                 std::string data_name = "data",
+                 std::string label_name = "softmax_label")
+      : sym_gen_(std::move(sym_gen)),
+        shape_gen_(std::move(shape_gen)),
+        default_key_(default_bucket_key),
+        data_name_(std::move(data_name)),
+        label_name_(std::move(label_name)) {}
+
+  /* Xavier-init the default bucket's params and seed the kvstore with
+   * them; every other bucket then pulls the shared values. */
+  void InitParams(KVStore &kv, uint32_t seed = 0) {
+    Bucket &b = GetBucket(default_key_);
+    InitParamsInto(*b.ex, b.params, kv, seed);
+  }
+
+  /* One train step on whichever bucket the batch belongs to. */
+  void FitBatch(int bucket_key, const NDArray &data, const NDArray &label,
+                KVStore &kv) {
+    Bucket &b = GetBucket(bucket_key);
+    PullParams(b, kv);
+    b.ex->SetArg(data_name_, data);
+    b.ex->SetArg(label_name_, label);
+    b.ex->Forward(true);
+    b.ex->Backward();
+    for (const std::string &p : b.params) {
+      NDArray grad = b.ex->GetGrad(p);
+      kv.Push(p, grad);
+    }
+  }
+
+  /* Batch accuracy on the bucket's executor with current kv weights. */
+  double ScoreBatch(int bucket_key, const NDArray &data,
+                    const NDArray &label, KVStore &kv) {
+    Bucket &b = GetBucket(bucket_key);
+    PullParams(b, kv);
+    b.ex->SetArg(data_name_, data);
+    b.ex->Forward(false);
+    return ArgmaxAccuracy(b.ex->Output(0), label);
+  }
+
+  size_t NumExecutors() const { return buckets_.size(); }
+  const std::vector<std::string> &ParamNames() {
+    return GetBucket(default_key_).params;
+  }
+
+ private:
+  struct Bucket {
+    Symbol sym;
+    std::unique_ptr<Executor> ex;
+    std::vector<std::string> params;
+  };
+
+  Bucket &GetBucket(int key) {
+    auto it = buckets_.find(key);
+    if (it != buckets_.end()) return it->second;
+    Bucket b;
+    b.sym = sym_gen_(key);
+    b.ex.reset(new Executor(b.sym, shape_gen_(key)));
+    for (const std::string &arg : b.sym.ListArguments())
+      if (arg != data_name_ && arg != label_name_) b.params.push_back(arg);
+    return buckets_.emplace(key, std::move(b)).first->second;
+  }
+
+  void PullParams(Bucket &b, KVStore &kv) {
+    for (const std::string &p : b.params)
+      b.ex->SetArg(p, kv.Pull(p, b.ex->GetArg(p).shape()));
+  }
+
+  SymGen sym_gen_;
+  ShapeGen shape_gen_;
+  int default_key_;
+  std::string data_name_, label_name_;
+  std::map<int, Bucket> buckets_;
 };
 
 }  // namespace train

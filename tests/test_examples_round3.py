@@ -136,3 +136,48 @@ def test_stochastic_depth_example():
     # mean is within ~3 sigma bounds below
     assert 0.15 < stats["closed_frac"] < 0.45, stats
     assert stats["n_gate_draws"] >= 150, stats
+
+
+def test_bayesian_methods_example():
+    """SGLD samples the Welling-Teh bimodal posterior (not optimizing:
+    nonzero spread, mass near the modes), HMC's Metropolis step both
+    accepts and rejects while the predictive mean fits, and the SGLD
+    teacher ensemble distills into a student within a point of its
+    accuracy (Bayesian Dark Knowledge)."""
+    stats = _run_example("bayesian_methods.py", "log=False")
+    assert stats["sgld_near_mode"] > 0.6, stats
+    assert 0.02 < stats["sgld_spread"] < 1.0, stats
+    assert 0.55 < stats["hmc_accept"] < 0.995, stats
+    assert stats["hmc_rmse"] < 0.2, stats
+    assert stats["teacher_acc"] > 0.9, stats
+    assert stats["student_acc"] > stats["teacher_acc"] - 0.05, stats
+
+
+def test_speech_recognition_example():
+    """Mini DeepSpeech (conv front-end -> BiGRU -> per-frame FC -> CTC):
+    greedy-decoded character error rate drops below 12% on synthetic
+    utterances with variable-duration tokens."""
+    stats = _run_example("speech_recognition.py",
+                         "num_epochs=14, stop_cer=0.08, log=False")
+    assert stats["cer"] < 0.12, stats
+
+
+def test_kaggle_ndsb2_example():
+    """NDSB-2 cardiac volume: frame-difference trick (SliceChannel +
+    pairwise subtract + Concat) + per-bin sigmoid CDF regression
+    (LogisticRegressionOutput) beats the best constant CDF predictor
+    under the reference's isotonic-corrected CRPS."""
+    stats = _run_example("kaggle_ndsb2.py", "epochs=12, log=False")
+    assert stats["crps"] < 0.8 * stats["crps_const"], stats
+    assert stats["crps"] < 0.055, stats
+
+
+def test_rnn_time_major_example():
+    """Time-major (TNC) and batch-major (NTC) LM builds are numerically
+    identical given the same parameters (the reference's rnn-time-major
+    demo point, minus the cuDNN speed asymmetry XLA erases), and both
+    train to near the synthetic Markov chain's true entropy."""
+    stats = _run_example("rnn_time_major.py", "epochs=6, log=False")
+    assert stats["parity_gap"] < 1e-5, stats
+    assert stats["ppl_tnc"] < 1.35 * stats["true_ppl"], stats
+    assert stats["ppl_ntc"] < 1.35 * stats["true_ppl"], stats

@@ -415,3 +415,35 @@ def test_cpp_frontend_trains_lenet(tmp_path):
         total += n
     py_acc = correct / total
     assert abs(py_acc - cpp_acc) < 0.05, (py_acc, cpp_acc)
+
+
+def test_cpp_frontend_bucketing():
+    """BucketingModel in the C++ frontend (BucketingModule analog; the
+    reference cpp-package had no bucketing): per-bucket executor cache
+    with kvstore-authoritative shared weights trains a variable-length
+    RNN across interleaved sequence lengths."""
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(["make", "-C", os.path.join(repo, "native"),
+                        "cpp_train", "PYTHON=%s" % _sys.executable],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    binary = os.path.join(repo, "native", "build", "train_bucketing")
+    prior = os.environ.get("PYTHONPATH")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_PLATFORM="cpu",
+               PYTHONPATH=repo + ((os.pathsep + prior) if prior else ""))
+    r = subprocess.run([binary, "6", "32"], capture_output=True,
+                       text=True, env=env, timeout=900)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("CPP_BUCKETING")]
+    assert line, r.stdout
+    acc = float(line[0].split("acc=")[1].split()[0])
+    assert acc >= 0.85, r.stdout
+    assert "buckets=2" in line[0], r.stdout

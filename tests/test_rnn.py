@@ -118,3 +118,50 @@ def test_ctc_ocr_example_converges():
     mod = load_example("warpctc_ocr.py")
     stats = mod.train(num_epochs=14, log=False, stop_acc=0.85)
     assert stats["seq_acc"] > 0.8, stats
+
+
+def test_unroll_layout_tnc_merge_axis():
+    """merge_outputs must stack along the LAYOUT's time axis (reference
+    _normalize_sequence: axis=layout.find('T')); regression for the TNC
+    merge landing on axis 1 and silently producing (B,T,H)."""
+    B, T, D, H = 4, 5, 3, 6
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (B, T, D)).astype(np.float32)
+    params = None
+    outs = {}
+    for layout, shape, feed in (("NTC", (B, T, D), x),
+                                ("TNC", (T, B, D), x.transpose(1, 0, 2))):
+        for cls, kw in ((mx.rnn.LSTMCell, {}),):
+            cell = cls(num_hidden=H, prefix="lstm_", **kw)
+            out, _ = cell.unroll(T, inputs=mx.sym.Variable("data"),
+                                 layout=layout, merge_outputs=True)
+            ex = out.simple_bind(mx.cpu(), data=shape, grad_req="null")
+            if params is None:
+                np.random.seed(1)
+                init = mx.initializer.Xavier()
+                for n, a in ex.arg_dict.items():
+                    if n != "data":
+                        init(mx.initializer.InitDesc(n), a)
+                params = {n: ex.arg_dict[n].asnumpy().copy()
+                          for n in ex.arg_dict if n != "data"}
+            else:
+                for n, v in params.items():
+                    ex.arg_dict[n][:] = v
+            ex.arg_dict["data"][:] = feed
+            ex.forward(is_train=False)
+            outs[layout] = ex.outputs[0].asnumpy()
+    assert outs["NTC"].shape == (B, T, H)
+    assert outs["TNC"].shape == (T, B, H)
+    assert_almost_equal(outs["NTC"], outs["TNC"].transpose(1, 0, 2))
+
+
+def test_bidirectional_unroll_tnc_merge_axis():
+    """BidirectionalCell merge_outputs honors TNC as well."""
+    B, T, D, H = 2, 4, 3, 5
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.GRUCell(num_hidden=H, prefix="f_"),
+        mx.rnn.GRUCell(num_hidden=H, prefix="b_"))
+    out, _ = cell.unroll(T, inputs=mx.sym.Variable("data"), layout="TNC",
+                         merge_outputs=True)
+    _, out_shapes, _ = out.infer_shape(data=(T, B, D))
+    assert out_shapes == [(T, B, 2 * H)]
