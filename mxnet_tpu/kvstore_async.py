@@ -515,6 +515,7 @@ class ServerGroup:
         self._clients = [AsyncClient(a, rank, heartbeat=heartbeat,
                                      secret=secret)
                          for a in addresses]
+        self._rank = rank
         self._n = len(self._clients)
         # NOTE: the bound decides routing, so it must agree across all
         # worker processes (the launcher exports one env for the job) —
@@ -565,8 +566,55 @@ class ServerGroup:
         return per_server
 
     def init(self, pairs):
+        """Cross-server atomic init.
+
+        Only rank 0 writes initial values (parity: ``kvstore_dist.h``
+        ``Init`` — rank-0 ``Push_`` then ``Barrier()``); every other
+        rank BLOCKS until rank 0's init is visible on all the shards it
+        touches.  Per-shard first-writer-wins alone is not atomic
+        across servers: with N workers racing, shard A could keep
+        worker 0's value while shard B keeps worker 1's — for a striped
+        big array that is a torn initial tensor.
+
+        As in the reference, the VALUES passed on ranks != 0 are
+        ignored by contract (only shapes drive stripe routing); a key
+        rank 0 never initializes times out with a clear error rather
+        than committing another rank's value.
+        """
+        if self._rank != 0:
+            self.wait_for_init([(k, _np.asarray(v).shape)
+                                for k, v in pairs])
+            return
         self._fanout([lambda s=s, p=p: self._clients[s].init(p)
                       for s, p in self._scatter(pairs).items()])
+
+    def wait_for_init(self, key_shapes, timeout=None):
+        """Block until every key is initialized on its shard(s);
+        the init-barrier half of the reference's rank-0+Barrier
+        contract.  Shapes drive stripe routing (same pure function of
+        element count the initializing rank used)."""
+        timeout = float(timeout if timeout is not None else
+                        os.environ.get("MXNET_TPU_PS_INIT_TIMEOUT", "120"))
+        pending = list(key_shapes)
+        deadline = time.monotonic() + timeout
+        delay = 0.02
+        while True:
+            # only still-missing keys are re-pulled: existence is the
+            # question, and re-fetching already-initialized big striped
+            # tensors every poll would multiply startup traffic
+            keys = [k for k, _ in pending]
+            shapes = [s for _, s in pending]
+            vals = self.pull(keys, shapes=shapes)
+            pending = [ks for ks, v in zip(pending, vals) if v is None]
+            if not pending:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "dist_async init barrier: keys %r not initialized "
+                    "by rank 0 within %.0fs"
+                    % ([k for k, _ in pending], timeout))
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
 
     def push(self, pairs):
         self._fanout([lambda s=s, p=p: self._clients[s].push(p)

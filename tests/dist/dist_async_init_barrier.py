@@ -1,0 +1,59 @@
+"""Cross-server atomic init barrier (``launch.py -n 3 -s 2``).
+
+Every rank attempts ``kv.init`` with a DIFFERENT value (rank+1), and
+rank 0 delays its init — under per-shard first-writer-wins this mixes
+winners across shards (a striped array could even end up torn, chunk 0
+from one rank and chunk 1 from another).  The barrier contract
+(parity: ``kvstore_dist.h`` Init = rank-0 ``Push_`` + ``Barrier()``)
+says: only rank 0 writes, everyone else blocks until that write is
+visible on every shard it touches.  Asserts every pulled value —
+sharded small keys and the striped big key — is EXACTLY rank 0's.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    assert os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS"), \
+        "launcher must provide server addresses (-s N)"
+    init_process_group()
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    group = kv._async
+    assert group.num_servers == 2, group.num_servers
+    group._bound = 64  # force 'big' to stripe across both servers
+
+    shape_small, shape_big = (3, 4), (16, 16)
+    if rank == 0:
+        # rank 0 inits LAST: the others must genuinely block, not race
+        time.sleep(1.5)
+    mine = float(rank + 1)
+    kv.init("alpha", mx.nd.ones(shape_small) * mine)
+    kv.init("beta", mx.nd.ones(shape_small) * mine)
+    kv.init("big", mx.nd.ones(shape_big) * mine)
+
+    # init returned -> rank 0's values must be visible, whole and
+    # untorn, to every rank (for a striped array: every chunk)
+    for key, shape in (("alpha", shape_small), ("beta", shape_small),
+                       ("big", shape_big)):
+        w = mx.nd.zeros(shape)
+        kv.pull(key, out=w)
+        got = w.asnumpy()
+        assert np.all(got == 1.0), (key, rank, np.unique(got))
+
+    kv.barrier()
+    print("worker %d: dist_async init barrier OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,3 +80,11 @@ def test_dist_async_liveness_detects_dead_worker():
     _launch_and_expect(2, "dist_async_liveness.py",
                        "dist_async liveness OK",
                        extra_env={"MXNET_TPU_PS_DEAD_AFTER": "3"})
+
+
+def test_dist_async_init_barrier_via_launcher():
+    # atomic cross-server init: ranks race inits with different values +
+    # rank 0 delayed; everyone must see rank 0's values, untorn, on both
+    # sharded and striped keys
+    _launch_and_expect(3, "dist_async_init_barrier.py",
+                       "dist_async init barrier OK", servers=2)
