@@ -52,7 +52,10 @@ def main():
         assert np.all(got == 1.0), (key, rank, np.unique(got))
 
     kv.barrier()
-    print("worker %d: dist_async init barrier OK" % rank)
+    # one write() syscall: ranks print in lockstep after the barrier and
+    # print()'s separate text/newline writes interleave under -u
+    sys.stdout.write("worker %d: dist_async init barrier OK\n" % rank)
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -88,9 +88,11 @@ def main():
     for r in range(1, nworkers):
         assert stats["push_counts"].get(r) == nslow, stats
     assert kv.num_dead_node(0) == 0
-    print("worker %d/%d: dist_async kvstore OK (err=%.3f, steps=%d, "
-          "counts=%s)" % (rank, nworkers, err, total_steps,
-                          stats["push_counts"]))
+    sys.stdout.write("worker %d/%d: dist_async kvstore OK (err=%.3f, "
+                     "steps=%d, counts=%s)\n"
+                     % (rank, nworkers, err, total_steps,
+                        stats["push_counts"]))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -40,8 +40,9 @@ def main():
             kv.pull("w", out=w)
             kv.push("w", mx.nd.ones(shape) * 0.01)
             time.sleep(0.1)
-        print("worker %d: dist_async liveness OK (exiting abruptly)" % rank,
-              flush=True)
+        sys.stdout.write(
+            "worker %d: dist_async liveness OK (exiting abruptly)\n" % rank)
+        sys.stdout.flush()
         os._exit(0)
 
     # rank 0: wait until the peer has appeared, then watch it die
@@ -65,8 +66,9 @@ def main():
             break
         time.sleep(0.2)
     assert flipped, "num_dead_node never reported the dead worker"
-    print("worker 0: dist_async liveness OK (observed dead=%d)"
-          % kv.num_dead_node(0), flush=True)
+    sys.stdout.write("worker 0: dist_async liveness OK (observed dead=%d)\n"
+                     % kv.num_dead_node(0))
+    sys.stdout.flush()
     # skip interpreter teardown: the coordination-service shutdown barrier
     # would wait on the intentionally-dead peer
     os._exit(0)

@@ -72,7 +72,8 @@ def main():
         err = float(np.abs(w.asnumpy() - target).max())
         assert err < 0.5, (key, err)
 
-    print("worker %d: dist_async multiserver OK" % rank)
+    sys.stdout.write("worker %d: dist_async multiserver OK\n" % rank)
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -65,8 +65,9 @@ def main():
     labels_local = labels[:B].reshape(nproc, -1)[jax.process_index()]
     acc = (prob.argmax(axis=1) == labels_local).mean()
     assert acc > 0.9, acc
-    print("rank %d/%d: dist GSPMD training OK (acc %.2f, mesh %s)"
-          % (jax.process_index(), nproc, acc, dict(mesh.shape)))
+    sys.stdout.write("rank %d/%d: dist GSPMD training OK (acc %.2f, mesh %s)\n"
+                     % (jax.process_index(), nproc, acc, dict(mesh.shape)))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -42,8 +42,9 @@ def main():
     kv.pull("99", out=out_big)
     np.testing.assert_array_equal(out_big.asnumpy(),
                                   np.full(big_shape, expected, np.float32))
-    print("worker %d/%d: dist_sync kvstore OK (expected=%d)"
-          % (rank, nworkers, expected))
+    sys.stdout.write("worker %d/%d: dist_sync kvstore OK (expected=%d)\n"
+                     % (rank, nworkers, expected))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -28,16 +28,33 @@ def _launch_and_expect(n, script, marker, attempts=4, extra_env=None,
                        servers=0):
     """Launch + assert all ranks print ``marker``.  Retries: on a loaded
     single-core box the 30 s gloo handshake occasionally times out; a
-    genuine regression fails every attempt.  Attempts used are printed so
-    a creeping flake (passes needing >1 attempt) is visible in CI logs."""
+    genuine regression fails every attempt.  Attempts used are appended
+    to ``DIST_ATTEMPTS.jsonl`` so a creeping flake (passes needing >1
+    attempt) is machine-checkable, not buried in CI logs."""
+    import json
     import time
 
     last = None
     for attempt in range(attempts):
-        r = _launch(n, os.path.join(_REPO, "tests", "dist", script),
-                    extra_env=extra_env, servers=servers)
+        try:
+            r = _launch(n, os.path.join(_REPO, "tests", "dist", script),
+                        extra_env=extra_env, servers=servers)
+        except subprocess.TimeoutExpired as e:
+            # a hang is the most common flake mode — record it and retry
+            # like any other failed attempt instead of escaping the loop
+            last = subprocess.CompletedProcess(
+                e.cmd, returncode=-1,
+                stdout="TIMEOUT after %ss\n%s" % (e.timeout, e.stdout or ""),
+                stderr=str(e.stderr or ""))
+            if attempt < attempts - 1:
+                time.sleep(8 * (attempt + 1))
+            continue
         ok = [l for l in r.stdout.splitlines() if marker in l]
         if r.returncode == 0 and len(ok) == n:
+            with open(os.path.join(_REPO, "DIST_ATTEMPTS.jsonl"), "a") as f:
+                f.write(json.dumps({"script": script, "n": n,
+                                    "attempts": attempt + 1,
+                                    "ok": True}) + "\n")
             if attempt > 0:
                 print("WARNING: %s needed %d launch attempts (gloo "
                       "handshake contention?)" % (script, attempt + 1))
@@ -45,6 +62,9 @@ def _launch_and_expect(n, script, marker, attempts=4, extra_env=None,
         last = r
         if attempt < attempts - 1:
             time.sleep(8 * (attempt + 1))  # let the load spike drain
+    with open(os.path.join(_REPO, "DIST_ATTEMPTS.jsonl"), "a") as f:
+        f.write(json.dumps({"script": script, "n": n, "attempts": attempts,
+                            "ok": False}) + "\n")
     raise AssertionError(last.stdout + "\n" + last.stderr)
 
 

@@ -1,9 +1,11 @@
 """Largest-verbatim-block scan of repo sources vs the reference python tree.
 
-For each repo file given (or the round-2 flagged set by default), find the
-longest run of consecutive identical non-blank lines (whitespace-stripped)
-against every reference python/mxnet/*.py file, and report runs >= the
-threshold (default 12, the judge's bar).
+For each repo file given (default: EVERY python source under mxnet_tpu/,
+tools/, and examples/), find the longest run of consecutive identical
+non-blank lines (whitespace-stripped) against every reference
+python/mxnet/*.py file, and report runs >= the threshold (default 12, the
+judge's bar).  ``--quick`` restricts to the historically-flagged set for
+fast iteration; CI runs the full tree.
 """
 
 import sys
@@ -18,6 +20,14 @@ FLAGGED = [
     "mxnet_tpu/module/sequential_module.py",
     "mxnet_tpu/image.py",
 ]
+
+
+def all_repo_sources():
+    out = []
+    for top in ("mxnet_tpu", "tools", "examples"):
+        for p in sorted((REPO / top).rglob("*.py")):
+            out.append(str(p.relative_to(REPO)))
+    return out
 
 
 def lines(path):
@@ -44,15 +54,15 @@ def longest_common_run(a, b):
     return best, best_i, best_j
 
 
-def main():
-    targets = sys.argv[1:] or FLAGGED
-    thresh = 12
+def scan_exact(targets, thresh):
+    """O(n*m) DP: exact longest-run report (small target sets)."""
+    ref_lines = [(ref, lines(ref)) for ref in sorted(REF.rglob("*.py"))]
     bad = False
     for rel in targets:
         src = lines(REPO / rel)
         worst = (0, None, -1, -1)
-        for ref in sorted(REF.rglob("*.py")):
-            run, i, j = longest_common_run(src, lines(ref))
+        for ref, rl in ref_lines:
+            run, i, j = longest_common_run(src, rl)
             if run > worst[0]:
                 worst = (run, ref, i, j)
         run, ref, i, j = worst
@@ -60,7 +70,63 @@ def main():
         if run >= thresh:
             bad = True
         print(f"{status}  {rel}: longest verbatim run {run} lines "
-              f"(vs {ref and ref.relative_to(REF)}, ending repo-nonblank-line {i})")
+              f"(vs {ref and ref.relative_to(REF)}, "
+              f"ending repo-nonblank-line {i})")
+    return bad
+
+
+def scan_tree(targets, thresh):
+    """Hash-window scan: indexes every ``thresh``-line window of the
+    reference tree, then slides each repo file over the index.  O(total
+    lines) instead of O(n*m) per pair — what makes a full-tree default
+    feasible as a CI gate.  Reports any run >= thresh (extended to its
+    actual length); sub-threshold runs are not sized."""
+    from collections import defaultdict
+
+    refs = [(ref, lines(ref)) for ref in sorted(REF.rglob("*.py"))]
+    index = defaultdict(list)  # window hash -> (ref_idx, start)
+    for ri, (_, rl) in enumerate(refs):
+        for p in range(len(rl) - thresh + 1):
+            index[hash(tuple(rl[p:p + thresh]))].append((ri, p))
+    bad = False
+    for rel in targets:
+        src = lines(REPO / rel)
+        hit = None
+        for p in range(len(src) - thresh + 1):
+            for ri, q in index.get(hash(tuple(src[p:p + thresh])), ()):
+                rl = refs[ri][1]
+                if rl[q:q + thresh] != src[p:p + thresh]:
+                    continue  # hash collision
+                run = thresh
+                while (p + run < len(src) and q + run < len(rl)
+                       and src[p + run] == rl[q + run]):
+                    run += 1
+                hit = (run, refs[ri][0], p)
+                break
+            if hit:
+                break
+        if hit:
+            bad = True
+            run, ref, i = hit
+            print(f"FAIL  {rel}: verbatim run {run} lines "
+                  f"(vs {ref.relative_to(REF)}, from repo-nonblank-line {i})")
+    return bad
+
+
+def main():
+    argv = sys.argv[1:]
+    thresh = 12
+    if argv and argv[0] == "--quick":
+        targets = argv[1:] or FLAGGED
+        bad = scan_exact(targets, thresh)
+    elif argv:
+        targets = argv
+        bad = scan_exact(targets, thresh)
+    else:
+        targets = all_repo_sources()
+        bad = scan_tree(targets, thresh)
+    print("copy_scan: %d files scanned, %s" % (
+        len(targets), "FAIL" if bad else "all ok (no run >= %d)" % thresh))
     sys.exit(1 if bad else 0)
 
 

@@ -22,6 +22,24 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
+
+
+def _relay(pipe, sink):
+    """Forward one worker's private pipe to the launcher's output, one
+    COMPLETE line per write() syscall.
+
+    Without this, all ranks share the launcher's stdout fd and — under
+    ``PYTHONUNBUFFERED=1`` — ``print()`` emits the text and the newline
+    as two separate unbuffered write()s, so ranks that print at the same
+    instant (e.g. right after a barrier) interleave mid-line and consumers
+    counting marker lines miscount.  Each rank writing to its own pipe +
+    readline() reassembling full lines + one write() per line (atomic for
+    pipes up to PIPE_BUF) makes cross-rank interleaving impossible."""
+    with pipe:
+        for line in iter(pipe.readline, b""):
+            sink.write(line)
+            sink.flush()
 
 
 def _free_port():
@@ -112,7 +130,17 @@ def launch_local(args, cmd):
         env["JAX_PLATFORMS"] = args.platform
         env["MXNET_TPU_PLATFORM"] = args.platform  # wins over site-hook presets
         env.update(server_env)
-        procs.append(subprocess.Popen(cmd, env=env))
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE))
+    relays = []
+    for p in procs:
+        for pipe, sink in ((p.stdout, sys.stdout.buffer),
+                           (p.stderr, sys.stderr.buffer)):
+            t = threading.Thread(target=_relay, args=(pipe, sink),
+                                 daemon=True)
+            t.start()
+            relays.append(t)
     code = 0
     try:
         for p in procs:
@@ -123,6 +151,10 @@ def launch_local(args, cmd):
             p.send_signal(signal.SIGTERM)
         code = 1
     finally:
+        # drain every relayed line (incl. SIGTERM shutdown tracebacks on
+        # the interrupt path) before the launcher exits and pipes close
+        for t in relays:
+            t.join(timeout=30)
         for p in server_procs:  # servers live for the workers' lifetime
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
