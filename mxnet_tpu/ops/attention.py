@@ -6,9 +6,10 @@ cover natively (§7.10).  Long sequences in the reference are handled only by
 bucketing and model-parallel LSTM; here they are handled the TPU way:
 
 * ``flash_attention`` — blockwise-softmax attention.  On TPU the forward is a
-  Pallas kernel (one VMEM pass per query block, online softmax, MXU matmuls);
-  elsewhere a numerically identical jax fallback runs.  The backward is an
-  exact recompute in plain jax (XLA fuses it well).
+  Pallas kernel (one VMEM pass per query block, online softmax, MXU matmuls)
+  and the backward is a pair of Pallas kernels (a dk/dv pass and a dq pass,
+  both O(block) VMEM, reusing the forward's saved log-sum-exp); elsewhere a
+  numerically identical jax fallback runs.
 * ``ring_attention`` — context-parallel attention for sequences sharded along
   a mesh ``seq`` axis: K/V blocks rotate around the ring via ``ppermute``
   while each device's query block folds them into an online softmax.  Used
@@ -30,6 +31,10 @@ from .registry import ParamSpec as P, register
 __all__ = ["flash_attention", "ring_attention"]
 
 _NEG_INF = -1e30
+# Mosaic tiles the last two block dims as (8 sublanes, 128 lanes); per-row
+# vectors (lse, delta) cross pallas_call boundaries broadcast over a
+# 128-lane trailing dim (the layout jax's own TPU flash kernel uses).
+_LANE = 128
 
 
 def _causal_mask(bq, bk, q_offset, k_offset):
@@ -44,7 +49,7 @@ def _causal_mask(bq, bk, q_offset, k_offset):
 # ----------------------------------------------------------------------
 
 
-def _attention_fwd_ref(q, k, v, causal, sm_scale):
+def _attention_fwd_ref(q, k, v, causal, sm_scale, return_lse=False):
     """Exact softmax attention on [B, H, T, D] tensors, fp32 softmax."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
@@ -55,7 +60,10 @@ def _attention_fwd_ref(q, k, v, causal, sm_scale):
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     p = p / l
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    if return_lse:
+        return out, (m + jnp.log(l))[..., 0]  # [B, H, T] fp32
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -63,7 +71,7 @@ def _attention_fwd_ref(q, k, v, causal, sm_scale):
 # ----------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   sm_scale, causal, block_q, block_k, n_k, kv_len):
     """One (batch*head, q-block, k-block) program of the online softmax.
 
@@ -71,8 +79,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     (m/l/acc) carries the running max, denominator, and weighted sum across
     k steps, so VMEM holds only one q-block and one k/v-block at a time —
     sequence length is bounded by HBM, not the 16 MB VMEM (the previous
-    kernel staged all of K/V per program and capped out near T=8K)."""
+    kernel staged all of K/V per program and capped out near T=8K).
+
+    ``rest`` is ``(lse_ref, m_scr, l_scr, acc_scr)`` when the caller asked
+    for the log-sum-exp residual (the VJP forward) and just the three
+    scratch refs otherwise — the primal/inference path skips the extra
+    [bq, 128] HBM write entirely."""
     import jax.experimental.pallas as pl
+
+    if len(rest) == 4:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -125,10 +144,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[...]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp residual for the backward kernels (padded rows
+            # get -inf + 0; they are sliced off before use).  Broadcast
+            # across a 128-lane trailing dim: Mosaic requires the last two
+            # block dims to tile (8, 128), so a per-row vector rides as
+            # [bq, 128] (the layout jax's own TPU flash kernel uses for
+            # its l/m residuals).
+            lse = m_scr[...] + jnp.log(l)
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
-                      interpret=False):
+                      interpret=False, return_lse=False):
     """Pallas forward on [B, H, T, D].  T is padded to block multiples."""
     import jax.experimental.pallas as pl
 
@@ -163,16 +191,23 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
         if params_cls is not None:
             kwargs["compiler_params"] = params_cls(
                 dimension_semantics=("parallel", "parallel", "arbitrary"))
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))]
+    if return_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Tp, _LANE), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Tp, D), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -181,8 +216,10 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
         interpret=interpret,
         **kwargs,
     )(qf, kf, vf)
-    out = out.reshape(B, H, Tp, D)
-    return out[:, :, :T] if Tp != T else out
+    out = res[0].reshape(B, H, Tp, D)[:, :, :T]
+    if return_lse:
+        return out, res[1][:, :, 0].reshape(B, H, Tp)[:, :, :T]
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -209,18 +246,248 @@ def _flash_dispatch(q, k, v, causal, sm_scale, interpret):
 
 
 def _flash_fwd_vjp(q, k, v, causal, sm_scale, interpret):
-    out = _flash_dispatch(q, k, v, causal, sm_scale, interpret)
-    return out, (q, k, v, out)
+    """Forward for the VJP: same dispatch as the primal, but every path
+    also emits the per-row log-sum-exp so the backward kernels never have
+    to re-derive the softmax statistics."""
+    platform = jax.default_backend()
+    if interpret:
+        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                     interpret=platform != "tpu",
+                                     return_lse=True)
+    elif platform == "tpu" and (q.shape[2] >= 1024 or k.shape[2] >= 1024):
+        # lower crossover than the primal's 2048: the Pallas bwd kernels
+        # consume the kernel's lse directly, and skipping the [T, T]
+        # XLA softmax materialization pays off earlier when training
+        # (measured on the transformer-LM bench, docs/PERF.md)
+        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                     return_lse=True)
+    else:
+        out, lse = _attention_fwd_ref(q, k, v, causal, sm_scale,
+                                      return_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+# ----------------------------------------------------------------------
+# Pallas TPU backward kernels (dk/dv pass + dq pass)
+# ----------------------------------------------------------------------
+
+
+def _bwd_p_ds(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qi, kj, *,
+              sm_scale, causal, block_q, block_k, kv_len):
+    """Shared backward tile math for one (q-block, k-block) pair: the
+    attention weights ``p`` and score gradients ``ds`` plus the fp32
+    block operands.  Both bwd kernels call this, so the mask and scale
+    logic can never diverge between dq and dk/dv."""
+    qb = q_ref[0].astype(jnp.float32)    # [bq, D]
+    dob = do_ref[0].astype(jnp.float32)  # [bq, D]
+    kb = k_ref[0].astype(jnp.float32)    # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    # [bq, _LANE] lane-broadcast vectors; any-lane reduce recovers them
+    lseb = jnp.max(lse_ref[0], axis=1)   # [bq] (+inf on padded q rows)
+    dlt = jnp.max(delta_ref[0], axis=1)  # [bq]
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+    p = jnp.exp(s - lseb[:, None])
+    mask = None
+    if causal:
+        mask = _causal_mask(block_q, block_k, qi * block_q, kj * block_k)
+    if kv_len % block_k:
+        # ragged tail: padded key columns contribute nothing
+        col = kj * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = col < kv_len
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        dob, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - dlt[:, None]) * sm_scale
+    return p, ds, qb, dob, kb
+
+
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *,
+                           sm_scale, causal, block_q, block_k, n_q, kv_len):
+    """One (batch*head, k-block, q-block) program: k-blocks are parallel,
+    q-blocks sequential; VMEM scratch accumulates dk/dv for the resident
+    k-block while q/do/lse/delta blocks stream past."""
+    import jax.experimental.pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        # q-blocks entirely above the diagonal contribute nothing
+        run = qi * block_q + block_q - 1 >= kj * block_k
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        p, ds, qb, dob, _ = _bwd_p_ds(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qi, kj,
+            sm_scale=sm_scale, causal=causal, block_q=block_q,
+            block_k=block_k, kv_len=kv_len)
+        dv_scr[...] += jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *,
+                         sm_scale, causal, block_q, block_k, n_k, kv_len):
+    """One (batch*head, q-block, k-block) program: q-blocks parallel,
+    k-blocks sequential; scratch accumulates dq for the resident q-block."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        _, ds, _, _, kb = _bwd_p_ds(
+            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qi, kj,
+            sm_scale=sm_scale, causal=causal, block_q=block_q,
+            block_k=block_k, kv_len=kv_len)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale,
+                      block_q=1024, block_k=1024, interpret=False):
+    """Two-pass Pallas flash backward on [B, H, T, D]: a dk/dv kernel and
+    a dq kernel, each O(block) VMEM — the backward twin of
+    ``_flash_fwd_pallas`` (ends the plain-jax recompute that capped the
+    transformer bench at 27.8% MFU, docs/PERF.md)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, max(8, T))
+    bk = min(block_k, max(8, Tk))
+    Tp = -(-T // bq) * bq
+    Tkp = -(-Tk // bk) * bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if Tp != T:
+        pad3 = ((0, 0), (0, 0), (0, Tp - T), (0, 0))
+        q = jnp.pad(q, pad3)
+        do = jnp.pad(do, pad3)
+        # +inf lse on padded q rows makes p = exp(s - inf) = 0 there, so
+        # the pads contribute nothing to dk/dv and their dq rows (sliced
+        # off below) stay zero
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, Tp - T)),
+                      constant_values=jnp.inf)
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Tp - T)))
+    if Tkp != Tk:
+        pad3 = ((0, 0), (0, 0), (0, Tkp - Tk), (0, 0))
+        k = jnp.pad(k, pad3)
+        v = jnp.pad(v, pad3)
+    BH = B * H
+    qf = q.reshape(BH, Tp, D)
+    dof = do.reshape(BH, Tp, D)
+    kf = k.reshape(BH, Tkp, D)
+    vf = v.reshape(BH, Tkp, D)
+    # per-row vectors cross as [BH, Tp, _LANE] lane-broadcasts (tiling rule)
+    lsef = jnp.broadcast_to(lse.reshape(BH, Tp, 1), (BH, Tp, _LANE))
+    deltaf = jnp.broadcast_to(delta.reshape(BH, Tp, 1), (BH, Tp, _LANE))
+    n_q = Tp // bq
+    n_k = Tkp // bk
+
+    kwargs = {}
+    if not interpret:
+        params_cls = getattr(pltpu, "CompilerParams",
+                             getattr(pltpu, "TPUCompilerParams", None))
+        if params_cls is not None:
+            kwargs["compiler_params"] = params_cls(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dkdv_kernel = functools.partial(
+        _flash_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, n_q=n_q, kv_len=Tk)
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        out_shape=[jax.ShapeDtypeStruct((BH, Tkp, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tkp, D), v.dtype)],
+        grid=(BH, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),      # q
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),      # do
+            pl.BlockSpec((1, bq, _LANE), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANE), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),      # k
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),      # v
+        ],
+        out_specs=[pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(qf, dof, lsef, deltaf, kf, vf)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, n_k=n_k, kv_len=Tk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),      # k
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),      # v
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),      # q
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),      # do
+            pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(kf, vf, qf, dof, lsef, deltaf)
+
+    dq = dq.reshape(B, H, Tp, D)[:, :, :T]
+    dk = dk.reshape(B, H, Tkp, D)[:, :, :Tk]
+    dv = dv.reshape(B, H, Tkp, D)[:, :, :Tk]
+    return dq, dk, dv
 
 
 _BWD_BLOCK_K = 512
 
 
-def _flash_bwd_vjp(causal, sm_scale, interpret, res, do):
-    """Blockwise flash backward: two O(T·bk)-memory passes over K blocks
-    (stats, then dq/dk/dv) — never materializes the [T, T] attention matrix,
-    matching the forward kernel's memory profile."""
-    q, k, v, o = res
+def _flash_bwd_scan(q, k, v, o, lse, do, causal, sm_scale):
+    """Plain-jax blockwise backward (CPU fallback): one scan over K blocks
+    reusing the saved lse — never materializes the [T, T] matrix."""
     B, H, T, D = q.shape
     Tk = k.shape[2]
     bk = min(_BWD_BLOCK_K, Tk)
@@ -244,22 +511,9 @@ def _flash_bwd_vjp(causal, sm_scale, interpret, res, do):
             s = jnp.where(mask, s, _NEG_INF)
         return s
 
-    # pass 1: per-row log-sum-exp
-    def stats_step(carry, xs):
-        m, l = carry
-        k_blk, k_off = xs
-        s = scores(k_blk, k_off)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]), -1)
-        return (m_new, l), None
-
-    m0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    (m, l), _ = lax.scan(stats_step, (m0, l0), (kb, k_offs))
-    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B,H,T]
 
-    # pass 2: accumulate dq; emit dk/dv per block
+    # accumulate dq; emit dk/dv per block
     def grad_step(dq, xs):
         k_blk, v_blk, k_off = xs
         s = scores(k_blk, k_off)
@@ -278,15 +532,30 @@ def _flash_bwd_vjp(causal, sm_scale, interpret, res, do):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_bwd_vjp(causal, sm_scale, interpret, res, do):
+    """Backward dispatch: Pallas two-pass kernels on TPU (and under
+    ``interpret=True`` for CPU testing); plain-jax blockwise scan
+    elsewhere."""
+    q, k, v, o, lse = res
+    platform = jax.default_backend()
+    if interpret:
+        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale,
+                                 interpret=platform != "tpu")
+    if platform == "tpu":
+        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale)
+    return _flash_bwd_scan(q, k, v, o, lse, do, causal, sm_scale)
+
+
 _flash.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False):
     """Softmax attention over [B, H, T, D] tensors.
 
-    On TPU the forward runs as a Pallas flash kernel (O(T) memory); the
-    backward is an exact jax recompute.  ``interpret=True`` forces the Pallas
-    kernel in interpreter mode (CPU testing).
+    On TPU both directions run as Pallas flash kernels (O(T) memory): the
+    online-softmax forward plus a dk/dv pass and a dq pass that reuse the
+    forward's log-sum-exp.  ``interpret=True`` forces the Pallas kernels in
+    interpreter mode (CPU testing).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
