@@ -460,6 +460,12 @@ def _make_sym_fn(op_name):
             # Concat(*args) style: also accept a list as first arg
             if len(sym_inputs) == 1 and isinstance(sym_inputs[0], (list, tuple)):
                 sym_inputs = list(sym_inputs[0])
+            # C-ABI compose path: inputs arrive as arg0..argN-1 keywords
+            # (Op.input_names for variable-args ops), not positionally
+            idx = sorted(int(k[3:]) for k, v in kwargs.items()
+                         if k.startswith("arg") and k[3:].isdigit()
+                         and isinstance(v, Symbol))
+            sym_inputs.extend(kwargs.pop("arg%d" % i) for i in idx)
         return _create(op_name, sym_inputs, kwargs, name=name, attr=attr)
 
     fn.__name__ = op_name

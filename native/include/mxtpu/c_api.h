@@ -150,9 +150,29 @@ MXTPU_DLL void mxtpu_decode_loader_free(void *h);
 
 typedef void *MXTPUNDArrayHandle;
 
+/* Dtype codes: the reference's mshadow TypeFlag order
+ * (include/mxnet/c_api.h dtype int + mshadow/base.h kFloat32..kInt64),
+ * with bfloat16 appended — the TPU-native training dtype the 2017
+ * reference predates. */
+#define MXTPU_DTYPE_FLOAT32 0
+#define MXTPU_DTYPE_FLOAT64 1
+#define MXTPU_DTYPE_FLOAT16 2
+#define MXTPU_DTYPE_UINT8 3
+#define MXTPU_DTYPE_INT32 4
+#define MXTPU_DTYPE_INT8 5
+#define MXTPU_DTYPE_INT64 6
+#define MXTPU_DTYPE_BFLOAT16 7
+
 MXTPU_DLL MXTPUNDArrayHandle mxtpu_ndarray_create(const int64_t *shape,
                                                   int ndim);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_ndarray_create_dtype(const int64_t *shape,
+                                                        int ndim, int dtype);
+MXTPU_DLL int mxtpu_ndarray_dtype(MXTPUNDArrayHandle h);
+/* float32 arrays only (NULL + error otherwise); use mxtpu_ndarray_bytes
+ * for the dtype-generic payload. */
 MXTPU_DLL float *mxtpu_ndarray_data(MXTPUNDArrayHandle h);
+MXTPU_DLL void *mxtpu_ndarray_bytes(MXTPUNDArrayHandle h);
+MXTPU_DLL size_t mxtpu_ndarray_nbytes(MXTPUNDArrayHandle h);
 MXTPU_DLL int mxtpu_ndarray_ndim(MXTPUNDArrayHandle h);
 MXTPU_DLL const int64_t *mxtpu_ndarray_shape(MXTPUNDArrayHandle h);
 MXTPU_DLL size_t mxtpu_ndarray_size(MXTPUNDArrayHandle h);
@@ -277,6 +297,39 @@ MXTPU_DLL int mxtpu_dataiter_next(MXTPUHandle it);
 MXTPU_DLL int mxtpu_dataiter_reset(MXTPUHandle it);
 MXTPU_DLL MXTPUNDArrayHandle mxtpu_dataiter_data(MXTPUHandle it);
 MXTPU_DLL MXTPUNDArrayHandle mxtpu_dataiter_label(MXTPUHandle it);
+
+/* ---------------- imperative NDArray tier ----------------
+ * Device-resident arrays + imperative op invocation (parity: reference
+ * MXImperativeInvoke, src/c_api/c_api_ndarray.cc:322 — the entire
+ * mx.nd.* surface callable from C).  Device arrays are MXTPUHandle ids
+ * living in the embedded TPU-native core (any dtype, incl. bfloat16);
+ * mxtpu_nd_to_device / mxtpu_nd_from_device cross the host<->device
+ * boundary dtype-losslessly. */
+
+MXTPU_DLL MXTPUHandle mxtpu_nd_to_device(MXTPUNDArrayHandle host);
+MXTPU_DLL MXTPUNDArrayHandle mxtpu_nd_from_device(MXTPUHandle dev);
+/* Invoke a registry op on device arrays: kwargs_json as in
+ * mxtpu_sym_create_atomic.  Writes up to max_outputs handles; returns
+ * the output count, or -1 on error. */
+MXTPU_DLL int mxtpu_imperative_invoke(const char *op_name,
+                                      const char *kwargs_json, int n_inputs,
+                                      const MXTPUHandle *inputs,
+                                      int max_outputs, MXTPUHandle *outputs);
+
+/* ---------------- autograd ----------------
+ * Imperative autograd over the device-array tier (parity: reference
+ * MXAutogradSetIsTraining / MXAutogradMarkVariables /
+ * MXAutogradComputeGradient, include/mxnet/c_api.h + contrib
+ * autograd.py:14-188).  While recording is on, every
+ * mxtpu_imperative_invoke is taped; backward replays the tape under
+ * jax.vjp and fills the gradient arrays returned by mark_variables. */
+
+MXTPU_DLL int mxtpu_autograd_set_recording(int on);
+/* For each vars[i], creates a zero gradient device array grads[i]
+ * (caller frees each via mxtpu_handle_free). */
+MXTPU_DLL int mxtpu_autograd_mark_variables(int n, const MXTPUHandle *vars,
+                                            MXTPUHandle *grads);
+MXTPU_DLL int mxtpu_autograd_backward(int n, const MXTPUHandle *outputs);
 
 /* ---------------- misc ---------------- */
 MXTPU_DLL const char *mxtpu_version(void);

@@ -27,6 +27,7 @@
 
 using mxtpu_capi::Gil;
 using mxtpu_capi::NDArr;
+using mxtpu_capi::dtype_size;
 using mxtpu_capi::ensure_python;
 using mxtpu_capi::nd;
 using mxtpu_capi::py_error;
@@ -78,25 +79,57 @@ char *as_cstr(PyObject *r) {
   return out;
 }
 
-/* (shape_list, float32_bytes) -> owned NDArr handle. */
+/* (shape_list, buffer[, dtype_code]) -> owned NDArr handle.  The payload
+ * crosses via the buffer protocol (numpy array or bytes) — one memcpy
+ * into the NDArr, no intermediate .tobytes() copy (the r3 verdict's
+ * full-copy marshalling fix). */
 MXTPUNDArrayHandle as_ndarray(PyObject *r) {
   if (!r) { set_err(py_error()); return nullptr; }
-  PyObject *shape = PyTuple_Check(r) && PyTuple_Size(r) == 2
-                        ? PyTuple_GetItem(r, 0) : nullptr;
-  PyObject *bytes = shape ? PyTuple_GetItem(r, 1) : nullptr;
-  if (!shape || !bytes || !PyList_Check(shape) || !PyBytes_Check(bytes)) {
-    set_err("bridge returned malformed (shape, bytes) pair");
+  Py_ssize_t n = PyTuple_Check(r) ? PyTuple_Size(r) : 0;
+  PyObject *shape = (n == 2 || n == 3) ? PyTuple_GetItem(r, 0) : nullptr;
+  PyObject *payload = shape ? PyTuple_GetItem(r, 1) : nullptr;
+  int dtype = 0;
+  if (n == 3) {
+    dtype = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+    if (dtype_size(dtype) == 0) {
+      set_err("bridge returned unknown dtype code");
+      Py_DECREF(r);
+      return nullptr;
+    }
+  }
+  if (!shape || !payload || !PyList_Check(shape)) {
+    set_err("bridge returned malformed (shape, buffer) pair");
+    Py_DECREF(r);
+    return nullptr;
+  }
+  Py_buffer view;
+  if (PyObject_GetBuffer(payload, &view, PyBUF_CONTIG_RO) != 0) {
+    set_err(py_error());
     Py_DECREF(r);
     return nullptr;
   }
   NDArr *arr = new NDArr();
-  for (Py_ssize_t i = 0; i < PyList_Size(shape); ++i)
-    arr->shape.push_back(PyLong_AsLongLong(PyList_GetItem(shape, i)));
-  char *buf = nullptr;
-  Py_ssize_t blen = 0;
-  PyBytes_AsStringAndSize(bytes, &buf, &blen);
-  arr->data.resize(static_cast<size_t>(blen) / sizeof(float));
-  std::memcpy(arr->data.data(), buf, static_cast<size_t>(blen));
+  arr->dtype = dtype;
+  size_t n_elem = 1;
+  for (Py_ssize_t i = 0; i < PyList_Size(shape); ++i) {
+    int64_t d = PyLong_AsLongLong(PyList_GetItem(shape, i));
+    arr->shape.push_back(d);
+    n_elem *= d > 0 ? static_cast<size_t>(d) : 0;
+  }
+  if (static_cast<size_t>(view.len) != n_elem * dtype_size(dtype)) {
+    set_err("bridge buffer length does not match shape * dtype size");
+    PyBuffer_Release(&view);
+    Py_DECREF(r);
+    delete arr;
+    return nullptr;
+  }
+  if (dtype == 0) {
+    arr->data.resize(n_elem);
+  } else {
+    arr->raw.resize(static_cast<size_t>(view.len));
+  }
+  std::memcpy(arr->bytes(), view.buf, static_cast<size_t>(view.len));
+  PyBuffer_Release(&view);
   Py_DECREF(r);
   if (PyErr_Occurred()) {
     set_err(py_error());
@@ -116,7 +149,10 @@ PyObject *shape_list(const NDArr *arr) {
 }
 
 /* Call bridge.<fn>(handle, key, shape, raw) — the NDArr-passing shape
- * shared by kvstore init/push and executor_set_array. */
+ * shared by kvstore init/push and executor_set_array.  The payload goes
+ * across as a memoryview over the NDArr's own buffer (valid for the
+ * duration of the call; the bridge copies on ingest) instead of an
+ * intermediate bytes object — one copy, not two. */
 int call_with_array(const char *fn, int64_t handle, const char *key,
                     const char *kind, MXTPUNDArrayHandle val) {
   if (!key || !val) { set_err("null argument"); return -1; }
@@ -124,19 +160,25 @@ int call_with_array(const char *fn, int64_t handle, const char *key,
   Gil gil;
   if (!bridge()) return -1;
   NDArr *arr = nd(val);
+  if (arr->dtype != 0) {
+    set_err("executor/kvstore arrays must be float32 (use the imperative "
+            "nd_to_device tier for other dtypes)");
+    return -1;
+  }
   PyObject *shape = shape_list(arr);
+  PyObject *view = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(arr->data.data()),
+      static_cast<Py_ssize_t>(arr->data.size() * sizeof(float)), PyBUF_READ);
   PyObject *r;
   if (kind) {
-    r = PyObject_CallMethod(
-        bridge(), fn, "LssOy#", static_cast<long long>(handle), kind, key,
-        shape, reinterpret_cast<const char *>(arr->data.data()),
-        static_cast<Py_ssize_t>(arr->data.size() * sizeof(float)));
+    r = PyObject_CallMethod(bridge(), fn, "LssOO",
+                            static_cast<long long>(handle), kind, key, shape,
+                            view);
   } else {
-    r = PyObject_CallMethod(
-        bridge(), fn, "LsOy#", static_cast<long long>(handle), key, shape,
-        reinterpret_cast<const char *>(arr->data.data()),
-        static_cast<Py_ssize_t>(arr->data.size() * sizeof(float)));
+    r = PyObject_CallMethod(bridge(), fn, "LsOO",
+                            static_cast<long long>(handle), key, shape, view);
   }
+  Py_DECREF(view);
   Py_DECREF(shape);
   return as_status(r);
 }
@@ -424,6 +466,125 @@ MXTPUNDArrayHandle mxtpu_dataiter_label(MXTPUHandle it) {
   if (!bridge()) return nullptr;
   return as_ndarray(PyObject_CallMethod(bridge(), "dataiter_label", "L",
                                         static_cast<long long>(it)));
+}
+
+/* ---------------- imperative NDArray tier ---------------- */
+
+MXTPUHandle mxtpu_nd_to_device(MXTPUNDArrayHandle host) {
+  if (!host) { set_err("null array"); return 0; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return 0;
+  NDArr *arr = nd(host);
+  PyObject *shape = shape_list(arr);
+  PyObject *view = PyMemoryView_FromMemory(
+      static_cast<char *>(arr->bytes()),
+      static_cast<Py_ssize_t>(arr->nbytes()), PyBUF_READ);
+  PyObject *r = PyObject_CallMethod(bridge(), "nd_to_device", "OOi", shape,
+                                    view, arr->dtype);
+  Py_DECREF(view);
+  Py_DECREF(shape);
+  return as_handle(r);
+}
+
+MXTPUNDArrayHandle mxtpu_nd_from_device(MXTPUHandle dev) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return nullptr;
+  return as_ndarray(PyObject_CallMethod(bridge(), "nd_from_device", "L",
+                                        static_cast<long long>(dev)));
+}
+
+namespace {
+/* Python int list from a handle array. */
+PyObject *handle_list(const MXTPUHandle *hs, int n) {
+  PyObject *list = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(list, i, PyLong_FromLongLong(hs[i]));
+  return list;
+}
+
+/* Copy a bridge-returned list of handles into out (freeing them all via
+ * the bridge if it does not fit).  Returns the count or -1. */
+int as_handle_array(PyObject *r, int max_out, MXTPUHandle *out) {
+  if (!r) { set_err(py_error()); return -1; }
+  if (!PyList_Check(r)) {
+    set_err("bridge returned a non-list");
+    Py_DECREF(r);
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(r));
+  if (n > max_out) {
+    for (int i = 0; i < n; ++i)
+      Py_XDECREF(PyObject_CallMethod(bridge(), "free", "L",
+                                     PyLong_AsLongLong(PyList_GetItem(r, i))));
+    PyErr_Clear();
+    set_err("output buffer too small (" + std::to_string(n) + " outputs)");
+    Py_DECREF(r);
+    return -1;
+  }
+  for (int i = 0; i < n; ++i)
+    out[i] = PyLong_AsLongLong(PyList_GetItem(r, i));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) { set_err(py_error()); return -1; }
+  return n;
+}
+}  // namespace
+
+int mxtpu_imperative_invoke(const char *op_name, const char *kwargs_json,
+                            int n_inputs, const MXTPUHandle *inputs,
+                            int max_outputs, MXTPUHandle *outputs) {
+  if (!op_name || n_inputs < 0 || (n_inputs > 0 && !inputs) ||
+      max_outputs < 1 || !outputs) {
+    set_err("bad imperative_invoke arguments");
+    return -1;
+  }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  PyObject *ins = handle_list(inputs, n_inputs);
+  PyObject *r = PyObject_CallMethod(bridge(), "imperative_invoke", "ssO",
+                                    op_name,
+                                    kwargs_json ? kwargs_json : "", ins);
+  Py_DECREF(ins);
+  return as_handle_array(r, max_outputs, outputs);
+}
+
+/* ---------------- autograd ---------------- */
+
+int mxtpu_autograd_set_recording(int on) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  return as_status(PyObject_CallMethod(bridge(), "autograd_set_recording",
+                                       "i", on));
+}
+
+int mxtpu_autograd_mark_variables(int n, const MXTPUHandle *vars,
+                                  MXTPUHandle *grads) {
+  if (n < 1 || !vars || !grads) { set_err("bad arguments"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  PyObject *vs = handle_list(vars, n);
+  PyObject *r = PyObject_CallMethod(bridge(), "autograd_mark_variables",
+                                    "O", vs);
+  Py_DECREF(vs);
+  int got = as_handle_array(r, n, grads);
+  if (got < 0) return -1;
+  if (got != n) { set_err("bridge returned wrong grad count"); return -1; }
+  return 0;
+}
+
+int mxtpu_autograd_backward(int n, const MXTPUHandle *outputs) {
+  if (n < 1 || !outputs) { set_err("bad arguments"); return -1; }
+  ensure_python();
+  Gil gil;
+  if (!bridge()) return -1;
+  PyObject *os = handle_list(outputs, n);
+  PyObject *r = PyObject_CallMethod(bridge(), "autograd_backward", "O", os);
+  Py_DECREF(os);
+  return as_status(r);
 }
 
 }  // extern "C"

@@ -1,6 +1,8 @@
 /*! Definitions for the shared embedded-CPython plumbing (see embed_py.h). */
 #include "embed_py.h"
 
+#include <dlfcn.h>
+
 #include <mutex>
 
 namespace mxtpu_capi {
@@ -8,11 +10,27 @@ namespace mxtpu_capi {
 namespace {
 thread_local std::string g_err;
 std::once_flag g_py_once;
+
+/* When the HOST process dlopens us RTLD_LOCAL (perl's DynaLoader, ruby,
+ * node-ffi, ...), libpython is pulled in as a private dependency and its
+ * symbols are invisible to the extension modules (math, numpy, ...) the
+ * embedded interpreter later dlopens — imports die with
+ * "undefined symbol: PyFloat_Type".  Re-opening libpython RTLD_GLOBAL
+ * promotes its symbols to the global scope before Py_Initialize.  A
+ * no-op when the embedding binary linked us normally (the C clients). */
+void promote_libpython() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info) &&
+      info.dli_fname) {
+    dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+  }
+}
 }  // namespace
 
 void ensure_python() {
   std::call_once(g_py_once, [] {
     if (!Py_IsInitialized()) {
+      promote_libpython();
       Py_InitializeEx(0);
       /* Release the GIL acquired by initialization so PyGILState_Ensure
        * works uniformly afterwards. */

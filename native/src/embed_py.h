@@ -18,13 +18,37 @@
 
 namespace mxtpu_capi {
 
-/* Host float32 NDArray backing MXTPUNDArrayHandle. */
+/* Host NDArray backing MXTPUNDArrayHandle.  float32 (the overwhelmingly
+ * common case) lives in `data`; other dtypes (MXTPU_DTYPE_* codes in
+ * c_api.h, the reference's mshadow TypeFlag order) carry raw bytes in
+ * `raw` so bf16/f16/int tensors cross the ABI losslessly. */
 struct NDArr {
   std::vector<int64_t> shape;
-  std::vector<float> data;
+  std::vector<float> data;   /* payload iff dtype == 0 (float32) */
+  int dtype = 0;             /* MXTPU_DTYPE_* */
+  std::vector<uint8_t> raw;  /* payload iff dtype != 0 */
+
+  void *bytes() {
+    return dtype == 0 ? static_cast<void *>(data.data())
+                      : static_cast<void *>(raw.data());
+  }
+  size_t nbytes() const {
+    return dtype == 0 ? data.size() * sizeof(float) : raw.size();
+  }
 };
 
 inline NDArr *nd(void *h) { return static_cast<NDArr *>(h); }
+
+/* Element width for an MXTPU_DTYPE_* code (0 = unknown). */
+inline size_t dtype_size(int dtype) {
+  switch (dtype) {
+    case 0: case 4: return 4;          /* f32, i32 */
+    case 1: case 6: return 8;          /* f64, i64 */
+    case 2: case 7: return 2;          /* f16, bf16 */
+    case 3: case 5: return 1;          /* u8, i8 */
+    default: return 0;
+  }
+}
 
 /* Initialize the process-lifetime interpreter exactly once (no Finalize:
  * handles may outlive any scope). */

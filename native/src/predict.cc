@@ -51,8 +51,44 @@ MXTPUNDArrayHandle mxtpu_ndarray_create(const int64_t *shape, int ndim) {
   return a;
 }
 
+MXTPUNDArrayHandle mxtpu_ndarray_create_dtype(const int64_t *shape, int ndim,
+                                              int dtype) {
+  size_t esize = mxtpu_capi::dtype_size(dtype);
+  if (esize == 0) return nullptr;
+  if (dtype == 0) return mxtpu_ndarray_create(shape, ndim);
+  if (ndim < 0 || (ndim > 0 && shape == nullptr)) return nullptr;
+  NDArr *a = new NDArr();
+  a->dtype = dtype;
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (shape[i] < 0) { delete a; return nullptr; }
+    a->shape.push_back(shape[i]);
+    n *= static_cast<size_t>(shape[i]);
+  }
+  a->raw.assign(n * esize, 0);
+  return a;
+}
+
+int mxtpu_ndarray_dtype(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->dtype : -1;
+}
+
 float *mxtpu_ndarray_data(MXTPUNDArrayHandle h) {
-  return h ? nd(h)->data.data() : nullptr;
+  if (!h) return nullptr;
+  if (nd(h)->dtype != 0) {
+    set_err("mxtpu_ndarray_data: array is not float32 "
+            "(use mxtpu_ndarray_bytes)");
+    return nullptr;
+  }
+  return nd(h)->data.data();
+}
+
+void *mxtpu_ndarray_bytes(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->bytes() : nullptr;
+}
+
+size_t mxtpu_ndarray_nbytes(MXTPUNDArrayHandle h) {
+  return h ? nd(h)->nbytes() : 0;
 }
 
 int mxtpu_ndarray_ndim(MXTPUNDArrayHandle h) {
@@ -64,14 +100,19 @@ const int64_t *mxtpu_ndarray_shape(MXTPUNDArrayHandle h) {
 }
 
 size_t mxtpu_ndarray_size(MXTPUNDArrayHandle h) {
-  return h ? nd(h)->data.size() : 0;
+  if (!h) return 0;
+  NDArr *a = nd(h);
+  return a->dtype == 0 ? a->data.size()
+                       : a->raw.size() / mxtpu_capi::dtype_size(a->dtype);
 }
 
 int mxtpu_ndarray_copy(MXTPUNDArrayHandle dst, MXTPUNDArrayHandle src) {
   if (!dst || !src) return -1;
-  if (nd(dst)->data.size() != nd(src)->data.size()) return -1;
+  if (nd(dst)->dtype != nd(src)->dtype) return -1;
+  if (mxtpu_ndarray_size(dst) != mxtpu_ndarray_size(src)) return -1;
   nd(dst)->shape = nd(src)->shape;
   nd(dst)->data = nd(src)->data;
+  nd(dst)->raw = nd(src)->raw;
   return 0;
 }
 

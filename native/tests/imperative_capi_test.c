@@ -1,0 +1,148 @@
+/*
+ * Imperative + autograd + dtype C ABI test (no Python in this file):
+ * the reference's MXImperativeInvoke tier (src/c_api/c_api_ndarray.cc:322
+ * — the whole mx.nd.* surface from C), the MXAutograd* tier
+ * (include/mxnet/c_api.h MXAutogradMarkVariables/ComputeGradient), and a
+ * lossless bfloat16 round trip across the ABI.  Driven by
+ * tests/test_native.py::test_c_api_imperative_autograd.
+ *
+ * Prints "C_API_IMPERATIVE ok" and exits 0 on success.
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+static void die(const char *what) {
+  fprintf(stderr, "FATAL %s: %s\n", what, mxtpu_capi_last_error());
+  exit(1);
+}
+
+/* float -> bfloat16 bits (round-to-nearest-even). */
+static uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
+static float bf16_to_f32(uint16_t h) {
+  uint32_t bits = (uint32_t)h << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+int main(void) {
+  /* ---- imperative invoke + autograd: y = sum(x * x), dy/dx = 2x ---- */
+  const int64_t shape[2] = {4, 8};
+  const int n = 4 * 8;
+  MXTPUNDArrayHandle hx = mxtpu_ndarray_create(shape, 2);
+  if (!hx) die("ndarray_create");
+  float *buf = mxtpu_ndarray_data(hx);
+  for (int i = 0; i < n; ++i) buf[i] = 0.25f * (float)(i - 11);
+
+  MXTPUHandle x = mxtpu_nd_to_device(hx);
+  if (!x) die("nd_to_device");
+
+  if (mxtpu_autograd_set_recording(1) != 0) die("set_recording");
+  MXTPUHandle grads[1];
+  MXTPUHandle vars[1] = {x};
+  if (mxtpu_autograd_mark_variables(1, vars, grads) != 0)
+    die("mark_variables");
+
+  MXTPUHandle sq[1];
+  MXTPUHandle mul_in[2] = {x, x};
+  if (mxtpu_imperative_invoke("broadcast_mul", "{}", 2, mul_in, 1, sq) != 1)
+    die("invoke broadcast_mul");
+  MXTPUHandle total[1];
+  if (mxtpu_imperative_invoke("sum", "{}", 1, sq, 1, total) != 1)
+    die("invoke sum");
+
+  if (mxtpu_autograd_backward(1, total) != 0) die("backward");
+  if (mxtpu_autograd_set_recording(0) != 0) die("set_recording off");
+
+  /* loss value check: sum of squares */
+  MXTPUNDArrayHandle hloss = mxtpu_nd_from_device(total[0]);
+  if (!hloss) die("nd_from_device loss");
+  double want_loss = 0.0;
+  for (int i = 0; i < n; ++i) want_loss += (double)buf[i] * buf[i];
+  float got_loss = mxtpu_ndarray_data(hloss)[0];
+  if (fabs(got_loss - want_loss) > 1e-3 * (fabs(want_loss) + 1.0)) {
+    fprintf(stderr, "loss mismatch: got %f want %f\n", got_loss,
+            (float)want_loss);
+    return 1;
+  }
+
+  /* gradient check: 2x */
+  MXTPUNDArrayHandle hg = mxtpu_nd_from_device(grads[0]);
+  if (!hg) die("nd_from_device grad");
+  if (mxtpu_ndarray_dtype(hg) != MXTPU_DTYPE_FLOAT32) die("grad dtype");
+  const float *g = mxtpu_ndarray_data(hg);
+  for (int i = 0; i < n; ++i) {
+    if (fabsf(g[i] - 2.0f * buf[i]) > 1e-4f) {
+      fprintf(stderr, "grad[%d] = %f, want %f\n", i, g[i], 2.0f * buf[i]);
+      return 1;
+    }
+  }
+
+  /* ---- bfloat16: lossless ABI round trip + imperative compute ---- */
+  const int64_t bshape[1] = {16};
+  MXTPUNDArrayHandle hb =
+      mxtpu_ndarray_create_dtype(bshape, 1, MXTPU_DTYPE_BFLOAT16);
+  if (!hb) die("create bf16");
+  if (mxtpu_ndarray_data(hb) != NULL) {
+    fprintf(stderr, "ndarray_data must refuse non-f32 arrays\n");
+    return 1;
+  }
+  if (mxtpu_ndarray_nbytes(hb) != 16 * 2) die("bf16 nbytes");
+  uint16_t *bb = (uint16_t *)mxtpu_ndarray_bytes(hb);
+  for (int i = 0; i < 16; ++i) bb[i] = f32_to_bf16(1.5f * (float)(i - 7));
+
+  MXTPUHandle db = mxtpu_nd_to_device(hb);
+  if (!db) die("bf16 to_device");
+  MXTPUNDArrayHandle hb2 = mxtpu_nd_from_device(db);
+  if (!hb2) die("bf16 from_device");
+  if (mxtpu_ndarray_dtype(hb2) != MXTPU_DTYPE_BFLOAT16) die("bf16 dtype");
+  const uint16_t *bb2 = (const uint16_t *)mxtpu_ndarray_bytes(hb2);
+  if (memcmp(bb, bb2, 16 * 2) != 0) {
+    fprintf(stderr, "bf16 round trip not bit-exact\n");
+    return 1;
+  }
+
+  /* bf16 imperative math stays bf16 end to end */
+  MXTPUHandle bsq[1];
+  MXTPUHandle bmul_in[2] = {db, db};
+  if (mxtpu_imperative_invoke("broadcast_mul", "{}", 2, bmul_in, 1, bsq) != 1)
+    die("bf16 invoke");
+  MXTPUNDArrayHandle hb3 = mxtpu_nd_from_device(bsq[0]);
+  if (!hb3) die("bf16 result");
+  if (mxtpu_ndarray_dtype(hb3) != MXTPU_DTYPE_BFLOAT16) die("bf16 out dtype");
+  const uint16_t *bb3 = (const uint16_t *)mxtpu_ndarray_bytes(hb3);
+  for (int i = 0; i < 16; ++i) {
+    float want = bf16_to_f32(bb[i]) * bf16_to_f32(bb[i]);
+    float got = bf16_to_f32(bb3[i]);
+    if (fabsf(got - want) > 0.01f * (fabsf(want) + 1.0f)) {
+      fprintf(stderr, "bf16 sq[%d] = %f, want %f\n", i, got, want);
+      return 1;
+    }
+  }
+
+  mxtpu_ndarray_free(hx);
+  mxtpu_ndarray_free(hloss);
+  mxtpu_ndarray_free(hg);
+  mxtpu_ndarray_free(hb);
+  mxtpu_ndarray_free(hb2);
+  mxtpu_ndarray_free(hb3);
+  mxtpu_handle_free(x);
+  mxtpu_handle_free(grads[0]);
+  mxtpu_handle_free(sq[0]);
+  mxtpu_handle_free(total[0]);
+  mxtpu_handle_free(db);
+  mxtpu_handle_free(bsq[0]);
+  printf("C_API_IMPERATIVE ok\n");
+  return 0;
+}

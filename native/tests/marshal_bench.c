@@ -1,0 +1,68 @@
+/*
+ * C data-plane microbenchmark: kvstore pull of a 64 MB float32 tensor in
+ * a loop — measures the C<->embedded-CPython marshalling bandwidth that
+ * bounds any real C/C++ training loop (docs/PERF.md "C ABI data plane").
+ * MXTPU_MARSHAL_BYTES=1 in the environment restores the r3 two-copy
+ * bytes-object path for an A/B.
+ *
+ * Usage: marshal_bench [iters]   — prints MB/s.
+ */
+#define _POSIX_C_SOURCE 199309L
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#include "mxtpu/c_api.h"
+
+int main(int argc, char **argv) {
+  int iters = argc > 1 ? atoi(argv[1]) : 20;
+  const int64_t shape[2] = {4096, 4096};
+  const double mb = 4096.0 * 4096.0 * 4.0 / (1024.0 * 1024.0);
+
+  MXTPUNDArrayHandle a = mxtpu_ndarray_create(shape, 2);
+  if (!a) { fprintf(stderr, "create: %s\n", mxtpu_capi_last_error()); return 1; }
+  float *buf = mxtpu_ndarray_data(a);
+  for (int i = 0; i < 4096 * 4096; ++i) buf[i] = (float)(i & 1023);
+
+  MXTPUHandle kv = mxtpu_kvstore_create("local");
+  if (!kv) { fprintf(stderr, "kv: %s\n", mxtpu_capi_last_error()); return 1; }
+  if (mxtpu_kvstore_init(kv, "w", a) != 0) {
+    fprintf(stderr, "init: %s\n", mxtpu_capi_last_error());
+    return 1;
+  }
+
+  /* warm up one pull (compile/caches) */
+  MXTPUNDArrayHandle w = mxtpu_kvstore_pull(kv, "w", shape, 2);
+  if (!w) { fprintf(stderr, "pull: %s\n", mxtpu_capi_last_error()); return 1; }
+  mxtpu_ndarray_free(w);
+
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  for (int i = 0; i < iters; ++i) {
+    w = mxtpu_kvstore_pull(kv, "w", shape, 2);
+    if (!w) { fprintf(stderr, "pull: %s\n", mxtpu_capi_last_error()); return 1; }
+    mxtpu_ndarray_free(w);
+  }
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double dt = (double)(t1.tv_sec - t0.tv_sec) +
+              1e-9 * (double)(t1.tv_nsec - t0.tv_nsec);
+  printf("pull: %.1f MB/s (%d x %.0f MB in %.2f s)\n",
+         iters * mb / dt, iters, mb, dt);
+
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  for (int i = 0; i < iters; ++i) {
+    if (mxtpu_kvstore_push(kv, "w", a) != 0) {
+      fprintf(stderr, "push: %s\n", mxtpu_capi_last_error());
+      return 1;
+    }
+  }
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  dt = (double)(t1.tv_sec - t0.tv_sec) +
+       1e-9 * (double)(t1.tv_nsec - t0.tv_nsec);
+  printf("push: %.1f MB/s (%d x %.0f MB in %.2f s)\n",
+         iters * mb / dt, iters, mb, dt);
+
+  mxtpu_ndarray_free(a);
+  mxtpu_handle_free(kv);
+  return 0;
+}

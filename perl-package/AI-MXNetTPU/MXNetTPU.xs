@@ -1,0 +1,424 @@
+/* AI::MXNetTPU XS layer — thin 1:1 wrappers over mxtpu/c_api.h.
+ *
+ * Parity: /root/reference/perl-package/AI-MXNetCAPI (the SWIG-generated
+ * mxnet.i layer binding every MXNET_DLL function for perl); here the XS
+ * is hand-written and the OO surface lives in pure perl
+ * (lib/AI/MXNetTPU.pm), mirroring how AI::MXNet wraps AI::MXNetCAPI.
+ *
+ * Conventions:
+ *  - MXTPUHandle (int64 ids) cross as plain IVs.
+ *  - MXTPUNDArrayHandle (pointers) cross as PTR2IV/INT2PTR IVs.
+ *  - bulk float data crosses as packed "f*" strings (pack/unpack on the
+ *    perl side) — one memcpy instead of a million SV boxes.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+MODULE = AI::MXNetTPU   PACKAGE = AI::MXNetTPU::C
+
+PROTOTYPES: DISABLE
+
+const char *
+version()
+    CODE:
+        RETVAL = mxtpu_version();
+    OUTPUT:
+        RETVAL
+
+const char *
+last_error()
+    CODE:
+        RETVAL = mxtpu_capi_last_error();
+    OUTPUT:
+        RETVAL
+
+int
+handle_free(h)
+        IV h
+    CODE:
+        RETVAL = mxtpu_handle_free((MXTPUHandle)h);
+    OUTPUT:
+        RETVAL
+
+IV
+sym_create_variable(name)
+        const char *name
+    CODE:
+        RETVAL = (IV)mxtpu_sym_create_variable(name);
+    OUTPUT:
+        RETVAL
+
+IV
+sym_create_atomic(op, kwargs)
+        const char *op
+        const char *kwargs
+    CODE:
+        RETVAL = (IV)mxtpu_sym_create_atomic(op, kwargs);
+    OUTPUT:
+        RETVAL
+
+int
+sym_compose(sym, name, names_av, handles_av)
+        IV sym
+        const char *name
+        AV *names_av
+        AV *handles_av
+    CODE:
+        int n = (int)(av_len(names_av) + 1);
+        const char **names;
+        MXTPUHandle *hs;
+        int i;
+        Newx(names, n, const char *);
+        Newx(hs, n, MXTPUHandle);
+        for (i = 0; i < n; ++i) {
+            SV **nv = av_fetch(names_av, i, 0);
+            SV **hv = av_fetch(handles_av, i, 0);
+            names[i] = nv ? SvPV_nolen(*nv) : "";
+            hs[i] = hv ? (MXTPUHandle)SvIV(*hv) : 0;
+        }
+        RETVAL = mxtpu_sym_compose((MXTPUHandle)sym, name, n, names, hs);
+        Safefree(names);
+        Safefree(hs);
+    OUTPUT:
+        RETVAL
+
+IV
+sym_from_json(json)
+        const char *json
+    CODE:
+        RETVAL = (IV)mxtpu_sym_from_json(json);
+    OUTPUT:
+        RETVAL
+
+SV *
+sym_to_json(sym)
+        IV sym
+    CODE:
+        char *s = mxtpu_sym_to_json((MXTPUHandle)sym);
+        if (!s) XSRETURN_UNDEF;
+        RETVAL = newSVpv(s, 0);
+        mxtpu_buf_free(s);
+    OUTPUT:
+        RETVAL
+
+SV *
+sym_list(sym, which)
+        IV sym
+        const char *which
+    CODE:
+        char *s = mxtpu_sym_list((MXTPUHandle)sym, which);
+        if (!s) XSRETURN_UNDEF;
+        RETVAL = newSVpv(s, 0);
+        mxtpu_buf_free(s);
+    OUTPUT:
+        RETVAL
+
+SV *
+sym_infer_shape(sym, shapes_json)
+        IV sym
+        const char *shapes_json
+    CODE:
+        char *s = mxtpu_sym_infer_shape((MXTPUHandle)sym, shapes_json);
+        if (!s) XSRETURN_UNDEF;
+        RETVAL = newSVpv(s, 0);
+        mxtpu_buf_free(s);
+    OUTPUT:
+        RETVAL
+
+IV
+executor_simple_bind(sym, shapes_json, grad_req)
+        IV sym
+        const char *shapes_json
+        const char *grad_req
+    CODE:
+        RETVAL = (IV)mxtpu_executor_simple_bind((MXTPUHandle)sym,
+                                                shapes_json, grad_req);
+    OUTPUT:
+        RETVAL
+
+int
+executor_forward(ex, is_train)
+        IV ex
+        int is_train
+    CODE:
+        RETVAL = mxtpu_executor_forward((MXTPUHandle)ex, is_train);
+    OUTPUT:
+        RETVAL
+
+int
+executor_backward(ex)
+        IV ex
+    CODE:
+        RETVAL = mxtpu_executor_backward((MXTPUHandle)ex);
+    OUTPUT:
+        RETVAL
+
+int
+executor_num_outputs(ex)
+        IV ex
+    CODE:
+        RETVAL = mxtpu_executor_num_outputs((MXTPUHandle)ex);
+    OUTPUT:
+        RETVAL
+
+IV
+executor_output(ex, idx)
+        IV ex
+        int idx
+    CODE:
+        RETVAL = PTR2IV(mxtpu_executor_output((MXTPUHandle)ex, idx));
+    OUTPUT:
+        RETVAL
+
+IV
+executor_get_array(ex, kind, name)
+        IV ex
+        const char *kind
+        const char *name
+    CODE:
+        RETVAL = PTR2IV(mxtpu_executor_get_array((MXTPUHandle)ex, kind,
+                                                 name));
+    OUTPUT:
+        RETVAL
+
+int
+executor_set_array(ex, kind, name, nd)
+        IV ex
+        const char *kind
+        const char *name
+        IV nd
+    CODE:
+        RETVAL = mxtpu_executor_set_array(
+            (MXTPUHandle)ex, kind, name,
+            INT2PTR(MXTPUNDArrayHandle, nd));
+    OUTPUT:
+        RETVAL
+
+int
+executor_save_checkpoint(ex, sym, prefix, epoch)
+        IV ex
+        IV sym
+        const char *prefix
+        int epoch
+    CODE:
+        RETVAL = mxtpu_executor_save_checkpoint((MXTPUHandle)ex,
+                                                (MXTPUHandle)sym, prefix,
+                                                epoch);
+    OUTPUT:
+        RETVAL
+
+int
+executor_load_params(ex, path)
+        IV ex
+        const char *path
+    CODE:
+        RETVAL = mxtpu_executor_load_params((MXTPUHandle)ex, path);
+    OUTPUT:
+        RETVAL
+
+IV
+kvstore_create(type)
+        const char *type
+    CODE:
+        RETVAL = (IV)mxtpu_kvstore_create(type);
+    OUTPUT:
+        RETVAL
+
+int
+kvstore_init(kv, key, nd)
+        IV kv
+        const char *key
+        IV nd
+    CODE:
+        RETVAL = mxtpu_kvstore_init((MXTPUHandle)kv, key,
+                                    INT2PTR(MXTPUNDArrayHandle, nd));
+    OUTPUT:
+        RETVAL
+
+int
+kvstore_push(kv, key, nd)
+        IV kv
+        const char *key
+        IV nd
+    CODE:
+        RETVAL = mxtpu_kvstore_push((MXTPUHandle)kv, key,
+                                    INT2PTR(MXTPUNDArrayHandle, nd));
+    OUTPUT:
+        RETVAL
+
+IV
+kvstore_pull(kv, key, shape_av)
+        IV kv
+        const char *key
+        AV *shape_av
+    CODE:
+        int nd = (int)(av_len(shape_av) + 1);
+        int64_t shape[16];
+        int i;
+        if (nd > 16) nd = 16;
+        for (i = 0; i < nd; ++i) {
+            SV **sv = av_fetch(shape_av, i, 0);
+            shape[i] = sv ? (int64_t)SvIV(*sv) : 0;
+        }
+        RETVAL = PTR2IV(mxtpu_kvstore_pull((MXTPUHandle)kv, key, shape,
+                                           nd));
+    OUTPUT:
+        RETVAL
+
+int
+kvstore_set_optimizer(kv, name, kwargs_json)
+        IV kv
+        const char *name
+        const char *kwargs_json
+    CODE:
+        RETVAL = mxtpu_kvstore_set_optimizer((MXTPUHandle)kv, name,
+                                             kwargs_json);
+    OUTPUT:
+        RETVAL
+
+int
+kvstore_rank(kv)
+        IV kv
+    CODE:
+        RETVAL = mxtpu_kvstore_rank((MXTPUHandle)kv);
+    OUTPUT:
+        RETVAL
+
+int
+kvstore_num_workers(kv)
+        IV kv
+    CODE:
+        RETVAL = mxtpu_kvstore_num_workers((MXTPUHandle)kv);
+    OUTPUT:
+        RETVAL
+
+IV
+dataiter_create(type, kwargs_json)
+        const char *type
+        const char *kwargs_json
+    CODE:
+        RETVAL = (IV)mxtpu_dataiter_create(type, kwargs_json);
+    OUTPUT:
+        RETVAL
+
+int
+dataiter_next(it)
+        IV it
+    CODE:
+        RETVAL = mxtpu_dataiter_next((MXTPUHandle)it);
+    OUTPUT:
+        RETVAL
+
+int
+dataiter_reset(it)
+        IV it
+    CODE:
+        RETVAL = mxtpu_dataiter_reset((MXTPUHandle)it);
+    OUTPUT:
+        RETVAL
+
+IV
+dataiter_data(it)
+        IV it
+    CODE:
+        RETVAL = PTR2IV(mxtpu_dataiter_data((MXTPUHandle)it));
+    OUTPUT:
+        RETVAL
+
+IV
+dataiter_label(it)
+        IV it
+    CODE:
+        RETVAL = PTR2IV(mxtpu_dataiter_label((MXTPUHandle)it));
+    OUTPUT:
+        RETVAL
+
+IV
+ndarray_create(shape_av)
+        AV *shape_av
+    CODE:
+        int nd = (int)(av_len(shape_av) + 1);
+        int64_t shape[16];
+        int i;
+        if (nd > 16) nd = 16;
+        for (i = 0; i < nd; ++i) {
+            SV **sv = av_fetch(shape_av, i, 0);
+            shape[i] = sv ? (int64_t)SvIV(*sv) : 0;
+        }
+        RETVAL = PTR2IV(mxtpu_ndarray_create(shape, nd));
+    OUTPUT:
+        RETVAL
+
+void
+ndarray_free(nd)
+        IV nd
+    CODE:
+        mxtpu_ndarray_free(INT2PTR(MXTPUNDArrayHandle, nd));
+
+IV
+ndarray_size(nd)
+        IV nd
+    CODE:
+        RETVAL = (IV)mxtpu_ndarray_size(INT2PTR(MXTPUNDArrayHandle, nd));
+    OUTPUT:
+        RETVAL
+
+SV *
+ndarray_shape(nd)
+        IV nd
+    CODE:
+        MXTPUNDArrayHandle h = INT2PTR(MXTPUNDArrayHandle, nd);
+        int ndim = mxtpu_ndarray_ndim(h);
+        const int64_t *shape = mxtpu_ndarray_shape(h);
+        AV *av = newAV();
+        int i;
+        for (i = 0; i < ndim; ++i)
+            av_push(av, newSViv((IV)shape[i]));
+        RETVAL = newRV_noinc((SV *)av);
+    OUTPUT:
+        RETVAL
+
+int
+ndarray_set(nd, packed)
+        IV nd
+        SV *packed
+    CODE:
+        MXTPUNDArrayHandle h = INT2PTR(MXTPUNDArrayHandle, nd);
+        STRLEN len;
+        const char *p = SvPV(packed, len);
+        size_t want = mxtpu_ndarray_size(h) * sizeof(float);
+        if (!h || len != want) {
+            RETVAL = -1;
+        } else {
+            memcpy(mxtpu_ndarray_data(h), p, want);
+            RETVAL = 0;
+        }
+    OUTPUT:
+        RETVAL
+
+SV *
+ndarray_get(nd)
+        IV nd
+    CODE:
+        MXTPUNDArrayHandle h = INT2PTR(MXTPUNDArrayHandle, nd);
+        if (!h) XSRETURN_UNDEF;
+        RETVAL = newSVpvn((const char *)mxtpu_ndarray_data(h),
+                          mxtpu_ndarray_size(h) * sizeof(float));
+    OUTPUT:
+        RETVAL
+
+int
+ndarray_copy(dst, src)
+        IV dst
+        IV src
+    CODE:
+        RETVAL = mxtpu_ndarray_copy(INT2PTR(MXTPUNDArrayHandle, dst),
+                                    INT2PTR(MXTPUNDArrayHandle, src));
+    OUTPUT:
+        RETVAL

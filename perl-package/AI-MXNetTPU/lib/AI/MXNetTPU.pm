@@ -1,0 +1,436 @@
+package AI::MXNetTPU;
+
+# AI::MXNetTPU — perl frontend for the TPU-native framework.
+#
+# Parity: /root/reference/perl-package/AI-MXNet (the OO perl API over the
+# AI-MXNetCAPI SWIG layer).  Same layering here: this pure-perl module is
+# the user surface; the XS layer (AI::MXNetTPU::C, MXNetTPU.xs) is the
+# flat 1:1 binding of mxtpu/c_api.h.  Tensor data crosses as packed
+# "f*" strings (one memcpy) rather than perl lists.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+use JSON::PP ();
+
+my $JSON = JSON::PP->new->canonical;
+
+sub _check {
+    my ($ok, $what) = @_;
+    die "$what: " . AI::MXNetTPU::C::last_error() . "\n" unless $ok;
+    return $ok;
+}
+
+# ---------------------------------------------------------------- Symbol
+
+package AI::MXNetTPU::Symbol;
+
+sub _wrap {
+    my ($class, $h) = @_;
+    AI::MXNetTPU::_check($h, 'symbol');
+    return bless { h => $h }, $class;
+}
+
+sub Variable {
+    my ($class, $name) = @_;
+    return $class->_wrap(AI::MXNetTPU::C::sym_create_variable($name));
+}
+
+# Generic operator application (AI::MXNet's $sym->$op(...) analog):
+#   AI::MXNetTPU::Symbol->op('Convolution', 'c1',
+#       { data => $x }, kernel => [5,5], num_filter => 8);
+sub op {
+    my ($class, $op_name, $name, $inputs, %attrs) = @_;
+    my $h = AI::MXNetTPU::C::sym_create_atomic($op_name, $JSON->encode(\%attrs));
+    AI::MXNetTPU::_check($h, "create_atomic $op_name");
+    my (@names, @handles);
+    for my $k (sort keys %$inputs) {
+        push @names, $k;
+        push @handles, $inputs->{$k}{h};
+    }
+    my $rc = AI::MXNetTPU::C::sym_compose($h, $name, \@names, \@handles);
+    if ($rc != 0) {
+        AI::MXNetTPU::C::handle_free($h);
+        die "compose $op_name: " . AI::MXNetTPU::C::last_error() . "\n";
+    }
+    return bless { h => $h }, $class;
+}
+
+sub from_json {
+    my ($class, $json) = @_;
+    return $class->_wrap(AI::MXNetTPU::C::sym_from_json($json));
+}
+
+sub to_json { AI::MXNetTPU::C::sym_to_json($_[0]{h}) }
+
+sub _list {
+    my ($self, $which) = @_;
+    my $json = AI::MXNetTPU::C::sym_list($self->{h}, $which);
+    AI::MXNetTPU::_check(defined $json, "sym_list $which");
+    return @{ $JSON->decode($json) };
+}
+
+sub list_arguments        { $_[0]->_list('arguments') }
+sub list_outputs          { $_[0]->_list('outputs') }
+sub list_auxiliary_states { $_[0]->_list('auxiliary_states') }
+
+sub infer_shape {
+    my ($self, %shapes) = @_;
+    my $json = AI::MXNetTPU::C::sym_infer_shape($self->{h},
+                                                $JSON->encode(\%shapes));
+    AI::MXNetTPU::_check(defined $json, 'infer_shape');
+    return $JSON->decode($json);
+}
+
+sub simple_bind {
+    my ($self, %args) = @_;
+    my $grad_req = delete $args{grad_req} // 'write';
+    my $h = AI::MXNetTPU::C::executor_simple_bind(
+        $self->{h}, $JSON->encode(\%args), $grad_req);
+    AI::MXNetTPU::_check($h, 'simple_bind');
+    return AI::MXNetTPU::Executor->_new($h, $self);
+}
+
+sub DESTROY { AI::MXNetTPU::C::handle_free($_[0]{h}) if $_[0]{h} }
+
+# --------------------------------------------------------------- NDArray
+
+package AI::MXNetTPU::NDArray;
+
+# Owns (and frees) a host MXTPUNDArrayHandle.  ->values / ->set_values
+# move float32 data via pack("f*", ...) strings.
+
+sub new {
+    my ($class, @shape) = @_;
+    my $p = AI::MXNetTPU::C::ndarray_create(\@shape);
+    AI::MXNetTPU::_check($p, 'ndarray_create');
+    return bless { p => $p }, $class;
+}
+
+sub _adopt {    # take ownership of an existing handle (pointer IV)
+    my ($class, $p, $what) = @_;
+    AI::MXNetTPU::_check($p, $what // 'ndarray');
+    return bless { p => $p }, $class;
+}
+
+sub size  { AI::MXNetTPU::C::ndarray_size($_[0]{p}) }
+sub shape { @{ AI::MXNetTPU::C::ndarray_shape($_[0]{p}) } }
+
+sub set_values {
+    my ($self, @vals) = @_;
+    my $rc = AI::MXNetTPU::C::ndarray_set($self->{p}, pack('f*', @vals));
+    die "ndarray_set: size mismatch\n" if $rc != 0;
+    return $self;
+}
+
+sub set_packed {
+    my ($self, $packed) = @_;
+    my $rc = AI::MXNetTPU::C::ndarray_set($self->{p}, $packed);
+    die "ndarray_set: size mismatch\n" if $rc != 0;
+    return $self;
+}
+
+sub values { unpack('f*', AI::MXNetTPU::C::ndarray_get($_[0]{p})) }
+sub packed { AI::MXNetTPU::C::ndarray_get($_[0]{p}) }
+
+sub DESTROY { AI::MXNetTPU::C::ndarray_free($_[0]{p}) if $_[0]{p} }
+
+# -------------------------------------------------------------- Executor
+
+package AI::MXNetTPU::Executor;
+
+sub _new {
+    my ($class, $h, $sym) = @_;
+    return bless { h => $h, sym => $sym }, $class;
+}
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::executor_forward($self->{h}, $is_train ? 1 : 0) == 0,
+        'forward');
+}
+
+sub backward {
+    my ($self) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::executor_backward($self->{h}) == 0, 'backward');
+}
+
+sub num_outputs { AI::MXNetTPU::C::executor_num_outputs($_[0]{h}) }
+
+sub output {
+    my ($self, $idx) = @_;
+    return AI::MXNetTPU::NDArray->_adopt(
+        AI::MXNetTPU::C::executor_output($self->{h}, $idx // 0), 'output');
+}
+
+sub get_array {
+    my ($self, $kind, $name) = @_;
+    return AI::MXNetTPU::NDArray->_adopt(
+        AI::MXNetTPU::C::executor_get_array($self->{h}, $kind, $name),
+        "get_array $kind/$name");
+}
+
+sub set_array {
+    my ($self, $kind, $name, $nd) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::executor_set_array($self->{h}, $kind, $name,
+                                            $nd->{p}) == 0,
+        "set_array $kind/$name");
+}
+
+sub save_checkpoint {
+    my ($self, $prefix, $epoch) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::executor_save_checkpoint(
+            $self->{h}, $self->{sym}{h}, $prefix, $epoch) == 0,
+        'save_checkpoint');
+}
+
+sub load_params {
+    my ($self, $path) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::executor_load_params($self->{h}, $path) == 0,
+        'load_params');
+}
+
+sub DESTROY { AI::MXNetTPU::C::handle_free($_[0]{h}) if $_[0]{h} }
+
+# --------------------------------------------------------------- KVStore
+
+package AI::MXNetTPU::KVStore;
+
+sub create {
+    my ($class, $type) = @_;
+    my $h = AI::MXNetTPU::C::kvstore_create($type // 'local');
+    AI::MXNetTPU::_check($h, 'kvstore_create');
+    return bless { h => $h }, $class;
+}
+
+sub init {
+    my ($self, $key, $nd) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::kvstore_init($self->{h}, $key, $nd->{p}) == 0,
+        "kv init $key");
+}
+
+sub push_grad {
+    my ($self, $key, $nd) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::kvstore_push($self->{h}, $key, $nd->{p}) == 0,
+        "kv push $key");
+}
+
+sub pull {
+    my ($self, $key, @shape) = @_;
+    return AI::MXNetTPU::NDArray->_adopt(
+        AI::MXNetTPU::C::kvstore_pull($self->{h}, $key, \@shape),
+        "kv pull $key");
+}
+
+sub set_optimizer {
+    my ($self, $name, %kwargs) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::kvstore_set_optimizer(
+            $self->{h}, $name, $JSON->encode(\%kwargs)) == 0,
+        'set_optimizer');
+}
+
+sub rank        { AI::MXNetTPU::C::kvstore_rank($_[0]{h}) }
+sub num_workers { AI::MXNetTPU::C::kvstore_num_workers($_[0]{h}) }
+
+sub DESTROY { AI::MXNetTPU::C::handle_free($_[0]{h}) if $_[0]{h} }
+
+# -------------------------------------------------------------- DataIter
+
+package AI::MXNetTPU::DataIter;
+
+sub create {
+    my ($class, $type, %kwargs) = @_;
+    my $h = AI::MXNetTPU::C::dataiter_create($type, $JSON->encode(\%kwargs));
+    AI::MXNetTPU::_check($h, "dataiter_create $type");
+    return bless { h => $h }, $class;
+}
+
+sub next_batch {    # 1 = ready, 0 = epoch end
+    my ($self) = @_;
+    my $rc = AI::MXNetTPU::C::dataiter_next($self->{h});
+    AI::MXNetTPU::_check($rc >= 0, 'dataiter_next');
+    return $rc;
+}
+
+sub reset {
+    my ($self) = @_;
+    AI::MXNetTPU::_check(
+        AI::MXNetTPU::C::dataiter_reset($self->{h}) == 0, 'dataiter_reset');
+}
+
+sub data {
+    AI::MXNetTPU::NDArray->_adopt(
+        AI::MXNetTPU::C::dataiter_data($_[0]{h}), 'dataiter_data');
+}
+
+sub label {
+    AI::MXNetTPU::NDArray->_adopt(
+        AI::MXNetTPU::C::dataiter_label($_[0]{h}), 'dataiter_label');
+}
+
+sub DESTROY { AI::MXNetTPU::C::handle_free($_[0]{h}) if $_[0]{h} }
+
+# ----------------------------------------------------------------- Model
+# FeedForward-style fit loop (AI::MXNet::Module->fit analog): scaled-
+# uniform init, epochs over a DataIter, kvstore push/pull per batch.
+
+package AI::MXNetTPU::Model;
+
+sub new {
+    my ($class, %args) = @_;
+    return bless {
+        symbol => $args{symbol},
+        ctx_shapes => $args{shapes},    # { data => [...], ... }
+        kv => $args{kvstore} // AI::MXNetTPU::KVStore->create('local'),
+    }, $class;
+}
+
+sub bind {
+    my ($self) = @_;
+    $self->{exec} //= $self->{symbol}->simple_bind(%{ $self->{ctx_shapes} });
+    return $self->{exec};
+}
+
+# Xavier-ish scaled-uniform init done frontend-side (the C client's
+# init_params analog), seeding the kvstore with the same values.
+sub init_params {
+    my ($self, $seed) = @_;
+    my $ex = $self->bind;
+    srand($seed // 42);
+    my @params = grep { $_ ne 'data' && $_ !~ /_label$/ }
+        $self->{symbol}->list_arguments;
+    $self->{params} = \@params;
+    for my $p (@params) {
+        my $arr = $ex->get_array(arg => $p);
+        my @shape = $arr->shape;
+        my $n = $arr->size;
+        my @vals;
+        if ($p =~ /bias|beta/) {
+            @vals = (0) x $n;
+        } elsif ($p =~ /gamma/) {
+            @vals = (1) x $n;
+        } else {
+            my $fan_in = $n / $shape[0];
+            my $scale = sqrt(3.0 / $fan_in);
+            push @vals, (2 * rand() - 1) * $scale for 1 .. $n;
+        }
+        $arr->set_values(@vals);
+        $ex->set_array(arg => $p, $arr);
+        $self->{kv}->init($p, $arr);
+    }
+}
+
+sub fit {
+    my ($self, %args) = @_;
+    my $iter   = $args{train_data};
+    my $epochs = $args{num_epoch} // 1;
+    my $ex     = $self->bind;
+    $self->{kv}->set_optimizer($args{optimizer} // 'sgd',
+                               %{ $args{optimizer_params} // {} });
+    $self->init_params($args{seed}) unless $self->{params};
+    for my $e (1 .. $epochs) {
+        $iter->reset;
+        while ($iter->next_batch) {
+            my $data  = $iter->data;
+            my $label = $iter->label;
+            $ex->set_array(arg => 'data', $data);
+            $ex->set_array(arg => 'softmax_label', $label);
+            $ex->forward(1);
+            $ex->backward;
+            for my $p (@{ $self->{params} }) {
+                my $grad = $ex->get_array(grad => $p);
+                $self->{kv}->push_grad($p, $grad);
+                my $w = $self->{kv}->pull($p, $grad->shape);
+                $ex->set_array(arg => $p, $w);
+            }
+        }
+        if ($args{verbose}) {
+            printf "epoch %d: train-acc=%.4f\n", $e,
+                $self->score($iter);
+        }
+    }
+}
+
+# Classification accuracy over one pass of the iterator.
+sub score {
+    my ($self, $iter) = @_;
+    my $ex = $self->bind;
+    my ($correct, $total) = (0, 0);
+    $iter->reset;
+    while ($iter->next_batch) {
+        my $data  = $iter->data;
+        my $label = $iter->label;
+        $ex->set_array(arg => 'data', $data);
+        $ex->forward(0);
+        my @probs  = $ex->output(0)->values;
+        my @labels = $label->values;
+        my $ncls   = @probs / @labels;
+        for my $i (0 .. $#labels) {
+            my ($best, $bestv) = (0, $probs[$i * $ncls]);
+            for my $c (1 .. $ncls - 1) {
+                if ($probs[$i * $ncls + $c] > $bestv) {
+                    ($best, $bestv) = ($c, $probs[$i * $ncls + $c]);
+                }
+            }
+            ++$correct if $best == int($labels[$i]);
+            ++$total;
+        }
+    }
+    return $total ? $correct / $total : 0;
+}
+
+sub save_checkpoint {
+    my ($self, $prefix, $epoch) = @_;
+    $self->bind->save_checkpoint($prefix, $epoch);
+}
+
+1;
+
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - perl frontend for the TPU-native MXNet-analog framework
+
+=head1 SYNOPSIS
+
+    use AI::MXNetTPU;
+
+    my $data = AI::MXNetTPU::Symbol->Variable('data');
+    my $net  = AI::MXNetTPU::Symbol->op(
+        'FullyConnected', 'fc1', { data => $data }, num_hidden => 128);
+    $net = AI::MXNetTPU::Symbol->op('Activation', 'a1', { data => $net },
+                                    act_type => 'relu');
+    $net = AI::MXNetTPU::Symbol->op('FullyConnected', 'fc2',
+                                    { data => $net }, num_hidden => 10);
+    $net = AI::MXNetTPU::Symbol->op('SoftmaxOutput', 'softmax',
+                                    { data => $net });
+
+    my $model = AI::MXNetTPU::Model->new(
+        symbol => $net,
+        shapes => { data => [32, 784], softmax_label => [32] });
+    $model->fit(train_data => $iter, num_epoch => 3,
+                optimizer => 'sgd',
+                optimizer_params => { learning_rate => 0.1 });
+
+=head1 DESCRIPTION
+
+OO layer over the C ABI of the TPU-native framework (mxtpu/c_api.h),
+mirroring how the reference's AI::MXNet wraps AI::MXNetCAPI.  Symbol
+composition, executor training, kvstore optimizers and data iterators
+all run through the same flat C API every other frontend binds.
+
+=cut

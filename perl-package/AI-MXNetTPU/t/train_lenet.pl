@@ -1,0 +1,62 @@
+#!/usr/bin/perl
+# LeNet/MNIST training from PURE PERL through the C ABI — the perl
+# analog of native/tests/train_capi_test.c, proving the "every frontend
+# binds the C API" contract in a non-C-family language (parity:
+# /root/reference/perl-package/AI-MXNet/examples/mnist.pl).
+#
+# Usage: train_lenet.pl <images.idx> <labels.idx> <epochs> <batch>
+# Prints "PERL_TRAIN acc=<final accuracy>"; exit 0 iff acc >= 0.9.
+use strict;
+use warnings;
+
+use AI::MXNetTPU;
+
+@ARGV == 4 or die "usage: $0 images.idx labels.idx epochs batch\n";
+my ($images, $labels, $epochs, $batch) = @ARGV;
+
+sub layer {
+    my ($op, $name, $input, %attrs) = @_;
+    return AI::MXNetTPU::Symbol->op($op, $name, { data => $input }, %attrs);
+}
+
+my $x = AI::MXNetTPU::Symbol->Variable('data');
+$x = layer('Convolution', 'c1', $x, kernel => [5, 5], num_filter => 8);
+$x = layer('Activation', 'a1', $x, act_type => 'tanh');
+$x = layer('Pooling', 'p1', $x, kernel => [2, 2], stride => [2, 2],
+           pool_type => 'max');
+$x = layer('Convolution', 'c2', $x, kernel => [5, 5], num_filter => 16);
+$x = layer('Activation', 'a2', $x, act_type => 'tanh');
+$x = layer('Pooling', 'p2', $x, kernel => [2, 2], stride => [2, 2],
+           pool_type => 'max');
+$x = layer('Flatten', 'fl', $x);
+$x = layer('FullyConnected', 'f1', $x, num_hidden => 64);
+$x = layer('Activation', 'a3', $x, act_type => 'tanh');
+$x = layer('FullyConnected', 'f2', $x, num_hidden => 10);
+my $net = layer('SoftmaxOutput', 'softmax', $x);
+
+# symbol listings + JSON round-trip (MXSymbolListArguments parity)
+my @args = $net->list_arguments;
+grep { $_ eq 'c1_weight' } @args or die "c1_weight missing from arguments";
+my $reloaded = AI::MXNetTPU::Symbol->from_json($net->to_json);
+$reloaded->list_outputs or die "round-trip symbol lost its outputs";
+
+my $iter = AI::MXNetTPU::DataIter->create(
+    'MNISTIter', image => $images, label => $labels,
+    batch_size => int($batch), shuffle => JSON::PP::true, seed => 7);
+
+my $model = AI::MXNetTPU::Model->new(
+    symbol => $net,
+    shapes => { data => [int($batch), 1, 28, 28],
+                softmax_label => [int($batch)] });
+$model->fit(
+    train_data => $iter,
+    num_epoch => int($epochs),
+    optimizer => 'sgd',
+    optimizer_params => { learning_rate => 0.1, momentum => 0.9,
+                          rescale_grad => 1.0 / $batch },
+    seed => 11,
+    verbose => 1);
+
+my $acc = $model->score($iter);
+printf "PERL_TRAIN acc=%.4f\n", $acc;
+exit($acc >= 0.9 ? 0 : 1);
