@@ -25,6 +25,7 @@
 #include <cstdio>
 #include <cstring>
 #include <functional>
+#include <limits>
 #include <map>
 #include <memory>
 #include <locale>
@@ -241,11 +242,14 @@ inline Symbol Flatten(const std::string &name, Symbol data) {
   return Symbol::Op("Flatten", "{}", name, {{"data", data}});
 }
 
-/* Locale-independent double formatting (std::to_string honors
- * LC_NUMERIC: a comma decimal point would break the JSON). */
+/* Locale-independent, round-trip-exact double formatting
+ * (std::to_string honors LC_NUMERIC — a comma decimal point would
+ * break the JSON; default ostream precision is 6 significant digits —
+ * silently truncating attr values like thresholds and scales). */
 inline std::string NumJSON(double v) {
   std::ostringstream os;
   os.imbue(std::locale::classic());
+  os.precision(std::numeric_limits<double>::max_digits10);
   os << v;
   return os.str();
 }
@@ -666,5 +670,11 @@ class BucketingModel {
 
 }  // namespace train
 }  // namespace mxtpu
+
+/* The FULL generated operator surface (every registry op as a typed
+ * builder in mxtpu::op::) — the OpWrapperGenerator-produced op.h analog
+ * (reference cpp-package/include/mxnet-cpp/MxNetCpp.h:17).  Included
+ * last: the builders use Symbol / NumJSON / ShapeJSON defined above. */
+#include "mxtpu/ops_generated.hpp"
 
 #endif  // MXTPU_TRAINING_HPP_
