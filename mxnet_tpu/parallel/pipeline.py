@@ -132,7 +132,8 @@ def _stage_pspec(stacked_params, axis):
 
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
-                        mesh: Mesh, n_microbatch: int, axis: str = "pipe"):
+                        mesh: Mesh, n_microbatch: int, axis: str = "pipe",
+                        batch_axis=None, param_axes=None, reduce_axes=()):
     """One training step with the **1F1B schedule** (PipeDream-flush):
     returns ``(mean_loss, grads)`` where grads matches ``stacked_params``.
 
@@ -154,12 +155,27 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
 
     ``loss_fn(y_mb, target_mb) -> scalar`` is applied at the last stage;
     its mean over microbatches is returned.
+
+    **Composed meshes** (dp x tp x pp in ONE mesh): pass ``batch_axis``
+    to shard ``x``/``target`` along a data axis (loss and grads are
+    ``pmean``-reduced over it — the kvstore all-reduce as an XLA
+    collective); ``param_axes`` to override the per-leaf PartitionSpecs
+    of ``stacked_params`` (leading dim must stay the pipe axis; other
+    dims may shard Megatron-style over a model axis); and
+    ``reduce_axes`` naming the model axes whose contraction the stage
+    shards.  Contract: with ``reduce_axes``, ``stage_fn`` returns
+    PARTIAL sums (no internal psum) and the pipeline reduces the stage
+    output on both passes — this keeps the manual per-stage vjp exact
+    (replicated cotangents seed each partial directly; ``dx`` is
+    psum-reduced because the replicated input feeds every shard).
     """
     S = mesh.shape[axis]
     B = x.shape[0]
-    assert B % n_microbatch == 0, "batch must divide into microbatches"
+    dp = mesh.shape[batch_axis] if batch_axis is not None else 1
+    assert B % (n_microbatch * dp) == 0, \
+        "batch must divide into data shards x microbatches"
     M = n_microbatch
-    mb = B // M
+    mb = B // dp // M  # microbatch size of the LOCAL data shard
     n_ticks = M + 2 * S - 1
     window = 2 * S  # ring slots for saved inputs; live span < window
 
@@ -181,6 +197,12 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
             m_f = jnp.clip(m_f, 0, M - 1)
             x_in = jnp.where(s_idx == 0, xs[m_f], act_in)
             y = _stage_call(stage_fn, params, x_in, s_idx)
+            if reduce_axes:
+                # model-parallel stages emit PARTIAL sums; the pipeline
+                # owns the reduction (keeping stage_fn free of psum makes
+                # the manual vjp below exact: replicated cotangents seed
+                # each partial directly, no transpose inflation)
+                y = lax.psum(y, reduce_axes)
             slot_f = m_f % window
             saved = saved.at[slot_f].set(
                 jnp.where(fwd_valid, x_in, saved[slot_f]))
@@ -195,15 +217,22 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
             y_re, vjp = jax.vjp(
                 lambda p, xi: _stage_call(stage_fn, p, xi, s_idx),
                 params, x_saved)
+            if reduce_axes:
+                y_re = lax.psum(y_re, reduce_axes)
             mb_loss, g_seed = jax.value_and_grad(
                 lambda yy: loss_fn(yy, tgt[m_b]))(y_re)
             g_eff = jnp.where(last, g_seed, grad_in)
-            dp, dx = vjp(g_eff)
+            dparams, dx = vjp(g_eff)
+            if reduce_axes:
+                # x is replicated across the model axis and consumed by
+                # every shard, so its cotangent is the sum of per-shard
+                # contributions
+                dx = lax.psum(dx, reduce_axes)
             # where (not multiply): warm-up/cool-down recomputes run on
             # garbage inputs whose grads may be NaN, and 0*NaN = NaN
             gacc = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(bwd_valid, g,
-                                           jnp.zeros_like(g)), gacc, dp)
+                                           jnp.zeros_like(g)), gacc, dparams)
             loss_acc = loss_acc + jnp.where(bwd_valid & last, mb_loss, 0.0)
 
             # ---------- ring rotations ----------
@@ -219,13 +248,22 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
         (_, _, _, gacc, loss_acc), _ = lax.scan(
             tick, init, jnp.arange(n_ticks))
         loss = lax.psum(loss_acc, axis) / M
-        # grads of mean-over-microbatches loss: accumulated per-mb grads / M;
-        # re-add the stage axis so out_specs P(axis) rebuilds the stack
-        return loss, jax.tree_util.tree_map(lambda g: g[None] / M, gacc)
+        # grads of mean-over-microbatches loss: accumulated per-mb grads / M
+        gacc = jax.tree_util.tree_map(lambda g: g / M, gacc)
+        if batch_axis is not None:
+            # data-parallel reduction: global loss is the mean over data
+            # shards, so its param grads are the pmean of shard grads
+            loss = lax.pmean(loss, batch_axis)
+            gacc = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, batch_axis), gacc)
+        # re-add the stage axis so out_specs' pipe axis rebuilds the stack
+        return loss, jax.tree_util.tree_map(lambda g: g[None], gacc)
 
-    pspec = _stage_pspec(stacked_params, axis)
+    pspec = param_axes if param_axes is not None \
+        else _stage_pspec(stacked_params, axis)
+    dspec = P(batch_axis) if batch_axis is not None else P()
     return shard_map(
-        per_device, mesh=mesh, in_specs=(pspec, P(), P()),
+        per_device, mesh=mesh, in_specs=(pspec, dspec, dspec),
         out_specs=(P(), pspec), **{_CHECK_KW: False})(
             stacked_params, x, target)
 

@@ -69,6 +69,22 @@ inline std::string ShapesJSON(
   return out + "}";
 }
 
+/* Locale-independent, round-trip-exact double formatting
+ * (std::to_string honors LC_NUMERIC — a comma decimal point would
+ * break the JSON; default ostream precision is 6 significant digits —
+ * silently truncating attr values like thresholds and scales). */
+inline std::string NumJSON(double v) {
+  /* Non-finite values in the spellings Python's json.loads accepts
+   * ("inf"/"nan" from ostream are invalid JSON). */
+  if (std::isnan(v)) return "NaN";
+  if (std::isinf(v)) return v < 0 ? "-Infinity" : "Infinity";
+  std::ostringstream os;
+  os.imbue(std::locale::classic());
+  os.precision(std::numeric_limits<double>::max_digits10);
+  os << v;
+  return os.str();
+}
+
 /* Parse a flat JSON array of strings: ["a", "b"] (sym_list output). */
 inline std::vector<std::string> ParseStringArray(const std::string &json) {
   std::vector<std::string> out;
@@ -197,94 +213,81 @@ class Symbol {
   std::shared_ptr<detail::HandleOwner> owner_;
 };
 
-/* ---------- symbolic ops (cpp-package op.h subset) ---------- */
+}  // namespace train
+}  // namespace mxtpu
+
+/* The FULL generated operator surface (every registry op as a typed
+ * builder in mxtpu::train::op::) — the OpWrapperGenerator-produced op.h
+ * analog (reference cpp-package/include/mxnet-cpp/MxNetCpp.h:17).
+ * Included here (global scope, after Symbol/JSON helpers) so the
+ * convenience wrappers below can delegate to it — ONE attr-emission
+ * path for every op. */
+#include "mxtpu/ops_generated.hpp"
+
+namespace mxtpu {
+namespace train {
+
+/* ---------- convenience wrappers (cpp-package op.h ergonomic subset)
+ * Thin forwards to the generated builders: pair<int,int> kernels and
+ * the historical argument orders, zero duplicated emission logic. */
 
 inline Symbol Convolution(const std::string &name, Symbol data,
                           std::pair<int, int> kernel, int num_filter,
                           std::pair<int, int> stride = {1, 1},
                           std::pair<int, int> pad = {0, 0}) {
-  char kw[192];
-  std::snprintf(kw, sizeof kw,
-                "{\"kernel\": [%d, %d], \"num_filter\": %d, "
-                "\"stride\": [%d, %d], \"pad\": [%d, %d]}",
-                kernel.first, kernel.second, num_filter, stride.first,
-                stride.second, pad.first, pad.second);
-  return Symbol::Op("Convolution", kw, name, {{"data", data}});
+  return op::Convolution(name, data, {kernel.first, kernel.second},
+                         num_filter, Symbol(), Symbol(),
+                         {stride.first, stride.second}, /*dilate=*/{},
+                         {pad.first, pad.second});
 }
 
 inline Symbol FullyConnected(const std::string &name, Symbol data,
                              int num_hidden) {
-  return Symbol::Op("FullyConnected",
-                    "{\"num_hidden\": " + std::to_string(num_hidden) + "}",
-                    name, {{"data", data}});
+  return op::FullyConnected(name, data, num_hidden);
 }
 
 inline Symbol Activation(const std::string &name, Symbol data,
                          const std::string &act_type) {
-  return Symbol::Op("Activation", "{\"act_type\": \"" + act_type + "\"}",
-                    name, {{"data", data}});
+  return op::Activation(name, data, act_type);
 }
 
 inline Symbol Pooling(const std::string &name, Symbol data,
                       std::pair<int, int> kernel,
                       const std::string &pool_type = "max",
                       std::pair<int, int> stride = {1, 1}) {
-  char kw[160];
-  std::snprintf(kw, sizeof kw,
-                "{\"kernel\": [%d, %d], \"stride\": [%d, %d], "
-                "\"pool_type\": \"%s\"}",
-                kernel.first, kernel.second, stride.first, stride.second,
-                pool_type.c_str());
-  return Symbol::Op("Pooling", kw, name, {{"data", data}});
+  return op::Pooling(name, data, {kernel.first, kernel.second}, pool_type,
+                     /*global_pool=*/false, /*pooling_convention=*/"valid",
+                     {stride.first, stride.second});
 }
 
 inline Symbol Flatten(const std::string &name, Symbol data) {
-  return Symbol::Op("Flatten", "{}", name, {{"data", data}});
-}
-
-/* Locale-independent, round-trip-exact double formatting
- * (std::to_string honors LC_NUMERIC — a comma decimal point would
- * break the JSON; default ostream precision is 6 significant digits —
- * silently truncating attr values like thresholds and scales). */
-inline std::string NumJSON(double v) {
-  std::ostringstream os;
-  os.imbue(std::locale::classic());
-  os.precision(std::numeric_limits<double>::max_digits10);
-  os << v;
-  return os.str();
+  return op::Flatten(name, data);
 }
 
 inline Symbol Dropout(const std::string &name, Symbol data, double p) {
-  return Symbol::Op("Dropout", "{\"p\": " + NumJSON(p) + "}", name,
-                    {{"data", data}});
+  return op::Dropout(name, data, p);
 }
 
 inline Symbol BatchNorm(const std::string &name, Symbol data) {
-  return Symbol::Op("BatchNorm", "{}", name, {{"data", data}});
+  return op::BatchNorm(name, data);
 }
 
 inline Symbol SoftmaxOutput(const std::string &name, Symbol data) {
-  return Symbol::Op("SoftmaxOutput", "{}", name, {{"data", data}});
+  return op::SoftmaxOutput(name, data);
 }
 
 inline Symbol Reshape(const std::string &name, Symbol data,
                       const std::vector<int64_t> &shape) {
-  return Symbol::Op("Reshape", "{\"shape\": " + ShapeJSON(shape) + "}",
-                    name, {{"data", data}});
+  return op::Reshape(name, data, shape);
 }
 
 inline Symbol SliceAxis(const std::string &name, Symbol data, int axis,
                         int begin, int end) {
-  char kw[96];
-  std::snprintf(kw, sizeof kw,
-                "{\"axis\": %d, \"begin\": %d, \"end\": %d}", axis, begin,
-                end);
-  return Symbol::Op("slice_axis", kw, name, {{"data", data}});
+  return op::slice_axis(name, data, axis, begin, end);
 }
 
 inline Symbol Add(const std::string &name, Symbol lhs, Symbol rhs) {
-  return Symbol::Op("broadcast_add", "{}", name,
-                    {{"lhs", lhs}, {"rhs", rhs}});
+  return op::broadcast_add(name, lhs, rhs);
 }
 
 /* Embedding / FullyConnected with EXPLICIT weight symbols: pass the same
@@ -293,20 +296,12 @@ inline Symbol Add(const std::string &name, Symbol lhs, Symbol rhs) {
  * (reference bucketing.md: all buckets share the master's arrays). */
 inline Symbol Embedding(const std::string &name, Symbol data, Symbol weight,
                         int input_dim, int output_dim) {
-  char kw[96];
-  std::snprintf(kw, sizeof kw,
-                "{\"input_dim\": %d, \"output_dim\": %d}", input_dim,
-                output_dim);
-  return Symbol::Op("Embedding", kw, name,
-                    {{"data", data}, {"weight", weight}});
+  return op::Embedding(name, data, input_dim, output_dim, weight);
 }
 
 inline Symbol FullyConnected(const std::string &name, Symbol data,
                              Symbol weight, Symbol bias, int num_hidden) {
-  return Symbol::Op("FullyConnected",
-                    "{\"num_hidden\": " + std::to_string(num_hidden) + "}",
-                    name,
-                    {{"data", data}, {"weight", weight}, {"bias", bias}});
+  return op::FullyConnected(name, data, num_hidden, weight, bias);
 }
 
 /* ---------- Executor ---------- */
@@ -670,11 +665,5 @@ class BucketingModel {
 
 }  // namespace train
 }  // namespace mxtpu
-
-/* The FULL generated operator surface (every registry op as a typed
- * builder in mxtpu::op::) — the OpWrapperGenerator-produced op.h analog
- * (reference cpp-package/include/mxnet-cpp/MxNetCpp.h:17).  Included
- * last: the builders use Symbol / NumJSON / ShapeJSON defined above. */
-#include "mxtpu/ops_generated.hpp"
 
 #endif  // MXTPU_TRAINING_HPP_
