@@ -110,9 +110,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
+        # matmuls take the INPUT dtype (bf16 rides the MXU at full rate;
+        # an fp32 pre-cast would quarter it) and accumulate fp32; all
+        # softmax math stays fp32
+        q = q_ref[0]  # [bq, D]
+        k = k_ref[0]  # [bk, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
@@ -135,7 +138,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         alpha = jnp.exp(m_prev - m_new)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1)
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
@@ -278,10 +281,12 @@ def _bwd_p_ds(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, qi, kj, *,
     attention weights ``p`` and score gradients ``ds`` plus the fp32
     block operands.  Both bwd kernels call this, so the mask and scale
     logic can never diverge between dq and dk/dv."""
-    qb = q_ref[0].astype(jnp.float32)    # [bq, D]
-    dob = do_ref[0].astype(jnp.float32)  # [bq, D]
-    kb = k_ref[0].astype(jnp.float32)    # [bk, D]
-    vb = v_ref[0].astype(jnp.float32)
+    # matmul operands stay in the input dtype (bf16 at full MXU rate),
+    # accumulating fp32; softmax statistics math is fp32 throughout
+    qb = q_ref[0]    # [bq, D]
+    dob = do_ref[0]  # [bq, D]
+    kb = k_ref[0]    # [bk, D]
+    vb = v_ref[0]
     # [bq, _LANE] lane-broadcast vectors; any-lane reduce recovers them
     lseb = jnp.max(lse_ref[0], axis=1)   # [bq] (+inf on padded q rows)
     dlt = jnp.max(delta_ref[0], axis=1)  # [bq]
@@ -336,10 +341,10 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             sm_scale=sm_scale, causal=causal, block_q=block_q,
             block_k=block_k, kv_len=kv_len)
         dv_scr[...] += jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[...] += jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == n_q - 1)
@@ -374,7 +379,7 @@ def _flash_bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             sm_scale=sm_scale, causal=causal, block_q=block_q,
             block_k=block_k, kv_len=kv_len)
         dq_scr[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_k - 1)
