@@ -19,8 +19,9 @@ from mxnet_tpu import models
 logging.basicConfig(level=logging.INFO)
 
 
-def score(network, dev, batch_size, num_batches, image_shape=(3, 224, 224),
-          num_layers=None, dtype="float32"):
+def _build_symbol(network, image_shape, num_layers, dtype):
+    """One network-setup path shared by both scoring modes (host-loop and
+    --device-loop must benchmark the identical configuration)."""
     kwargs = {}
     if num_layers:
         kwargs["num_layers"] = num_layers
@@ -28,6 +29,12 @@ def score(network, dev, batch_size, num_batches, image_shape=(3, 224, 224),
         image_shape = (3, 299, 299)
     sym = models.get_symbol(network, num_classes=1000,
                             image_shape=image_shape, dtype=dtype, **kwargs)
+    return sym, image_shape
+
+
+def score(network, dev, batch_size, num_batches, image_shape=(3, 224, 224),
+          num_layers=None, dtype="float32"):
+    sym, image_shape = _build_symbol(network, image_shape, num_layers, dtype)
     data_shape = [("data", (batch_size,) + image_shape)]
     mod = mx.mod.Module(symbol=sym, context=dev)
     mod.bind(for_training=False, inputs_need_grad=False, data_shapes=data_shape)
@@ -53,12 +60,63 @@ def score(network, dev, batch_size, num_batches, image_shape=(3, 224, 224),
     return num_batches * batch_size / (time.time() - tic)
 
 
+def score_device_loop(network, dev, batch_size, num_batches,
+                      image_shape=(3, 224, 224), num_layers=None,
+                      dtype="float32"):
+    """Pure-device inference throughput: ``num_batches`` forwards inside
+    ONE jitted ``lax.fori_loop``, so per-batch host dispatch never enters
+    the measurement.  This is the apples-to-apples number against the
+    reference's local-PCIe GPUs (`benchmark_score.py`): over the
+    tunneled PJRT device, per-call dispatch latency (~1-2 ms) dominates
+    any sub-2ms step in the host-loop ``score`` — see the BENCH_TABLE.md
+    footnote.  Each iteration's input depends on the previous output (a
+    1e-30-scaled logit perturbation), so XLA can neither hoist the
+    forward out of the loop nor collapse iterations."""
+    import jax
+    import jax.numpy as jnp
+
+    sym, image_shape = _build_symbol(network, image_shape, num_layers, dtype)
+    ex = sym.simple_bind(dev, grad_req="null",
+                         data=(batch_size,) + image_shape)
+    for name, arr in ex.arg_dict.items():
+        if name != "data" and not name.endswith("_label"):
+            mx.initializer.Xavier(magnitude=2.0)(name, arr)
+    params = {k: v._data for k, v in ex.arg_dict.items() if k != "data"}
+    aux = {k: v._data for k, v in ex.aux_dict.items()}
+    run = ex._run  # the executor's already-built graph function
+    data = jnp.asarray(np.random.uniform(
+        -1, 1, (batch_size,) + image_shape).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def loop(params, aux, data):
+        def body(i, carry):
+            acc, d = carry
+            args = dict(params)
+            args["data"] = d.astype(data.dtype)
+            outs, _ = run(args, aux, key, False)
+            m = outs[0].astype(jnp.float32).ravel()[0]
+            return (acc + m, d + m * 1e-30)
+        acc, d = jax.lax.fori_loop(0, num_batches, body, (0.0, data))
+        return acc
+
+    np.asarray(loop(params, aux, data))  # compile + warm
+    tic = time.time()
+    np.asarray(loop(params, aux, data))  # D2H scalar fetch = true sync
+    return num_batches * batch_size / (time.time() - tic)
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--network", type=str, default="all")
     parser.add_argument("--batch-size", type=int, default=0)
     parser.add_argument("--num-batches", type=int, default=10)
     parser.add_argument("--dtype", type=str, default="float32")
+    parser.add_argument("--device-loop", action="store_true",
+                        help="run all batches inside one jitted fori_loop "
+                             "(excludes per-batch tunnel dispatch latency; "
+                             "the apples-to-apples number vs local-PCIe "
+                             "GPUs for sub-2ms steps)")
     args = parser.parse_args()
 
     import jax
@@ -67,9 +125,10 @@ if __name__ == "__main__":
                  "resnet-50", "resnet-152"]
                 if args.network == "all" else [args.network])
     batch_sizes = [args.batch_size] if args.batch_size else [1, 32, 64, 128]
+    fn = score_device_loop if args.device_loop else score
     for net in networks:
         logging.info("network: %s", net)
         for b in batch_sizes:
-            speed = score(net, dev, b, args.num_batches, dtype=args.dtype)
+            speed = fn(net, dev, b, args.num_batches, dtype=args.dtype)
             logging.info("batch size %3d, dtype %s, images/sec: %f",
                          b, args.dtype, speed)

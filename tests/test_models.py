@@ -158,3 +158,27 @@ def test_resnet_s2d_stem_backward_parity():
     g2 = ex2.grad_dict["conv0_weight"].asnumpy()
     np.testing.assert_allclose(g2[support], g1m[support], rtol=1e-3,
                                atol=1e-5)
+
+
+def test_benchmark_score_device_loop_smoke():
+    """--device-loop scoring (all batches in one jitted fori_loop; the
+    dispatch-free methodology of docs/PERF.md) runs end to end and
+    produces a positive throughput on a tiny net."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "image_classification",
+                      "benchmark_score.py"),
+         "--network", "alexnet", "--batch-size", "2", "--num-batches", "3",
+         "--device-loop"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_PLATFORM="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [l for l in r.stderr.splitlines() + r.stdout.splitlines()
+            if "images/sec" in l]
+    assert line, (r.stdout, r.stderr)
+    assert float(line[0].rsplit(" ", 1)[1]) > 0
