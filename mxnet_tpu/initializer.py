@@ -48,6 +48,14 @@ class Initializer(object):
     def __call__(self, desc, arr):
         if not isinstance(desc, string_types):
             raise TypeError("desc must be string or InitDesc")
+        # a Variable's own init wins over suffix routing (parity:
+        # reference initializer.py:102-107 — the '__init__' attr set by
+        # mx.sym.Variable(init=...), e.g. the fused RNN's parameters)
+        init = getattr(desc, "attrs", None) and desc.attrs.get("__init__")
+        if init:
+            klass, kwargs = json.loads(init)
+            _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
+            return
         if desc.endswith("weight"):
             self._init_weight(desc, arr)
         elif desc.endswith("bias"):
@@ -302,7 +310,11 @@ class FusedRNN(Initializer):
                             forget_bias=self._forget_bias, prefix="")
         args = cell.unpack_weights({"parameters": arr})
         for name in args:
-            desc_i = InitDesc(name, getattr(desc, "attrs", {}))
+            # slice descs must NOT inherit the packed variable's attrs:
+            # its '__init__' (this FusedRNN) would re-enter here on a
+            # single slice (the reference passes only global_init)
+            desc_i = InitDesc(name,
+                              global_init=getattr(desc, "global_init", None))
             # for lstm bias, we use special initializer which adds a bias to forget gate
             if self._mode == "lstm" and name.endswith("_f_bias"):
                 args[name][:] = self._forget_bias

@@ -181,3 +181,13 @@ def test_rnn_time_major_example():
     assert stats["parity_gap"] < 1e-5, stats
     assert stats["ppl_tnc"] < 1.35 * stats["true_ppl"], stats
     assert stats["ppl_ntc"] < 1.35 * stats["true_ppl"], stats
+
+
+def test_speech_demo_example():
+    """Kaldi-pipeline acoustic model (reference example/speech-demo):
+    features written as REAL Kaldi binary ark/scp (pure-numpy reader —
+    the reference needs a compiled Kaldi), round-tripped, trained
+    through an LSTM acoustic model, posteriors written back to ark and
+    verified; frame accuracy >= 0.9."""
+    stats = _run_example("speech_demo.py", "epochs=6, log=False")
+    assert stats["frame_acc"] >= 0.9, stats

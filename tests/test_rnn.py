@@ -165,3 +165,29 @@ def test_bidirectional_unroll_tnc_merge_axis():
                          merge_outputs=True)
     _, out_shapes, _ = out.infer_shape(data=(T, B, D))
     assert out_shapes == [(T, B, 2 * H)]
+
+
+def test_variable_init_attr_fused_rnn():
+    """A Variable's init=... attr drives initialization (reference
+    initializer.py:102-107), including the self-referential FusedRNN
+    case: the packed-parameter desc carries '__init__' but the
+    per-slice descs must not re-enter it (regression: the slice descs
+    once inherited the attr and crashed in unpack_weights)."""
+    H, L = 8, 1
+    fused_init = mx.initializer.FusedRNN(
+        mx.initializer.Uniform(0.1), H, L, "lstm")
+    data = mx.sym.Variable("data")
+    rnn = mx.sym.RNN(
+        data,
+        parameters=mx.sym.Variable("lstm_parameters", init=fused_init),
+        state=mx.sym.Variable("lstm_state", init=mx.initializer.Zero()),
+        state_cell=mx.sym.Variable("lstm_state_cell",
+                                   init=mx.initializer.Zero()),
+        mode="lstm", num_layers=L, state_size=H, name="lstm")
+    mod = mx.mod.Module(rnn, context=mx.cpu(), label_names=())
+    mod.bind(data_shapes=[("data", (5, 3, 4))], for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    params, _ = mod.get_params()
+    w = params["lstm_parameters"].asnumpy()
+    assert np.abs(w).max() <= 1.0 + 1e-6  # uniform slices + forget bias
+    assert np.abs(w).sum() > 0
