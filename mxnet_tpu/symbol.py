@@ -683,6 +683,23 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
     input_names = op.input_names(node.attrs) + op.aux_names
     for (inode, _), iname in zip(node.inputs, input_names):
         name_of[iname] = inode
+    if op.name == "TorchModule":
+        # parameter shapes come from the torch module itself (no data
+        # shape needed — the reference plugin's InferShape asks torch)
+        from .torch_bridge import torch_param_info
+
+        solved = {iname: shape
+                  for iname, _, shape in torch_param_info(node.attrs)}
+        progress = False
+        for pname, pshape in solved.items():
+            vnode = name_of.get(pname)
+            if vnode is not None and vnode.is_variable \
+                    and vnode._id not in shapes_out:
+                shapes_out[vnode._id] = [
+                    jax.ShapeDtypeStruct(tuple(pshape), _np.float32)]
+                resolved[vnode.name] = tuple(pshape)
+                progress = True
+        return progress
     data = name_of.get("data")
     if data is None or data._id not in shapes_out:
         return False

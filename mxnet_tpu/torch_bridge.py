@@ -84,3 +84,243 @@ class TorchModule(object):
         if isinstance(out, (list, tuple)):
             return [array(o.numpy()) for o in out]
         return array(out.numpy())
+
+
+# ----------------------------------------------------------------------
+# TorchModule as a SYMBOL op with training (parity: reference
+# plugin/torch TorchModuleOp + example/torch/torch_module.py — torch nn
+# layers as graph nodes whose parameters the framework trains).
+#
+# TPU-native design: the torch module runs as a HOST CALLBACK
+# (jax.pure_callback) with a custom VJP whose backward is a second
+# callback through torch.autograd — the same escape-hatch role as the
+# reference's CPU Torch plugin (torch has no TPU backend; on a TPU
+# device every call round-trips host memory, exactly like the
+# reference's GPU<->CPU torch path).  module spec strings are python
+# expressions over a restricted {nn, torch} namespace, e.g.
+# "nn.Linear(784, 128)" (the lua_string analog).
+# ----------------------------------------------------------------------
+
+def _validate_spec_ast(spec):
+    """Whitelist-parse a module spec: only ``nn.<Name>(...)`` /
+    ``torch.nn....`` constructor calls over literal arguments (and nested
+    allowed calls) are admitted.  Symbol JSON is untrusted model data —
+    shape inference instantiates the spec at BIND time, so a bare eval
+    would be remote code execution through a model file (the kvstore wire
+    format is non-executable for the same reason)."""
+    import ast
+
+    tree = ast.parse(spec, mode="eval")
+
+    def ok(node):
+        if isinstance(node, ast.Expression):
+            return ok(node.body)
+        if isinstance(node, ast.Call):
+            return (ok(node.func)
+                    and all(ok(a) for a in node.args)
+                    and all(ok(k.value) for k in node.keywords))
+        if isinstance(node, ast.Attribute):
+            # attribute chains must root at `nn` or `torch.nn`
+            parts = []
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if not isinstance(cur, ast.Name):
+                return False
+            parts.append(cur.id)
+            parts.reverse()
+            return parts[0] == "nn" or parts[:2] == ["torch", "nn"]
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value,
+                              (int, float, bool, str, type(None)))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(ok(e) for e in node.elts)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            return ok(node.operand)
+        return False
+
+    if not ok(tree):
+        raise MXNetError(
+            "TorchModule spec %r rejected: only nn.<Module>(...) "
+            "constructor expressions over literals are allowed" % spec)
+
+
+def _template(spec):
+    """Cached validated template module for a spec (read for metadata,
+    deep-copied for execution — eval + torch init run once per spec)."""
+    mod = _TEMPLATES.get(spec)
+    if mod is None:
+        torch = _torch()
+        _validate_spec_ast(spec)
+        try:
+            mod = eval(spec, {"__builtins__": {}},  # noqa: S307 - AST-vetted
+                       {"nn": torch.nn, "torch": torch})
+        except Exception as exc:
+            raise MXNetError("cannot build torch module %r: %s"
+                             % (spec, exc))
+        if not isinstance(mod, torch.nn.Module):
+            raise MXNetError("TorchModule spec %r is not an nn.Module"
+                             % spec)
+        if list(mod.named_buffers()):
+            raise MXNetError(
+                "TorchModule %r has registered buffers (BatchNorm running "
+                "stats etc.); stateful modules are not supported — the op "
+                "is stateless between calls" % spec)
+        _TEMPLATES[spec] = mod
+    return mod
+
+
+_TEMPLATES = {}
+
+
+def _instantiate(spec):
+    import copy
+
+    return copy.deepcopy(_template(spec))
+
+
+def torch_param_info(attrs):
+    """[(input_name, torch_name, shape), ...] for the module spec —
+    drives Op.input_names and symbol shape inference."""
+    mod = _template(attrs["module"])
+    out = []
+    for tname, p in mod.named_parameters():
+        out.append((tname.replace(".", "_"), tname, tuple(p.shape)))
+    return out
+
+
+def _torch_input_names(attrs):
+    names = ["data_%d" % i for i in range(int(attrs.get("num_data", 1)))]
+    declared = int(attrs.get("num_params", 0))
+    if declared:
+        pnames = [n for n, _, _ in torch_param_info(attrs)]
+        if declared != len(pnames):
+            raise MXNetError(
+                "TorchModule %r: num_params=%d declared but the module "
+                "has %d parameters (%s)"
+                % (attrs.get("module"), declared, len(pnames), pnames))
+        names += pnames
+    return names
+
+
+def _run_module(spec, train, seed, np_datas, np_params, ct=None):
+    """Host-side torch execution: forward, or forward+backward when a
+    cotangent is given (returns input+param grads).  ``seed`` pins the
+    torch RNG inside a fork_rng scope so a stochastic module (Dropout)
+    draws the SAME realization in the forward and the backward's
+    recompute — without it, grads would belong to a different random
+    mask than the reported outputs."""
+    import numpy as np
+
+    torch = _torch()
+    mod = _instantiate(spec)
+    mod.train(bool(train))
+    with torch.no_grad():
+        for (_, p), v in zip(mod.named_parameters(), np_params):
+            # copy: callback arrays may be read-only views
+            p.copy_(torch.from_numpy(np.array(v, dtype=np.float32)))
+    tins = [torch.from_numpy(np.ascontiguousarray(d, dtype=np.float32))
+            for d in np_datas]
+    with torch.random.fork_rng(devices=[]):
+        torch.manual_seed(int(abs(float(seed))) % (2 ** 31))
+        if ct is None:
+            with torch.no_grad():
+                out = mod(*tins)
+            if isinstance(out, (list, tuple)):
+                raise MXNetError("TorchModule supports num_outputs=1")
+            return np.ascontiguousarray(out.numpy(), dtype=np.float32)
+        for t in tins:
+            t.requires_grad_(True)
+        out = mod(*tins)
+        out.backward(torch.from_numpy(
+            np.ascontiguousarray(ct, dtype=np.float32)))
+    grads = [t.grad for t in tins] + [p.grad for _, p
+                                      in mod.named_parameters()]
+    return tuple(
+        np.ascontiguousarray(
+            g.numpy() if g is not None else np.zeros(shape, np.float32),
+            dtype=np.float32)
+        for g, shape in zip(grads, [tuple(t.shape) for t in tins]
+                            + [tuple(p.shape)
+                               for _, p in mod.named_parameters()]))
+
+
+def _register_torch_module_op():
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import ParamSpec as P, register
+
+    @register(
+        "TorchModule",
+        arg_names=["data_0"],
+        input_names_fn=_torch_input_names,
+        params={
+            "module": P("str", required=True),
+            "num_data": P("int", 1),
+            "num_params": P("int", 0),
+            "num_outputs": P("int", 1),
+        },
+        needs_mode=True,
+        needs_rng=True,
+    )
+    def _torch_module(attrs, *inputs, is_train=False, rng=None):
+        if int(attrs.get("num_outputs", 1)) != 1:
+            raise MXNetError("TorchModule supports num_outputs=1")
+        spec = attrs["module"]
+        n_data = int(attrs.get("num_data", 1))
+        declared = int(attrs.get("num_params", 0))
+        vals = [jnp.asarray(x, jnp.float32) for x in inputs]
+        info = torch_param_info(attrs)
+        if declared != len(info) or len(vals) - n_data != len(info):
+            raise MXNetError(
+                "TorchModule %r: num_params=%d declared, %d inputs bound, "
+                "but the module has %d parameters"
+                % (spec, declared, len(vals) - n_data, len(info)))
+        # output shape: run torch once on zeros (host, trace time)
+        import numpy as np
+
+        out_np = _run_module(
+            spec, False, 0.0,
+            [np.zeros(v.shape, np.float32) for v in vals[:n_data]],
+            [np.zeros(v.shape, np.float32) for v in vals[n_data:]])
+        out_sdt = jax.ShapeDtypeStruct(out_np.shape, jnp.float32)
+        train = bool(is_train)
+        # float32 seed (its cotangent is an ordinary zero; an int seed
+        # would need float0 handling) shared by fwd + bwd callbacks so
+        # stochastic modules draw one realization per step
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        seed = jax.random.uniform(rng, (), jnp.float32) * (2.0 ** 30)
+
+        @jax.custom_vjp
+        def apply_(seed_, *vs):
+            return jax.pure_callback(
+                lambda s, *hv: _run_module(spec, train, s, hv[:n_data],
+                                           hv[n_data:]),
+                out_sdt, seed_, *vs)
+
+        def fwd_(seed_, *vs):
+            return apply_(seed_, *vs), (seed_, vs)
+
+        def bwd_(res, ct):
+            seed_, vs = res
+            grad_sdt = tuple(jax.ShapeDtypeStruct(v.shape, jnp.float32)
+                             for v in vs)
+            grads = jax.pure_callback(
+                lambda ct_, s, *hv: _run_module(spec, train, s,
+                                                hv[:n_data], hv[n_data:],
+                                                ct=ct_),
+                grad_sdt, ct, seed_, *vs)
+            return (jnp.zeros_like(seed_),) + tuple(grads)
+
+        apply_.defvjp(fwd_, bwd_)
+        return apply_(seed, *vals)
+
+
+try:  # torch itself stays optional (errors surface at USE time), but a
+    # broken registry import must not be silently swallowed
+    _register_torch_module_op()
+except ImportError:  # pragma: no cover
+    pass

@@ -191,3 +191,11 @@ def test_speech_demo_example():
     verified; frame accuracy >= 0.9."""
     stats = _run_example("speech_demo.py", "epochs=6, log=False")
     assert stats["frame_acc"] >= 0.9, stats
+
+
+def test_torch_module_example():
+    """Hybrid net with torch nn.Linear layers as trainable graph nodes
+    (reference example/torch/torch_module.py): trains to >=0.95 with
+    the torch parameters updated by the framework's optimizer."""
+    stats = _run_example("torch_module.py", "epochs=8, log=False")
+    assert stats["acc"] >= 0.95, stats
