@@ -451,11 +451,32 @@ def _make_sym_fn(op_name):
         name = kwargs.pop("name", None)
         attr = kwargs.pop("attr", None)
         sym_inputs = list(args)
-        # tensor inputs by keyword
-        consumed = []
-        for aname in op.arg_names:
-            if aname in kwargs and isinstance(kwargs[aname], Symbol):
-                sym_inputs.append(kwargs.pop(aname))
+        # tensor inputs by keyword, slot-aligned: input names come from
+        # input_names_fn when the op's slots depend on attrs (TorchModule's
+        # torch-param inputs, RNN state slots), else arg_names.  An omitted
+        # middle name gets a None placeholder (auto-materialized by
+        # _create), so a later keyword can never shift into a wrong slot.
+        names = None
+        if op.input_names_fn is not None:
+            try:
+                names = list(op.input_names_fn(
+                    {k: v for k, v in kwargs.items()
+                     if not isinstance(v, Symbol)}))
+            except MXNetError:
+                raise  # registry-level validation (e.g. num_params mismatch)
+            except Exception:
+                names = None  # attrs incomplete; fall back to static names
+        if names is None:
+            names = list(op.arg_names)
+        tail = names[len(sym_inputs):]
+        if any(isinstance(kwargs.get(n), Symbol) for n in tail):
+            for aname in tail:
+                if isinstance(kwargs.get(aname), Symbol):
+                    sym_inputs.append(kwargs.pop(aname))
+                else:
+                    sym_inputs.append(None)
+            while sym_inputs and sym_inputs[-1] is None:
+                sym_inputs.pop()
         if op.variable_args:
             # Concat(*args) style: also accept a list as first arg
             if len(sym_inputs) == 1 and isinstance(sym_inputs[0], (list, tuple)):

@@ -53,6 +53,28 @@ int main() {
       std::fprintf(stderr, "softmax row does not sum to 1 (%f)\n", sum);
       return 1;
     }
+    // named-input overload: attr-dependent input names (TorchModule binds
+    // one input per torch parameter, named after the parameter — the
+    // fixed-arity form cannot express this)
+    Symbol td = Symbol::Variable("td");
+    Symbol tw = Symbol::Variable("tw");
+    Symbol tb = Symbol::Variable("tb");
+    Symbol tm = op::TorchModule(
+        "tm", {{"data_0", td}, {"weight", tw}, {"bias", tb}},
+        /*module=*/"nn.Linear(4,3)", /*num_data=*/1, /*num_params=*/2);
+    auto targs = tm.ListArguments();
+    if (targs.size() != 3) {
+      std::fprintf(stderr, "TorchModule named overload bound %zu args\n",
+                   targs.size());
+      return 1;
+    }
+    Executor tex(tm, {{"td", {2, 4}}, {"tw", {3, 4}}, {"tb", {3}}});
+    tex.Forward(false);
+    if (tex.Output(0).size() != 2 * 3) {
+      std::fprintf(stderr, "TorchModule bad output size\n");
+      return 1;
+    }
+
     std::printf("GEN_OPS ok (%zu args)\n", args.size());
     return 0;
   } catch (const std::exception &e) {

@@ -156,3 +156,53 @@ def test_s2d_resnet_json_roundtrip():
     o1 = ex1.forward(is_train=False)[0].asnumpy()
     o2 = ex2.forward(is_train=False)[0].asnumpy()
     np.testing.assert_array_equal(o1, o2)
+
+
+def test_keyword_inputs_slot_aligned():
+    """Keyword tensor inputs bind by NAME with slot alignment: an omitted
+    middle input gets an auto-created Variable in ITS slot — a later
+    keyword Symbol never shifts into the wrong position.  Covers both
+    static arg_names ops and attr-dependent input_names_fn ops (the
+    C-ABI compose path sends all inputs as keywords)."""
+    fd = mx.sym.Variable("fd")
+    fb = mx.sym.Variable("fb")
+    f = mx.sym.FullyConnected(bias=fb, data=fd, num_hidden=8, name="fc")
+    assert f.list_arguments() == ["fd", "fc_weight", "fb"]
+    # no_bias trims the dynamic name list
+    g = mx.sym.FullyConnected(data=fd, num_hidden=8, no_bias=True, name="g")
+    assert g.list_arguments() == ["fd", "g_weight"]
+
+
+def test_torch_module_keyword_compose():
+    """TorchModule's torch-param input slots are named after the module's
+    parameters (dynamic input_names_fn); keyword-only compose — the C-ABI
+    path — must wire them correctly, diagnose num_params mismatches with
+    the registry's own error, and slot-align omitted params."""
+    import pytest
+
+    pytest.importorskip("torch")
+    from mxnet_tpu.base import MXNetError
+
+    d = mx.sym.Variable("d")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    s = mx.sym.TorchModule(data_0=d, weight=w, bias=b,
+                           module="nn.Linear(4,3)", num_data=1,
+                           num_params=2, name="tm")
+    assert s.list_arguments() == ["d", "w", "b"]
+    # omitted middle name: bias stays in the bias slot
+    s2 = mx.sym.TorchModule(data_0=d, bias=b, module="nn.Linear(4,3)",
+                            num_data=1, num_params=2, name="tm2")
+    assert s2.list_arguments() == ["d", "tm2_weight", "b"]
+    # registry validation propagates (not masked as unknown-attribute)
+    with pytest.raises(MXNetError, match="num_params=3"):
+        mx.sym.TorchModule(data_0=d, weight=w, bias=b,
+                           module="nn.Linear(4,3)", num_params=3)
+    # numerics through the keyword-composed graph
+    rng = np.random.RandomState(0)
+    dv = rng.randn(2, 4).astype(np.float32)
+    wv = rng.randn(3, 4).astype(np.float32)
+    ex = s.bind(mx.cpu(), {"d": mx.nd.array(dv), "w": mx.nd.array(wv),
+                           "b": mx.nd.zeros((3,))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, dv @ wv.T, rtol=1e-5, atol=1e-5)

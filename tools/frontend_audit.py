@@ -73,7 +73,6 @@ SKIPPED_MODULES = {
 # per-name waivers: reference public names deliberately not carried,
 # reason on record
 WAIVED = {
-    ("test_utils", "download"): "no-egress environment: downloads banned",
     ("test_utils", "get_mnist"): "no-egress environment: downloads banned",
 }
 
