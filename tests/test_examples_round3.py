@@ -199,3 +199,16 @@ def test_torch_module_example():
     the torch parameters updated by the framework's optimizer."""
     stats = _run_example("torch_module.py", "epochs=8, log=False")
     assert stats["acc"] >= 0.95, stats
+
+
+def test_kaggle_ndsb1_example():
+    """NDSB-1 full competition pipeline: class-folder tree -> stratified
+    .lst split -> im2rec RecordIO at short-edge-48 -> DSB convnet via
+    Module.fit -> test-set prediction -> Kaggle submission CSV with
+    normalized probability rows."""
+    stats = _run_example(
+        "kaggle_ndsb1.py",
+        "epochs=14, n_per_class=40, n_test=48, width_mult=0.5, log=False")
+    assert stats["val_acc"] > 0.8, stats
+    assert stats["test_acc"] > 0.7, stats
+    assert stats["n_submission_rows"] == 48, stats
