@@ -245,21 +245,33 @@ def run(epochs=10, batch=32, n_per_class=60, n_test=64, width_mult=1.0,
                 eval_metric="acc",
                 batch_end_callback=(mx.callback.Speedometer(batch, 10)
                                     if log else None))
+        # validation accuracy over predict() output (pad-trimmed; Accuracy
+        # via score() would also count the zero-filled pad rows of the last
+        # batch as label-0 samples)
+        def read_lst(name):
+            with open(os.path.join(data_root, name)) as f:
+                rows = list(csv.reader(f, delimiter="\t"))
+            return ([int(float(r[1])) for r in rows],
+                    [os.path.basename(r[-1]) for r in rows])
+
+        va_labels, _ = read_lst("va.lst")
         val_iter.reset()
-        val_acc = dict(mod.score(val_iter, ["acc"]))["accuracy"]
+        va_probs = mod.predict(val_iter).asnumpy()[:len(va_labels)]
+        val_acc = float((va_probs.argmax(axis=1) == np.array(va_labels))
+                        .mean())
 
         # step 4: predict the test set + submission CSV
         test_iter = mx.io.ImageRecordIter(path_imgrec=te_rec, **kw)
         probs = mod.predict(test_iter).asnumpy()[:n_test]
-        image_names = [os.path.basename(r[-1]) for r in csv.reader(
-            open(os.path.join(data_root, "test.lst")), delimiter="\t")]
+        _, image_names = read_lst("test.lst")
         sub_path = os.path.join(workdir, "submission.csv")
         write_submission(sub_path, probs, image_names)
 
         # gates the reference could only get from the Kaggle leaderboard;
         # the lst is shuffled, so realign the true labels by filename
         lst_labels = np.array([
-            test_labels[int(p[2:6])] for p in image_names])
+            test_labels[int(os.path.splitext(p)[0].split("_")[1])]
+            for p in image_names])
         test_acc = float((probs.argmax(axis=1) == lst_labels).mean())
         with open(sub_path) as f:
             rows = list(csv.reader(f))

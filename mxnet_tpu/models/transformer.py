@@ -43,9 +43,12 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
         x = x + h
 
     x = sym.LayerNorm(x, name="final_ln")
-    if dtype != "float32":
-        x = sym.Cast(x, dtype="float32")
     pred = sym.Reshape(x, shape=(-1, num_embed))
+    # vocab projection in the model dtype (the largest matmul in the
+    # model — in bf16 it runs at full MXU rate with fp32 accumulation);
+    # logits cast up AFTER, so softmax/loss run in fp32
     pred = sym.FullyConnected(pred, num_hidden=num_classes, name="pred")
+    if dtype != "float32":
+        pred = sym.Cast(pred, dtype="float32")
     label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
     return sym.SoftmaxOutput(data=pred, label=label, name="softmax")
