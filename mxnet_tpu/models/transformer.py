@@ -14,7 +14,8 @@ from .. import symbol as sym
 
 def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
                num_layers=6, dropout=0.0, causal=True,
-               context_parallel_axis="", dtype="float32", **kwargs):
+               context_parallel_axis="", dtype="float32", head="softmax",
+               ce_chunk=2048, **kwargs):
     data = sym.Variable("data")
     x = sym.Embedding(data=data, input_dim=num_classes, output_dim=num_embed,
                       name="embed")
@@ -44,11 +45,25 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
 
     x = sym.LayerNorm(x, name="final_ln")
     pred = sym.Reshape(x, shape=(-1, num_embed))
+    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    if head not in ("softmax", "fused_ce"):
+        raise ValueError("head must be 'softmax' or 'fused_ce', got %r"
+                         % (head,))
+    if head == "fused_ce":
+        # long-context head: chunked fused linear + softmax CE — never
+        # materializes the [T, vocab] logits (O(chunk*V) live instead of
+        # O(T*V)); output is per-token fp32 loss, which ShardedTrainer's
+        # sum-of-outputs loss consumes directly.  Reuses the FC weight
+        # layout (pred_weight [V, d]) so checkpoints swap between heads
+        # (the softmax head's pred_bias has no fused counterpart).
+        pred_w = sym.Variable("pred_weight",
+                              shape=(num_classes, num_embed))
+        return sym._contrib_fused_lm_head(pred, pred_w, label, name="softmax",
+                                          chunk=ce_chunk)
     # vocab projection in the model dtype (the largest matmul in the
     # model — in bf16 it runs at full MXU rate with fp32 accumulation);
     # logits cast up AFTER, so softmax/loss run in fp32
     pred = sym.FullyConnected(pred, num_hidden=num_classes, name="pred")
     if dtype != "float32":
         pred = sym.Cast(pred, dtype="float32")
-    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
     return sym.SoftmaxOutput(data=pred, label=label, name="softmax")

@@ -549,6 +549,104 @@ def _softmax_output(attrs, data, label):
     return rule(data, label.astype(data.dtype))
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_lm_head_rule(chunk):
+    """Chunked fused linear + softmax cross-entropy (beyond-parity: the
+    2017 reference predates LM heads; this is the long-context enabler).
+
+    Computes per-token CE of ``logits = x @ W.T`` WITHOUT materializing
+    the [T, V] logits: both passes stream T in ``chunk``-row slices via
+    ``lax.scan``, so peak memory is O(chunk*V + d*V) instead of O(T*V)
+    — at T=32k, V=32k that is the difference between 130 MB and 4.2 GB.
+    Custom vjp (so the recompute is explicit, like the flash-attention
+    backward): bwd recomputes each chunk's softmax and accumulates dW in
+    fp32.  Matmuls run in the input dtype with fp32 accumulation
+    (``preferred_element_type`` is safe here — no XLA transpose is ever
+    taken of this op)."""
+
+    @jax.custom_vjp
+    def f(x, w, lab):
+        return _loss(x, w, lab)
+
+    def _dot_f32(a, b, dims):
+        return jax.lax.dot_general(a, b, dims,
+                                   preferred_element_type=jnp.float32)
+
+    def _loss(x, w, lab):
+        T, d = x.shape
+        n = T // chunk
+        xs = x.reshape(n, chunk, d)
+        labs = lab.reshape(n, chunk).astype(jnp.int32)
+        wl = w.astype(x.dtype)
+
+        def body(_, xl):
+            xc, lc = xl
+            # [chunk, V] fp32, live only inside this scan step
+            logits = _dot_f32(xc, wl, (((1,), (1,)), ((), ())))
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            ll = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+            return None, jnp.where(lc >= 0, lse - ll, 0.0)
+
+        _, losses = jax.lax.scan(body, None, (xs, labs))
+        return losses.reshape(T)
+
+    def fwd(x, w, lab):
+        return _loss(x, w, lab), (x, w, lab)
+
+    def bwd(res, g):
+        x, w, lab = res
+        T, d = x.shape
+        n = T // chunk
+        xs = x.reshape(n, chunk, d)
+        labs = lab.reshape(n, chunk).astype(jnp.int32)
+        gs = g.reshape(n, chunk)
+        wl = w.astype(x.dtype)
+
+        def body(dw, xlg):
+            xc, lc, gc = xlg
+            logits = _dot_f32(xc, wl, (((1,), (1,)), ((), ())))
+            p = jax.nn.softmax(logits, axis=-1)
+            onehot = jax.nn.one_hot(lc, p.shape[-1], dtype=p.dtype)
+            mask = (lc >= 0).astype(p.dtype)
+            dl = ((p - onehot) * (gc * mask)[:, None]).astype(xc.dtype)
+            dxc = dl @ wl  # [chunk, d]
+            dw = dw + _dot_f32(dl, xc, (((0,), (0,)), ((), ())))
+            return dw, dxc
+
+        dw0 = jnp.zeros(w.shape, jnp.float32)
+        dw, dxs = jax.lax.scan(body, dw0, (xs, labs, gs))
+        return dxs.reshape(T, d), dw.astype(w.dtype), jnp.zeros_like(lab)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register(
+    "_contrib_fused_lm_head",
+    arg_names=["data", "weight", "label"],
+    params={"chunk": P("int", 2048)},
+)
+def _fused_lm_head(attrs, data, weight, label):
+    """Per-token softmax cross-entropy of ``data @ weight.T`` against
+    integer ``label`` rows, streamed in chunks (see
+    :func:`_fused_lm_head_rule`).  ``weight`` uses the FullyConnected
+    [num_classes, d] layout so an LM checkpoint's ``pred_weight`` drops
+    in unchanged; labels < 0 are ignored (zero loss and gradient).
+    Output: [T] fp32 losses."""
+    T = data.shape[0]
+    chunk = min(int(attrs["chunk"]), T)
+    pad = (-T) % chunk
+    x = data.reshape(T, -1)
+    lab = label.reshape(T)
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad, x.shape[1]), x.dtype)], axis=0)
+        lab = jnp.concatenate(
+            [lab, jnp.full((pad,), -1.0, lab.dtype)], axis=0)
+    out = _fused_lm_head_rule(chunk)(x, weight, lab)
+    return out[:T] if pad else out
+
+
 @register("SoftmaxActivation", params={"mode": P("str", "instance", enum=["instance", "channel"])})
 def _softmax_activation(attrs, x):
     if attrs["mode"] == "channel":

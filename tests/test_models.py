@@ -182,3 +182,50 @@ def test_benchmark_score_device_loop_smoke():
             if "images/sec" in l]
     assert line, (r.stdout, r.stderr)
     assert float(line[0].rsplit(" ", 1)[1]) > 0
+
+
+def test_transformer_fused_ce_head_matches_softmax_grads():
+    """transformer.get_symbol(head='fused_ce') trains through
+    ShardedTrainer with IDENTICAL parameter updates to the softmax head
+    (same math, chunked; the softmax head's unused pred_bias aside) —
+    the long-context configuration that never materializes [T, vocab]
+    logits."""
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    batch, seq, vocab = 2, 32, 29
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    label = rng.randint(0, vocab, (batch, seq)).astype(np.float32)
+    results = {}
+    for head in ("softmax", "fused_ce"):
+        sym = transformer.get_symbol(
+            num_classes=vocab, seq_len=seq, num_embed=16, num_heads=2,
+            num_layers=2, head=head, ce_chunk=16)
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "seq"))
+        tr = ShardedTrainer(
+            sym, mesh, data_shapes={"data": (batch, seq)},
+            label_shapes={"softmax_label": (batch, seq)},
+            type_dict={"data": "int32"}, learning_rate=0.2, momentum=0.9,
+            rescale_grad=1.0 / (batch * seq))
+        params, moms, aux = tr.init(seed=0)
+        if head == "softmax":
+            # zero the bias the fused head lacks so updates can align
+            params["pred_bias"] = params["pred_bias"] * 0.0
+        arrays = tr.place_batch({"data": data, "softmax_label": label})
+        step = tr.step_fn()
+        # ONE step: after it the softmax head's pred_bias becomes nonzero
+        # and the heads legitimately diverge from step 2 on
+        outs, params, moms, aux = step(params, moms, aux, arrays,
+                                       jax.random.PRNGKey(0))
+        results[head] = {k: np.asarray(jax.device_get(v))
+                         for k, v in params.items() if k != "pred_bias"}
+    for k in results["fused_ce"]:
+        np.testing.assert_allclose(
+            results["softmax"][k], results["fused_ce"][k],
+            rtol=1e-3, atol=1e-4,
+            err_msg="param %r diverges between heads" % k)

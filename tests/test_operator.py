@@ -708,3 +708,76 @@ def test_beyond_reference_unary_and_mod():
     # stack: symbol n-ary
     s = mx.sym.stack(x, y, axis=1)
     check_symbolic_forward(s, {"x": a, "y": b}, [np.stack([a, b], axis=1)])
+
+
+def test_fused_lm_head_matches_dense():
+    """_contrib_fused_lm_head (beyond-parity long-context head): per-token
+    CE of x @ W.T computed in chunks must match the dense
+    logits-materializing path exactly — forward, dx and dW — including
+    the padding arm (T not divisible by chunk) and ignored (<0) labels."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+
+    op = OP_REGISTRY["_contrib_fused_lm_head"]
+    rng = np.random.RandomState(3)
+    T, d, V = 37, 16, 50  # 37 % 8 != 0 -> padding path
+    x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(V, d).astype(np.float32)) * 0.3
+    lab = jnp.asarray(rng.randint(0, V, (T,)).astype(np.float32))
+    lab = lab.at[5].set(-1.0)
+    attrs = op.parse_attrs({"chunk": 8})
+
+    def dense(x_, w_, l_):
+        logits = x_ @ w_.T
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        idx = jnp.clip(l_.astype(jnp.int32), 0, V - 1)[:, None]
+        ll = jnp.take_along_axis(logits, idx, axis=-1)[:, 0]
+        return jnp.where(l_ >= 0, lse - ll, 0.0)
+
+    loss = op.fn(attrs, x, w, lab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(dense(x, w, lab)),
+                               rtol=1e-6, atol=1e-6)
+    assert float(loss[5]) == 0.0  # ignored row
+    gf = jax.grad(lambda a, b: jnp.sum(op.fn(attrs, a, b, lab)),
+                  argnums=(0, 1))(x, w)
+    gd = jax.grad(lambda a, b: jnp.sum(dense(a, b, lab)),
+                  argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gd[0]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gd[1]),
+                               rtol=1e-5, atol=1e-5)
+    # ignored row contributes no dx
+    assert float(np.abs(np.asarray(gf[0])[5]).max()) == 0.0
+
+
+def test_fused_lm_head_symbol_trains():
+    """The fused head as a graph node: bind, forward (per-token losses),
+    backward — and three SGD steps reduce the mean loss."""
+    rng = np.random.RandomState(4)
+    T, d, V = 48, 8, 13
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("pred_weight", shape=(V, d))
+    lab = mx.sym.Variable("softmax_label")
+    out = mx.sym._contrib_fused_lm_head(data, w, lab, chunk=16,
+                                        name="softmax")
+    xs = rng.randn(T, d).astype(np.float32)
+    ys = rng.randint(0, V, (T,)).astype(np.float32)
+    ex = out.simple_bind(mx.cpu(), data=(T, d), softmax_label=(T,),
+                         grad_req="write")
+    ex.arg_dict["data"][:] = xs
+    ex.arg_dict["softmax_label"][:] = ys
+    ex.arg_dict["pred_weight"][:] = rng.randn(V, d).astype(np.float32) * 0.2
+    first = None
+    for _ in range(3):
+        ex.forward(is_train=True)
+        loss = ex.outputs[0].asnumpy()
+        if first is None:
+            first = loss.mean()
+        ex.backward()
+        ex.arg_dict["pred_weight"][:] = (
+            ex.arg_dict["pred_weight"].asnumpy()
+            - 0.5 * ex.grad_dict["pred_weight"].asnumpy())
+    assert loss.shape == (T,)
+    assert loss.mean() < first, (loss.mean(), first)
