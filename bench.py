@@ -54,10 +54,18 @@ def transformer_main():
     vocab = 32000 if on_tpu else 256
     steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "3"))
 
+    # BENCH_HEAD=fused_ce selects the chunked fused linear+softmax-CE head
+    # (the long-context configuration: T=32768 b1 fits one chip with it —
+    # docs/PERF.md "Long context on one chip")
+    head = os.environ.get("BENCH_HEAD", "softmax")
+    # BENCH_REMAT=block enables per-block __remat__ checkpoint regions
+    # (docs/PERF.md "Per-block rematerialization")
+    remat = os.environ.get("BENCH_REMAT", "none")
     sym = transformer.get_symbol(
         num_classes=vocab, seq_len=seq, num_embed=d_model,
         num_heads=heads, num_layers=layers, dtype="bfloat16" if on_tpu
-        else "float32")
+        else "float32", head=head, remat=remat,
+        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "4096")))
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
     tr = ShardedTrainer(
         sym, mesh, data_shapes={"data": (batch, seq)},
@@ -98,7 +106,7 @@ def transformer_main():
         "vs_baseline": 0.0,  # the 2017 reference has no transformer
         "mfu": round(mfu, 4), "n_params": n_params,
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
-                   "layers": layers},
+                   "layers": layers, "head": head},
     }))
 
 

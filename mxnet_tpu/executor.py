@@ -74,27 +74,75 @@ def _graph_fn(symbol: Symbol, node_device=None):
     if node_device and not _os.environ.get("MXTPU_PLACED_EAGER"):
         return _placed_graph_fn(nodes, out_entries, node_device)
 
+    # __remat__ segmentation composes with the default single-device path
+    # only: under heterogeneous placement (node_device — including the
+    # MXTPU_PLACED_EAGER walker) remat regions would silently skip the
+    # per-node device_put contract, so placement wins and tags are ignored
+    if node_device:
+        plan = [("var", n) if n.is_variable else ("node", n) for n in nodes]
+    else:
+        plan = _remat_plan(nodes, out_entries)
+
+    def _eval_plain(node, env, new_aux, rng, is_train):
+        ins = [env[s._id][i] for s, i in node.inputs]
+        dev = node_device.get(node._id)
+        if dev is not None:
+            ins = [jax.device_put(v, dev) for v in ins]
+        n_args = len(node.op.input_names(node.attrs))
+        outs, aux_updates = _eval_node(
+            node, ins[:n_args], ins[n_args:], rng, is_train)
+        env[node._id] = outs
+        for (aux_node, _), new_val in zip(node.inputs[n_args:], aux_updates):
+            new_aux[aux_node.name] = new_val
+
     def run(arg_values, aux_values, rng, is_train):
         env = {}
         new_aux = {}
-        for node in nodes:
-            if node.is_variable:
+        for item in plan:
+            if item[0] == "var":
+                node = item[1]
                 src = aux_values if node.is_aux else arg_values
                 if node.name not in src:
                     raise MXNetError("unbound variable %r" % node.name)
                 env[node._id] = [src[node.name]]
-                continue
-            op = node.op
-            ins = [env[s._id][i] for s, i in node.inputs]
-            dev = node_device.get(node._id)
-            if dev is not None:
-                ins = [jax.device_put(v, dev) for v in ins]
-            n_args = len(op.input_names(node.attrs))
-            outs, aux_updates = _eval_node(
-                node, ins[:n_args], ins[n_args:], rng, is_train)
-            env[node._id] = outs
-            for (aux_node, _), new_val in zip(node.inputs[n_args:], aux_updates):
-                new_aux[aux_node.name] = new_val
+            elif item[0] == "node":
+                _eval_plain(item[1], env, new_aux, rng, is_train)
+            else:  # remat segment
+                _, seg_nodes, ext, live = item
+                ext_vals = [env[sid][i] for sid, i in ext]
+                seg_ids = {n._id for n in seg_nodes}
+                ext_index = {e: k for k, e in enumerate(ext)}
+
+                def seg_fn(ext_vals, rng, _seg_nodes=seg_nodes,
+                           _seg_ids=seg_ids, _ext_index=ext_index,
+                           _live=live):
+                    lenv = {}
+                    laux = {}
+
+                    def get(s, i):
+                        if s._id in _seg_ids:
+                            return lenv[s._id][i]
+                        return ext_vals[_ext_index[(s._id, i)]]
+
+                    for node in _seg_nodes:
+                        ins = [get(s, i) for s, i in node.inputs]
+                        n_args = len(node.op.input_names(node.attrs))
+                        outs, aux_updates = _eval_node(
+                            node, ins[:n_args], ins[n_args:], rng, is_train)
+                        lenv[node._id] = outs
+                        for (an, _), nv in zip(node.inputs[n_args:],
+                                               aux_updates):
+                            laux[an.name] = nv
+                    # return ONLY values consumed outside (anything
+                    # returned becomes a saved residual — returning every
+                    # intermediate would defeat the remat)
+                    return [lenv[sid][i] for sid, i in _live], laux
+
+                outs_live, laux = jax.checkpoint(
+                    seg_fn, policy=_remat_policy())(ext_vals, rng)
+                for (sid, i), v in zip(live, outs_live):
+                    env.setdefault(sid, {})[i] = v
+                new_aux.update(laux)
         outputs = [env[n._id][i] for n, i in out_entries]
         # pass untouched aux through so the pytree structure is stable
         for name in aux_values:
@@ -102,6 +150,84 @@ def _graph_fn(symbol: Symbol, node_device=None):
         return outputs, new_aux
 
     return run
+
+
+def _remat_policy():
+    """Optional jax.checkpoint policy for __remat__ segments, by name
+    (``MXTPU_REMAT_POLICY=dots_saveable`` etc.); default: save only
+    segment inputs + live outputs."""
+    name = _os.environ.get("MXTPU_REMAT_POLICY")
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
+def _remat_plan(nodes, out_entries):
+    """Partition the topo order into an execution plan honoring the
+    ``__remat__`` node attr (the reference's graph-executor *mirror*
+    option, ``graph_executor.cc:225-233`` ``nnvm::pass::Gradient`` mirror
+    fun — recompute-in-backward at marked boundaries; here each maximal
+    contiguous run of op nodes sharing a ``__remat__`` tag becomes one
+    ``jax.checkpoint`` region whose intermediates are rematerialized in
+    the backward pass).
+
+    Returns a list of items:
+      ("var", node)                       — variable read
+      ("node", node)                      — plain op eval
+      ("seg", nodes, ext, live)           — remat segment; ``ext`` is the
+        ordered list of external (node_id, out_idx) inputs, ``live`` the
+        (node_id, out_idx) values consumed outside the segment.
+    Variables never join segments (their values are explicit segment
+    inputs, so jax.checkpoint differentiates through them); an untagged
+    op between two same-tag ops splits the run (correct, just smaller
+    regions).
+    """
+    # variables depend on nothing: hoist them to the front of the plan so
+    # interleaved parameter reads cannot split a block's contiguous run
+    # into per-op fragments
+    runs = [("var", n) for n in nodes if n.is_variable]
+    for node in nodes:
+        if node.is_variable:
+            continue
+        tag = node.extra_attrs.get("__remat__")
+        if not tag:
+            runs.append(("node", node))
+            continue
+        if runs and runs[-1][0] == "seg" and runs[-1][1] == tag:
+            runs[-1][2].append(node)
+        else:
+            runs.append(("seg", tag, [node]))
+
+    out_set = {(n._id, i) for n, i in out_entries}
+    consumers = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for s, i in node.inputs:
+            consumers.setdefault((s._id, i), []).append(node._id)
+
+    plan = []
+    for item in runs:
+        if item[0] != "seg":
+            plan.append(item)
+            continue
+        _, _, seg_nodes = item
+        seg_ids = {n._id for n in seg_nodes}
+        ext, seen = [], set()
+        for node in seg_nodes:
+            for s, i in node.inputs:
+                key = (s._id, i)
+                if s._id not in seg_ids and key not in seen:
+                    seen.add(key)
+                    ext.append(key)
+        live = []
+        for node in seg_nodes:
+            for i in range(node.num_outputs()):
+                key = (node._id, i)
+                outside = [c for c in consumers.get(key, ())
+                           if c not in seg_ids]
+                if outside or key in out_set:
+                    live.append(key)
+        plan.append(("seg", seg_nodes, ext, live))
+    return plan
 
 
 def _already_on(v, dev):

@@ -9,13 +9,16 @@ model zoo: inputs ``data`` (batch, seq_len) int tokens and ``softmax_label``
 (batch, seq_len); single ``SoftmaxOutput`` head named ``softmax``.
 """
 
+import contextlib
+
 from .. import symbol as sym
+from ..attribute import AttrScope
 
 
 def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
                num_layers=6, dropout=0.0, causal=True,
                context_parallel_axis="", dtype="float32", head="softmax",
-               ce_chunk=2048, **kwargs):
+               ce_chunk=2048, remat="none", **kwargs):
     data = sym.Variable("data")
     x = sym.Embedding(data=data, input_dim=num_classes, output_dim=num_embed,
                       name="embed")
@@ -24,24 +27,34 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
     if dtype != "float32":
         x = sym.Cast(x, dtype=dtype)
 
+    if remat not in ("none", "block"):
+        raise ValueError("remat must be 'none' or 'block', got %r" % (remat,))
     for i in range(num_layers):
-        h = sym.LayerNorm(x, name="l%d_ln1" % i)
-        h = sym.MultiHeadAttention(
-            h, num_heads=num_heads, causal=causal,
-            context_parallel_axis=context_parallel_axis,
-            name="l%d_attn" % i)
-        if dropout > 0:
-            h = sym.Dropout(h, p=dropout, name="l%d_attndrop" % i)
-        x = x + h
-        h = sym.LayerNorm(x, name="l%d_ln2" % i)
-        h = sym.FullyConnected(h, num_hidden=4 * num_embed, flatten=False,
-                               name="l%d_ffn1" % i)
-        h = sym.Activation(h, act_type="gelu", name="l%d_gelu" % i)
-        h = sym.FullyConnected(h, num_hidden=num_embed, flatten=False,
-                               name="l%d_ffn2" % i)
-        if dropout > 0:
-            h = sym.Dropout(h, p=dropout, name="l%d_ffndrop" % i)
-        x = x + h
+        # remat='block': each layer becomes one __remat__ checkpoint
+        # region (executor._remat_plan) — activations inside the block are
+        # recomputed in backward, so live memory is one residual stream
+        # per layer instead of every intermediate (the graph-executor
+        # mirror option, reference graph_executor.cc:225-233)
+        scope = (AttrScope(__remat__="l%d" % i) if remat == "block"
+                 else contextlib.nullcontext())
+        with scope:
+            h = sym.LayerNorm(x, name="l%d_ln1" % i)
+            h = sym.MultiHeadAttention(
+                h, num_heads=num_heads, causal=causal,
+                context_parallel_axis=context_parallel_axis,
+                name="l%d_attn" % i)
+            if dropout > 0:
+                h = sym.Dropout(h, p=dropout, name="l%d_attndrop" % i)
+            x = x + h
+            h = sym.LayerNorm(x, name="l%d_ln2" % i)
+            h = sym.FullyConnected(h, num_hidden=4 * num_embed,
+                                   flatten=False, name="l%d_ffn1" % i)
+            h = sym.Activation(h, act_type="gelu", name="l%d_gelu" % i)
+            h = sym.FullyConnected(h, num_hidden=num_embed, flatten=False,
+                                   name="l%d_ffn2" % i)
+            if dropout > 0:
+                h = sym.Dropout(h, p=dropout, name="l%d_ffndrop" % i)
+            x = x + h
 
     x = sym.LayerNorm(x, name="final_ln")
     pred = sym.Reshape(x, shape=(-1, num_embed))
