@@ -1,0 +1,124 @@
+"""Multi-worker training-throughput sweep (parity: reference
+``example/image-classification/benchmark.py`` — the driver that launches
+``train_imagenet.py`` over 1..N workers through ``tools/launch.py``,
+scrapes the Speedometer throughput from every rank's log, and reports
+aggregate images/sec + scaling efficiency per network).
+
+TPU-native differences: workers are local processes over the collective
+dist kvstore (the reference sshed to GPU hosts and used ps-lite); the
+synthetic-data mode is ``--benchmark 1`` exactly like the reference; the
+report is CSV + a printed table (the reference rendered pygal SVGs,
+pygal isn't in this image).
+
+    python examples/image_classification/benchmark.py \
+        --networks mlp --worker-counts 1,2 --num-examples 512
+"""
+
+import argparse
+import csv
+import os
+import re
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+
+_SPEED_RE = re.compile(r"Speed:\s*([0-9.]+)\s*samples/sec")
+_TAGGED_RE = re.compile(r"\[worker-(\d+)\].*?Speed:\s*([0-9.]+)\s*samples/sec")
+
+
+def run_config(network, workers, args):
+    """One sweep point: train `network` on `workers` local ranks; return
+    the aggregate samples/sec — the sum over ranks of each rank's LAST
+    Speedometer window (earlier windows absorb the jit compile; the
+    reference aggregated total images_processed across rank logs)."""
+    train_cmd = [
+        sys.executable, os.path.join(_HERE, "train_imagenet.py"),
+        "--network", network,
+        "--num-layers", str(args.num_layers),
+        "--benchmark", "1",
+        "--num-classes", str(args.num_classes),
+        "--num-examples", str(args.num_examples),
+        "--image-shape", args.image_shape,
+        "--batch-size", str(args.batch_size),
+        "--num-epochs", "1",
+        "--disp-batches", str(args.disp_batches),
+        "--kv-store", args.kv_store if workers > 1 else "local",
+    ]
+    if workers > 1:
+        cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+               "-n", str(workers), "--tag-output"] + train_cmd
+    else:
+        cmd = train_cmd
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=args.timeout, cwd=_REPO)
+    text = r.stdout + r.stderr
+    if r.returncode != 0:
+        raise RuntimeError("config %s x%d failed:\n%s"
+                           % (network, workers, text[-2000:]))
+    # aggregate = sum over ranks of each rank's LAST Speedometer window
+    # (steady state; earlier windows absorb the jit compile)
+    if workers > 1:
+        per_rank = {}
+        for rank, speed in _TAGGED_RE.findall(text):
+            per_rank[int(rank)] = float(speed)
+        if len(per_rank) != workers:
+            raise RuntimeError("Speedometer lines from %d/%d ranks for "
+                               "%s:\n%s" % (len(per_rank), workers,
+                                            network, text[-2000:]))
+        return sum(per_rank.values())
+    speeds = [float(s) for s in _SPEED_RE.findall(text)]
+    if not speeds:
+        raise RuntimeError("no Speedometer lines for %s x%d:\n%s"
+                           % (network, workers, text[-2000:]))
+    return speeds[-1]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--networks", type=str, default="mlp",
+                    help="comma-separated network names (symbols/ registry)")
+    ap.add_argument("--worker-counts", type=str, default="1,2",
+                    help="comma-separated local worker counts to sweep")
+    ap.add_argument("--num-layers", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=16)
+    ap.add_argument("--num-examples", type=int, default=512)
+    ap.add_argument("--image-shape", type=str, default="3,28,28")
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="PER-WORKER batch size (the dist-kvstore "
+                         "convention: global batch = workers x this)")
+    ap.add_argument("--disp-batches", type=int, default=2)
+    ap.add_argument("--kv-store", type=str, default="dist_sync")
+    ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument("--output", type=str, default="benchmark_sweep.csv")
+    args = ap.parse_args()
+
+    rows = []
+    for network in args.networks.split(","):
+        base = None  # per-worker rate at the FIRST sweep point; efficiency
+        # is relative to it (exact only when the sweep starts at 1 worker)
+        for workers in [int(w) for w in args.worker_counts.split(",")]:
+            agg = run_config(network, workers, args)
+            if base is None:
+                base = agg / workers
+            eff = agg / (base * workers) if base else 0.0
+            rows.append({"network": network, "workers": workers,
+                         "per_worker_batch": args.batch_size,
+                         "samples_per_sec": round(agg, 2),
+                         "efficiency_vs_first": round(eff, 3)})
+            print("%-12s x%d: %8.1f samples/sec (eff %.0f%% vs first)"
+                  % (network, workers, agg, eff * 100))
+
+    with open(args.output, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+    print("wrote %s (%d rows)" % (args.output, len(rows)))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

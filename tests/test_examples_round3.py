@@ -212,3 +212,31 @@ def test_kaggle_ndsb1_example():
     assert stats["val_acc"] > 0.8, stats
     assert stats["test_acc"] > 0.7, stats
     assert stats["n_submission_rows"] == 48, stats
+
+
+def test_benchmark_sweep_driver():
+    """Multi-worker throughput sweep driver (reference benchmark.py): runs
+    train_imagenet over 1 and 2 local workers through tools/launch.py
+    --tag-output, attributes Speedometer lines per rank, writes the CSV.
+    Scaling efficiency itself is not gated — the box has one core."""
+    import csv as _csv
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = os.path.join(d, "sweep.csv")
+        env = dict(os.environ, MXNET_TPU_PLATFORM="cpu",
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "examples", "image_classification",
+                          "benchmark.py"),
+             "--networks", "mlp", "--worker-counts", "1,2",
+             "--num-examples", "512", "--batch-size", "64",
+             "--disp-batches", "2", "--output", out],
+            capture_output=True, text=True, env=env, timeout=800,
+            cwd=_REPO)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        with open(out) as f:
+            rows = list(_csv.DictReader(f))
+        assert [int(x["workers"]) for x in rows] == [1, 2]
+        assert all(float(x["samples_per_sec"]) > 0 for x in rows)

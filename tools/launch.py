@@ -25,7 +25,7 @@ import sys
 import threading
 
 
-def _relay(pipe, sink):
+def _relay(pipe, sink, prefix=b""):
     """Forward one worker's private pipe to the launcher's output, one
     COMPLETE line per write() syscall.
 
@@ -35,10 +35,14 @@ def _relay(pipe, sink):
     instant (e.g. right after a barrier) interleave mid-line and consumers
     counting marker lines miscount.  Each rank writing to its own pipe +
     readline() reassembling full lines + one write() per line (atomic for
-    pipes up to PIPE_BUF) makes cross-rank interleaving impossible."""
+    pipes up to PIPE_BUF) makes cross-rank interleaving impossible.
+
+    ``prefix`` (``--tag-output``, the mpirun option of the same name)
+    prepends a rank tag to every line so consumers can attribute output
+    per rank — the prefix rides in the same atomic write."""
     with pipe:
         for line in iter(pipe.readline, b""):
-            sink.write(line)
+            sink.write(prefix + line)
             sink.flush()
 
 
@@ -134,10 +138,12 @@ def launch_local(args, cmd):
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE))
     relays = []
-    for p in procs:
+    for i, p in enumerate(procs):
+        prefix = (("[worker-%d] " % i).encode()
+                  if getattr(args, "tag_output", False) else b"")
         for pipe, sink in ((p.stdout, sys.stdout.buffer),
                            (p.stderr, sys.stderr.buffer)):
-            t = threading.Thread(target=_relay, args=(pipe, sink),
+            t = threading.Thread(target=_relay, args=(pipe, sink, prefix),
                                  daemon=True)
             t.start()
             relays.append(t)
@@ -242,6 +248,9 @@ def main():
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument("--platform", type=str, default="cpu",
                         help="JAX platform for local workers")
+    parser.add_argument("--tag-output", action="store_true",
+                        help="prefix every relayed line with [worker-N] "
+                             "(mpirun-style) for per-rank attribution")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
