@@ -175,3 +175,62 @@ def test_frontend_audit_gate():
         capture_output=True, text=True, timeout=300, cwd=_REPO)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "zero unexplained misses" in r.stdout, r.stdout
+
+
+def test_kill_mxnet_finds_launcher_processes():
+    """tools/kill_mxnet.py (reference kill-mxnet.py role): spots stray
+    launcher-spawned processes by their environment markers and can
+    terminate them; unrelated processes are never matched."""
+    import signal
+    import time
+
+    coord = "127.0.0.1:%d" % os.getpid()  # unique to this test run
+    env = dict(os.environ, MXNET_TPU_COORDINATOR=coord,
+               MXNET_TPU_NUM_PROCS="1", MXNET_TPU_PROC_ID="0")
+    straggler = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"], env=env)
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        # wait past the fork/exec window: a pre-exec child still shows the
+        # parent's environ in /proc, so the marker scan could miss it
+        import re
+
+        marker = ("MXNET_TPU_COORDINATOR=%s" % coord).encode() + b"\0"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                with open("/proc/%d/environ" % straggler.pid, "rb") as f:
+                    if marker in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+        def listed_pids(stdout):
+            return {int(m) for m in re.findall(
+                r"^(?:would kill|kill)\s+(\d+)\b", stdout, re.M)}
+
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_mxnet.py"),
+             "--dry-run", "--coordinator", coord],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        pids = listed_pids(r.stdout)
+        assert straggler.pid in pids, r.stdout
+        assert bystander.pid not in pids, r.stdout
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_mxnet.py"),
+             "--signal", str(int(signal.SIGKILL)),
+             "--coordinator", coord],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        deadline = time.time() + 10
+        while straggler.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert straggler.poll() is not None, "straggler survived"
+        assert bystander.poll() is None, "bystander was killed"
+    finally:
+        for p in (straggler, bystander):
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,99 @@
+"""Kill stray training processes across the cluster (role parity:
+reference ``tools/kill-mxnet.py`` — ps|grep|kill over every host in a
+hostfile; used by the reference's benchmark sweep to clean up between
+configs).
+
+TPU-native form: local mode (the simulated one-host cluster the dist
+tests and ``benchmark.py`` use) finds processes by the launcher's
+environment markers (``MXNET_TPU_COORDINATOR`` / ``MXNET_TPU_PS_SECRET``
+in ``/proc/<pid>/environ``) rather than a fragile ``grep <prog>`` —
+matching by env can't kill an unrelated process that merely shares a
+script name.  With ``--hostfile``, the same sweep runs over ssh like the
+reference.
+
+    python tools/kill_mxnet.py                # local: kill stray workers
+    python tools/kill_mxnet.py --dry-run      # list only
+    python tools/kill_mxnet.py --hostfile H --prog train_imagenet.py
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+_MARKERS = (b"MXNET_TPU_COORDINATOR=", b"MXNET_TPU_PS_SECRET=",
+            b"MXNET_TPU_SERVER_ADDR_FILE=")
+
+
+def find_local(coordinator=None):
+    """PIDs (not ours) whose environment carries a launcher marker;
+    ``coordinator`` restricts to one cluster's processes (its
+    ``MXNET_TPU_COORDINATOR`` value) so killing a stray sweep can never
+    take down an unrelated healthy cluster on the same host."""
+    me = os.getpid()
+    parent = os.getppid()
+    out = []
+    needle = (("MXNET_TPU_COORDINATOR=%s" % coordinator).encode() + b"\0"
+              if coordinator else None)
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) in (me, parent):
+            continue
+        try:
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read()
+        except OSError:
+            continue
+        if needle is not None and needle not in env:
+            continue
+        if any(m in env for m in _MARKERS):
+            try:
+                with open("/proc/%s/cmdline" % pid, "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace").strip()
+            except OSError:
+                cmd = "?"
+            out.append((int(pid), cmd))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--hostfile", type=str, default=None,
+                    help="kill over ssh on every host (reference mode)")
+    ap.add_argument("--prog", type=str, default="mxnet_tpu",
+                    help="remote mode: substring to match in ps output")
+    ap.add_argument("--coordinator", type=str, default=None,
+                    help="only kill processes of the cluster with this "
+                         "MXNET_TPU_COORDINATOR value")
+    ap.add_argument("--signal", type=int, default=signal.SIGTERM)
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    if args.hostfile:
+        kill_cmd = ("pgrep -u \"$USER\" -f %s | xargs -r kill -%d"
+                    % (args.prog, args.signal))
+        with open(args.hostfile) as f:
+            hosts = [h.split(":")[0].strip() for h in f if h.strip()]
+        for host in hosts:
+            print("%s: %s" % (host, kill_cmd))
+            if not args.dry_run:
+                subprocess.run(["ssh", "-oStrictHostKeyChecking=no", host,
+                                kill_cmd], check=False)
+        return 0
+
+    victims = find_local(args.coordinator)
+    for pid, cmd in victims:
+        print("%s%d  %s" % ("would kill " if args.dry_run else "kill ",
+                            pid, cmd[:120]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except OSError as exc:
+                print("  failed: %s" % exc)
+    print("%d process(es)" % len(victims))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
