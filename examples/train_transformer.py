@@ -67,7 +67,7 @@ def make_batch(rng, batch, seq_len):
 
 
 def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
-          seed=0, log=True):
+          seed=0, head="softmax", remat="none", log=True):
     import jax
     from jax.sharding import Mesh
 
@@ -78,9 +78,13 @@ def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
     assert len(devs) == dp * sp, "need %d devices" % (dp * sp)
     mesh = Mesh(np.array(devs).reshape(dp, sp), ("data", "seq"))
 
+    # head="fused_ce" streams the loss without [T, vocab] logits and
+    # remat="block" trades recompute for activation memory — the two
+    # long-context knobs (docs/PERF.md)
     sym = transformer.get_symbol(
         num_classes=VOCAB, seq_len=seq_len, num_embed=64, num_heads=4,
-        num_layers=2, context_parallel_axis="seq" if sp > 1 else "")
+        num_layers=2, context_parallel_axis="seq" if sp > 1 else "",
+        head=head, ce_chunk=512, remat=remat)
     tr = ShardedTrainer(
         sym, mesh, data_shapes={"data": (batch, seq_len)},
         label_shapes={"softmax_label": (batch, seq_len)},
@@ -97,10 +101,15 @@ def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
         arrays = tr.place_batch({"data": data, "softmax_label": labels})
         outs, params, moms, aux = step(params, moms, aux, arrays, key)
         if (i + 1) % 25 == 0 or i == steps - 1:
-            probs = np.asarray(outs[0]).reshape(batch, seq_len, VOCAB)
-            idx = labels.astype(np.int64)
-            p = np.take_along_axis(probs, idx[..., None], axis=2)[..., 0]
-            ppl = float(np.exp(-np.mean(np.log(np.maximum(p, 1e-9)))))
+            if head == "fused_ce":
+                # output IS the per-token CE loss vector
+                ppl = float(np.exp(np.asarray(outs[0]).mean()))
+            else:
+                probs = np.asarray(outs[0]).reshape(batch, seq_len, VOCAB)
+                idx = labels.astype(np.int64)
+                p = np.take_along_axis(probs, idx[..., None],
+                                       axis=2)[..., 0]
+                ppl = float(np.exp(-np.mean(np.log(np.maximum(p, 1e-9)))))
             if log:
                 logging.info("step %d: perplexity=%.2f (mesh=%s)",
                              i + 1, ppl, dict(mesh.shape))
@@ -114,11 +123,16 @@ def main():
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--mesh", type=str, default="1,1",
                    help="dp,sp mesh shape (sp>1 = ring attention)")
+    p.add_argument("--head", choices=["softmax", "fused_ce"],
+                   default="softmax",
+                   help="fused_ce = chunked fused linear+softmax-CE head")
+    p.add_argument("--remat", choices=["none", "block"], default="none",
+                   help="block = per-layer recompute (__remat__ segments)")
     p.add_argument("--tpus", type=int, default=0)
     args = p.parse_args()
     mesh_shape = tuple(int(x) for x in args.mesh.split(","))
     stats = train(steps=args.steps, seq_len=args.seq_len,
-                  mesh_shape=mesh_shape)
+                  mesh_shape=mesh_shape, head=args.head, remat=args.remat)
     print("final:", stats)
     # unigram baseline over this corpus is ~VOCAB-ish for noise tokens and
     # pattern entropy ~0; a working LM lands far below vocab-size ppl

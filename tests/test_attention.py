@@ -226,3 +226,18 @@ def test_transformer_lm_example_converges_and_matches_across_meshes():
     sharded = mod.train(steps=60, mesh_shape=(2, 2), log=False)
     assert abs(sharded["perplexity"] - single["perplexity"]) < 1e-3, (
         single, sharded)
+
+
+def test_transformer_lm_example_fused_head_and_remat():
+    """The two long-context knobs through the user-facing example: the
+    fused-CE head and per-block remat must converge to the same
+    perplexity as the default configuration (same seeds, same data)."""
+    from conftest import load_example
+
+    mod = load_example("train_transformer.py")
+    base = mod.train(steps=60, mesh_shape=(1, 1), log=False)
+    fused = mod.train(steps=60, mesh_shape=(1, 1), head="fused_ce",
+                      remat="block", log=False)
+    assert fused["perplexity"] < 5.0, fused
+    assert abs(fused["perplexity"] - base["perplexity"]) < 0.05, (
+        base, fused)

@@ -31,20 +31,36 @@ def find_local(coordinator=None):
     ``coordinator`` restricts to one cluster's processes (its
     ``MXNET_TPU_COORDINATOR`` value) so killing a stray sweep can never
     take down an unrelated healthy cluster on the same host."""
-    me = os.getpid()
-    parent = os.getppid()
+    skip = set()
+    pid = os.getpid()
+    # exclude the whole ancestor chain: an operator's shell with an
+    # exported marker (e.g. inside a launcher-managed job) must never be
+    # a kill target of its own cleanup
+    while pid > 1 and pid not in skip:
+        skip.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().rsplit(") ", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
     out = []
-    needle = (("MXNET_TPU_COORDINATOR=%s" % coordinator).encode() + b"\0"
-              if coordinator else None)
+    needles = None
+    if coordinator:
+        # workers carry MXNET_TPU_COORDINATOR (jax.distributed bootstrap);
+        # PS servers carry the inert MXNET_TPU_CLUSTER_ID stamp
+        needles = [("MXNET_TPU_COORDINATOR=%s" % coordinator).encode()
+                   + b"\0",
+                   ("MXNET_TPU_CLUSTER_ID=%s" % coordinator).encode()
+                   + b"\0"]
     for pid in os.listdir("/proc"):
-        if not pid.isdigit() or int(pid) in (me, parent):
+        if not pid.isdigit() or int(pid) in skip:
             continue
         try:
             with open("/proc/%s/environ" % pid, "rb") as f:
                 env = f.read()
         except OSError:
             continue
-        if needle is not None and needle not in env:
+        if needles is not None and not any(n in env for n in needles):
             continue
         if any(m in env for m in _MARKERS):
             try:
@@ -71,8 +87,20 @@ def main():
     args = ap.parse_args()
 
     if args.hostfile:
+        import shlex
+
+        if args.coordinator:
+            ap.error("--coordinator scoping needs /proc environ access "
+                     "and only works in local mode; remote sweeps match "
+                     "by --prog name per host")
+
+        # bracket trick ([m]xnet...) so pgrep -f never matches the remote
+        # shell running this very pipeline (the reference's grep -v grep);
+        # shlex.quote keeps metacharacters in --prog from executing
+        pattern = "[%s]%s" % (args.prog[0], args.prog[1:]) \
+            if args.prog else args.prog
         kill_cmd = ("pgrep -u \"$USER\" -f %s | xargs -r kill -%d"
-                    % (args.prog, args.signal))
+                    % (shlex.quote(pattern), args.signal))
         with open(args.hostfile) as f:
             hosts = [h.split(":")[0].strip() for h in f if h.strip()]
         for host in hosts:

@@ -54,10 +54,13 @@ def _free_port():
     return port
 
 
-def launch_servers(args):
+def launch_servers(args, coordinator=None):
     """Start ``-s N`` parameter-server shard processes (the reference's
     ``DMLC_ROLE=server`` topology, ``kvstore_dist_server.h``).  Returns
     (server procs, env entries workers need to find them).
+    ``coordinator`` stamps the cluster id (as the inert
+    ``MXNET_TPU_CLUSTER_ID``) into each server's env so
+    ``tools/kill_mxnet.py --coordinator`` covers servers too.
 
     Each server binds port 0 and reports its actual address through a
     file — the launcher never pre-allocates ports, so there is no
@@ -82,6 +85,11 @@ def launch_servers(args):
         env["MXNET_TPU_SERVER_ID"] = str(i)
         env["MXNET_TPU_NUM_SERVERS"] = str(args.num_servers)
         env["MXNET_TPU_PS_SECRET"] = secret
+        if coordinator:
+            # inert cluster-identity marker (NOT MXNET_TPU_COORDINATOR —
+            # that one makes jax.distributed join the worker cluster, and
+            # a server registering as a phantom task aborts every worker)
+            env["MXNET_TPU_CLUSTER_ID"] = coordinator
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "mxnet_tpu._async_ps_main"], env=env))
@@ -121,7 +129,7 @@ def launch_local(args, cmd):
     coordinator = "127.0.0.1:%d" % _free_port()
     server_procs, server_env = ([], {})
     if args.num_servers > 0:
-        server_procs, server_env = launch_servers(args)
+        server_procs, server_env = launch_servers(args, coordinator)
     procs = []
     for i in range(args.num_workers):
         env = dict(os.environ)
