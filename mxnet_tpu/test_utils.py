@@ -260,12 +260,14 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
         type_dict = ctx_spec.pop("type_dict", {})
         shapes = ctx_spec
         exe = s.simple_bind(ctx, grad_req=grad_req, type_dict=type_dict, **shapes)
-        if arg_params is None:
-            arg_params = {}
-            for name, arr in exe.arg_dict.items():
-                if name not in shapes:
-                    arg_params[name] = _np.random.normal(
-                        size=arr.shape, scale=scale).astype(_np.float32)
+        # a PARTIAL arg_params (e.g. only integer-valued inputs pinned) is
+        # completed with shared random draws — a param left at the bind's
+        # zeros would make the cross-check degenerate
+        arg_params = {} if arg_params is None else dict(arg_params)
+        for name, arr in exe.arg_dict.items():
+            if name not in shapes and name not in arg_params:
+                arg_params[name] = _np.random.normal(
+                    size=arr.shape, scale=scale).astype(_np.float32)
         for name, arr in exe.arg_dict.items():
             if name in shapes:
                 if name in arg_params:
