@@ -1,0 +1,200 @@
+"""Post-training int8 quantization workflow (reference surface:
+``src/operator/contrib/quantize.cc`` — the 2017 reference ships
+quantize/dequantize contrib ops but no end-to-end flow; this drives
+them, plus the TPU-native ``_contrib_quantized_fully_connected`` that
+runs the quantized matmul as int8 on the MXU).
+
+Flow:
+1. train a small fp32 MLP classifier on synthetic blob data;
+2. calibrate symmetric per-tensor ranges (max |x|) for weights and for
+   each layer's input activations on a calibration batch;
+3. fake-quant inference: ``quantize -> dequantize`` around each FC
+   input/weight (the reference-parity path — numerics of int8 storage,
+   float compute);
+4. real int8 inference: ``_contrib_quantized_fully_connected`` —
+   int8 x int8 -> int32 on the MXU, rescaled to fp32.  With symmetric
+   ranges this is bit-equal to (3) up to the final fp32 rounding.
+
+Gates: both quantized paths match each other tightly, and int8 accuracy
+stays within a point of fp32.
+
+    python examples/quantization.py
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _want_tpu(argv):
+    return any(a == "--tpus" and argv[i + 1] != "0"
+               for i, a in enumerate(argv[:-1])) or \
+        any(a.startswith("--tpus=") and a.split("=", 1)[1] != "0"
+            for a in argv)
+
+
+if __name__ == "__main__" and not _want_tpu(sys.argv[1:]):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import mxnet_tpu as mx  # noqa: E402
+
+HIDDEN = (64, 32)
+N_CLASSES = 5
+D_IN = 16
+
+
+def make_data(rng, n, centers):
+    labels = rng.randint(0, N_CLASSES, n)
+    x = (centers[labels] + rng.randn(n, D_IN)).astype(np.float32)
+    return x, labels.astype(np.float32)
+
+
+def train_fp32(x, y, epochs=10, batch=50, seed=0, log=True):
+    net = mx.sym.Variable("data")
+    for i, h in enumerate(HIDDEN):
+        net = mx.sym.FullyConnected(net, num_hidden=h, no_bias=True,
+                                    name="fc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=N_CLASSES, no_bias=True,
+                                name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.test_utils.default_context())
+    np.random.seed(seed + 1)
+    it = mx.io.NDArrayIter(x, y, batch_size=batch, shuffle=True)
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=None if not log else
+            mx.callback.Speedometer(batch, 10))
+    return mod
+
+
+def _sym_range(arr):
+    """Symmetric calibration range: lo = -hi = -max|x| (so the affine
+    int8 mapping has zero zero-point and the int8 dot is exact)."""
+    hi = float(np.max(np.abs(arr))) or 1.0
+    return -hi, hi
+
+
+def quantize_params(mod):
+    """Per-tensor symmetric int8 weights via _contrib_quantize."""
+    qparams = {}
+    params, _ = mod.get_params()
+    for name, w in params.items():
+        arr = w.asnumpy()
+        lo, hi = _sym_range(arr)
+        q, qlo, qhi = mx.contrib.nd.quantize(
+            mx.nd.array(arr), mx.nd.array([lo]), mx.nd.array([hi]),
+            out_type="int8")
+        qparams[name] = (q, float(qlo.asnumpy()[0]), float(qhi.asnumpy()[0]))
+    return qparams
+
+
+def calibrate_activations(mod, x_cal):
+    """max|activation| per layer input on a calibration batch (the
+    standard PTQ max-calibration)."""
+    params, _ = mod.get_params()
+    acts = {"fc0": x_cal}
+    h = x_cal
+    names = ["fc%d" % i for i in range(len(HIDDEN))] + ["head"]
+    for i, name in enumerate(names):
+        w = params["%s_weight" % name].asnumpy()
+        h = h @ w.T
+        if i < len(HIDDEN):
+            h = np.maximum(h, 0.0)
+            acts[names[i + 1]] = h
+    return {k: _sym_range(v) for k, v in acts.items()}
+
+
+def predict_fake_quant(qparams, act_ranges, x):
+    """Reference-parity path: int8 storage, float compute
+    (quantize -> dequantize around every FC input and weight)."""
+    h = mx.nd.array(x)
+    names = ["fc%d" % i for i in range(len(HIDDEN))] + ["head"]
+    for i, name in enumerate(names):
+        lo, hi = act_ranges[name]
+        qh, qlo, qhi = mx.contrib.nd.quantize(
+            h, mx.nd.array([lo]), mx.nd.array([hi]), out_type="int8")
+        h = mx.contrib.nd.dequantize(qh, qlo, qhi)
+        qw, wlo, whi = qparams["%s_weight" % name]
+        w = mx.contrib.nd.dequantize(qw, mx.nd.array([wlo]),
+                                     mx.nd.array([whi]))
+        h = mx.nd.dot(h, w, transpose_b=True)
+        if i < len(HIDDEN):
+            h = mx.nd.relu(h)
+    return h.asnumpy()
+
+
+def predict_int8(qparams, act_ranges, x):
+    """TPU-native path: the matmul itself runs int8 on the MXU."""
+    h = mx.nd.array(x)
+    names = ["fc%d" % i for i in range(len(HIDDEN))] + ["head"]
+    for i, name in enumerate(names):
+        lo, hi = act_ranges[name]
+        qh, qlo, qhi = mx.contrib.nd.quantize(
+            h, mx.nd.array([lo]), mx.nd.array([hi]), out_type="int8")
+        qw, wlo, whi = qparams["%s_weight" % name]
+        h = mx.contrib.nd.quantized_fully_connected(
+            qh, qw, qlo, qhi, mx.nd.array([wlo]), mx.nd.array([whi]),
+            num_hidden=qw.shape[0])
+        if i < len(HIDDEN):
+            h = mx.nd.relu(h)
+    return h.asnumpy()
+
+
+def run(epochs=10, n_train=1000, n_test=400, seed=0, log=True):
+    if log:
+        logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(N_CLASSES, D_IN) * 2.5
+    x, y = make_data(rng, n_train, centers)
+    xt, yt = make_data(rng, n_test, centers)
+    mod = train_fp32(x, y, epochs=epochs, seed=seed, log=log)
+
+    it = mx.io.NDArrayIter(xt, yt, batch_size=50)
+    fp32_acc = dict(mod.score(it, ["acc"]))["accuracy"]
+
+    qparams = quantize_params(mod)
+    act_ranges = calibrate_activations(mod, x[:200])
+    out_fake = predict_fake_quant(qparams, act_ranges, xt)
+    out_int8 = predict_int8(qparams, act_ranges, xt)
+
+    # the int8-dot path must match fake-quant to fp32 rounding
+    denom = np.maximum(np.abs(out_fake), 1.0)
+    path_delta = float(np.max(np.abs(out_fake - out_int8) / denom))
+    fake_acc = float((out_fake.argmax(1) == yt).mean())
+    int8_acc = float((out_int8.argmax(1) == yt).mean())
+    if log:
+        logging.info("fp32 acc=%.3f  fake-quant acc=%.3f  int8 acc=%.3f  "
+                     "path delta=%.2e", fp32_acc, fake_acc, int8_acc,
+                     path_delta)
+    return {"fp32_acc": fp32_acc, "fake_quant_acc": fake_acc,
+            "int8_acc": int8_acc, "path_delta": path_delta}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--tpus", type=int, default=0)
+    args = ap.parse_args()
+    if args.tpus:
+        mx.test_utils.set_default_context(mx.tpu(0))
+    stats = run(epochs=args.epochs)
+    print(stats)
+    assert stats["int8_acc"] > stats["fp32_acc"] - 0.02, stats
+    assert stats["path_delta"] < 1e-5, stats
+
+
+if __name__ == "__main__":
+    main()

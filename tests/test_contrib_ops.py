@@ -240,3 +240,43 @@ def test_contrib_ops_in_symbol_graph():
     ex = prior.bind(mx.cpu(), {"data": _nd(np.zeros((1, 3, 2, 2)))})
     out = ex.forward()[0].asnumpy()
     assert out.shape == (1, 4, 4)
+
+
+def test_quantized_fully_connected_matches_fake_quant():
+    """_contrib_quantized_fully_connected (beyond-parity int8 MXU op):
+    with symmetric ranges, int8 x int8 -> int32 rescaled must equal the
+    fake-quant float path (dequantize both operands, float dot) up to
+    fp32 rounding."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32) * 2.0
+    w = rng.randn(12, 16).astype(np.float32)
+    hx = float(np.abs(x).max())
+    hw = float(np.abs(w).max())
+    qx, xlo, xhi = contrib.nd.quantize(
+        mx.nd.array(x), mx.nd.array([-hx]), mx.nd.array([hx]),
+        out_type="int8")
+    qw, wlo, whi = contrib.nd.quantize(
+        mx.nd.array(w), mx.nd.array([-hw]), mx.nd.array([hw]),
+        out_type="int8")
+    assert qx.dtype == np.int8
+    out = contrib.nd.quantized_fully_connected(
+        qx, qw, xlo, xhi, wlo, whi, num_hidden=12).asnumpy()
+    ref = (contrib.nd.dequantize(qx, xlo, xhi).asnumpy()
+           @ contrib.nd.dequantize(qw, wlo, whi).asnumpy().T)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # asymmetric uint8 path: the zero-point cross terms must make the op
+    # STILL equal the fake-quant float path
+    qx8, xlo8, xhi8 = contrib.nd.quantize(
+        mx.nd.array(x), mx.nd.array([float(x.min())]),
+        mx.nd.array([float(x.max())]), out_type="uint8")
+    out8 = contrib.nd.quantized_fully_connected(
+        qx8, qw, xlo8, xhi8, wlo, whi, num_hidden=12).asnumpy()
+    ref8 = (contrib.nd.dequantize(qx8, xlo8, xhi8).asnumpy()
+            @ contrib.nd.dequantize(qw, wlo, whi).asnumpy().T)
+    np.testing.assert_allclose(out8, ref8, rtol=1e-4, atol=1e-4)
+    # and the quantization error vs the true product stays bounded by
+    # the two tensors' quantization steps
+    true = x @ w.T
+    step = (hx / 127.0) * np.abs(w).sum(1).max() \
+        + (hw / 127.0) * np.abs(x).sum(1).max()
+    assert float(np.abs(out - true).max()) < step, (out, true)

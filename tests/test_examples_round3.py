@@ -240,3 +240,13 @@ def test_benchmark_sweep_driver():
             rows = list(_csv.DictReader(f))
         assert [int(x["workers"]) for x in rows] == [1, 2]
         assert all(float(x["samples_per_sec"]) > 0 for x in rows)
+
+
+def test_quantization_example():
+    """PTQ workflow: symmetric int8 calibration, fake-quant path
+    (reference quantize/dequantize parity) and the int8-MXU path agree
+    to fp32 rounding, and int8 accuracy matches fp32."""
+    stats = _run_example("quantization.py", "epochs=10, log=False")
+    assert stats["path_delta"] < 1e-5, stats
+    assert stats["int8_acc"] > stats["fp32_acc"] - 0.02, stats
+    assert stats["fp32_acc"] > 0.9, stats
