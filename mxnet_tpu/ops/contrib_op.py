@@ -469,6 +469,17 @@ def _ctc_loss(attrs, data, label, *lengths):
 # quantize / dequantize (reference src/operator/contrib/quantize.cc)
 # ----------------------------------------------------------------------
 
+
+def _qscale_bias(lo_t, hi_t, dtype):
+    """Affine (scale, bias) of a quantized tensor: x = s*q + b.  The
+    single definition keeps the quantized compute ops bit-consistent
+    with :func:`_quantize`/:func:`_dequantize`'s mapping."""
+    lo = jnp.min(lo_t)
+    hi = jnp.max(hi_t)
+    qmin, qmax = (0.0, 255.0) if dtype == jnp.uint8 else (-127.0, 127.0)
+    s = jnp.maximum(hi - lo, 1e-8) / (qmax - qmin)
+    return s, lo - s * qmin
+
 @register(
     "_contrib_quantize",
     arg_names=["data", "min_range", "max_range"],
@@ -537,15 +548,8 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
             "num_hidden=%d but weight has %d output rows"
             % (attrs["num_hidden"], weight.shape[0]))
 
-    def scale_bias(lo_t, hi_t, dtype):
-        lo = jnp.min(lo_t)
-        hi = jnp.max(hi_t)
-        qmin, qmax = (0.0, 255.0) if dtype == jnp.uint8 else (-127.0, 127.0)
-        s = jnp.maximum(hi - lo, 1e-8) / (qmax - qmin)
-        return s, lo - s * qmin
-
-    s_d, b_d = scale_bias(min_data, max_data, data.dtype)
-    s_w, b_w = scale_bias(min_weight, max_weight, weight.dtype)
+    s_d, b_d = _qscale_bias(min_data, max_data, data.dtype)
+    s_w, b_w = _qscale_bias(min_weight, max_weight, weight.dtype)
     acc = jax.lax.dot_general(
         data, weight, (((data.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32).astype(jnp.float32)
@@ -555,6 +559,75 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
     K = data.shape[-1]
     return (s_d * s_w * acc + s_d * b_w * row_d + s_w * b_d * row_w
             + K * b_d * b_w)
+
+
+@register(
+    "_contrib_quantized_conv",
+    arg_names=["data", "weight", "min_data", "max_data", "min_weight",
+               "max_weight"],
+    params={
+        "kernel": P("shape", None, required=True),
+        "num_filter": P("int", 0, required=True),
+        "stride": P("shape", None),
+        "pad": P("shape", None),
+    },
+)
+def _quantized_conv(attrs, data, weight, min_data, max_data,
+                    min_weight, max_weight):
+    """Quantized 2-D Convolution on the MXU (beyond-parity; the compute
+    twin of :func:`_quantized_fully_connected` for the conv zoo).
+
+    int8/uint8 NCHW data x OIHW weight accumulate int32 on the MXU.
+    Exact affine handling incl. PADDING: a padded slot is zero in
+    q-space but ``b = lo - s*qmin`` in float space, so the zero-point
+    cross terms must count only VALID window elements — three cheap
+    auxiliary convs (data-with-ones-kernel, ones-with-weight, and a
+    valid-element count) make any ``_contrib_quantize`` output
+    dequantize bit-equal to the fake-quant float path up to fp32
+    rounding; with symmetric calibration all three vanish."""
+    if data.dtype not in (jnp.int8, jnp.uint8) or \
+            weight.dtype not in (jnp.int8, jnp.uint8):
+        raise TypeError(
+            "quantized_conv takes int8/uint8 inputs from "
+            "_contrib_quantize, got %s/%s" % (data.dtype, weight.dtype))
+    if weight.shape[0] != attrs["num_filter"]:
+        raise ValueError("num_filter=%d but weight has %d output channels"
+                         % (attrs["num_filter"], weight.shape[0]))
+    kh, kw = weight.shape[2:]
+    if tuple(attrs["kernel"]) != (kh, kw):
+        raise ValueError("kernel=%s but weight is %dx%d"
+                         % (tuple(attrs["kernel"]), kh, kw))
+    stride = tuple(attrs.get("stride") or (1, 1))
+    ph, pw = tuple(attrs.get("pad") or (0, 0))
+    padding = ((ph, ph), (pw, pw))
+    dn = ("NCHW", "OIHW", "NCHW")
+
+    s_d, b_d = _qscale_bias(min_data, max_data, data.dtype)
+    s_w, b_w = _qscale_bias(min_weight, max_weight, weight.dtype)
+
+    def conv(x, w):
+        if x.dtype != w.dtype:
+            # XLA conv needs matching operand dtypes; uint8 x int8 can't
+            # share one (255 doesn't fit int8), so the mixed case pays an
+            # int32 upcast — the int8 x int8 fast path stays on the MXU
+            x = x.astype(jnp.int32)
+            w = w.astype(jnp.int32)
+        return jax.lax.conv_general_dilated(
+            x, w, stride, padding, dimension_numbers=dn,
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+
+    C = data.shape[1]
+    acc = conv(data, weight)                                # (N,O,H,W)
+    ones_k = jnp.ones((1, C, kh, kw), data.dtype)
+    win_d = conv(data, ones_k)                              # (N,1,H,W)
+    ones_x = jnp.ones((1, C) + data.shape[2:], weight.dtype)
+    win_w = conv(ones_x, weight)                            # (1,O,H,W)
+    # channels are never padded: a single-channel count conv x C is
+    # C-times cheaper than counting across all input channels
+    cnt = C * conv(jnp.ones((1, 1) + data.shape[2:], jnp.int8),
+                   jnp.ones((1, 1, kh, kw), jnp.int8))      # (1,1,H,W)
+    return (s_d * s_w * acc + s_d * b_w * win_d + s_w * b_d * win_w
+            + b_d * b_w * cnt)
 
 
 # ----------------------------------------------------------------------

@@ -280,3 +280,42 @@ def test_quantized_fully_connected_matches_fake_quant():
     step = (hx / 127.0) * np.abs(w).sum(1).max() \
         + (hw / 127.0) * np.abs(x).sum(1).max()
     assert float(np.abs(out - true).max()) < step, (out, true)
+
+
+def test_quantized_conv_matches_fake_quant():
+    """_contrib_quantized_conv: int8 (and mixed uint8-data) convolution
+    with int32 MXU accumulation must equal the fake-quant float path —
+    including PADDING, where a padded slot is zero in q-space but
+    b = lo - s*qmin in float space, so the zero-point corrections must
+    count only valid window elements."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 10, 10).astype(np.float32) * 1.7
+    w = rng.randn(6, 3, 3, 3).astype(np.float32)
+    hx, hw = float(np.abs(x).max()), float(np.abs(w).max())
+    qw, wlo, whi = contrib.nd.quantize(
+        mx.nd.array(w), mx.nd.array([-hw]), mx.nd.array([hw]),
+        out_type="int8")
+    conv_sym = mx.sym.Convolution(
+        mx.sym.Variable("d"), mx.sym.Variable("w"), kernel=(3, 3),
+        num_filter=6, pad=(1, 1), stride=(2, 2), no_bias=True)
+    # asymmetric uint8 WEIGHTS too, so the s_d*b_w*win_d correction is
+    # genuinely exercised (symmetric int8 weights have b_w == 0)
+    qw8, wlo8, whi8 = contrib.nd.quantize(
+        mx.nd.array(w), mx.nd.array([float(w.min())]),
+        mx.nd.array([float(w.max())]), out_type="uint8")
+    for out_type, lo_v, hi_v, (qww, wl, wh) in (
+            ("int8", -hx, hx, (qw, wlo, whi)),
+            ("uint8", float(x.min()), float(x.max()), (qw, wlo, whi)),
+            ("uint8", float(x.min()), float(x.max()), (qw8, wlo8, whi8))):
+        qx, xlo, xhi = contrib.nd.quantize(
+            mx.nd.array(x), mx.nd.array([lo_v]), mx.nd.array([hi_v]),
+            out_type=out_type)
+        out = contrib.nd.quantized_conv(
+            qx, qww, xlo, xhi, wl, wh, kernel=(3, 3), num_filter=6,
+            pad=(1, 1), stride=(2, 2)).asnumpy()
+        ex = conv_sym.bind(mx.cpu(), {
+            "d": contrib.nd.dequantize(qx, xlo, xhi),
+            "w": contrib.nd.dequantize(qww, wl, wh)})
+        ref = ex.forward()[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg="%s/%s" % (out_type, qww.dtype))
