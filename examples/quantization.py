@@ -183,6 +183,116 @@ def run(epochs=10, n_train=1000, n_test=400, seed=0, log=True):
             "int8_acc": int8_acc, "path_delta": path_delta}
 
 
+SIDE = 12  # conv-path image side
+
+
+def make_images(rng, n, n_classes=3):
+    """Oriented-grating textures (like tests/test_train_rec_pipeline.py)."""
+    labels = rng.randint(0, n_classes, n)
+    yy, xx = np.mgrid[0:SIDE, 0:SIDE]
+    x = np.zeros((n, 1, SIDE, SIDE), np.float32)
+    for i, cls in enumerate(labels):
+        ang = np.pi / n_classes * cls + rng.uniform(-0.1, 0.1)
+        wave = np.sin(0.9 * (np.cos(ang) * xx + np.sin(ang) * yy)
+                      + rng.uniform(0, 2 * np.pi))
+        x[i, 0] = 0.5 + 0.4 * wave + rng.normal(0, 0.05, (SIDE, SIDE))
+    return x, labels.astype(np.float32)
+
+
+def run_conv(epochs=8, n_train=600, n_test=200, seed=0, log=True):
+    """PTQ of a small convnet: the conv layers run through
+    _contrib_quantized_conv (int8 on the MXU, exact padded-affine
+    handling), the head through _contrib_quantized_fully_connected."""
+    if log:
+        logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(seed)
+    x, y = make_images(rng, n_train)
+    xt, yt = make_images(rng, n_test)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             no_bias=True, name="c0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                             no_bias=True, name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, no_bias=True,
+                                name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.test_utils.default_context())
+    np.random.seed(seed + 1)
+    it = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=None)
+    itv = mx.io.NDArrayIter(xt, yt, batch_size=50)
+    fp32_acc = dict(mod.score(itv, ["acc"]))["accuracy"]
+    params, _ = mod.get_params()
+
+    def q(arr, rng_pair):
+        lo, hi = rng_pair
+        return mx.contrib.nd.quantize(
+            mx.nd.array(arr) if isinstance(arr, np.ndarray) else arr,
+            mx.nd.array([lo]), mx.nd.array([hi]), out_type="int8")
+
+    # calibrate activation ranges on a float forward over a calib batch —
+    # through the SAME mx.nd ops the quantized graph approximates, so
+    # ranges can never drift from what the int8 path actually sees
+    def float_fwd(xa, collect=None):
+        h = mx.nd.array(xa)
+        for name, kind in (("c0", "conv"), ("c1", "conv"), ("head", "fc")):
+            if collect is not None:
+                collect[name] = _sym_range(h.asnumpy())
+            w = params["%s_weight" % name]
+            if kind == "conv":
+                h = mx.nd.relu(mx.nd.Convolution(
+                    h, w, kernel=(3, 3), num_filter=w.shape[0],
+                    pad=(1, 1), no_bias=True))
+                if name == "c0":
+                    h = mx.nd.Pooling(h, kernel=(2, 2), stride=(2, 2),
+                                      pool_type="max")
+            else:
+                h = mx.nd.FullyConnected(
+                    h.reshape((h.shape[0], -1)), w,
+                    num_hidden=w.shape[0], no_bias=True)
+        return h.asnumpy()
+
+    act_ranges = {}
+    float_fwd(x[:200], collect=act_ranges)
+
+    # quantized inference: conv layers on the int8 MXU path
+    qweights = {n: q(params["%s_weight" % n].asnumpy(),
+                     _sym_range(params["%s_weight" % n].asnumpy()))
+                for n in ("c0", "c1", "head")}
+
+    def int8_fwd(xa):
+        h = mx.nd.array(xa)
+        for name in ("c0", "c1"):
+            qh, hlo, hhi = q(h, act_ranges[name])
+            qw, wlo, whi = qweights[name]
+            h = mx.contrib.nd.quantized_conv(
+                qh, qw, hlo, hhi, wlo, whi, kernel=(3, 3),
+                num_filter=qw.shape[0], pad=(1, 1))
+            h = mx.nd.relu(h)
+            if name == "c0":
+                h = mx.nd.Pooling(h, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="max")
+        qh, hlo, hhi = q(h.reshape((h.shape[0], -1)), act_ranges["head"])
+        qw, wlo, whi = qweights["head"]
+        return mx.contrib.nd.quantized_fully_connected(
+            qh, qw, hlo, hhi, wlo, whi, num_hidden=qw.shape[0]).asnumpy()
+
+    out_int8 = int8_fwd(xt)
+    int8_acc = float((out_int8.argmax(1) == yt).mean())
+    if log:
+        logging.info("conv PTQ: fp32 acc=%.3f int8 acc=%.3f",
+                     fp32_acc, int8_acc)
+    return {"fp32_acc": fp32_acc, "int8_acc": int8_acc}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--epochs", type=int, default=10)
@@ -194,6 +304,9 @@ def main():
     print(stats)
     assert stats["int8_acc"] > stats["fp32_acc"] - 0.02, stats
     assert stats["path_delta"] < 1e-5, stats
+    cstats = run_conv(epochs=args.epochs)
+    print(cstats)
+    assert cstats["int8_acc"] > cstats["fp32_acc"] - 0.05, cstats
 
 
 if __name__ == "__main__":

@@ -19,8 +19,8 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(name, call):
-    """Execute examples/<name>'s run() in a subprocess; return stats."""
+def _run_example(name, call, func="run"):
+    """Execute examples/<name>'s entry point in a subprocess; return stats."""
     code = (
         "import sys, json\n"
         "sys.path.insert(0, %r)\n"
@@ -29,10 +29,10 @@ def _run_example(name, call):
         "mod = importlib.util.module_from_spec(spec)\n"
         "sys.modules['ex'] = mod\n"
         "spec.loader.exec_module(mod)\n"
-        "stats = mod.run(%s)\n"
+        "stats = mod.%s(%s)\n"
         "stats.pop('image', None)\n"
         "print('STATS ' + json.dumps({k: float(v) for k, v in stats.items()}))\n"
-        % (_REPO, os.path.join(_REPO, "examples", name), call)
+        % (_REPO, os.path.join(_REPO, "examples", name), func, call)
     )
     env = dict(os.environ, MXNET_TPU_PLATFORM="cpu")
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
@@ -250,3 +250,12 @@ def test_quantization_example():
     assert stats["path_delta"] < 1e-5, stats
     assert stats["int8_acc"] > stats["fp32_acc"] - 0.02, stats
     assert stats["fp32_acc"] > 0.9, stats
+
+
+def test_quantization_conv_example():
+    """Conv-path PTQ: _contrib_quantized_conv + quantized FC carry a
+    small convnet to fp32-matching accuracy on the int8 MXU path."""
+    stats = _run_example("quantization.py", "epochs=8, log=False",
+                         func="run_conv")
+    assert stats["fp32_acc"] > 0.9, stats
+    assert stats["int8_acc"] > stats["fp32_acc"] - 0.05, stats

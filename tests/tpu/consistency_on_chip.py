@@ -106,6 +106,20 @@ CASES = [
      mx.sym.batch_dot(mx.sym.SwapAxis(v(), dim1=1, dim2=2),
                       mx.sym.Variable("rhs")),
      {"data": (4, 6, 5), "rhs": (4, 6, 7)}, MXU_TOL),
+    # quantized compute tier: float in -> quantize -> int8 MXU op; int32
+    # accumulation is exact on both backends so the tolerance is tight
+    ("quantized_fc",
+     mx.sym._contrib_quantized_fully_connected(
+         *(lambda dq, wq: (dq[0], wq[0], dq[1], dq[2], wq[1], wq[2]))(
+             mx.sym._contrib_quantize(
+                 v(), mx.sym.Variable("dlo", shape=(1,)),
+                 mx.sym.Variable("dhi", shape=(1,)), out_type="int8"),
+             mx.sym._contrib_quantize(
+                 mx.sym.Variable("w"), mx.sym.Variable("wlo", shape=(1,)),
+                 mx.sym.Variable("whi", shape=(1,)), out_type="int8")),
+         num_hidden=12),
+     {"data": (8, 16), "w": (12, 16), "dlo": (1,), "dhi": (1,),
+      "wlo": (1,), "whi": (1,)}, VPU_TOL, "null"),
 ]
 
 
@@ -118,14 +132,23 @@ INT_INPUTS = {"Embedding+take": {"data": (0, 50)},
 # grid away from floor() cell boundaries, where the MXU's ~1e-2 fp32
 # coordinate error would legitimately flip a cell on one backend only
 # (a real discontinuity of the op, not an implementation divergence)
-PINNED_INPUTS = {"BilinearSampler": {"affine": np.tile(
-    np.array([0.91, 0.03, 0.013, 0.02, 0.87, -0.021], np.float32),
-    (2, 1))}}
+PINNED_INPUTS = {
+    "BilinearSampler": {"affine": np.tile(
+        np.array([0.91, 0.03, 0.013, 0.02, 0.87, -0.021], np.float32),
+        (2, 1))},
+    # valid (lo < hi) quantization ranges covering the uniform(-1,1) data
+    "quantized_fc": {"dlo": np.array([-1.0], np.float32),
+                     "dhi": np.array([1.0], np.float32),
+                     "wlo": np.array([-1.0], np.float32),
+                     "whi": np.array([1.0], np.float32)},
+}
 
 
 def main():
     n_ok = 0
-    for name, s, shapes, tol in CASES:
+    for case in CASES:
+        name, s, shapes, tol = case[:4]
+        grad_req = case[4] if len(case) > 4 else "write"
         # pin only the integer-valued inputs; check_consistency shares
         # one draw of everything else across both contexts (and completes
         # a partial arg_params with random params)
@@ -135,7 +158,7 @@ def main():
         arg_params.update(PINNED_INPUTS.get(name, {}))
         mx.test_utils.check_consistency(
             s, [dict(ctx=mx.cpu(), **shapes), dict(ctx=mx.tpu(0), **shapes)],
-            tol=tol, arg_params=arg_params or None)
+            tol=tol, grad_req=grad_req, arg_params=arg_params or None)
         n_ok += 1
         print("ok %s" % name, flush=True)
     print("CONSISTENCY_OK %d" % n_ok)
