@@ -121,13 +121,17 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
 
         pstruct = {n: struct(n, trainer.param_specs[n])
                    for n in trainer.param_names}
+        # momentum lives in the ZeRO sharding (opt_specs) when zero_stage>=1;
+        # restoring it into param_specs would silently re-replicate it
+        opt_specs = getattr(trainer, "opt_specs", trainer.param_specs)
+        mstruct = {n: struct(n, opt_specs[n]) for n in trainer.param_names}
         astruct = {n: jax.ShapeDtypeStruct(
             tuple(trainer.aux_shapes[n]),
             trainer.aux_dtypes.get(n, "float32"),
             sharding=trainer._sharding(P()))
             for n in trainer.aux_shapes}
         probe = _ckpt_probe_moms(mgr, step) if trainer._use_momentum else False
-        moms_target = dict(pstruct) if trainer._use_momentum else {}
+        moms_target = dict(mstruct) if trainer._use_momentum else {}
         if probe is False and trainer._use_momentum:
             # checkpoint definitively saved without momentum state: restore
             # the rest; because this is probed from metadata, unrelated

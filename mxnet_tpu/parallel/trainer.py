@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["ShardedTrainer", "auto_tp_specs"]
+__all__ = ["ShardedTrainer", "auto_tp_specs", "zero_extend_spec"]
 
 
 def auto_tp_specs(symbol, arg_shapes, mesh, data_axis="data", model_axis="model"):
@@ -45,6 +45,34 @@ def auto_tp_specs(symbol, arg_shapes, mesh, data_axis="data", model_axis="model"
         elif name.endswith("_bias") and len(shape) == 1 and shape[0] % msize == 0:
             specs[name] = P(model_axis)
     return specs
+
+
+def zero_extend_spec(spec, shape, mesh, data_axis="data"):
+    """Extend a parameter's PartitionSpec with the ``data`` axis on the first
+    unsharded, divisible dimension — the ZeRO sharding rule.
+
+    The reference shards optimizer state across parameter-server processes by
+    key (``src/kvstore/kvstore_dist_server.h:136-205`` applies the optimizer on
+    each server's shard); on a TPU mesh the same idea is a sharding
+    annotation: optimizer state (and, for ZeRO-3/FSDP, the weights) live
+    sliced along ``data`` and XLA inserts the reduce-scatter/all-gather.
+    Returns ``spec`` unchanged when no dimension divides the axis size.
+    """
+    if data_axis not in mesh.axis_names:
+        return spec
+    dsize = mesh.shape[data_axis]
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = [ax for e in entries if e is not None
+            for ax in (e if isinstance(e, tuple) else (e,))]
+    if data_axis in used:  # caller already shards this param over data
+        return spec
+    for i, (e, s) in enumerate(zip(entries, shape)):
+        if e is None and s > 0 and s % dsize == 0:
+            entries[i] = data_axis
+            while entries and entries[-1] is None:
+                entries.pop()
+            return P(*entries)
+    return spec
 
 
 def _sgd_update(w, g, mom, lr, momentum, wd, rescale, clip):
@@ -83,7 +111,7 @@ class ShardedTrainer:
                  learning_rate=0.01, momentum=0.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=None,
                  data_axis="data", dtype="float32",
-                 remat=False, remat_policy=None):
+                 remat=False, remat_policy=None, zero_stage=0):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -119,6 +147,20 @@ class ShardedTrainer:
             data_axis)
         pspecs.update(param_specs or {})
         self.param_specs = {n: pspecs.get(n, P()) for n in self.param_names}
+        # ZeRO: stage>=1 shards optimizer state (and constrains gradients)
+        # along the data axis; stage>=3 shards the weights themselves (FSDP).
+        # Stages compose with TP specs — zero_extend_spec only claims a
+        # dimension the TP spec left unsharded.
+        if zero_stage not in (0, 1, 2, 3):
+            raise MXNetError("zero_stage must be 0, 1, 2, or 3")
+        self.zero_stage = zero_stage
+        self.opt_specs = dict(self.param_specs)
+        if zero_stage >= 1:
+            for n in self.param_names:
+                self.opt_specs[n] = zero_extend_spec(
+                    self.param_specs[n], self.arg_shapes[n], mesh, data_axis)
+            if zero_stage >= 3:
+                self.param_specs = dict(self.opt_specs)
         dspecs = {}
         for n in self._input_names:
             shp = self.arg_shapes[n]
@@ -171,7 +213,7 @@ class ShardedTrainer:
                     arr, self._sharding(self.param_specs[n]))
                 if self._use_momentum:
                     moms[n] = jax.device_put(
-                        _np.zeros_like(arr), self._sharding(self.param_specs[n]))
+                        _np.zeros_like(arr), self._sharding(self.opt_specs[n]))
             for n, shp in self.aux_shapes.items():
                 init_val = (_np.ones if n.endswith("_var") or "moving_var" in n
                             else _np.zeros)
@@ -221,6 +263,12 @@ class ShardedTrainer:
             dparams = {n: params[n] for n in diff}
             (_, (outs, new_aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(dparams)
+            if zero:
+                # force the gradient reduction to land sharded (reduce-scatter
+                # rather than all-reduce) so the optimizer math runs on 1/dp
+                # of each tensor — the ZeRO bandwidth/memory saving
+                grads = {n: jax.lax.with_sharding_constraint(
+                    grads[n], zero_shard[n]) for n in grads}
             new_params, new_moms = dict(params), dict(moms)
             for n in diff:
                 m = moms.get(n) if use_mom else None
@@ -231,8 +279,12 @@ class ShardedTrainer:
                     new_moms[n] = nm
             return outs, new_params, new_moms, new_aux
 
+        zero = self.zero_stage >= 1
+        zero_shard = {n: self._sharding(self.opt_specs[n])
+                      for n in self.param_names}
         pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
-        mshard = dict(pshard) if use_mom else {}
+        mshard = ({n: zero_shard[n] for n in self.param_names}
+                  if use_mom else {})
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
         self._jit_step_raw = jax.jit(

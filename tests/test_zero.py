@@ -1,0 +1,154 @@
+"""ZeRO-sharded data parallelism (optimizer-state / full parameter sharding).
+
+The reference's nearest concept is the parameter server applying the
+optimizer on each server's key shard (``src/kvstore/kvstore_dist_server.h:
+136-205``, big arrays striped across servers ``kvstore_dist.h:269-300``).
+The TPU-native expression is a sharding annotation: optimizer state (ZeRO-1)
+and optionally the weights themselves (ZeRO-3 / FSDP) live sliced along the
+``data`` mesh axis, and XLA inserts reduce-scatter/all-gather on ICI.
+
+These tests pin (a) numerics: every stage matches plain DP exactly;
+(b) placement: the state really is sharded, so the memory saving is real.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.trainer import ShardedTrainer, zero_extend_spec
+
+
+def _mlp_sym():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _batch(b=8, d=8):
+    rs = np.random.RandomState(0)
+    return {"data": rs.randn(b, d).astype(np.float32),
+            "softmax_label": rs.randint(0, 8, (b,)).astype(np.float32)}
+
+
+def _train(mesh, zero_stage, steps=4, param_specs=None, momentum=0.9):
+    tr = ShardedTrainer(_mlp_sym(), mesh, data_shapes={"data": (8, 8)},
+                        label_shapes={"softmax_label": (8,)},
+                        momentum=momentum, wd=1e-4,
+                        param_specs=param_specs, zero_stage=zero_stage)
+    params, moms, aux = tr.init(seed=0)
+    batch = tr.place_batch(_batch())
+    step = tr.step_fn()
+    for i in range(steps):
+        outs, params, moms, aux = step(params, moms, aux, batch,
+                                       jax.random.PRNGKey(i))
+    return tr, params, moms
+
+
+def _np_params(params):
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+def test_zero_extend_spec_rules():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+    # first unsharded divisible dim gets the data axis
+    assert zero_extend_spec(P(), (4, 6), mesh) == P("data")
+    # dim0 taken by TP: falls through to dim1
+    assert zero_extend_spec(P("model"), (4, 6), mesh) == P("model", "data")
+    # nothing divisible by 2: unchanged
+    assert zero_extend_spec(P(), (3, 5), mesh) == P()
+    # no data axis in mesh: unchanged
+    mmesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    assert zero_extend_spec(P(), (4, 6), mmesh) == P()
+    # caller already shards over data (any dim): never double-claim
+    assert zero_extend_spec(P("data"), (4, 6), mesh) == P("data")
+    assert zero_extend_spec(P(("model", "data")), (4, 6), mesh) \
+        == P(("model", "data"))
+
+
+def test_zero1_checkpoint_roundtrip_keeps_mom_sharding(tmp_path):
+    # restore must land momentum back in opt_specs, not re-replicated
+    from mxnet_tpu.parallel import checkpoint as ckpt
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    tr, params, moms = _train(mesh, zero_stage=1, steps=2)
+    d = str(tmp_path / "zck")
+    ckpt.save_sharded(d, 1, params, moms,
+                      {})
+    p2, m2, _ = ckpt.restore_sharded(d, 1, trainer=tr)
+    for n in tr.param_names:
+        np.testing.assert_allclose(np.asarray(m2[n]), np.asarray(moms[n]),
+                                   rtol=0, atol=0, err_msg=n)
+        assert m2[n].sharding.spec == moms[n].sharding.spec, n
+        assert "data" in jax.tree_util.tree_leaves(tuple(m2[n].sharding.spec))
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_matches_plain_dp(stage):
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    _, base, base_moms = _train(mesh, zero_stage=0)
+    _, z, z_moms = _train(mesh, zero_stage=stage)
+    for k in base:
+        np.testing.assert_allclose(np.asarray(z[k]), np.asarray(base[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(np.asarray(z_moms[k]),
+                                   np.asarray(base_moms[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_zero1_shards_optimizer_state_only():
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    tr, params, moms = _train(mesh, zero_stage=1, steps=1)
+    for n in tr.param_names:
+        mspec = moms[n].sharding.spec
+        assert "data" in jax.tree_util.tree_leaves(tuple(mspec)), (n, mspec)
+        pspec = tuple(params[n].sharding.spec)
+        assert "data" not in jax.tree_util.tree_leaves(pspec), (n, pspec)
+        # the shard on each device really is 1/dp of the tensor
+        shard = moms[n].addressable_shards[0].data
+        assert shard.size == np.prod(tr.arg_shapes[n]) // 4, n
+
+
+def test_zero3_shards_params():
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    tr, params, moms = _train(mesh, zero_stage=3, steps=1)
+    for n in tr.param_names:
+        for tree in (params, moms):
+            spec = tree[n].sharding.spec
+            assert "data" in jax.tree_util.tree_leaves(tuple(spec)), (n, spec)
+            shard = tree[n].addressable_shards[0].data
+            assert shard.size == np.prod(tr.arg_shapes[n]) // 4, n
+
+
+def test_zero3_composes_with_tp():
+    # dp x tp mesh: TP claims the output-channel dim, ZeRO claims another
+    devs = jax.devices()[:4]
+    if len(devs) < 4:
+        pytest.skip("need 4 devices")
+    mesh2d = Mesh(np.array(devs).reshape(2, 2), ("data", "model"))
+    tp = {"fc1_weight": P("model"), "fc1_bias": P("model")}
+    _, base, _ = _train(mesh2d, zero_stage=0, param_specs=tp)
+    tr, z, _ = _train(mesh2d, zero_stage=3, param_specs=tp)
+    for k in base:
+        np.testing.assert_allclose(np.asarray(z[k]), np.asarray(base[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # fc1_weight: dim0 = model (TP), dim1 = data (ZeRO)
+    assert tuple(tr.opt_specs["fc1_weight"]) == ("model", "data")
+
+
+def test_zero_without_momentum():
+    # plain SGD: no state to shard, but stage-3 weight sharding still works
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    _, base, _ = _train(mesh, zero_stage=0, momentum=0.0)
+    _, z, _ = _train(mesh, zero_stage=3, momentum=0.0)
+    for k in base:
+        np.testing.assert_allclose(np.asarray(z[k]), np.asarray(base[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
