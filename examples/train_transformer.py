@@ -67,7 +67,8 @@ def make_batch(rng, batch, seq_len):
 
 
 def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
-          seed=0, head="softmax", remat="none", log=True):
+          seed=0, head="softmax", remat="none", log=True,
+          optimizer="sgd", zero_stage=0):
     import jax
     from jax.sharding import Mesh
 
@@ -89,7 +90,8 @@ def train(steps=150, batch=8, seq_len=64, mesh_shape=(1, 1), lr=3e-3,
         sym, mesh, data_shapes={"data": (batch, seq_len)},
         label_shapes={"softmax_label": (batch, seq_len)},
         type_dict={"data": "int32"},
-        learning_rate=lr, momentum=0.9,
+        learning_rate=lr, momentum=0.9 if optimizer == "sgd" else 0.0,
+        optimizer=optimizer, zero_stage=zero_stage,
         rescale_grad=1.0 / (batch * seq_len))
     params, moms, aux = tr.init(seed=seed)
     step = tr.step_fn()
@@ -128,11 +130,17 @@ def main():
                    help="fused_ce = chunked fused linear+softmax-CE head")
     p.add_argument("--remat", choices=["none", "block"], default="none",
                    help="block = per-layer recompute (__remat__ segments)")
+    p.add_argument("--optimizer", choices=["sgd", "adam", "rmsprop"],
+                   default="sgd",
+                   help="fused update rule (adam state shards under --zero)")
+    p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
+                   help="ZeRO stage: 1/2 shard optimizer state, 3 = FSDP")
     p.add_argument("--tpus", type=int, default=0)
     args = p.parse_args()
     mesh_shape = tuple(int(x) for x in args.mesh.split(","))
     stats = train(steps=args.steps, seq_len=args.seq_len,
-                  mesh_shape=mesh_shape, head=args.head, remat=args.remat)
+                  mesh_shape=mesh_shape, head=args.head, remat=args.remat,
+                  optimizer=args.optimizer, zero_stage=args.zero)
     print("final:", stats)
     # unigram baseline over this corpus is ~VOCAB-ish for noise tokens and
     # pattern entropy ~0; a working LM lands far below vocab-size ppl

@@ -1018,8 +1018,10 @@ def _adam_update(attrs, w, g, mean, var):
     b1, b2 = attrs["beta1"], attrs["beta2"]
     new_mean = b1 * mean + (1 - b1) * g
     new_var = b2 * var + (1 - b2) * jnp.square(g)
+    # t may be a traced scalar (ShardedTrainer passes the on-device step
+    # counter so long runs don't recompile per step) — jnp handles both
     t = attrs["t"]
-    lr = attrs["lr"] * _np.sqrt(1 - b2**t) / (1 - b1**t)
+    lr = attrs["lr"] * jnp.sqrt(1 - b2**t) / (1 - b1**t)
     new_w = w - lr * new_mean / (jnp.sqrt(new_var) + attrs["epsilon"])
     return new_w, new_mean, new_var
 

@@ -121,10 +121,10 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
 
         pstruct = {n: struct(n, trainer.param_specs[n])
                    for n in trainer.param_names}
-        # momentum lives in the ZeRO sharding (opt_specs) when zero_stage>=1;
-        # restoring it into param_specs would silently re-replicate it
-        opt_specs = getattr(trainer, "opt_specs", trainer.param_specs)
-        mstruct = {n: struct(n, opt_specs[n]) for n in trainer.param_names}
+        # optimizer state lives in the trainer's declared structure (ZeRO
+        # opt_specs shardings, tuples for multi-state optimizers, the step
+        # counter) — restoring into param_specs would re-replicate it
+        mstruct = trainer.opt_state_struct()
         astruct = {n: jax.ShapeDtypeStruct(
             tuple(trainer.aux_shapes[n]),
             trainer.aux_dtypes.get(n, "float32"),

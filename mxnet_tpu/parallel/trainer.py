@@ -75,15 +75,7 @@ def zero_extend_spec(spec, shape, mesh, data_axis="data"):
     return spec
 
 
-def _sgd_update(w, g, mom, lr, momentum, wd, rescale, clip):
-    g = g * rescale
-    if clip is not None:
-        g = jnp.clip(g, -clip, clip)
-    g = g + wd * w
-    if mom is None:
-        return w - lr * g, None
-    new_mom = momentum * mom - lr * g
-    return w + new_mom, new_mom
+_STEP_COUNT = "__num_update__"  # reserved key in the optimizer-state tree
 
 
 class ShardedTrainer:
@@ -111,7 +103,8 @@ class ShardedTrainer:
                  learning_rate=0.01, momentum=0.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=None,
                  data_axis="data", dtype="float32",
-                 remat=False, remat_policy=None, zero_stage=0):
+                 remat=False, remat_policy=None, zero_stage=0,
+                 optimizer="sgd", optimizer_params=None):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -184,8 +177,49 @@ class ShardedTrainer:
         self._remat = bool(remat) or remat_policy is not None
         self._remat_policy = (getattr(jax.checkpoint_policies, remat_policy)
                               if remat_policy is not None else None)
-        self._hyper = (learning_rate, momentum, wd, rescale_grad, clip_gradient)
-        self._use_momentum = momentum != 0.0
+        # -- optimizer: any registered fused-update op (reference
+        # src/operator/optimizer_op.cc; the single source of update math
+        # shared with the imperative Optimizer classes).  "sgd" keeps the
+        # historical momentum= knob; everything else configures through
+        # optimizer_params (beta1/beta2/epsilon/gamma1/...).
+        from ..ops.registry import get_op
+
+        opt_name = (optimizer or "sgd").lower()
+        opt_kwargs = dict(optimizer_params or {})
+        if opt_name == "sgd":
+            # momentum may arrive via the historical kwarg or (MXNet-parity)
+            # optimizer_params; both at once must agree
+            if ("momentum" in opt_kwargs and momentum
+                    and opt_kwargs["momentum"] != momentum):
+                raise MXNetError(
+                    "momentum given twice (momentum=%r, optimizer_params"
+                    "['momentum']=%r)" % (momentum, opt_kwargs["momentum"]))
+            eff_mom = opt_kwargs.pop("momentum", momentum)
+            op_name = "sgd_mom_update" if eff_mom else "sgd_update"
+            if eff_mom:
+                opt_kwargs["momentum"] = eff_mom
+        else:
+            if momentum:
+                raise MXNetError(
+                    "momentum= is an SGD knob; pass optimizer_params for %r"
+                    % opt_name)
+            op_name = (opt_name if opt_name.endswith("_update")
+                       else opt_name + "_update")
+        try:
+            self._update_op = get_op(op_name)
+        except Exception:
+            raise MXNetError(
+                "no fused update op %r for optimizer %r" % (op_name, opt_name))
+        static = {"lr": learning_rate, "wd": wd, "rescale_grad": rescale_grad,
+                  "clip_gradient": (clip_gradient if clip_gradient is not None
+                                    else -1.0)}
+        static.update(opt_kwargs)
+        self._opt_attrs = self._update_op.parse_attrs(static)
+        self._n_states = self._update_op.n_outputs(self._opt_attrs) - 1
+        # bias-corrected optimizers take the step count; keep it on device
+        # so long runs don't recompile per step
+        self._needs_t = "t" in self._update_op.params
+        self._use_momentum = self._n_states > 0
         self._jit_step = None
         self._jit_fwd = None
 
@@ -212,8 +246,12 @@ class ShardedTrainer:
                 params[n] = jax.device_put(
                     arr, self._sharding(self.param_specs[n]))
                 if self._use_momentum:
-                    moms[n] = jax.device_put(
-                        _np.zeros_like(arr), self._sharding(self.opt_specs[n]))
+                    def st():
+                        return jax.device_put(
+                            _np.zeros_like(arr),
+                            self._sharding(self.opt_specs[n]))
+                    moms[n] = (st() if self._n_states == 1
+                               else tuple(st() for _ in range(self._n_states)))
             for n, shp in self.aux_shapes.items():
                 init_val = (_np.ones if n.endswith("_var") or "moving_var" in n
                             else _np.zeros)
@@ -222,7 +260,27 @@ class ShardedTrainer:
                     self._sharding(P()))
         finally:
             _np.random.set_state(saved_state)
+        if self._needs_t:
+            moms[_STEP_COUNT] = jax.device_put(
+                _np.zeros((), _np.int32), self._sharding(P()))
         return params, moms, aux
+
+    def opt_state_struct(self):
+        """ShapeDtypeStructs matching ``init()``'s optimizer-state tree
+        (tuples for multi-state optimizers, the on-device step counter for
+        bias-corrected ones) — the restore target for sharded checkpoints."""
+        if not self._use_momentum and not self._needs_t:
+            return {}
+        out = {}
+        for n in self.param_names:
+            s = jax.ShapeDtypeStruct(
+                tuple(self.arg_shapes[n]), self.arg_dtypes.get(n, "float32"),
+                sharding=self._sharding(self.opt_specs[n]))
+            out[n] = s if self._n_states == 1 else (s,) * self._n_states
+        if self._needs_t:
+            out[_STEP_COUNT] = jax.ShapeDtypeStruct(
+                (), _np.int32, sharding=self._sharding(P()))
+        return out
 
     def place_batch(self, arrays: Dict[str, _np.ndarray]):
         """Shard a host batch onto the mesh along the declared input specs."""
@@ -238,8 +296,11 @@ class ShardedTrainer:
         if self._jit_step is not None:
             return self._jit_step
         run = self._run
-        lr, momentum, wd, rescale, clip = self._hyper
         use_mom = self._use_momentum
+        update_op = self._update_op
+        opt_attrs = self._opt_attrs
+        n_states = self._n_states
+        needs_t = self._needs_t
         diff = [
             n for n in self.param_names
             if not _np.issubdtype(_np.dtype(self.arg_dtypes.get(n, "float32")),
@@ -270,21 +331,35 @@ class ShardedTrainer:
                 grads = {n: jax.lax.with_sharding_constraint(
                     grads[n], zero_shard[n]) for n in grads}
             new_params, new_moms = dict(params), dict(moms)
+            attrs = opt_attrs
+            if needs_t:
+                t_new = moms[_STEP_COUNT] + 1
+                new_moms[_STEP_COUNT] = t_new
+                attrs = dict(opt_attrs)
+                attrs["t"] = t_new
             for n in diff:
-                m = moms.get(n) if use_mom else None
-                w, nm = _sgd_update(params[n], grads[n], m, lr, momentum, wd,
-                                    rescale, clip)
-                new_params[n] = w
-                if use_mom:
-                    new_moms[n] = nm
+                st = moms.get(n, ()) if use_mom else ()
+                if n_states == 1:
+                    st = (st,)
+                upd, _ = update_op.apply(attrs, [params[n], grads[n], *st])
+                new_params[n] = upd[0]
+                if n_states == 1:
+                    new_moms[n] = upd[1]
+                elif n_states > 1:
+                    new_moms[n] = tuple(upd[1:])
             return outs, new_params, new_moms, new_aux
 
         zero = self.zero_stage >= 1
         zero_shard = {n: self._sharding(self.opt_specs[n])
                       for n in self.param_names}
         pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
-        mshard = ({n: zero_shard[n] for n in self.param_names}
-                  if use_mom else {})
+        mshard = {}
+        if use_mom:
+            for n in self.param_names:
+                mshard[n] = (zero_shard[n] if n_states == 1
+                             else (zero_shard[n],) * n_states)
+        if needs_t:
+            mshard[_STEP_COUNT] = self._sharding(P())
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
         self._jit_step_raw = jax.jit(

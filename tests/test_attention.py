@@ -241,3 +241,19 @@ def test_transformer_lm_example_fused_head_and_remat():
     assert fused["perplexity"] < 5.0, fused
     assert abs(fused["perplexity"] - base["perplexity"]) < 0.05, (
         base, fused)
+
+
+def test_transformer_lm_example_adam_zero():
+    """Adam + ZeRO through the user-facing example: the sharded-optimizer
+    path must converge, and ZeRO-1 must reproduce the unsharded Adam run
+    exactly (same seeds, same data)."""
+    from conftest import load_example
+
+    mod = load_example("train_transformer.py")
+    plain = mod.train(steps=60, mesh_shape=(1, 1), optimizer="adam",
+                      log=False)
+    assert plain["perplexity"] < 5.0, plain
+    zero = mod.train(steps=60, mesh_shape=(2, 2), optimizer="adam",
+                     zero_stage=1, log=False)
+    assert abs(zero["perplexity"] - plain["perplexity"]) < 1e-3, (
+        plain, zero)
