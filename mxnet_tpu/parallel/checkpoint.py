@@ -75,12 +75,12 @@ def latest_step(directory):
     return _manager(directory).latest_step()
 
 
-def _ckpt_probe_moms(mgr, step):
-    """Tri-state metadata probe: True/False when the checkpoint's metadata
-    definitively shows a non-empty / absent ``moms`` subtree; None when the
-    metadata shape is unrecognized (orbax API variation) or unavailable.
-    Anchored on ``params`` — our save layout always contains it — so an
-    unfamiliar wrapper dict can't masquerade as a definitive answer."""
+def _ckpt_moms_tree(mgr, step):
+    """The checkpoint's ``moms`` metadata subtree as a dict ({} when saved
+    without optimizer state), or None when the metadata shape is
+    unrecognized (orbax API variation) or unavailable.  Anchored on
+    ``params`` — our save layout always contains it — so an unfamiliar
+    wrapper dict can't masquerade as a definitive answer."""
     try:
         meta = mgr.item_metadata(step)
         tree = getattr(meta, "tree", meta)  # orbax wraps the tree on new APIs
@@ -90,10 +90,20 @@ def _ckpt_probe_moms(mgr, step):
             tree = tree["default"]
             tree = getattr(tree, "tree", tree)
         if hasattr(tree, "get") and "params" in tree:
-            return bool(tree.get("moms"))
+            moms = tree.get("moms")
+            if moms is None:
+                return {}
+            return dict(moms) if hasattr(moms, "keys") else None
         return None
     except Exception:
         return None
+
+
+def _ckpt_probe_moms(mgr, step):
+    """Tri-state: True/False when the metadata definitively shows a
+    non-empty / absent ``moms`` subtree; None when unknowable."""
+    tree = _ckpt_moms_tree(mgr, step)
+    return bool(tree) if tree is not None else None
 
 
 def restore_sharded(directory, step, trainer=None, shardings=None):
@@ -130,9 +140,28 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
             trainer.aux_dtypes.get(n, "float32"),
             sharding=trainer._sharding(P()))
             for n in trainer.aux_shapes}
-        probe = _ckpt_probe_moms(mgr, step) if trainer._use_momentum else False
-        moms_target = dict(mstruct) if trainer._use_momentum else {}
-        if probe is False and trainer._use_momentum:
+        has_state = bool(mstruct)  # momentum tree and/or the step counter
+        probe = _ckpt_probe_moms(mgr, step) if has_state else False
+        moms_target = dict(mstruct) if has_state else {}
+        # step-counter presence may differ between save and restore (a
+        # scheduler/Adam enabled or dropped mid-run): reconcile from the
+        # metadata instead of hard-failing on the tree mismatch
+        from .trainer import _STEP_COUNT
+
+        inject_counter = None
+        if moms_target and probe:
+            mtree = _ckpt_moms_tree(mgr, step)
+            if mtree is not None:
+                if _STEP_COUNT in moms_target and _STEP_COUNT not in mtree:
+                    # pre-counter checkpoint: restore the rest, resume the
+                    # schedule/bias-correction from zero
+                    inject_counter = moms_target.pop(_STEP_COUNT)
+                elif _STEP_COUNT in mtree and _STEP_COUNT not in moms_target:
+                    # checkpoint carries a counter this trainer doesn't use:
+                    # restore and discard it
+                    moms_target[_STEP_COUNT] = jax.ShapeDtypeStruct(
+                        (), "int32", sharding=trainer._sharding(P()))
+        if probe is False and has_state:
             # checkpoint definitively saved without momentum state: restore
             # the rest; because this is probed from metadata, unrelated
             # restore failures (corrupt shard, sharding mismatch) still
@@ -160,7 +189,16 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
                     step, args=ocp.args.StandardRestore(target))
             else:
                 raise
-        return state["params"], state["moms"], state["aux"]
+        moms = dict(state["moms"])
+        if inject_counter is not None:
+            import numpy as _np
+
+            moms[_STEP_COUNT] = jax.device_put(
+                _np.zeros(inject_counter.shape, inject_counter.dtype),
+                inject_counter.sharding)
+        elif _STEP_COUNT in moms and _STEP_COUNT not in mstruct:
+            moms.pop(_STEP_COUNT)  # restored only to satisfy the tree
+        return state["params"], moms, state["aux"]
 
     state = mgr.restore(step)
     if shardings is not None:

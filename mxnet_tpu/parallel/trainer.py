@@ -78,6 +78,8 @@ def zero_extend_spec(spec, shape, mesh, data_axis="data"):
 _STEP_COUNT = "__num_update__"  # reserved key in the optimizer-state tree
 
 
+
+
 class ShardedTrainer:
     """A whole-model sharded training step over a device mesh.
 
@@ -104,7 +106,7 @@ class ShardedTrainer:
                  rescale_grad=1.0, clip_gradient=None,
                  data_axis="data", dtype="float32",
                  remat=False, remat_policy=None, zero_stage=0,
-                 optimizer="sgd", optimizer_params=None):
+                 optimizer="sgd", optimizer_params=None, lr_scheduler=None):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -217,8 +219,30 @@ class ShardedTrainer:
         self._opt_attrs = self._update_op.parse_attrs(static)
         self._n_states = self._update_op.n_outputs(self._opt_attrs) - 1
         # bias-corrected optimizers take the step count; keep it on device
-        # so long runs don't recompile per step
+        # so long runs don't recompile per step.  LR schedules evaluate on
+        # the same counter (Optimizer sets sched.base_lr, reference
+        # optimizer.py:60-61)
         self._needs_t = "t" in self._update_op.params
+        if lr_scheduler is not None:
+            from ..lr_scheduler import LRScheduler
+
+            if isinstance(lr_scheduler, LRScheduler):
+                lr_scheduler.base_lr = learning_rate
+                # fail at construction, not first trace: the subclass must
+                # provide the jnp form next to its host __call__
+                if type(lr_scheduler).traced is LRScheduler.traced:
+                    raise MXNetError(
+                        "%s has no traced() form for in-step evaluation"
+                        % type(lr_scheduler).__name__)
+                self._lr_fn = lr_scheduler.traced
+            elif callable(lr_scheduler):
+                self._lr_fn = lr_scheduler  # jnp map of the traced counter
+            else:
+                raise MXNetError("lr_scheduler must be an LRScheduler or a "
+                                 "callable(num_update) -> lr")
+        else:
+            self._lr_fn = None
+        self._needs_count = self._needs_t or self._lr_fn is not None
         self._use_momentum = self._n_states > 0
         self._jit_step = None
         self._jit_fwd = None
@@ -260,7 +284,7 @@ class ShardedTrainer:
                     self._sharding(P()))
         finally:
             _np.random.set_state(saved_state)
-        if self._needs_t:
+        if self._needs_count:
             moms[_STEP_COUNT] = jax.device_put(
                 _np.zeros((), _np.int32), self._sharding(P()))
         return params, moms, aux
@@ -269,15 +293,17 @@ class ShardedTrainer:
         """ShapeDtypeStructs matching ``init()``'s optimizer-state tree
         (tuples for multi-state optimizers, the on-device step counter for
         bias-corrected ones) — the restore target for sharded checkpoints."""
-        if not self._use_momentum and not self._needs_t:
+        if not self._use_momentum and not self._needs_count:
             return {}
         out = {}
-        for n in self.param_names:
-            s = jax.ShapeDtypeStruct(
-                tuple(self.arg_shapes[n]), self.arg_dtypes.get(n, "float32"),
-                sharding=self._sharding(self.opt_specs[n]))
-            out[n] = s if self._n_states == 1 else (s,) * self._n_states
-        if self._needs_t:
+        if self._use_momentum:
+            for n in self.param_names:
+                s = jax.ShapeDtypeStruct(
+                    tuple(self.arg_shapes[n]),
+                    self.arg_dtypes.get(n, "float32"),
+                    sharding=self._sharding(self.opt_specs[n]))
+                out[n] = s if self._n_states == 1 else (s,) * self._n_states
+        if self._needs_count:
             out[_STEP_COUNT] = jax.ShapeDtypeStruct(
                 (), _np.int32, sharding=self._sharding(P()))
         return out
@@ -301,6 +327,8 @@ class ShardedTrainer:
         opt_attrs = self._opt_attrs
         n_states = self._n_states
         needs_t = self._needs_t
+        needs_count = self._needs_count
+        lr_fn = self._lr_fn
         diff = [
             n for n in self.param_names
             if not _np.issubdtype(_np.dtype(self.arg_dtypes.get(n, "float32")),
@@ -332,11 +360,14 @@ class ShardedTrainer:
                     grads[n], zero_shard[n]) for n in grads}
             new_params, new_moms = dict(params), dict(moms)
             attrs = opt_attrs
-            if needs_t:
+            if needs_count:
                 t_new = moms[_STEP_COUNT] + 1
                 new_moms[_STEP_COUNT] = t_new
                 attrs = dict(opt_attrs)
-                attrs["t"] = t_new
+                if needs_t:
+                    attrs["t"] = t_new
+                if lr_fn is not None:
+                    attrs["lr"] = lr_fn(t_new)
             for n in diff:
                 st = moms.get(n, ()) if use_mom else ()
                 if n_states == 1:
@@ -358,7 +389,7 @@ class ShardedTrainer:
             for n in self.param_names:
                 mshard[n] = (zero_shard[n] if n_states == 1
                              else (zero_shard[n],) * n_states)
-        if needs_t:
+        if needs_count:
             mshard[_STEP_COUNT] = self._sharding(P())
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}

@@ -169,6 +169,140 @@ def test_sgd_momentum_via_optimizer_params():
         _mk(mesh, momentum=0.9, optimizer_params={"momentum": 0.5})
 
 
+@pytest.mark.parametrize("sched_kind", ["factor", "multifactor", "poly"])
+def test_lr_scheduler_traced_matches_host(sched_kind):
+    # the schedule evaluates inside the jitted step from the on-device
+    # counter; its trajectory must match the host scheduler's closed form
+    from mxnet_tpu.lr_scheduler import (FactorScheduler,
+                                        MultiFactorScheduler, PolyScheduler)
+
+    def make():
+        return {"factor": FactorScheduler(step=2, factor=0.5),
+                "multifactor": MultiFactorScheduler(step=[2, 4], factor=0.1),
+                "poly": PolyScheduler(max_update=6, pwr=2)}[sched_kind]
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    lr0 = 0.2
+    tr = _mk(mesh, learning_rate=lr0, lr_scheduler=make())
+    params, moms, aux = tr.init(seed=0)
+    data = np.arange(24, dtype=np.float32).reshape(4, 6) / 10.0
+    batch = tr.place_batch({"data": data})
+    step = tr.step_fn()
+
+    host_sched = make()
+    host_sched.base_lr = lr0
+    w = np.asarray(params["fc_weight"]).copy()
+    grad = np.tile(data.sum(axis=0), (w.shape[0], 1))
+    for t in range(1, 7):
+        _, params, moms, aux = step(params, moms, aux, batch,
+                                    jax.random.PRNGKey(t))
+        w = w - host_sched(t) * grad
+    np.testing.assert_allclose(np.asarray(params["fc_weight"]), w,
+                               rtol=2e-5, atol=1e-6)
+    assert int(np.asarray(moms[_STEP_COUNT])) == 6
+
+
+def test_lr_scheduler_with_adam():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    lr0 = 0.05
+    tr = _mk(mesh, learning_rate=lr0, optimizer="adam",
+             lr_scheduler=FactorScheduler(step=2, factor=0.5))
+    params, moms, aux = tr.init(seed=0)
+    data = np.arange(24, dtype=np.float32).reshape(4, 6) / 10.0
+    batch = tr.place_batch({"data": data})
+    step = tr.step_fn()
+
+    sched = FactorScheduler(step=2, factor=0.5)
+    sched.base_lr = lr0
+    w = np.asarray(params["fc_weight"]).copy()
+    mean = np.zeros_like(w)
+    var = np.zeros_like(w)
+    grad = np.tile(data.sum(axis=0), (w.shape[0], 1))
+    for t in range(1, 6):
+        _, params, moms, aux = step(params, moms, aux, batch,
+                                    jax.random.PRNGKey(t))
+        w, mean, var = _np_adam(w, grad, mean, var, t, sched(t))
+    np.testing.assert_allclose(np.asarray(params["fc_weight"]), w,
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_lr_scheduler_checkpoint_counter_without_momentum(tmp_path):
+    # plain SGD + schedule: the only optimizer state is the step counter,
+    # and it must survive a save/restore cycle (resume keeps the schedule)
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    from mxnet_tpu.parallel import checkpoint as ckpt
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = _mk(mesh, learning_rate=0.1,
+             lr_scheduler=FactorScheduler(step=2, factor=0.5))
+    params, moms, aux = tr.init(seed=0)
+    batch = tr.place_batch({"data": np.ones((4, 6), np.float32)})
+    step = tr.step_fn()
+    for i in range(3):
+        _, params, moms, aux = step(params, moms, aux, batch,
+                                    jax.random.PRNGKey(i))
+    d = str(tmp_path / "schedck")
+    ckpt.save_sharded(d, 3, params, moms, aux)
+    p2, m2, _ = ckpt.restore_sharded(d, 3, trainer=tr)
+    assert int(np.asarray(m2[_STEP_COUNT])) == 3
+
+
+def test_checkpoint_counter_mismatch_tolerated(tmp_path):
+    # enabling a scheduler mid-run (or dropping one) must not brick resume:
+    # a missing counter restores as zero, a surplus counter is discarded
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    from mxnet_tpu.parallel import checkpoint as ckpt
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    batch_np = {"data": np.ones((4, 6), np.float32)}
+
+    # save WITHOUT a counter (plain sgd+momentum)
+    tr0 = _mk(mesh, learning_rate=0.1, momentum=0.9)
+    params, moms, aux = tr0.init(seed=0)
+    batch = tr0.place_batch(batch_np)
+    step = tr0.step_fn()
+    for i in range(2):
+        _, params, moms, aux = step(params, moms, aux, batch,
+                                    jax.random.PRNGKey(i))
+    d1 = str(tmp_path / "pre_sched")
+    ckpt.save_sharded(d1, 2, params, moms, aux)
+    # restore WITH a scheduler: counter injected at zero
+    tr1 = _mk(mesh, learning_rate=0.1, momentum=0.9,
+              lr_scheduler=FactorScheduler(step=2, factor=0.5))
+    p2, m2, _ = ckpt.restore_sharded(d1, 2, trainer=tr1)
+    assert int(np.asarray(m2[_STEP_COUNT])) == 0
+    np.testing.assert_array_equal(np.asarray(m2["fc_weight"]),
+                                  np.asarray(moms["fc_weight"]))
+
+    # save WITH a counter, restore WITHOUT a scheduler: counter dropped
+    params, moms, aux = tr1.init(seed=0)
+    step = tr1.step_fn()
+    batch = tr1.place_batch(batch_np)
+    for i in range(2):
+        _, params, moms, aux = step(params, moms, aux, batch,
+                                    jax.random.PRNGKey(i))
+    d2 = str(tmp_path / "post_sched")
+    ckpt.save_sharded(d2, 2, params, moms, aux)
+    p3, m3, _ = ckpt.restore_sharded(d2, 2, trainer=tr0)
+    assert _STEP_COUNT not in m3
+    np.testing.assert_array_equal(np.asarray(m3["fc_weight"]),
+                                  np.asarray(moms["fc_weight"]))
+
+
+def test_unsupported_scheduler_rejected_at_construction():
+    from mxnet_tpu.lr_scheduler import LRScheduler
+
+    class NoTraced(LRScheduler):
+        def __call__(self, num_update):
+            return self.base_lr
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    with pytest.raises(MXNetError):
+        _mk(mesh, lr_scheduler=NoTraced())
+
+
 def test_momentum_knob_rejected_for_non_sgd():
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     with pytest.raises(MXNetError):
