@@ -67,10 +67,14 @@ def transformer_main():
         else "float32", head=head, remat=remat,
         ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "4096")))
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
+    # BENCH_OPT=adam benches the sharded-Adam path (2 extra state tensors
+    # per param + bias correction); default stays sgd+momentum
+    opt = os.environ.get("BENCH_OPT", "sgd")
     tr = ShardedTrainer(
         sym, mesh, data_shapes={"data": (batch, seq)},
         label_shapes={"softmax_label": (batch, seq)},
-        type_dict={"data": "int32"}, learning_rate=1e-3, momentum=0.9,
+        type_dict={"data": "int32"}, learning_rate=1e-3,
+        momentum=0.9 if opt == "sgd" else 0.0, optimizer=opt,
         rescale_grad=1.0 / (batch * seq))
     params, moms, aux = tr.init(seed=0)
     rng = np.random.RandomState(0)

@@ -106,7 +106,8 @@ class ShardedTrainer:
                  rescale_grad=1.0, clip_gradient=None,
                  data_axis="data", dtype="float32",
                  remat=False, remat_policy=None, zero_stage=0,
-                 optimizer="sgd", optimizer_params=None, lr_scheduler=None):
+                 optimizer="sgd", optimizer_params=None, lr_scheduler=None,
+                 grad_accum=1):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -117,6 +118,25 @@ class ShardedTrainer:
         self.data_axis = data_axis
         label_shapes = label_shapes or {}
         type_dict = dict(type_dict or {})
+        # gradient accumulation: the declared shapes stay the GLOBAL batch;
+        # the graph traces at the microbatch (dim0 / grad_accum), the step
+        # lax.scans the microbatches and sums gradients before ONE optimizer
+        # update — effective batch beyond HBM with identical update math.
+        # place_batch splits row-major: microbatch i = rows [i*mb, (i+1)*mb).
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise MXNetError("grad_accum must be >= 1")
+        if self.grad_accum > 1:
+            def _micro(name, shp):
+                if not shp or shp[0] % self.grad_accum:
+                    raise MXNetError(
+                        "input %r dim0 %r not divisible by grad_accum=%d"
+                        % (name, shp, self.grad_accum))
+                return (shp[0] // self.grad_accum,) + tuple(shp[1:])
+
+            data_shapes = {n: _micro(n, s) for n, s in data_shapes.items()}
+            label_shapes = {n: _micro(n, s)
+                            for n, s in label_shapes.items()}
         shapes = dict(data_shapes)
         shapes.update(label_shapes)
         # mesh-aware ops (ring attention) consult the ambient mesh while the
@@ -308,12 +328,27 @@ class ShardedTrainer:
                 (), _np.int32, sharding=self._sharding(P()))
         return out
 
-    def place_batch(self, arrays: Dict[str, _np.ndarray]):
-        """Shard a host batch onto the mesh along the declared input specs."""
-        return {
-            n: jax.device_put(_np.asarray(v), self._sharding(self.data_specs[n]))
-            for n, v in arrays.items()
-        }
+    def place_batch(self, arrays: Dict[str, _np.ndarray], train=True):
+        """Shard a host batch onto the mesh along the declared input specs.
+        With ``grad_accum=k`` a TRAINING batch splits row-major into
+        ``[k, dim0/k, ...]`` on the host (free) so the scanned microbatch
+        axis is unsharded and each device keeps its own rows.
+        ``train=False`` places the batch unsplit for ``forward_fn`` —
+        inference has no accumulation semantics, so any batch size goes."""
+        out = {}
+        for n, v in arrays.items():
+            v = _np.asarray(v)
+            if train and self.grad_accum > 1:
+                k = self.grad_accum
+                if v.shape[0] % k:
+                    raise MXNetError(
+                        "batch %r dim0 %d not divisible by grad_accum=%d"
+                        % (n, v.shape[0], k))
+                v = v.reshape((k, v.shape[0] // k) + v.shape[1:])
+            out[n] = jax.device_put(
+                v, self._sharding(self._batch_spec(n) if train
+                                  else self.data_specs[n]))
+        return out
 
     # ------------------------------------------------------------------
     def step_fn(self):
@@ -340,24 +375,55 @@ class ShardedTrainer:
             graph = jax.checkpoint(
                 run, policy=self._remat_policy, static_argnums=(3,))
 
+        accum = self.grad_accum
+
         def step(params, moms, aux, batch, rng):
-            def loss_fn(p):
-                args = dict(batch)
-                args.update(params)
-                args.update(p)
-                outs, new_aux = graph(args, aux, rng, True)
-                total = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
-                return total, (outs, new_aux)
+            def micro_grads(dparams, aux_c, mb, key):
+                def loss_fn(p):
+                    args = dict(mb)
+                    args.update(params)
+                    args.update(p)
+                    outs, new_aux = graph(args, aux_c, key, True)
+                    total = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                    return total, (outs, new_aux)
+
+                return jax.value_and_grad(loss_fn, has_aux=True)(dparams)
+
+            def constrain(g):
+                # force the gradient reduction to land sharded
+                # (reduce-scatter rather than all-reduce) so the optimizer
+                # math runs on 1/dp of each tensor — the ZeRO saving
+                if not zero:
+                    return g
+                return {n: jax.lax.with_sharding_constraint(
+                    g[n], zero_shard[n]) for n in g}
 
             dparams = {n: params[n] for n in diff}
-            (_, (outs, new_aux)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(dparams)
-            if zero:
-                # force the gradient reduction to land sharded (reduce-scatter
-                # rather than all-reduce) so the optimizer math runs on 1/dp
-                # of each tensor — the ZeRO bandwidth/memory saving
-                grads = {n: jax.lax.with_sharding_constraint(
-                    grads[n], zero_shard[n]) for n in grads}
+            if accum == 1:
+                (_, (outs, new_aux)), grads = micro_grads(
+                    dparams, aux, batch, rng)
+                grads = constrain(grads)
+            else:
+                def body(carry, xs):
+                    gacc, aux_c = carry
+                    mb, i = xs
+                    (_, (outs_i, aux_n)), g = micro_grads(
+                        dparams, aux_c, mb, jax.random.fold_in(rng, i))
+                    gacc = constrain({
+                        n: gacc[n] + g[n].astype(jnp.float32) for n in g})
+                    return (gacc, aux_n), outs_i
+
+                gacc0 = constrain({
+                    n: jnp.zeros(dparams[n].shape, jnp.float32)
+                    for n in diff})
+                (gacc, new_aux), outs_stack = jax.lax.scan(
+                    body, (gacc0, aux), (batch, jnp.arange(accum)))
+                grads = {n: gacc[n].astype(dparams[n].dtype) for n in diff}
+                # merge the stacked microbatch axis back into the batch axis
+                # (row-major — the inverse of place_batch's split); rank-1
+                # stacks (per-microbatch scalars) stay stacked
+                outs = [o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
+                        if o.ndim >= 2 else o for o in outs_stack]
             new_params, new_moms = dict(params), dict(moms)
             attrs = opt_attrs
             if needs_count:
@@ -392,7 +458,8 @@ class ShardedTrainer:
         if needs_count:
             mshard[_STEP_COUNT] = self._sharding(P())
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
-        dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
+        dshard = {n: self._sharding(self._batch_spec(n))
+                  for n in self._input_names}
         self._jit_step_raw = jax.jit(
             step,
             in_shardings=(pshard, mshard, ashard, dshard, None),
@@ -401,6 +468,12 @@ class ShardedTrainer:
         )
         self._jit_step = self._with_mesh(self._jit_step_raw)
         return self._jit_step
+
+    def _batch_spec(self, name):
+        """Input spec as the step receives it (microbatch axis prepended
+        under grad_accum — matching place_batch's host-side split)."""
+        spec = self.data_specs[name]
+        return P(None, *spec) if self.grad_accum > 1 else spec
 
     def lowered_step(self, params, moms, aux, batch, rng):
         """AOT-lower the fused step for inspection (cost/memory analysis via
@@ -418,6 +491,8 @@ class ShardedTrainer:
         run = self._run
 
         def fwd(params, aux, batch, rng):
+            # inference takes the batch UNSPLIT regardless of grad_accum —
+            # accumulation only exists to fit the backward pass in HBM
             args = dict(batch)
             args.update(params)
             outs, _ = run(args, aux, rng, False)
@@ -425,7 +500,8 @@ class ShardedTrainer:
 
         pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
-        dshard = {n: self._sharding(self.data_specs[n]) for n in self._input_names}
+        dshard = {n: self._sharding(self.data_specs[n])
+                  for n in self._input_names}
         self._jit_fwd = self._with_mesh(jax.jit(
             fwd, in_shardings=(pshard, ashard, dshard, None)))
         return self._jit_fwd
