@@ -506,6 +506,112 @@ class ShardedTrainer:
             fwd, in_shardings=(pshard, ashard, dshard, None)))
         return self._jit_fwd
 
+    # ------------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, num_epoch=1, seed=0,
+            eval_metric="accuracy", initializer=None, state=None,
+            begin_epoch=0, checkpoint_dir=None, log_every=50, logger=None):
+        """Mesh-native training loop — ``Module.fit``'s role
+        (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
+        epochs over a ``DataIter``, metric updates, throughput logging
+        (``Speedometer``, reference ``callback.py:89``), optional eval pass
+        and per-epoch sharded checkpoints.
+
+        ``state`` resumes from an existing ``(params, moms, aux)`` (e.g. a
+        ``checkpoint.restore_sharded`` result); pass ``begin_epoch`` so
+        checkpoint steps and history keys continue from the right epoch.
+        NOTE: the step donates its inputs, so ``state``'s arrays are
+        CONSUMED by the first step — a caller branching several runs from
+        one restore must re-restore (or copy) per run.
+        Returns ``((params, moms, aux), history)`` where ``history[epoch]``
+        maps ``"train"``/``"eval"`` to the metric's ``get()`` result.
+        """
+        import logging
+        import time
+
+        import jax as _jax
+
+        from .. import metric as _metric_mod
+
+        log = logger or logging.getLogger(__name__)
+        metric = (eval_metric if isinstance(eval_metric, _metric_mod.EvalMetric)
+                  else _metric_mod.create(eval_metric))
+        params, moms, aux = (state if state is not None
+                             else self.init(initializer=initializer,
+                                            seed=seed))
+        step = self.step_fn()
+        fwd = self.forward_fn()
+
+        def batch_arrays(batch, it):
+            # descriptors live on the batch when set, else on the iterator
+            # (NDArrayIter populates only the iter-level provide_*)
+            ddescs = list(batch.provide_data or it.provide_data or [])
+            ldescs = list(batch.provide_label or it.provide_label or [])
+            arrays, data_names = {}, set()
+            vals = list(batch.data or []) + list(batch.label or [])
+            for i, (desc, v) in enumerate(zip(ddescs + ldescs, vals)):
+                name = desc[0] if isinstance(desc, (tuple, list)) \
+                    else desc.name
+                if name in self._input_names:
+                    arrays[name] = v.asnumpy() if hasattr(v, "asnumpy") \
+                        else _np.asarray(v)
+                    if i < len(ddescs):
+                        data_names.add(name)
+            return arrays, data_names
+
+        history = {}
+        global_step = 0
+        base_key = _jax.random.PRNGKey(seed)
+        for epoch in range(begin_epoch, begin_epoch + num_epoch):
+            metric.reset()
+            train_data.reset()
+            tic = time.time()
+            seen = 0
+            for batch in train_data:
+                arrays, data_names = batch_arrays(batch, train_data)
+                placed = self.place_batch(arrays)
+                outs, params, moms, aux = step(
+                    params, moms, aux, placed,
+                    _jax.random.fold_in(base_key, global_step))
+                labels = [v for n, v in arrays.items()
+                          if n not in data_names]
+                metric.update([_np.asarray(v) for v in labels],
+                              [_np.asarray(o) for o in outs])
+                global_step += 1
+                seen += next(iter(arrays.values())).shape[0]
+                if log_every and global_step % log_every == 0:
+                    names, vals = metric.get()
+                    if isinstance(names, str):  # single metric -> scalars
+                        names, vals = [names], [vals]
+                    log.info(
+                        "epoch %d batch %d: %.1f samples/s %s", epoch,
+                        global_step, seen / max(time.time() - tic, 1e-9),
+                        " ".join("%s=%.4f" % nv for nv in
+                                 zip(names, vals)))
+            history.setdefault(epoch, {})["train"] = metric.get()
+            log.info("epoch %d train: %s", epoch, history[epoch]["train"])
+
+            if eval_data is not None:
+                metric.reset()
+                eval_data.reset()
+                for batch in eval_data:
+                    arrays, data_names = batch_arrays(batch, eval_data)
+                    placed = self.place_batch(arrays, train=False)
+                    outs = fwd(params, aux, placed,
+                               _jax.random.PRNGKey(0))
+                    labels = [v for n, v in arrays.items()
+                              if n not in data_names]
+                    metric.update([_np.asarray(v) for v in labels],
+                                  [_np.asarray(o) for o in outs])
+                history[epoch]["eval"] = metric.get()
+                log.info("epoch %d eval: %s", epoch, history[epoch]["eval"])
+
+            if checkpoint_dir is not None:
+                from . import checkpoint as _ckpt
+
+                _ckpt.save_sharded(checkpoint_dir, epoch + 1, params, moms,
+                                   aux)
+        return (params, moms, aux), history
+
     def _with_mesh(self, jitted):
         """Call `jitted` with this trainer's mesh ambient, so mesh-aware ops
         trace against the right mesh no matter which trainer traced last."""

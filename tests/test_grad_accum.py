@@ -137,6 +137,53 @@ def test_accum_forward_takes_unsplit_batches():
     assert np.asarray(o3[0]).shape[0] == 3
 
 
+def test_sharded_fit_loop(tmp_path):
+    # ShardedTrainer.fit: the Module.fit role at mesh scale — converges on
+    # separable blobs, evals, checkpoints per epoch, and resumes
+    import mxnet_tpu.io as mio
+    from mxnet_tpu.parallel import checkpoint as ckpt
+
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 6) * 3.0
+    labels = rs.randint(0, 4, 256)
+    data = (centers[labels] + rs.randn(256, 6)).astype(np.float32)
+    train = mio.NDArrayIter(data, labels.astype(np.float32), batch_size=32,
+                            shuffle=True)
+    val = mio.NDArrayIter(data, labels.astype(np.float32), batch_size=32)
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("data",))
+    d = str(tmp_path / "fitck")
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    tr = ShardedTrainer(net, mesh, data_shapes={"data": (32, 6)},
+                        label_shapes={"softmax_label": (32,)},
+                        learning_rate=0.2, momentum=0.9,
+                        lr_scheduler=FactorScheduler(step=16, factor=0.5),
+                        rescale_grad=1.0 / 32, grad_accum=2, zero_stage=1)
+    state, hist = tr.fit(train, eval_data=val, num_epoch=6,
+                         checkpoint_dir=d, log_every=0)
+    name, acc = hist[5]["eval"]
+    assert name == "accuracy" and acc > 0.9, hist
+
+    # resume from the saved checkpoint and keep training; begin_epoch
+    # continues the checkpoint step sequence instead of colliding with it
+    assert ckpt.latest_step(d) == 6
+    restored = ckpt.restore_sharded(d, 6, trainer=tr)
+    state2, hist2 = tr.fit(train, eval_data=val, num_epoch=1,
+                           state=restored, begin_epoch=6,
+                           checkpoint_dir=d, log_every=0)
+    _, acc2 = hist2[6]["eval"]
+    assert acc2 > 0.9, hist2
+    assert ckpt.latest_step(d) == 7
+
+
 def test_accum_shape_validation():
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     with pytest.raises(MXNetError):
