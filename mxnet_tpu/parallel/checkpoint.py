@@ -126,7 +126,7 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
         def struct(name, spec):
             return jax.ShapeDtypeStruct(
                 tuple(trainer.arg_shapes[name]),
-                trainer.arg_dtypes.get(name, "float32"),
+                trainer._param_dtype(name),  # bf16 under multi_precision
                 sharding=trainer._sharding(spec))
 
         pstruct = {n: struct(n, trainer.param_specs[n])

@@ -107,7 +107,7 @@ class ShardedTrainer:
                  data_axis="data", dtype="float32",
                  remat=False, remat_policy=None, zero_stage=0,
                  optimizer="sgd", optimizer_params=None, lr_scheduler=None,
-                 grad_accum=1):
+                 grad_accum=1, multi_precision=False):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -263,9 +263,40 @@ class ShardedTrainer:
         else:
             self._lr_fn = None
         self._needs_count = self._needs_t or self._lr_fn is not None
-        self._use_momentum = self._n_states > 0
+        # -- multi-precision: weights live in a low-precision dtype (HBM
+        # bandwidth + memory), the optimizer updates an fp32 MASTER copy
+        # stored as the leading optimizer-state slot (so ZeRO shards it —
+        # the bf16 + sharded-fp32-master recipe).  The reference's
+        # fp16 + multi_precision SGD concept, TPU-idiomatic in bf16.
+        if multi_precision:
+            self._mp_dtype = ("bfloat16" if multi_precision is True
+                              else str(multi_precision))
+        else:
+            self._mp_dtype = None
+        self._diff_set = {
+            n for n in self.param_names
+            if not _np.issubdtype(_np.dtype(self.arg_dtypes.get(n, "float32")),
+                                  _np.integer)
+        }
+        self._use_momentum = (self._n_states > 0
+                              or self._mp_dtype is not None)
         self._jit_step = None
         self._jit_fwd = None
+
+    def _param_dtype(self, name):
+        """On-device storage dtype for a parameter (the working copy)."""
+        if self._mp_dtype is not None and name in self._diff_set:
+            return self._mp_dtype
+        return self.arg_dtypes.get(name, "float32")
+
+    def _state_layout(self, name):
+        """(slots, state_dtype, bare) of ``moms[name]``: slot count
+        (+1 leading fp32 master under multi_precision), element dtype, and
+        whether a single slot stores bare (legacy sgd-momentum layout)."""
+        mp = self._mp_dtype is not None and name in self._diff_set
+        slots = self._n_states + (1 if mp else 0)
+        dtype = "float32" if mp else self.arg_dtypes.get(name, "float32")
+        return slots, dtype, (slots == 1 and not mp)
 
     # ------------------------------------------------------------------
     def _sharding(self, spec):
@@ -288,14 +319,20 @@ class ShardedTrainer:
                 arr = _np.zeros(shp, dtype=self.arg_dtypes.get(n, "float32"))
                 initializer(InitDesc(n), _HostArray(arr))
                 params[n] = jax.device_put(
-                    arr, self._sharding(self.param_specs[n]))
-                if self._use_momentum:
-                    def st():
-                        return jax.device_put(
-                            _np.zeros_like(arr),
-                            self._sharding(self.opt_specs[n]))
-                    moms[n] = (st() if self._n_states == 1
-                               else tuple(st() for _ in range(self._n_states)))
+                    arr.astype(self._param_dtype(n)),
+                    self._sharding(self.param_specs[n]))
+                slots, sdtype, bare = self._state_layout(n)
+                if slots:
+                    oshard = self._sharding(self.opt_specs[n])
+                    mp_here = self._mp_dtype is not None and n in self._diff_set
+                    states = []
+                    if mp_here:  # leading slot = the fp32 master copy
+                        states.append(jax.device_put(
+                            arr.astype(_np.float32), oshard))
+                    while len(states) < slots:
+                        states.append(jax.device_put(
+                            _np.zeros(shp, dtype=sdtype), oshard))
+                    moms[n] = states[0] if bare else tuple(states)
             for n, shp in self.aux_shapes.items():
                 init_val = (_np.ones if n.endswith("_var") or "moving_var" in n
                             else _np.zeros)
@@ -318,11 +355,13 @@ class ShardedTrainer:
         out = {}
         if self._use_momentum:
             for n in self.param_names:
+                slots, sdtype, bare = self._state_layout(n)
+                if not slots:
+                    continue
                 s = jax.ShapeDtypeStruct(
-                    tuple(self.arg_shapes[n]),
-                    self.arg_dtypes.get(n, "float32"),
+                    tuple(self.arg_shapes[n]), sdtype,
                     sharding=self._sharding(self.opt_specs[n]))
-                out[n] = s if self._n_states == 1 else (s,) * self._n_states
+                out[n] = s if bare else (s,) * slots
         if self._needs_count:
             out[_STEP_COUNT] = jax.ShapeDtypeStruct(
                 (), _np.int32, sharding=self._sharding(P()))
@@ -360,15 +399,13 @@ class ShardedTrainer:
         use_mom = self._use_momentum
         update_op = self._update_op
         opt_attrs = self._opt_attrs
-        n_states = self._n_states
         needs_t = self._needs_t
         needs_count = self._needs_count
         lr_fn = self._lr_fn
-        diff = [
-            n for n in self.param_names
-            if not _np.issubdtype(_np.dtype(self.arg_dtypes.get(n, "float32")),
-                                  _np.integer)
-        ]
+        diff = [n for n in self.param_names if n in self._diff_set]
+        layouts = {n: self._state_layout(n) for n in self.param_names}
+        mp_set = (set(diff) if self._mp_dtype is not None else set())
+        mp_dtype = self._mp_dtype
 
         graph = run
         if self._remat:
@@ -418,7 +455,11 @@ class ShardedTrainer:
                     for n in diff})
                 (gacc, new_aux), outs_stack = jax.lax.scan(
                     body, (gacc0, aux), (batch, jnp.arange(accum)))
-                grads = {n: gacc[n].astype(dparams[n].dtype) for n in diff}
+                # multi-precision updates consume fp32 grads directly;
+                # otherwise return to the parameter dtype
+                grads = {n: (gacc[n] if n in mp_set
+                             else gacc[n].astype(dparams[n].dtype))
+                         for n in diff}
                 # merge the stacked microbatch axis back into the batch axis
                 # (row-major — the inverse of place_batch's split); rank-1
                 # stacks (per-microbatch scalars) stay stacked
@@ -435,15 +476,27 @@ class ShardedTrainer:
                 if lr_fn is not None:
                     attrs["lr"] = lr_fn(t_new)
             for n in diff:
+                slots, _, bare = layouts[n]
                 st = moms.get(n, ()) if use_mom else ()
-                if n_states == 1:
+                if bare:
                     st = (st,)
-                upd, _ = update_op.apply(attrs, [params[n], grads[n], *st])
-                new_params[n] = upd[0]
-                if n_states == 1:
-                    new_moms[n] = upd[1]
-                elif n_states > 1:
-                    new_moms[n] = tuple(upd[1:])
+                if n in mp_set:
+                    # update the fp32 master (leading state slot); the
+                    # working weight is its low-precision cast
+                    master, op_st = st[0], st[1:]
+                    upd, _ = update_op.apply(
+                        attrs,
+                        [master, grads[n].astype(jnp.float32), *op_st])
+                    new_params[n] = upd[0].astype(mp_dtype)
+                    new_moms[n] = tuple(upd)
+                else:
+                    upd, _ = update_op.apply(
+                        attrs, [params[n], grads[n], *st])
+                    new_params[n] = upd[0]
+                    if bare:
+                        new_moms[n] = upd[1]
+                    elif slots:
+                        new_moms[n] = tuple(upd[1:])
             return outs, new_params, new_moms, new_aux
 
         zero = self.zero_stage >= 1
@@ -453,8 +506,11 @@ class ShardedTrainer:
         mshard = {}
         if use_mom:
             for n in self.param_names:
-                mshard[n] = (zero_shard[n] if n_states == 1
-                             else (zero_shard[n],) * n_states)
+                slots, _, bare = layouts[n]
+                if not slots:
+                    continue
+                mshard[n] = (zero_shard[n] if bare
+                             else (zero_shard[n],) * slots)
         if needs_count:
             mshard[_STEP_COUNT] = self._sharding(P())
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
@@ -509,7 +565,8 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, num_epoch=1, seed=0,
             eval_metric="accuracy", initializer=None, state=None,
-            begin_epoch=0, checkpoint_dir=None, log_every=50, logger=None):
+            begin_epoch=0, checkpoint_dir=None, log_every=50, logger=None,
+            batch_end_callback=None):
         """Mesh-native training loop — ``Module.fit``'s role
         (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
         epochs over a ``DataIter``, metric updates, throughput logging
@@ -526,7 +583,6 @@ class ShardedTrainer:
         maps ``"train"``/``"eval"`` to the metric's ``get()`` result.
         """
         import logging
-        import time
 
         import jax as _jax
 
@@ -558,14 +614,25 @@ class ShardedTrainer:
                         data_names.add(name)
             return arrays, data_names
 
+        from ..callback import Speedometer
+        from ..model import BatchEndParam
+
+        callbacks = (list(batch_end_callback)
+                     if isinstance(batch_end_callback, (list, tuple))
+                     else [batch_end_callback] if batch_end_callback
+                     else [])
+        speedo = None  # built from the first batch's row count
+
         history = {}
         global_step = 0
-        base_key = _jax.random.PRNGKey(seed)
+        # fold begin_epoch in so a resumed run continues a fresh key stream
+        # instead of replaying the original run's dropout masks
+        base_key = _jax.random.fold_in(_jax.random.PRNGKey(seed),
+                                       begin_epoch)
         for epoch in range(begin_epoch, begin_epoch + num_epoch):
             metric.reset()
             train_data.reset()
-            tic = time.time()
-            seen = 0
+            nbatch = 0
             for batch in train_data:
                 arrays, data_names = batch_arrays(batch, train_data)
                 placed = self.place_batch(arrays)
@@ -577,16 +644,19 @@ class ShardedTrainer:
                 metric.update([_np.asarray(v) for v in labels],
                               [_np.asarray(o) for o in outs])
                 global_step += 1
-                seen += next(iter(arrays.values())).shape[0]
-                if log_every and global_step % log_every == 0:
-                    names, vals = metric.get()
-                    if isinstance(names, str):  # single metric -> scalars
-                        names, vals = [names], [vals]
-                    log.info(
-                        "epoch %d batch %d: %.1f samples/s %s", epoch,
-                        global_step, seen / max(time.time() - tic, 1e-9),
-                        " ".join("%s=%.4f" % nv for nv in
-                                 zip(names, vals)))
+                nbatch += 1
+                if speedo is None and log_every:
+                    # windowed samples/s (metric=None so the epoch metric
+                    # is not reset mid-epoch by the logger)
+                    speedo = Speedometer(
+                        next(iter(arrays.values())).shape[0],
+                        frequent=log_every)
+                bep = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=metric, locals=None)
+                if speedo is not None:
+                    speedo(bep._replace(eval_metric=None))
+                for cb in callbacks:
+                    cb(bep)
             history.setdefault(epoch, {})["train"] = metric.get()
             log.info("epoch %d train: %s", epoch, history[epoch]["train"])
 

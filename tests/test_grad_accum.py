@@ -176,12 +176,17 @@ def test_sharded_fit_loop(tmp_path):
     # continues the checkpoint step sequence instead of colliding with it
     assert ckpt.latest_step(d) == 6
     restored = ckpt.restore_sharded(d, 6, trainer=tr)
+    seen = []
     state2, hist2 = tr.fit(train, eval_data=val, num_epoch=1,
                            state=restored, begin_epoch=6,
-                           checkpoint_dir=d, log_every=0)
+                           checkpoint_dir=d, log_every=0,
+                           batch_end_callback=lambda p: seen.append(
+                               (p.epoch, p.nbatch)))
     _, acc2 = hist2[6]["eval"]
     assert acc2 > 0.9, hist2
     assert ckpt.latest_step(d) == 7
+    # batch-end callbacks see the resumed epoch number and 1-based batches
+    assert seen[0] == (6, 1) and seen[-1][1] == len(seen)
 
 
 def test_accum_shape_validation():
