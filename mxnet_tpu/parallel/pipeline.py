@@ -32,6 +32,8 @@ _CHECK_KW = ("check_vma" if "check_vma"
              in _inspect.signature(shard_map).parameters else "check_rep")
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..base import MXNetError
+
 __all__ = ["pipeline_apply", "pipeline_train_1f1b", "stack_stage_params",
            "PipelinedTrainer"]
 
@@ -76,7 +78,7 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh: Mesh,
             # masked out of the output accumulation below)
             feed = xs[jnp.minimum(t, n_microbatch - 1)]
             cur_in = jnp.where(stage_idx == 0, feed, cur_in)
-            y = stage_fn(params, cur_in)
+            y = _stage_call(stage_fn, params, cur_in, stage_idx)
             # last stage banks its finished microbatch t-(S-1)
             done = (stage_idx == S - 1) & (t >= S - 1)
             slot = jnp.clip(t - (S - 1), 0, n_microbatch - 1)
@@ -269,38 +271,129 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, target, *,
 
 
 class PipelinedTrainer:
-    """Minimal fused train step for a pipelined homogeneous-stage model:
-    embed -> S pipelined blocks -> head, with SGD update.  Demonstrates the
-    composition Module users get via ``ShardedTrainer`` elsewhere; also the
-    unit under test for the ``pipe`` mesh axis."""
+    """Fused train step for a pipelined homogeneous-stage model: S stages
+    sharded over the ``pipe`` axis, GPipe or 1F1B schedule, updated by any
+    registered fused-optimizer op (the same contract as ``ShardedTrainer``:
+    ``optimizer=``/``optimizer_params=``/``lr_scheduler=``).
+
+    Stateless configurations (plain SGD, no schedule) keep the historical
+    step signature ``step(params, x, target) -> (loss, new_params)``.
+    Stateful ones (momentum/adam/…, or a schedule) use
+    ``step(params, states, x, target) -> (loss, new_params, new_states)``
+    with ``states = init_states(params)``; ``has_state`` says which."""
 
     def __init__(self, stage_fn, loss_fn, mesh, n_microbatch, axis="pipe",
-                 learning_rate=0.1):
+                 learning_rate=0.1, schedule="gpipe", optimizer="sgd",
+                 optimizer_params=None, momentum=0.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=None, lr_scheduler=None,
+                 batch_axis=None, param_axes=None, reduce_axes=()):
+        from .trainer import resolve_lr_fn, resolve_update_op
+
         self.stage_fn = stage_fn
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.n_microbatch = n_microbatch
         self.axis = axis
-        self.lr = learning_rate
+        if schedule not in ("gpipe", "1f1b"):
+            raise MXNetError("schedule must be 'gpipe' or '1f1b', got %r"
+                             % (schedule,))
+        if schedule == "gpipe" and (batch_axis or param_axes
+                                    or tuple(reduce_axes)):
+            # pipeline_apply has no partial-sum/param-sharding contract;
+            # silently dropping these would train on wrong gradients
+            raise MXNetError(
+                "batch_axis/param_axes/reduce_axes require schedule='1f1b'")
+        self.schedule = schedule
+        self.batch_axis = batch_axis
+        self.param_axes = param_axes
+        self.reduce_axes = tuple(reduce_axes)
+        (self._update_op, self._opt_attrs, self._n_states,
+         self._needs_t) = resolve_update_op(
+            optimizer, optimizer_params, momentum, learning_rate, wd,
+            rescale_grad, clip_gradient)
+        self._lr_fn = resolve_lr_fn(lr_scheduler, learning_rate)
+        self._needs_count = self._needs_t or self._lr_fn is not None
+        self.has_state = self._n_states > 0 or self._needs_count
         self._jit = None
+
+    def init_states(self, stacked_params):
+        """Optimizer state for placed params: one zeros-tree per state slot
+        (inheriting the params' stage-stacked sharding) plus the on-device
+        step counter when the optimizer/schedule consumes it."""
+        st = {}
+        if self._n_states:
+            st["slots"] = tuple(
+                jax.tree_util.tree_map(jnp.zeros_like, stacked_params)
+                for _ in range(self._n_states))
+        if self._needs_count:
+            st["num_update"] = jnp.zeros((), jnp.int32)
+        return st
+
+    def _grads(self, params, x, target):
+        if self.schedule == "1f1b":
+            return pipeline_train_1f1b(
+                self.stage_fn, self.loss_fn, params, x, target,
+                mesh=self.mesh, n_microbatch=self.n_microbatch,
+                axis=self.axis, batch_axis=self.batch_axis,
+                param_axes=self.param_axes, reduce_axes=self.reduce_axes)
+
+        def loss(p):
+            y = pipeline_apply(self.stage_fn, p, x, mesh=self.mesh,
+                               n_microbatch=self.n_microbatch,
+                               axis=self.axis)
+            return self.loss_fn(y, target)
+
+        return jax.value_and_grad(loss)(params)
+
+    def _apply_updates(self, params, grads, slot_trees, attrs):
+        """Flat sweep of the fused-update op over every param leaf."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        slot_leaves = [treedef.flatten_up_to(s) for s in slot_trees]
+        new_w, new_slots = [], [[] for _ in slot_trees]
+        for i, (w, g) in enumerate(zip(leaves, g_leaves)):
+            upd, _ = self._update_op.apply(
+                attrs, [w, g, *(s[i] for s in slot_leaves)])
+            new_w.append(upd[0])
+            for k in range(len(slot_trees)):
+                new_slots[k].append(upd[1 + k])
+        unflatten = jax.tree_util.tree_unflatten
+        return (unflatten(treedef, new_w),
+                tuple(unflatten(treedef, s) for s in new_slots))
 
     def step_fn(self):
         if self._jit is not None:
             return self._jit
 
-        def step(stacked_params, x, target):
-            def loss(p):
-                y = pipeline_apply(self.stage_fn, p, x, mesh=self.mesh,
-                                   n_microbatch=self.n_microbatch,
-                                   axis=self.axis)
-                return self.loss_fn(y, target)
+        if not self.has_state:
+            def step(stacked_params, x, target):
+                l, grads = self._grads(stacked_params, x, target)
+                new_params, _ = self._apply_updates(
+                    stacked_params, grads, (), self._opt_attrs)
+                return l, new_params
 
-            l, grads = jax.value_and_grad(loss)(stacked_params)
-            new_params = jax.tree_util.tree_map(
-                lambda w, g: w - self.lr * g, stacked_params, grads)
-            return l, new_params
+            self._jit = jax.jit(step, donate_argnums=(0,))
+            return self._jit
 
-        self._jit = jax.jit(step, donate_argnums=(0,))
+        def step(stacked_params, states, x, target):
+            l, grads = self._grads(stacked_params, x, target)
+            attrs = self._opt_attrs
+            new_states = dict(states)
+            if self._needs_count:
+                t_new = states["num_update"] + 1
+                new_states["num_update"] = t_new
+                attrs = dict(attrs)
+                if self._needs_t:
+                    attrs["t"] = t_new
+                if self._lr_fn is not None:
+                    attrs["lr"] = self._lr_fn(t_new)
+            new_params, slots = self._apply_updates(
+                stacked_params, grads, states.get("slots", ()), attrs)
+            if slots:
+                new_states["slots"] = slots
+            return l, new_params, new_states
+
+        self._jit = jax.jit(step, donate_argnums=(0, 1))
         return self._jit
 
     def place_params(self, stage_params_list):

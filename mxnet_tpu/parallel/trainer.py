@@ -78,6 +78,71 @@ def zero_extend_spec(spec, shape, mesh, data_axis="data"):
 _STEP_COUNT = "__num_update__"  # reserved key in the optimizer-state tree
 
 
+def resolve_update_op(optimizer, optimizer_params, momentum, learning_rate,
+                      wd, rescale_grad, clip_gradient):
+    """Resolve an optimizer name to ``(update_op, attrs, n_states, needs_t)``
+    over the registered fused-update ops (reference
+    ``src/operator/optimizer_op.cc``) — shared by ShardedTrainer and
+    PipelinedTrainer so there is ONE spelling of the optimizer contract."""
+    from ..ops.registry import get_op
+
+    opt_name = (optimizer or "sgd").lower()
+    opt_kwargs = dict(optimizer_params or {})
+    if opt_name == "sgd":
+        # momentum may arrive via the historical kwarg or (MXNet-parity)
+        # optimizer_params; both at once must agree
+        if ("momentum" in opt_kwargs and momentum
+                and opt_kwargs["momentum"] != momentum):
+            raise MXNetError(
+                "momentum given twice (momentum=%r, optimizer_params"
+                "['momentum']=%r)" % (momentum, opt_kwargs["momentum"]))
+        eff_mom = opt_kwargs.pop("momentum", momentum)
+        op_name = "sgd_mom_update" if eff_mom else "sgd_update"
+        if eff_mom:
+            opt_kwargs["momentum"] = eff_mom
+    else:
+        if momentum:
+            raise MXNetError(
+                "momentum= is an SGD knob; pass optimizer_params for %r"
+                % opt_name)
+        op_name = (opt_name if opt_name.endswith("_update")
+                   else opt_name + "_update")
+    try:
+        update_op = get_op(op_name)
+    except Exception:
+        raise MXNetError(
+            "no fused update op %r for optimizer %r" % (op_name, opt_name))
+    static = {"lr": learning_rate, "wd": wd, "rescale_grad": rescale_grad,
+              "clip_gradient": (clip_gradient if clip_gradient is not None
+                                else -1.0)}
+    static.update(opt_kwargs)
+    attrs = update_op.parse_attrs(static)
+    n_states = update_op.n_outputs(attrs) - 1
+    return update_op, attrs, n_states, "t" in update_op.params
+
+
+def resolve_lr_fn(lr_scheduler, learning_rate):
+    """Resolve a scheduler to a traced ``num_update -> lr`` callable (or
+    None), validating at construction time rather than first trace."""
+    if lr_scheduler is None:
+        return None
+    from ..lr_scheduler import LRScheduler
+
+    if isinstance(lr_scheduler, LRScheduler):
+        lr_scheduler.base_lr = learning_rate
+        # fail at construction, not first trace: the subclass must provide
+        # the jnp form next to its host __call__
+        if type(lr_scheduler).traced is LRScheduler.traced:
+            raise MXNetError(
+                "%s has no traced() form for in-step evaluation"
+                % type(lr_scheduler).__name__)
+        return lr_scheduler.traced
+    if callable(lr_scheduler):
+        return lr_scheduler  # jnp map of the traced counter
+    raise MXNetError("lr_scheduler must be an LRScheduler or a "
+                     "callable(num_update) -> lr")
+
+
 
 
 class ShardedTrainer:
@@ -199,69 +264,16 @@ class ShardedTrainer:
         self._remat = bool(remat) or remat_policy is not None
         self._remat_policy = (getattr(jax.checkpoint_policies, remat_policy)
                               if remat_policy is not None else None)
-        # -- optimizer: any registered fused-update op (reference
-        # src/operator/optimizer_op.cc; the single source of update math
-        # shared with the imperative Optimizer classes).  "sgd" keeps the
-        # historical momentum= knob; everything else configures through
-        # optimizer_params (beta1/beta2/epsilon/gamma1/...).
-        from ..ops.registry import get_op
-
-        opt_name = (optimizer or "sgd").lower()
-        opt_kwargs = dict(optimizer_params or {})
-        if opt_name == "sgd":
-            # momentum may arrive via the historical kwarg or (MXNet-parity)
-            # optimizer_params; both at once must agree
-            if ("momentum" in opt_kwargs and momentum
-                    and opt_kwargs["momentum"] != momentum):
-                raise MXNetError(
-                    "momentum given twice (momentum=%r, optimizer_params"
-                    "['momentum']=%r)" % (momentum, opt_kwargs["momentum"]))
-            eff_mom = opt_kwargs.pop("momentum", momentum)
-            op_name = "sgd_mom_update" if eff_mom else "sgd_update"
-            if eff_mom:
-                opt_kwargs["momentum"] = eff_mom
-        else:
-            if momentum:
-                raise MXNetError(
-                    "momentum= is an SGD knob; pass optimizer_params for %r"
-                    % opt_name)
-            op_name = (opt_name if opt_name.endswith("_update")
-                       else opt_name + "_update")
-        try:
-            self._update_op = get_op(op_name)
-        except Exception:
-            raise MXNetError(
-                "no fused update op %r for optimizer %r" % (op_name, opt_name))
-        static = {"lr": learning_rate, "wd": wd, "rescale_grad": rescale_grad,
-                  "clip_gradient": (clip_gradient if clip_gradient is not None
-                                    else -1.0)}
-        static.update(opt_kwargs)
-        self._opt_attrs = self._update_op.parse_attrs(static)
-        self._n_states = self._update_op.n_outputs(self._opt_attrs) - 1
-        # bias-corrected optimizers take the step count; keep it on device
-        # so long runs don't recompile per step.  LR schedules evaluate on
-        # the same counter (Optimizer sets sched.base_lr, reference
-        # optimizer.py:60-61)
-        self._needs_t = "t" in self._update_op.params
-        if lr_scheduler is not None:
-            from ..lr_scheduler import LRScheduler
-
-            if isinstance(lr_scheduler, LRScheduler):
-                lr_scheduler.base_lr = learning_rate
-                # fail at construction, not first trace: the subclass must
-                # provide the jnp form next to its host __call__
-                if type(lr_scheduler).traced is LRScheduler.traced:
-                    raise MXNetError(
-                        "%s has no traced() form for in-step evaluation"
-                        % type(lr_scheduler).__name__)
-                self._lr_fn = lr_scheduler.traced
-            elif callable(lr_scheduler):
-                self._lr_fn = lr_scheduler  # jnp map of the traced counter
-            else:
-                raise MXNetError("lr_scheduler must be an LRScheduler or a "
-                                 "callable(num_update) -> lr")
-        else:
-            self._lr_fn = None
+        # -- optimizer: any registered fused-update op (the single source of
+        # update math shared with the imperative Optimizer classes).  The
+        # bias-correction step count and LR schedules both ride an on-device
+        # counter so long runs never recompile (Optimizer sets
+        # sched.base_lr, reference optimizer.py:60-61).
+        (self._update_op, self._opt_attrs, self._n_states,
+         self._needs_t) = resolve_update_op(
+            optimizer, optimizer_params, momentum, learning_rate, wd,
+            rescale_grad, clip_gradient)
+        self._lr_fn = resolve_lr_fn(lr_scheduler, learning_rate)
         self._needs_count = self._needs_t or self._lr_fn is not None
         # -- multi-precision: weights live in a low-precision dtype (HBM
         # bandwidth + memory), the optimizer updates an fp32 MASTER copy

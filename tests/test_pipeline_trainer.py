@@ -1,0 +1,172 @@
+"""PipelinedTrainer with registry optimizers and the 1F1B schedule.
+
+The pipe-axis trainer shares ShardedTrainer's optimizer contract
+(resolve_update_op): any fused-update op, momentum via either spelling,
+traced LR schedules on an on-device counter.  Stateless configs keep the
+historical (loss, new_params) step; stateful ones add a states tree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import mxnet_tpu  # noqa: F401  (registers ops)
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import pipeline as pp
+
+
+def _stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _setup(S=4, d=8, B=16):
+    devs = jax.devices()[:S]
+    mesh = Mesh(np.array(devs), ("pipe",))
+    rs = np.random.RandomState(0)
+    stages = [{"w": jnp.asarray(rs.randn(d, d).astype(np.float32)) * 0.3,
+               "b": jnp.zeros((d,), jnp.float32)} for _ in range(S)]
+    x = jnp.asarray(rs.randn(B, d).astype(np.float32))
+    t = jnp.asarray(rs.randn(B, d).astype(np.float32))
+    return mesh, stages, x, t
+
+
+def _ref_run(stages, x, t, steps, update):
+    """Direct (non-pipelined) training loop with the given update rule."""
+    import jax.tree_util as jtu
+
+    stacked = pp.stack_stage_params(stages)
+    state = None
+    for i in range(steps):
+        def loss(p):
+            y = x
+            for s in range(len(stages)):
+                y = _stage(jtu.tree_map(lambda a: a[s], p), y)
+            return _loss(y, t)
+
+        l, g = jax.value_and_grad(loss)(stacked)
+        stacked, state = update(stacked, g, state, i + 1)
+    return l, stacked
+
+
+def test_stateless_signature_unchanged():
+    mesh, stages, x, t = _setup()
+    tr = pp.PipelinedTrainer(_stage, _loss, mesh, n_microbatch=4,
+                             learning_rate=0.1)
+    assert not tr.has_state
+    p = tr.place_params(stages)
+    l, p = tr.step_fn()(p, x, t)  # two-tuple, as before
+    assert np.isfinite(float(l))
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_momentum_matches_direct(schedule):
+    mesh, stages, x, t = _setup()
+    tr = pp.PipelinedTrainer(_stage, _loss, mesh, n_microbatch=4,
+                             learning_rate=0.1, momentum=0.9,
+                             schedule=schedule)
+    assert tr.has_state
+    p = tr.place_params(stages)
+    st = tr.init_states(p)
+    step = tr.step_fn()
+    for i in range(3):
+        l, p, st = step(p, st, x, t)
+
+    def sgd_mom(w, g, state, _):
+        import jax.tree_util as jtu
+
+        if state is None:
+            state = jtu.tree_map(jnp.zeros_like, w)
+        new_m = jtu.tree_map(lambda m, gg: 0.9 * m - 0.1 * gg, state, g)
+        return jtu.tree_map(lambda ww, m: ww + m, w, new_m), new_m
+
+    l_ref, ref = _ref_run(stages, x, t, 3, sgd_mom)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(jax.device_get(p[k])),
+                                   np.asarray(ref[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_adam_with_schedule_and_1f1b():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    mesh, stages, x, t = _setup()
+    tr = pp.PipelinedTrainer(_stage, _loss, mesh, n_microbatch=4,
+                             learning_rate=0.05, optimizer="adam",
+                             lr_scheduler=FactorScheduler(step=2, factor=0.5),
+                             schedule="1f1b")
+    p = tr.place_params(stages)
+    st = tr.init_states(p)
+    assert len(st["slots"]) == 2  # adam: mean + var
+    step = tr.step_fn()
+    losses = []
+    for i in range(4):
+        l, p, st = step(p, st, x, t)
+        losses.append(float(l))
+    assert int(np.asarray(st["num_update"])) == 4
+    assert losses[-1] < losses[0]
+
+    def adam(w, g, state, step_i):
+        import jax.tree_util as jtu
+
+        lr = 0.05 * (0.5 ** max(0, (step_i - 1) // 2))
+        if state is None:
+            state = (jtu.tree_map(jnp.zeros_like, w),
+                     jtu.tree_map(jnp.zeros_like, w))
+        mean = jtu.tree_map(lambda m, gg: 0.9 * m + 0.1 * gg, state[0], g)
+        var = jtu.tree_map(lambda v, gg: 0.999 * v + 0.001 * gg * gg,
+                           state[1], g)
+        corr = np.sqrt(1 - 0.999 ** step_i) / (1 - 0.9 ** step_i)
+        new_w = jtu.tree_map(
+            lambda ww, m, v: ww - lr * corr * m / (jnp.sqrt(v) + 1e-8),
+            w, mean, var)
+        return new_w, (mean, var)
+
+    _, ref = _ref_run(stages, x, t, 4, adam)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(jax.device_get(p[k])),
+                                   np.asarray(ref[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_bad_schedule_rejected():
+    mesh, _, _, _ = _setup()
+    with pytest.raises(MXNetError):
+        pp.PipelinedTrainer(_stage, _loss, mesh, n_microbatch=4,
+                            schedule="interleaved")
+    # the partial-sum / param-sharding stage contract is 1F1B-only;
+    # accepting it under gpipe would silently train on wrong gradients
+    with pytest.raises(MXNetError):
+        pp.PipelinedTrainer(_stage, _loss, mesh, n_microbatch=4,
+                            schedule="gpipe", reduce_axes=("model",))
+
+
+def test_gpipe_heterogeneous_stage_idx():
+    # stage_fn(params, x, stage_idx) opt-in works under BOTH schedules
+    mesh, stages, x, t = _setup()
+
+    def het_stage(p, x, stage_idx):
+        # even stages tanh, odd stages identity-ish (scaled linear)
+        y = x @ p["w"] + p["b"]
+        return jnp.where(stage_idx % 2 == 0, jnp.tanh(y), 0.5 * y)
+
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        tr = pp.PipelinedTrainer(het_stage, _loss, mesh, n_microbatch=4,
+                                 learning_rate=0.1, momentum=0.9,
+                                 schedule=schedule)
+        p = tr.place_params(stages)
+        st = tr.init_states(p)
+        step = tr.step_fn()
+        for i in range(2):
+            l, p, st = step(p, st, x, t)
+        results[schedule] = {k: np.asarray(jax.device_get(p[k]))
+                             for k in p}
+    for k in results["gpipe"]:
+        np.testing.assert_allclose(results["1f1b"][k], results["gpipe"][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
