@@ -144,6 +144,57 @@ PINNED_INPUTS = {
 }
 
 
+def trainer_step_case():
+    """The fused ShardedTrainer step (momentum + traced Factor schedule +
+    grad_accum) cross-checked cpu-vs-tpu: 3 updates on identical data
+    must land the same parameters.  Extends the consistency tier from
+    single graphs to the training stack itself.  Momentum-SGD, not Adam:
+    Adam's variance normalization turns a near-zero gradient's backend
+    sign flip into a full ±lr update divergence (a property of the
+    optimizer under ~1e-2 fp32 backend skew, not an implementation
+    difference), while SGD keeps parameter error proportional to
+    gradient error; Adam's plumbing is pinned by exact-parity CPU tests
+    (tests/test_trainer_optimizers.py)."""
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    rs = np.random.RandomState(11)
+    data = rs.randn(8, 16).astype(np.float32)
+    labels = rs.randint(0, 4, (8,)).astype(np.float32)
+    results = {}
+    for dev in (jax.devices("cpu")[0], jax.devices()[0]):
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                    name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            net, num_hidden=4, name="fc2"), name="softmax")
+        mesh = Mesh(np.array([dev]), ("data",))
+        tr = ShardedTrainer(
+            net, mesh, data_shapes={"data": (8, 16)},
+            label_shapes={"softmax_label": (8,)},
+            learning_rate=0.1, momentum=0.9,
+            lr_scheduler=FactorScheduler(step=2, factor=0.5),
+            rescale_grad=1.0 / 8, grad_accum=2)
+        params, moms, aux = tr.init(seed=0)
+        batch = tr.place_batch({"data": data, "softmax_label": labels})
+        step = tr.step_fn()
+        for i in range(3):
+            _, params, moms, aux = step(params, moms, aux, batch,
+                                        jax.random.PRNGKey(0))
+        results[dev.platform] = {
+            k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+    ref, got = results["cpu"], results["tpu"]
+    for k in ref:
+        err = np.abs(got[k] - ref[k])
+        bound = MXU_TOL * np.abs(ref[k]) + 3e-3  # atol floor: bias values
+        # start at zero, so tiny absolute skew is all relative error
+        worst = float(np.max(err - bound))
+        assert worst <= 0, "trainer param %r diverged (worst excess %.3e)" \
+            % (k, worst)
+
+
 def main():
     n_ok = 0
     for case in CASES:
@@ -161,6 +212,9 @@ def main():
             tol=tol, grad_req=grad_req, arg_params=arg_params or None)
         n_ok += 1
         print("ok %s" % name, flush=True)
+    trainer_step_case()
+    n_ok += 1
+    print("ok trainer_step(momentum+schedule+accum)", flush=True)
     print("CONSISTENCY_OK %d" % n_ok)
 
 
