@@ -75,12 +75,11 @@ def latest_step(directory):
     return _manager(directory).latest_step()
 
 
-def _ckpt_moms_tree(mgr, step):
-    """The checkpoint's ``moms`` metadata subtree as a dict ({} when saved
-    without optimizer state), or None when the metadata shape is
-    unrecognized (orbax API variation) or unavailable.  Anchored on
-    ``params`` — our save layout always contains it — so an unfamiliar
-    wrapper dict can't masquerade as a definitive answer."""
+def _ckpt_tree(mgr, step):
+    """The checkpoint's full metadata tree as a dict, or None when the
+    metadata shape is unrecognized (orbax API variation) or unavailable.
+    Anchored on ``params`` — our save layout always contains it — so an
+    unfamiliar wrapper dict can't masquerade as a definitive answer."""
     try:
         meta = mgr.item_metadata(step)
         tree = getattr(meta, "tree", meta)  # orbax wraps the tree on new APIs
@@ -90,13 +89,22 @@ def _ckpt_moms_tree(mgr, step):
             tree = tree["default"]
             tree = getattr(tree, "tree", tree)
         if hasattr(tree, "get") and "params" in tree:
-            moms = tree.get("moms")
-            if moms is None:
-                return {}
-            return dict(moms) if hasattr(moms, "keys") else None
+            return tree
         return None
     except Exception:
         return None
+
+
+def _ckpt_moms_tree(mgr, step):
+    """The checkpoint's ``moms`` metadata subtree as a dict ({} when saved
+    without optimizer state), or None when unknowable."""
+    tree = _ckpt_tree(mgr, step)
+    if tree is None:
+        return None
+    moms = tree.get("moms")
+    if moms is None:
+        return {}
+    return dict(moms) if hasattr(moms, "keys") else None
 
 
 def _ckpt_probe_moms(mgr, step):
@@ -104,6 +112,38 @@ def _ckpt_probe_moms(mgr, step):
     non-empty / absent ``moms`` subtree; None when unknowable."""
     tree = _ckpt_moms_tree(mgr, step)
     return bool(tree) if tree is not None else None
+
+
+def _describe_state(node):
+    """One-line structural description of an optimizer-state entry (works on
+    both ShapeDtypeStructs and orbax metadata leaves): shows tuple arity and
+    per-slot dtypes so layout mismatches read as layouts, not tree errors."""
+    if isinstance(node, (tuple, list)):
+        return "tuple[%d](%s)" % (
+            len(node), ", ".join(_describe_state(s) for s in node))
+    if hasattr(node, "keys"):
+        return "dict(%s)" % ", ".join(sorted(node.keys()))
+    dt = getattr(node, "dtype", None)
+    return str(dt) if dt is not None else type(node).__name__
+
+
+def _diff_state_layout(expected, saved, scope):
+    """Human-readable layout differences between the restore target and the
+    checkpoint metadata for one state group; [] when structurally alike."""
+    lines = []
+    for n in sorted(set(expected) | set(saved)):
+        if n not in saved:
+            lines.append("%s[%r]: expected %s, absent from checkpoint"
+                         % (scope, n, _describe_state(expected[n])))
+        elif n not in expected:
+            lines.append("%s[%r]: checkpoint has %s, not expected"
+                         % (scope, n, _describe_state(saved[n])))
+        else:
+            de, ds = _describe_state(expected[n]), _describe_state(saved[n])
+            if de != ds:
+                lines.append("%s[%r]: expected %s, checkpoint has %s"
+                             % (scope, n, de, ds))
+    return lines
 
 
 def restore_sharded(directory, step, trainer=None, shardings=None):
@@ -188,6 +228,37 @@ def restore_sharded(directory, step, trainer=None, shardings=None):
                 state = mgr.restore(
                     step, args=ocp.args.StandardRestore(target))
             else:
+                # orbax tree/dtype mismatch errors are opaque; when the
+                # metadata shows the saved layout actually differs from this
+                # trainer's (optimizer class changed, multi_precision
+                # toggled), name both layouts instead
+                tree = _ckpt_tree(mgr, step)
+                if tree is not None:
+                    def subtree(key):
+                        # None (unrecognized shape) disables that group's
+                        # diff rather than mis-reporting it as absent
+                        sub = tree.get(key)
+                        if sub is None:
+                            return {}
+                        return dict(sub) if hasattr(sub, "keys") else None
+
+                    diffs = []
+                    for expected, key in ((moms_target, "moms"),
+                                          (pstruct, "params")):
+                        saved = subtree(key)
+                        if saved is not None:
+                            diffs += _diff_state_layout(expected, saved, key)
+                    if diffs:
+                        from ..base import MXNetError
+
+                        raise MXNetError(
+                            "restore_sharded(%r, step=%d): checkpoint "
+                            "optimizer-state layout does not match this "
+                            "trainer's (optimizer or multi_precision "
+                            "changed between save and restore?):\n  %s\n"
+                            "Restore with a matching trainer, or pass "
+                            "trainer=None and re-key the state by hand."
+                            % (directory, step, "\n  ".join(diffs)))
                 raise
         moms = dict(state["moms"])
         if inject_counter is not None:

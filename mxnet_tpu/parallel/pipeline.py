@@ -317,13 +317,22 @@ class PipelinedTrainer:
         self._jit = None
 
     def init_states(self, stacked_params):
-        """Optimizer state for placed params: one zeros-tree per state slot
-        (inheriting the params' stage-stacked sharding) plus the on-device
-        step counter when the optimizer/schedule consumes it."""
+        """Optimizer state for placed params: one zeros-tree per state slot,
+        explicitly placed on each param's own sharding (stage-stacked from
+        :meth:`place_params`; ``zeros_like`` sharding inheritance is not
+        guaranteed across JAX versions), plus the on-device step counter
+        when the optimizer/schedule consumes it."""
+        stage_shard = NamedSharding(self.mesh, P(self.axis))
+
+        def zeros_placed(a):
+            return jax.device_put(
+                jnp.zeros(a.shape, a.dtype),
+                getattr(a, "sharding", None) or stage_shard)
+
         st = {}
         if self._n_states:
             st["slots"] = tuple(
-                jax.tree_util.tree_map(jnp.zeros_like, stacked_params)
+                jax.tree_util.tree_map(zeros_placed, stacked_params)
                 for _ in range(self._n_states))
         if self._needs_count:
             st["num_update"] = jnp.zeros((), jnp.int32)

@@ -123,7 +123,15 @@ def resolve_update_op(optimizer, optimizer_params, momentum, learning_rate,
 
 def resolve_lr_fn(lr_scheduler, learning_rate):
     """Resolve a scheduler to a traced ``num_update -> lr`` callable (or
-    None), validating at construction time rather than first trace."""
+    None), validating at construction time rather than first trace.
+
+    Matching the reference optimizer contract (``optimizer.py`` sets
+    ``lr_scheduler.base_lr = optimizer.learning_rate``), the scheduler
+    object is retargeted **in place** to this trainer's ``learning_rate``.
+    Consequence: one scheduler instance cannot be shared between trainers
+    with different learning rates — the last-constructed trainer wins.
+    Pass separate scheduler instances (or a plain ``callable(num_update)``,
+    which is never mutated) when rates differ."""
     if lr_scheduler is None:
         return None
     from ..lr_scheduler import LRScheduler
@@ -160,6 +168,15 @@ class ShardedTrainer:
         axis over ``data``, and — when a ``seq`` axis exists in the mesh —
         axis 1 over ``seq`` for rank>=2 integer/sequence inputs).
     param_specs : dict name -> PartitionSpec (default: auto_tp_specs).
+
+    Output-shape contract under ``grad_accum=k``: batched outputs (rank>=1
+    per microbatch) merge back row-major to the full-batch shape; rank-0
+    scalar heads are AVERAGED across the k microbatches so shapes (not
+    dtypes — integer scalars promote to float) are invariant to k.  The
+    average equals the full-batch value for mean-normalized losses over
+    the equal row-major split; a sum-normalized scalar head reads k times
+    smaller — fold the factor into ``grad_scale``/``rescale_grad`` or
+    normalize per-row if the logged magnitude matters.
     """
 
     def __init__(self, symbol, mesh: Mesh, data_shapes: Dict[str, tuple],
@@ -473,10 +490,12 @@ class ShardedTrainer:
                              else gacc[n].astype(dparams[n].dtype))
                          for n in diff}
                 # merge the stacked microbatch axis back into the batch axis
-                # (row-major — the inverse of place_batch's split); rank-1
-                # stacks (per-microbatch scalars) stay stacked
+                # (row-major — the inverse of place_batch's split); scalar
+                # heads (rank-0 per microbatch) average across microbatches
+                # so output shapes are invariant to grad_accum — exact for
+                # mean-normalized losses over the equal row-major split
                 outs = [o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
-                        if o.ndim >= 2 else o for o in outs_stack]
+                        if o.ndim >= 2 else o.mean(0) for o in outs_stack]
             new_params, new_moms = dict(params), dict(moms)
             attrs = opt_attrs
             if needs_count:

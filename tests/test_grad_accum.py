@@ -189,6 +189,35 @@ def test_sharded_fit_loop(tmp_path):
     assert seen[0] == (6, 1) and seen[-1][1] == len(seen)
 
 
+def test_accum_scalar_head_shape_invariant():
+    # a rank-0 loss head (MakeLoss over a mean) must produce the SAME
+    # output shape whether or not the step accumulates — the stacked
+    # per-microbatch scalars average back to one scalar, which for a
+    # mean-normalized loss over the equal row-major split equals the
+    # full-batch value
+    def scalar_net():
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                    name="fc")
+        err = net - mx.sym.Reshape(mx.sym.Variable("softmax_label"),
+                                   shape=(-1, 1))
+        return mx.sym.MakeLoss(mx.sym.mean(err * err))
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    outs = {}
+    for accum in (1, 2):
+        tr = ShardedTrainer(scalar_net(), mesh,
+                            data_shapes={"data": (8, 6)},
+                            label_shapes={"softmax_label": (8,)},
+                            grad_accum=accum)
+        params, moms, aux = tr.init(seed=0)
+        batch = tr.place_batch(_batch())
+        o, params, moms, aux = tr.step_fn()(params, moms, aux, batch,
+                                            jax.random.PRNGKey(0))
+        outs[accum] = np.asarray(o[0])
+    assert outs[1].shape == outs[2].shape == ()
+    np.testing.assert_allclose(outs[2], outs[1], rtol=1e-6)
+
+
 def test_accum_shape_validation():
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
     with pytest.raises(MXNetError):

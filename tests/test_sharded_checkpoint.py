@@ -121,3 +121,48 @@ def test_restore_inconclusive_metadata_falls_back(tmp_path, monkeypatch):
     for k in params:
         np.testing.assert_array_equal(np.asarray(p2[k]),
                                       np.asarray(params[k]))
+
+
+def _trainer_opt(optimizer, multi_precision=False):
+    devs = jax.devices()[:4]
+    if len(devs) < 4:
+        pytest.skip("need 4 devices")
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("data", "model"))
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=8, name="fc"), name="softmax")
+    return ShardedTrainer(
+        sym, mesh, data_shapes={"data": (4, 6)},
+        label_shapes={"softmax_label": (4,)}, optimizer=optimizer,
+        momentum=0.9 if optimizer == "sgd" else 0.0,
+        multi_precision=multi_precision)
+
+
+def test_restore_optimizer_layout_mismatch_names_layouts(tmp_path):
+    """Changing the optimizer between save and restore must raise a clear
+    MXNetError naming the saved vs expected state layouts — not an opaque
+    orbax tree error."""
+    from mxnet_tpu.base import MXNetError
+
+    tr = _trainer_opt("sgd")  # bare momentum array per param
+    params, moms, aux = tr.init(seed=0)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_sharded(d, 1, params, moms, aux)
+
+    tr2 = _trainer_opt("adam")  # (m, v) tuple per param + step counter
+    with pytest.raises(MXNetError, match="layout"):
+        ckpt.restore_sharded(d, 1, trainer=tr2)
+
+
+def test_restore_multi_precision_toggle_names_dtypes(tmp_path):
+    """Toggling multi_precision between save and restore (bf16 working
+    weights + fp32 master vs plain fp32) raises the named layout error."""
+    from mxnet_tpu.base import MXNetError
+
+    tr = _trainer_opt("sgd", multi_precision=False)
+    params, moms, aux = tr.init(seed=0)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_sharded(d, 1, params, moms, aux)
+
+    tr2 = _trainer_opt("sgd", multi_precision=True)
+    with pytest.raises(MXNetError, match="layout"):
+        ckpt.restore_sharded(d, 1, trainer=tr2)
