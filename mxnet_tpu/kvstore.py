@@ -15,6 +15,13 @@ Types mirror the reference's ``KVStore::Create`` registry
   ``parallel/``); sync semantics match ``dist_sync`` (all workers see the
   aggregated update after pull).  Single-process fallback behaves like
   ``local`` with rank 0 of 1, so the same script runs anywhere.
+* ``dist_tpu`` — the TPU-native sync mode (SURVEY §5): ``dist_sync``
+  semantics, but each push runs ONE jitted XLA program per key — the
+  cross-process gradient sum over the global device mesh AND the
+  registered fused ``*_update`` optimizer op — so weights and optimizer
+  state never leave the device between steps (``parallel/dist_tpu.py``;
+  exact-arithmetic parity with ``dist_sync`` pinned by
+  ``tests/dist/dist_tpu_kvstore.py``).
 * ``dist_async`` — update-on-push with **no barrier** (reference
   ``kvstore.cc:32`` + async ``DataHandle``,
   ``kvstore_dist_server.h:136-205``): a host-side parameter server thread
@@ -73,8 +80,13 @@ class KVStore(object):
         # dependency engine so the optimizer application overlaps the
         # caller's device work; pull() is the read-after-write wait
         self._key_vars = {}
+        self._tpu = None     # FusedTPUStore for the dist_tpu mode
         if kind == "dist_async" and self.num_workers > 1:
             self._init_async()
+        elif kind == "dist_tpu":
+            from .parallel.dist_tpu import FusedTPUStore
+
+            self._tpu = FusedTPUStore()
 
     def _key_var(self, k):
         from . import engine
@@ -137,6 +149,8 @@ class KVStore(object):
 
                 engine.wait_for_var(self._key_vars[k])
             self._store[k] = vlist[0].copy()
+            if self._tpu is not None:
+                self._tpu.init(_updater_key(k), vlist[0]._data)
         if self._async is not None:
             import numpy as _np
 
@@ -175,6 +189,23 @@ class KVStore(object):
                         "dist_async applies the optimizer on the server: "
                         "use set_optimizer(), not set_updater()")
                 pairs.append((_updater_key(k), _np.asarray(merged._data)))
+                continue
+            if self._tpu is not None:
+                # dist_tpu: ONE jitted program = cross-process reduce +
+                # fused optimizer update; weights/state stay on-device.
+                # Hyperparameter bookkeeping (schedule, lr/wd multipliers,
+                # Adam's t) runs host-side through the SAME Optimizer
+                # methods the dist_sync updater uses, so the two modes
+                # walk identical schedules.
+                idx = _updater_key(k)
+                if self._optimizer is not None:
+                    lr = self._optimizer._get_lr(idx)
+                    wd = self._optimizer._get_wd(idx)
+                    self._optimizer._update_count(idx)
+                    t = self._optimizer._index_update_count[idx]
+                    self._tpu.push(idx, merged._data, lr=lr, wd=wd, t=t)
+                else:
+                    self._tpu.push(idx, merged._data)
                 continue
             if self._kind.startswith("dist"):
                 # collectives involve every process: run on the caller's
@@ -226,6 +257,12 @@ class KVStore(object):
                 for o in olist:
                     o._set_data(arr.astype(o.dtype))
             return
+        if self._tpu is not None:
+            for k, olist in zip(keys, outs):
+                val = self._tpu.pull(_updater_key(k))
+                for o in olist:
+                    o._set_data(val.astype(o.dtype))
+            return
         from . import engine
 
         for k, olist in zip(keys, outs):
@@ -248,6 +285,12 @@ class KVStore(object):
 
     # -- control plane -------------------------------------------------
     def set_updater(self, updater):
+        if self._tpu is not None:
+            raise MXNetError(
+                "dist_tpu fuses the update on-device; an arbitrary host "
+                "updater would reintroduce the per-key host round-trip. "
+                "Use set_optimizer (sgd/adam/rmsprop) or kvstore "
+                "'dist_sync'.")
         self._updater = updater
 
     def set_optimizer(self, optimizer):
@@ -264,6 +307,15 @@ class KVStore(object):
             self.barrier()  # others wait until the server has it
             return
         optimizer = pickle.loads(pickled)
+        if self._tpu is not None:
+            # dist_tpu: the optimizer becomes a fused on-device step (its
+            # registered *_update op inside the sync program); only the
+            # schedule bookkeeping stays host-side.  Validate BEFORE
+            # recording it, so a rejected optimizer (no fused op) leaves
+            # the store unconfigured instead of half-configured.
+            self._tpu.set_optimizer(optimizer)
+            self._optimizer = optimizer
+            return
         self._optimizer = optimizer
         self.set_updater(opt.get_updater(optimizer))
 
@@ -309,6 +361,14 @@ class KVStore(object):
         return 0
 
     def save_optimizer_states(self, fname):
+        if self._tpu is not None:
+            if self._optimizer is None:
+                raise MXNetError(
+                    "dist_tpu has no optimizer state to save: call "
+                    "set_optimizer first")
+            with open(fname, "wb") as fout:
+                fout.write(self._tpu.get_states())
+            return
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
         from . import engine
@@ -319,6 +379,17 @@ class KVStore(object):
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        if self._tpu is not None:
+            if self._optimizer is None:
+                # set_optimizer resets the fused state tree; accepting a
+                # load before it would silently discard the loaded states
+                raise MXNetError(
+                    "dist_tpu: call set_optimizer before "
+                    "load_optimizer_states (set_optimizer reinitializes "
+                    "optimizer state)")
+            with open(fname, "rb") as fin:
+                self._tpu.set_states(fin.read())
+            return
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
         from . import engine

@@ -1018,10 +1018,14 @@ def _adam_update(attrs, w, g, mean, var):
     b1, b2 = attrs["beta1"], attrs["beta2"]
     new_mean = b1 * mean + (1 - b1) * g
     new_var = b2 * var + (1 - b2) * jnp.square(g)
-    # t may be a traced scalar (ShardedTrainer passes the on-device step
-    # counter so long runs don't recompile per step) — jnp handles both
-    t = attrs["t"]
-    lr = attrs["lr"] * jnp.sqrt(1 - b2**t) / (1 - b1**t)
+    # t may be a traced scalar (ShardedTrainer and dist_tpu pass the
+    # on-device step counter so long runs don't recompile per step).
+    # Compute the bias correction explicitly in f32 so static-t (python
+    # float64 powers) and traced-t callers get BITWISE-identical updates
+    # — the dist_tpu-vs-dist_sync exact-parity contract depends on it.
+    t = jnp.asarray(attrs["t"], jnp.float32)
+    b1f, b2f = jnp.float32(b1), jnp.float32(b2)
+    lr = attrs["lr"] * jnp.sqrt(1 - b2f**t) / (1 - b1f**t)
     new_w = w - lr * new_mean / (jnp.sqrt(new_var) + attrs["epsilon"])
     return new_w, new_mean, new_var
 

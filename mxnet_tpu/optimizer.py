@@ -155,6 +155,37 @@ class Optimizer(object):
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    def _fused_spec_for(self, op_name, **static):
+        """Build a ``dist_tpu`` fused-step spec from a registered update
+        op: ``(op, attrs, n_states, needs_t)``.  ``attrs`` is fully parsed
+        with lr/wd (and t) as placeholders the fused program overwrites
+        with traced values — so the update arithmetic is THE registered
+        op's, the same one :meth:`update` calls (one registry, zero
+        drift)."""
+        from .ops.registry import get_op
+
+        op = get_op(op_name)
+        full = dict(static, lr=0.0, wd=0.0,
+                    rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient or -1.0)
+        needs_t = "t" in op.params
+        if needs_t:
+            full["t"] = 1
+        attrs = op.parse_attrs(full)
+        return op, attrs, op.n_outputs(attrs) - 1, needs_t
+
+    def fused_spec(self):
+        """The fused reduce+update spec for the ``dist_tpu`` kvstore.
+        Optimizers whose update math has no registered fused op cannot run
+        on-device-fused; use ``dist_sync`` (host-side updater) for those."""
+        from .base import MXNetError
+
+        raise MXNetError(
+            "%s has no fused update op: dist_tpu fuses the optimizer into "
+            "the on-device sync step and needs one (sgd/adam/rmsprop). "
+            "Use kvstore 'dist_sync' for host-side updaters."
+            % type(self).__name__)
+
 
 register = Optimizer.register
 
@@ -194,10 +225,19 @@ class SGD(Optimizer):
         else:
             nd.sgd_update(weight, grad, out=weight, **kwargs)
 
+    def fused_spec(self):
+        if self.momentum:
+            return self._fused_spec_for("sgd_mom_update",
+                                        momentum=self.momentum)
+        return self._fused_spec_for("sgd_update")
+
 
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (parity: ``optimizer.py:NAG``)."""
+
+    def fused_spec(self):  # NAG's lookahead is not sgd_mom_update's math
+        return Optimizer.fused_spec(self)
 
     def update(self, index, weight, grad, state):
         import jax.numpy as jnp
@@ -269,6 +309,10 @@ class Adam(Optimizer):
                        rescale_grad=self.rescale_grad,
                        clip_gradient=self.clip_gradient or -1.0)
 
+    def fused_spec(self):
+        return self._fused_spec_for("adam_update", beta1=self.beta1,
+                                    beta2=self.beta2, epsilon=self.epsilon)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -331,6 +375,14 @@ class RMSProp(Optimizer):
             nd.rmspropalex_update(weight, grad, n, g, delta,
                                   out=[weight, n, g, delta],
                                   gamma2=self.gamma2, **kwargs)
+
+    def fused_spec(self):
+        if self.centered:
+            return self._fused_spec_for(
+                "rmspropalex_update", gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon)
+        return self._fused_spec_for("rmsprop_update", gamma1=self.gamma1,
+                                    epsilon=self.epsilon)
 
 
 @register

@@ -1,0 +1,94 @@
+"""Multi-process dist_tpu kvstore worker script: the TPU-native fused
+sync mode must match dist_sync EXACTLY (reference exact-arithmetic test
+strategy: ``tests/nightly/dist_sync_kvstore.py:14-45``), while never
+routing weights through a host-side updater.
+
+Three tiers, all exact:
+  1. accumulate (no optimizer) — the dist_sync default-updater behavior;
+  2. sgd-momentum update-on-push parity vs a dist_sync store walking the
+     same schedule on the same pushes (bitwise on every pull);
+  3. adam parity (exercises the on-device t/bias-correction path).
+
+Run: python tools/launch.py -n 2 python tests/dist/dist_tpu_kvstore.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402  (bootstraps jax.distributed)
+
+
+def _parity(optimizer_name, shape, rank, nworkers, nrepeat=3, atol=0.0,
+            **opt_kw):
+    kv_sync = mx.kv.create("dist_sync")
+    kv_tpu = mx.kv.create("dist_tpu")
+    init = mx.nd.array(np.arange(np.prod(shape), dtype=np.float32)
+                       .reshape(shape) / 7.0)
+    kv_sync.init("w", init)
+    kv_tpu.init("w", init)
+    # separate instances: each store owns its own schedule counters
+    kv_sync.set_optimizer(mx.optimizer.create(optimizer_name, **opt_kw))
+    kv_tpu.set_optimizer(mx.optimizer.create(optimizer_name, **opt_kw))
+    out_s, out_t = mx.nd.zeros(shape), mx.nd.zeros(shape)
+    for i in range(nrepeat):
+        # integer-valued, rank- and step-dependent gradients: the
+        # cross-worker sum is exact, so any deviation is an update-math
+        # or reduce-semantics bug, not float noise
+        g = mx.nd.ones(shape) * float((rank + 1) * (i + 1))
+        kv_sync.push("w", g)
+        kv_tpu.push("w", g)
+        kv_sync.pull("w", out=out_s)
+        kv_tpu.pull("w", out=out_t)
+        if atol:  # adam: XLA constant-folded vs runtime pow(b, t), 1 ulp
+            np.testing.assert_allclose(
+                out_s.asnumpy(), out_t.asnumpy(), atol=atol, rtol=0,
+                err_msg="%s step %d: dist_tpu != dist_sync"
+                        % (optimizer_name, i))
+        else:
+            np.testing.assert_array_equal(
+                out_s.asnumpy(), out_t.asnumpy(),
+                err_msg="%s step %d: dist_tpu != dist_sync"
+                        % (optimizer_name, i))
+        kv_sync.barrier()
+    # the weight must actually have moved
+    assert not np.allclose(out_t.asnumpy(), init.asnumpy())
+
+
+def main():
+    kv = mx.kv.create("dist_tpu")
+    rank, nworkers = kv.rank, kv.num_workers
+    assert nworkers == int(os.environ.get("MXNET_TPU_NUM_PROCS", "1")), \
+        (nworkers, os.environ.get("MXNET_TPU_NUM_PROCS"))
+
+    # -- tier 1: accumulate semantics (dist_sync's default updater) ----
+    shape = (3, 4)
+    kv.init("3", mx.nd.ones(shape))
+    nrepeat = 3
+    for _ in range(nrepeat):
+        kv.push("3", mx.nd.ones(shape) * (rank + 1))
+        kv.barrier()
+    expected = 1 + nrepeat * sum(range(1, nworkers + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("3", out=out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full(shape, expected, np.float32))
+
+    # -- tier 2/3: fused update-on-push parity vs dist_sync ------------
+    _parity("sgd", (4, 5), rank, nworkers,
+            learning_rate=0.1, momentum=0.9, wd=1e-3,
+            rescale_grad=1.0 / nworkers)
+    _parity("adam", (2, 8), rank, nworkers, atol=2e-6,
+            learning_rate=0.05, rescale_grad=1.0 / nworkers)
+
+    sys.stdout.write("worker %d/%d: dist_tpu kvstore OK (expected=%d)\n"
+                     % (rank, nworkers, expected))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,13 @@ def test_dist_sync_kvstore_via_launcher(n):
     _launch_and_expect(n, "dist_sync_kvstore.py", "dist_sync kvstore OK")
 
 
+def test_dist_tpu_kvstore_via_launcher():
+    # the TPU-native fused sync mode: accumulate semantics + bitwise
+    # update-on-push parity with dist_sync (sgd-momentum AND adam),
+    # weights/optimizer state never visiting a host-side updater
+    _launch_and_expect(2, "dist_tpu_kvstore.py", "dist_tpu kvstore OK")
+
+
 def test_dist_sharded_trainer_via_launcher():
     # cross-process GSPMD: one global mesh, grads psum over the process
     # boundary, params stay replicated, model converges

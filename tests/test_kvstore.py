@@ -268,3 +268,121 @@ def test_async_server_group_sharding_and_striping():
     finally:
         for s in servers:
             s.stop()
+
+
+# ---------------------------------------------------------------------
+# dist_tpu: the fused TPU-native sync mode (single-process fallback —
+# the cross-process path runs via the launcher in tests/test_dist.py)
+# ---------------------------------------------------------------------
+
+def test_dist_tpu_accumulate_and_pull():
+    kv = mx.kv.create("dist_tpu")
+    assert kv.type == "dist_tpu"
+    kv.init("3", mx.nd.ones((2, 3)))
+    for _ in range(2):
+        kv.push("3", mx.nd.ones((2, 3)) * 4.0)
+    out = mx.nd.zeros((2, 3))
+    kv.pull("3", out=out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full((2, 3), 9.0, np.float32))
+
+
+def test_dist_tpu_rejects_host_updater():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kv.create("dist_tpu")
+    with pytest.raises(MXNetError, match="fuses the update"):
+        kv.set_updater(lambda k, g, w: None)
+
+
+def test_dist_tpu_unfused_optimizer_rejected():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kv.create("dist_tpu")
+    with pytest.raises(MXNetError, match="no fused update op"):
+        kv.set_optimizer(mx.optimizer.NAG(momentum=0.9))
+    # rejection must leave the store unconfigured, not half-configured
+    assert kv._optimizer is None
+    # and state IO without an optimizer is an error, not a silent {} /
+    # silent wipe-on-later-set_optimizer
+    with pytest.raises(MXNetError, match="set_optimizer"):
+        kv.save_optimizer_states("/tmp/never_written")
+    with pytest.raises(MXNetError, match="set_optimizer"):
+        kv.load_optimizer_states("/tmp/never_written")
+
+
+def _fused_vs_local(opt_name, steps=4, atol=0.0, **opt_kw):
+    """dist_tpu's one-jit reduce+update must match the local kvstore's
+    host-updater path — both run the SAME registered update op.  Bitwise
+    for t-free optimizers; adam's bias correction admits 1 ulp (XLA
+    constant-folds ``pow(b, t)`` for the static-t imperative path but
+    evaluates it at runtime for the traced-t fused path)."""
+    shape = (4, 6)
+    init = mx.nd.array(np.arange(24, dtype=np.float32).reshape(shape) / 3.0)
+    kv_loc = mx.kv.create("local")
+    kv_tpu = mx.kv.create("dist_tpu")
+    kv_loc.init(0, init)
+    kv_tpu.init(0, init)
+    kv_loc.set_optimizer(mx.optimizer.create(opt_name, **opt_kw))
+    kv_tpu.set_optimizer(mx.optimizer.create(opt_name, **opt_kw))
+    o1, o2 = mx.nd.zeros(shape), mx.nd.zeros(shape)
+    rs = np.random.RandomState(0)
+    for i in range(steps):
+        g = mx.nd.array(rs.randint(-3, 4, shape).astype(np.float32))
+        kv_loc.push(0, g)
+        kv_tpu.push(0, g)
+    kv_loc.pull(0, out=o1)
+    kv_tpu.pull(0, out=o2)
+    assert not np.allclose(o2.asnumpy(), init.asnumpy())
+    if atol:
+        np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), atol=atol,
+                                   rtol=0)
+    else:
+        np.testing.assert_array_equal(o1.asnumpy(), o2.asnumpy())
+
+
+def test_dist_tpu_sgd_momentum_parity():
+    _fused_vs_local("sgd", learning_rate=0.1, momentum=0.9, wd=1e-3)
+
+
+def test_dist_tpu_adam_parity():
+    _fused_vs_local("adam", learning_rate=0.05, atol=2e-6)
+
+
+def test_dist_tpu_rmsprop_parity():
+    _fused_vs_local("rmsprop", learning_rate=0.01, gamma1=0.95)
+
+
+def test_dist_tpu_lr_schedule_walks_host_side():
+    # schedules run through the same Optimizer bookkeeping as dist_sync:
+    # FactorScheduler decays on the shared num_update counter
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    _fused_vs_local("sgd", learning_rate=0.2, momentum=0.9,
+                    lr_scheduler=FactorScheduler(step=2, factor=0.5))
+
+
+def test_dist_tpu_optimizer_state_roundtrip(tmp_path):
+    shape = (3, 3)
+    kv = mx.kv.create("dist_tpu")
+    kv.init(0, mx.nd.ones(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(0, mx.nd.ones(shape))
+    f = str(tmp_path / "states")
+    kv.save_optimizer_states(f)
+
+    cur = mx.nd.zeros(shape)
+    kv.pull(0, out=cur)  # resume = restored weights + restored state
+    kv2 = mx.kv.create("dist_tpu")
+    kv2.init(0, cur)
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(f)
+    # second push from restored state matches continuing the original
+    kv.push(0, mx.nd.ones(shape) * 2.0)
+    kv2.push(0, mx.nd.ones(shape) * 2.0)
+    a, b = mx.nd.zeros(shape), mx.nd.zeros(shape)
+    kv.pull(0, out=a)
+    kv2.pull(0, out=b)
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
