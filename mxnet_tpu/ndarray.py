@@ -531,7 +531,11 @@ def _make_nd_fn(op_name):
         return invoke(op_name, pos, kwargs, out=out)
 
     fn.__name__ = op_name
-    fn.__doc__ = "Imperative op %r (TPU-native; see ops registry)." % op_name
+    from .ops.opdocs import op_doc
+
+    fn.__doc__ = "%s\n\n%s" % (
+        "Imperative op %r (TPU-native)." % op_name,
+        op_doc(op, aliases=[a for a, t in _ALIAS.items() if t == op.name]))
     return fn
 
 

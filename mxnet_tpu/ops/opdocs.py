@@ -1,0 +1,399 @@
+"""Operator documentation — the registry's ``describe()`` text.
+
+The reference attaches human descriptions to every op at registration
+(``NNVM_REGISTER_OP(...).describe(...)``; e.g. ``src/operator/
+tensor/elemwise_unary_op.cc``) and its Python frontend reflects them into
+docstrings (``python/mxnet/ndarray.py`` autogen docs).  Here the compute
+rules live in Python, so ops that need commentary carry a real docstring on
+the compute fn; the mechanical families (scalar arithmetic, broadcast
+binaries, unary math, samplers) get their text from this module instead of
+192 near-identical docstrings.
+
+:func:`describe` is the single lookup both frontends and the docs
+generator use; a CI gate (``tests/test_docs.py``) walks the registry and
+fails on any op that resolves to no description — a newly registered op
+must be documented to land.
+"""
+
+from __future__ import annotations
+
+# --- explicit descriptions (ops whose compute fn carries no docstring) ---
+
+OPDOCS = {
+    # -- NN layers -----------------------------------------------------
+    "Activation": "Element-wise activation: `act_type` selects relu, "
+        "sigmoid, tanh or softrelu (softplus). Lowers to one fused VPU "
+        "elementwise op.",
+    "BatchNorm": "Batch normalization over all axes but `axis` (default "
+        "the channel axis 1). Training mode normalizes with batch "
+        "statistics and updates the `moving_mean`/`moving_var` auxiliary "
+        "states by `momentum`; inference (or `use_global_stats`) uses the "
+        "moving statistics. `fix_gamma` pins gamma to 1 and zeroes its "
+        "gradient, matching the reference convention for conv stems.",
+    "BilinearSampler": "Sample `data` (NCHW) at the normalized "
+        "coordinates in `grid` ([-1,1], shape (N,2,Hout,Wout)) with "
+        "bilinear interpolation; out-of-range samples read zero-padding. "
+        "The sampling half of SpatialTransformer.",
+    "BlockGrad": "Identity in the forward pass; stops the gradient (the "
+        "backward pass sees zero cotangent through this node).",
+    "Cast": "Cast every element to `dtype`. On TPU, `float32 -> bfloat16` "
+        "casts mark matmul/conv inputs for MXU-rate execution.",
+    "Concat": "Join inputs along existing axis `dim`; all other "
+        "dimensions must match. Variable-arity (`num_args` inputs).",
+    "Convolution": "N-D convolution (1/2/3-D from `kernel` rank) with "
+        "`num_filter` output channels, `stride`/`dilate`/`pad`, grouped "
+        "when `num_group` > 1. NCHW/NCDHW layouts. Lowers to "
+        "`lax.conv_general_dilated`, which XLA tiles onto the MXU; the "
+        "cuDNN tuning attrs (`cudnn_*`, `workspace`) are accepted for "
+        "graph compatibility and ignored.",
+    "Crop": "Crop the spatial (last two) dims of the first input to "
+        "`h_w`, or to the reference shape of a second input symbol; "
+        "`offset` fixes the top-left corner, `center_crop` centers it.",
+    "Custom": "Invoke a user-registered CustomOp (`mx.operator."
+        "register`): forward/backward run as host callbacks with "
+        "`num_inputs`/`num_outputs` declared by the CustomOpProp. "
+        "The escape hatch for python-defined ops inside jitted graphs.",
+    "Deconvolution": "Transposed convolution (gradient of Convolution "
+        "w.r.t. its input) — upsamples by `stride`; `adj`/`target_shape` "
+        "disambiguate the output size. Lowers to "
+        "`lax.conv_transpose`-style dilated convolution on the MXU.",
+    "Dropout": "Randomly zero a fraction `p` of elements during training "
+        "and rescale the survivors by 1/(1-p); identity at inference. "
+        "Driven by the framework PRNG stream (`mx.random.seed`).",
+    "Embedding": "Look up integer indices in a (`input_dim`, "
+        "`output_dim`) weight table. The gradient scatters into the "
+        "table; under tensor parallelism the table row-shards over the "
+        "model axis.",
+    "Flatten": "Collapse all dimensions but the first into one: "
+        "(d0, d1, ..., dk) -> (d0, d1*...*dk).",
+    "FullyConnected": "Affine layer `Y = X W^T + b` with `num_hidden` "
+        "output features; `flatten` collapses trailing input dims first, "
+        "`no_bias` drops `b`. One MXU matmul; fp32 master weights cast "
+        "to the activation dtype at use.",
+    "GridGenerator": "Generate a sampling grid for BilinearSampler: "
+        "`affine` maps a 6-dof theta per sample to `target_shape` "
+        "coordinates; `warp` converts a dense flow field to coordinates.",
+    "IdentityAttachKLSparseReg": "Identity whose backward adds the "
+        "gradient of a KL sparseness penalty (`penalty` * KL(rho || "
+        "rho_hat)) on the sigmoid mean activation tracked in the "
+        "`moving_avg` aux (sparse-autoencoder regularizer).",
+    "InstanceNorm": "Normalize each sample over its spatial dims per "
+        "channel (contrast normalization), then scale/shift by "
+        "gamma/beta.",
+    "L2Normalization": "Scale elements so the L2 norm over the selected "
+        "scope is 1: whole `instance`, per-`channel`, or per-`spatial` "
+        "position.",
+    "LRN": "Local response normalization across `nsize` adjacent "
+        "channels (AlexNet-era): x / (knorm + alpha/n * sum x^2)^beta.",
+    "LeakyReLU": "Leaky/parametric ReLU family: `leaky` (fixed `slope`), "
+        "`elu`, `prelu` (learned slope), `rrelu` (random slope in "
+        "[`lower_bound`, `upper_bound`] during training).",
+    "LinearRegressionOutput": "L2 regression head: forward is identity "
+        "on `data`; backward emits `(data - label) * grad_scale` "
+        "directly (no head gradient needed), the reference loss-layer "
+        "contract.",
+    "LogisticRegressionOutput": "Sigmoid regression head: forward is "
+        "sigmoid(data); backward emits `(sigmoid(data) - label) * "
+        "grad_scale` directly.",
+    "MAERegressionOutput": "L1 regression head: forward is identity; "
+        "backward emits `sign(data - label) * grad_scale` directly.",
+    "MakeLoss": "Turn any symbol into a loss: forward passes `data` "
+        "through; backward seeds the gradient with `grad_scale` "
+        "(normalized by batch/valid count per `normalization`) instead "
+        "of an incoming cotangent.",
+    "Pad": "Pad the spatial dims by `pad_width` (edge pairs, "
+        "2*ndim values) in `constant` (with `constant_value`), `edge` "
+        "or `reflect` mode.",
+    "Pooling": "Spatial pooling over `kernel` windows: `max`, `avg` or "
+        "`sum`; `global_pool` reduces the whole map. "
+        "`pooling_convention` picks the reference's `valid` (floor) or "
+        "`full` (ceil) output-size rule. Lowers to "
+        "`lax.reduce_window`.",
+    "RNN": "Fused multi-layer RNN (`mode`: rnn_relu/rnn_tanh/lstm/gru) "
+        "over a (T, N, C) sequence with packed `parameters`, matching "
+        "the reference's cuDNN-RNN layout (gate order, bias pairs, "
+        "`bidirectional` concat). Optionally emits final states "
+        "(`state_outputs`); lowers to a `lax.scan` of MXU gate matmuls. "
+        "See also mx.rnn cells (LSTMCell/GRUCell/FusedRNNCell).",
+    "ROIPooling": "Max-pool each region of interest (batch_idx, x1, y1, "
+        "x2, y2 scaled by `spatial_scale`) to a fixed `pooled_size` "
+        "grid — the Fast-R-CNN head input.",
+    "Reshape": "Reshape preserving element order. `shape` supports the "
+        "reference's special codes: 0 copies an input dim, -1 infers, "
+        "-2 copies the remainder, -3 merges two dims, -4 splits a dim "
+        "(with `reverse` applying codes right-to-left).",
+    "SVMOutput": "Margin (hinge) classification head over class scores: "
+        "L1 hinge or squared (`use_linear=False`) hinge with margin and "
+        "`regularization_coefficient`; backward needs no head gradient.",
+    "SequenceLast": "Select the last valid time step of a (T, N, ...) "
+        "sequence — per-sample positions from `sequence_length` when "
+        "`use_sequence_length`.",
+    "SequenceMask": "Zero (or set to `value`) all time steps past each "
+        "sample's `sequence_length` in a (T, N, ...) sequence.",
+    "SequenceReverse": "Reverse the time axis of a (T, N, ...) sequence; "
+        "with `use_sequence_length`, reverse only each sample's valid "
+        "prefix in place.",
+    "SliceChannel": "Split along `axis` into `num_outputs` equal parts "
+        "(`squeeze_axis` drops the now-size-1 axis). The multi-output "
+        "inverse of Concat.",
+    "SoftmaxActivation": "Softmax as a plain activation (no loss "
+        "semantics): per-`instance` over the trailing axis, or per "
+        "spatial position over channels (`mode='channel'`).",
+    "SoftmaxOutput": "Softmax cross-entropy classification head: forward "
+        "is softmax probabilities; backward emits `(p - onehot(label))` "
+        "scaled by `grad_scale` and `normalization` directly — no head "
+        "gradient, the reference loss-layer contract. `multi_output` "
+        "treats dim 1 as classes with one label per remaining position; "
+        "`ignore_label` (+`use_ignore`) masks positions; `smooth_alpha` "
+        "label-smooths.",
+    "SpatialTransformer": "Spatial transformer network: GridGenerator on "
+        "the 6-dof `loc` predictions + BilinearSampler on `data`, "
+        "end-to-end differentiable.",
+    "SwapAxis": "Exchange dimensions `dim1` and `dim2`.",
+    "TorchModule": "Host-callback bridge to a torch module: the "
+        "AST-whitelisted `module` spec constructs the torch layer, "
+        "`num_params` weight slots ride as graph inputs, and backward "
+        "calls torch.autograd on the host. Training-capable interop "
+        "(plugin/torch parity).",
+    "UpSampling": "Upsample spatial dims by `scale`: `nearest` repeats "
+        "pixels; `bilinear` uses a (learnable) Deconvolution kernel "
+        "initialized to bilinear interpolation.",
+
+    # -- array creation ------------------------------------------------
+    "_arange": "Evenly spaced values in [start, stop) with `step`, each "
+        "value repeated `repeat` times.",
+    "_full": "A `shape` array filled with `value`.",
+    "_ones": "A `shape` array of ones.",
+    "_zeros": "A `shape` array of zeros.",
+    "ones_like": "An array of ones with the input's shape and dtype.",
+    "zeros_like": "An array of zeros with the input's shape and dtype.",
+    "one_hot": "Expand integer indices to one-hot vectors of length "
+        "`depth` (`on_value`/`off_value` fill the hit/miss slots).",
+
+    # -- basic tensor manipulation ------------------------------------
+    "_copy": "Identity copy of the input.",
+    "expand_dims": "Insert a new size-1 dimension at `axis`.",
+    "slice": "Slice `[begin, end)` per dimension (None leaves a "
+        "dimension unsliced).",
+    "slice_axis": "Slice `[begin, end)` along a single `axis` (None end "
+        "= to the end; negatives allowed).",
+    "take": "Gather slices of `a` along `axis` at integer `indices`; "
+        "`mode` clips or wraps out-of-range indices.",
+    "batch_take": "Per-row gather: `out[i] = a[i, indices[i]]`.",
+    "pick": "Per-position gather along `axis`: `out[i] = "
+        "data[i, index[i]]` (e.g. per-sample class probabilities).",
+    "where": "Element-wise select: `condition ? x : y`.",
+    "reverse": "Reverse the order of elements along `axis`.",
+    "tile": "Repeat the whole array `reps` times per dimension.",
+    "repeat": "Repeat each element `repeats` times along `axis` "
+        "(flattened when `axis` is None).",
+    "stack": "Join same-shape inputs along a NEW axis at `axis`.",
+    "transpose": "Permute dimensions by `axes` (reversed when empty).",
+    "broadcast_to": "Broadcast size-1 dimensions up to `shape` "
+        "(0 keeps the input dim).",
+    "broadcast_axis": "Broadcast the given size-1 `axis` (or axes) up "
+        "to `size`.",
+    "sort": "Sort values along `axis` (`is_ascend` picks direction).",
+    "argsort": "Indices that would sort `data` along `axis`, as floats "
+        "(reference dtype convention).",
+    "argmax": "Index of the maximum along `axis` (float output; "
+        "`keepdims` preserves the reduced axis).",
+    "argmin": "Index of the minimum along `axis` (float output).",
+    "argmax_channel": "Per-row argmax over the trailing axis of a 2-D "
+        "input — the reference's channel-argmax shortcut.",
+    "topk": "Top `k` along `axis`: returns indices (`ret_typ='indices'`),"
+        " values, both, or a 0/1 mask; `is_ascend` flips to bottom-k.",
+    "clip": "Clamp every element into [`a_min`, `a_max`].",
+
+    # -- matmul --------------------------------------------------------
+    "dot": "Matrix/tensor product contracting lhs's last axis with "
+        "rhs's first (`transpose_a`/`transpose_b` pre-transpose 2-D "
+        "operands). The MXU primitive: keep operands bf16 and large.",
+    "batch_dot": "Batched matrix product over matching leading batch "
+        "dims: `out[i] = lhs[i] @ rhs[i]`.",
+
+    # -- losses / misc -------------------------------------------------
+    "softmax": "Softmax over `axis` with `temperature` scaling.",
+    "log_softmax": "Numerically stable log(softmax) over `axis`.",
+    "softmax_cross_entropy": "Scalar summed cross-entropy between row "
+        "logits and integer labels — the imperative loss helper.",
+    "norm": "Scalar L2 (Frobenius) norm of the whole array.",
+    "add_n": "Element-wise sum of N same-shape inputs in one fused op.",
+    "negative": "Element-wise negation.",
+    "logical_not": "Element-wise logical NOT (1.0 where x == 0).",
+    "abs": "Element-wise absolute value.",
+    "sign": "Element-wise sign (-1, 0, +1).",
+
+    # -- fused optimizer updates --------------------------------------
+    "sgd_update": "Fused SGD step: `w -= lr * (rescale*clip(grad) + "
+        "wd*w)`. All `*_update` ops apply in one kernel on-device — the "
+        "TPU form of the reference's two-operand mshadow updates — and "
+        "drive mx.optimizer, KVStore updaters and ShardedTrainer alike.",
+    "sgd_mom_update": "Fused SGD-with-momentum step: `m = momentum*m - "
+        "lr*(rescale*clip(grad) + wd*w); w += m`. Returns (weight, mom).",
+    "adam_update": "Fused Adam step with bias correction `t`: updates "
+        "first/second moment states and the weight in one kernel. "
+        "Returns (weight, mean, var).",
+    "rmsprop_update": "Fused RMSProp (Tieleman-Hinton) step: running "
+        "squared-gradient state `n`, step size lr/sqrt(n+eps).",
+    "rmspropalex_update": "Fused RMSPropAlex (Graves) step: states n, g "
+        "and momentum delta; the non-centered variant's stabler cousin.",
+
+    # -- quantization --------------------------------------------------
+    "_contrib_dequantize": "Map int8/uint8 values back to float with the "
+        "affine range [`min_range`, `max_range`] calibrated at quantize "
+        "time.",
+}
+
+# -- mechanical families (generated text, one source of truth each) ----
+
+_UNARY = {
+    "arccos": "inverse cosine", "arccosh": "inverse hyperbolic cosine",
+    "arcsin": "inverse sine", "arcsinh": "inverse hyperbolic sine",
+    "arctan": "inverse tangent", "arctanh": "inverse hyperbolic tangent",
+    "cos": "cosine", "cosh": "hyperbolic cosine",
+    "sin": "sine (radians)", "sinh": "hyperbolic sine",
+    "tan": "tangent", "tanh": "hyperbolic tangent",
+    "exp": "exponential", "expm1": "exp(x) - 1 (accurate near zero)",
+    "log": "natural logarithm", "log10": "base-10 logarithm",
+    "log2": "base-2 logarithm",
+    "log1p": "log(1 + x) (accurate near zero)",
+    "sqrt": "square root", "rsqrt": "reciprocal square root",
+    "square": "square", "reciprocal": "reciprocal (1/x)",
+    "ceil": "ceiling", "floor": "floor (round down)",
+    "round": "round half away from zero",
+    "rint": "round to nearest even integer",
+    "fix": "truncation toward zero",
+    "gamma": "gamma function", "gammaln": "log of |gamma(x)|",
+    "degrees": "radians-to-degrees conversion",
+    "radians": "degrees-to-radians conversion",
+    "relu": "rectified linear unit max(x, 0)",
+    "sigmoid": "logistic sigmoid 1/(1+exp(-x))",
+    "softsign": "softsign x/(1+|x|)",
+}
+for _n, _d in _UNARY.items():
+    OPDOCS.setdefault(_n, "Element-wise %s." % _d)
+
+_BINARY = {
+    "add": "addition", "plus": "addition", "sub": "subtraction",
+    "minus": "subtraction", "mul": "multiplication", "div": "division",
+    "mod": "modulo", "power": "power (lhs ** rhs)",
+    "maximum": "maximum", "minimum": "minimum",
+    "hypot": "hypotenuse sqrt(lhs^2 + rhs^2)",
+    "equal": "equality comparison (1.0/0.0)",
+    "not_equal": "inequality comparison (1.0/0.0)",
+    "greater": "greater-than comparison (1.0/0.0)",
+    "greater_equal": "greater-or-equal comparison (1.0/0.0)",
+    "lesser": "less-than comparison (1.0/0.0)",
+    "lesser_equal": "less-or-equal comparison (1.0/0.0)",
+}
+for _n, _d in _BINARY.items():
+    OPDOCS.setdefault("elemwise_%s" % _n,
+                      "Element-wise %s of two same-shape arrays." % _d)
+    OPDOCS.setdefault("broadcast_%s" % _n,
+                      "Element-wise %s with numpy-style broadcasting of "
+                      "size-1 dimensions." % _d)
+    OPDOCS.setdefault("_%s" % _n,
+                      "Element-wise %s of two same-shape arrays." % _d)
+    OPDOCS.setdefault("_%s_scalar" % _n,
+                      "Element-wise %s with a scalar operand." % _d)
+for _n, _d in (("rdiv", "division"), ("rminus", "subtraction"),
+               ("rmod", "modulo"), ("rpower", "power")):
+    OPDOCS.setdefault("_%s_scalar" % _n,
+                      "Element-wise reversed %s with a scalar operand "
+                      "(scalar op x)." % _d)
+
+_DISTS = {
+    "uniform": "uniform distribution on [low, high)",
+    "normal": "normal (Gaussian) distribution with mean `loc` and "
+              "standard deviation `scale`",
+    "gamma": "gamma distribution with shape `alpha` and scale `beta`",
+    "exponential": "exponential distribution with rate `lam`",
+    "poisson": "Poisson distribution with rate `lam` (float output)",
+    "negative_binomial": "negative binomial distribution with `k` "
+                         "failures and success probability `p`",
+    "generalized_negative_binomial": "generalized negative binomial "
+                                     "distribution with mean `mu` and "
+                                     "dispersion `alpha`",
+}
+_SAMPLE_SHORT = {"negative_binomial": "negbinomial",
+                 "generalized_negative_binomial": "gennegbinomial"}
+for _n, _d in _DISTS.items():
+    OPDOCS.setdefault(
+        "_random_%s" % _n,
+        "Draw a `shape` array from the %s. Seeded by the framework PRNG "
+        "stream (`mx.random.seed`)." % _d)
+    OPDOCS.setdefault(
+        "_sample_%s" % _SAMPLE_SHORT.get(_n, _n),
+        "Draw `shape` samples per row of per-distribution parameter "
+        "arrays from the %s (output shape = param shape + `shape`)." % _d)
+
+_REDUCE = {
+    "sum": "sum", "mean": "arithmetic mean", "prod": "product",
+    "max": "maximum", "min": "minimum",
+    "nansum": "sum ignoring NaNs", "nanprod": "product ignoring NaNs",
+}
+for _n, _d in _REDUCE.items():
+    OPDOCS.setdefault(
+        _n, "Reduce by %s over `axis` (all axes when unset; `exclude` "
+        "inverts the axis set; `keepdims` keeps reduced axes as size "
+        "1)." % _d)
+
+
+def describe(op):
+    """The human description for a registered op: the compute fn's
+    docstring when it has one, else this module's entry.  Raises KeyError
+    for an undocumented op — the CI gate turns that into a failing test."""
+    doc = (op.fn.__doc__ or "").strip()
+    if doc:
+        return doc
+    return OPDOCS[op.name]
+
+
+def op_doc(op, aliases=()):
+    """Full reflected docstring for a frontend op function: description,
+    tensor inputs, auxiliary states, outputs, and the attribute table from
+    the ParamSpecs — the reference's registry-reflected docstring pattern
+    (``python/mxnet/ndarray.py`` autogen docs)."""
+    try:
+        desc = describe(op)
+    except KeyError:
+        desc = "(undocumented op)"
+    lines = [desc, ""]
+    if op.variable_args:
+        lines.append("Inputs: variable arity (`num_args` tensors).")
+    elif op.arg_names:
+        lines.append("Inputs: %s." % ", ".join(
+            "`%s`" % a for a in op.arg_names))
+        if op.input_names_fn is not None:
+            lines.append("(the effective input list depends on attrs; "
+                         "omitted inputs auto-create Variables)")
+    else:
+        lines.append("Inputs: none (creation op).")
+    if op.aux_names:
+        lines.append("Auxiliary states: %s (mutated by training "
+                     "forward)." % ", ".join(
+                         "`%s`" % a for a in op.aux_names))
+    if callable(op.num_outputs):
+        lines.append("Outputs: attr-dependent count.")
+    elif op.num_outputs != 1:
+        names = (", ".join(op.output_names) if op.output_names
+                 else str(op.num_outputs))
+        lines.append("Outputs: %s." % names)
+    if op.params:
+        lines.append("")
+        lines.append("Attributes:")
+        for name in sorted(op.params):
+            spec = op.params[name]
+            bits = [spec.type]
+            if spec.required:
+                bits.append("required")
+            else:
+                bits.append("default=%r" % (spec.default,))
+            if spec.enum:
+                bits.append("one of %s" % (tuple(spec.enum),))
+            lines.append("    %s : %s" % (name, ", ".join(bits)))
+    if aliases:
+        lines.append("")
+        lines.append("Aliases: %s." % ", ".join(sorted(aliases)))
+    return "\n".join(lines)

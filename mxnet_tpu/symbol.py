@@ -490,7 +490,11 @@ def _make_sym_fn(op_name):
         return _create(op_name, sym_inputs, kwargs, name=name, attr=attr)
 
     fn.__name__ = op_name
-    fn.__doc__ = "Symbolic op %r (TPU-native; see ops registry)." % op_name
+    from .ops.opdocs import op_doc
+
+    fn.__doc__ = "%s\n\n%s" % (
+        "Symbolic op %r (TPU-native)." % op_name,
+        op_doc(op, aliases=[a for a, t in _ALIAS.items() if t == op.name]))
     return fn
 
 

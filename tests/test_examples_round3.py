@@ -91,8 +91,11 @@ def test_neural_style_example():
 
 def test_bi_lstm_sort_example():
     """Bidirectional LSTM emits the sorted sequence (per-position order
-    statistics need whole-sequence context)."""
-    stats = _run_example("bi_lstm_sort.py", "epochs=15, log=False")
+    statistics need whole-sequence context).  8 epochs keeps the gate at
+    ~200 s — under a quarter of the subprocess limit even on a busy box
+    (15 epochs ran ~700 s against the 900 s limit: a latent timeout) —
+    while clearing the accuracy bar with margin (0.949 measured)."""
+    stats = _run_example("bi_lstm_sort.py", "epochs=8, log=False")
     assert stats["elem_acc"] > 0.85, stats
 
 
