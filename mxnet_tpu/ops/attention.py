@@ -158,9 +158,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
 
 
-def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=1024, block_k=2048,
                       interpret=False, return_lse=False):
-    """Pallas forward on [B, H, T, D].  T is padded to block multiples."""
+    """Pallas forward on [B, H, T, D].  T is padded to block multiples.
+
+    Default blocks re-tuned r5 on v5e (tools/attn_bench.py sweep at
+    b8h16d64): (1024, 2048) beats the old (512, 1024) by 4-14% across
+    T=1024..8192 (e.g. 16.6 -> 14.9 ms at T4096); the backward kernels
+    keep (1024, 1024) — their dk/dv pass at block_k=2048 exceeds what
+    the compiler will schedule."""
     import jax.experimental.pallas as pl
 
     from jax.experimental.pallas import tpu as pltpu
@@ -240,10 +246,12 @@ def _flash_dispatch(q, k, v, causal, sm_scale, interpret):
     if interpret:
         return _flash_fwd_pallas(q, k, v, causal, sm_scale,
                                  interpret=platform != "tpu")
-    # short sequences: one fused XLA kernel beats the blocked Pallas loop
-    # (measured crossover ~2-4K on v5e); long sequences need the O(T)
-    # streaming kernel — exact attention OOMs past 8K
-    if platform == "tpu" and (q.shape[2] > 2048 or k.shape[2] > 2048):
+    # crossover re-measured r5 (tools/attn_bench.py, docs/PERF.md): the
+    # Pallas kernel wins from T>=1024 in the primal too (9.4 vs 12.5 ms
+    # at T2048 b8h16d64; ~tie at 512), matching the VJP-forward's
+    # threshold — and the blocked kernel is the only option past 8K
+    # where exact attention OOMs
+    if platform == "tpu" and (q.shape[2] >= 1024 or k.shape[2] >= 1024):
         return _flash_fwd_pallas(q, k, v, causal, sm_scale)
     return _attention_fwd_ref(q, k, v, causal, sm_scale)
 
@@ -258,9 +266,9 @@ def _flash_fwd_vjp(q, k, v, causal, sm_scale, interpret):
                                      interpret=platform != "tpu",
                                      return_lse=True)
     elif platform == "tpu" and (q.shape[2] >= 1024 or k.shape[2] >= 1024):
-        # lower crossover than the primal's 2048: the Pallas bwd kernels
-        # consume the kernel's lse directly, and skipping the [T, T]
-        # XLA softmax materialization pays off earlier when training
+        # same T>=1024 crossover as the primal (re-measured r5): the
+        # Pallas bwd kernels consume the kernel's lse directly, and
+        # skipping the [T, T] XLA softmax materialization pays off
         # (measured on the transformer-LM bench, docs/PERF.md)
         out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
                                      return_lse=True)

@@ -350,6 +350,39 @@ def describe(op):
     return OPDOCS[op.name]
 
 
+def op_io_summary(op):
+    """Structured input/aux/output description shared by every doc
+    renderer (frontend docstrings AND the generated ops.md), so the two
+    surfaces cannot drift: returns a dict with
+
+    * ``inputs``  — list of input names, or the strings
+      ``"<variable>"`` / ``"<none>"`` for variable-arity / creation ops
+    * ``inputs_note`` — extra caveat when the effective list is
+      attr-dependent (or None)
+    * ``aux``     — auxiliary state names
+    * ``outputs`` — list of output names, an int count, the string
+      ``"<attr-dependent>"``, or None for the common single output
+    """
+    if op.variable_args:
+        inputs, note = "<variable>", None
+    elif op.arg_names:
+        inputs = list(op.arg_names)
+        note = ("the effective input list depends on attrs; omitted "
+                "inputs auto-create Variables"
+                if op.input_names_fn is not None else None)
+    else:
+        inputs, note = "<none>", None
+    if callable(op.num_outputs):
+        outputs = "<attr-dependent>"
+    elif op.num_outputs != 1:
+        outputs = list(op.output_names) if op.output_names \
+            else op.num_outputs
+    else:
+        outputs = None
+    return {"inputs": inputs, "inputs_note": note,
+            "aux": list(op.aux_names), "outputs": outputs}
+
+
 def op_doc(op, aliases=()):
     """Full reflected docstring for a frontend op function: description,
     tensor inputs, auxiliary states, outputs, and the attribute table from
@@ -360,25 +393,26 @@ def op_doc(op, aliases=()):
     except KeyError:
         desc = "(undocumented op)"
     lines = [desc, ""]
-    if op.variable_args:
+    io = op_io_summary(op)
+    if io["inputs"] == "<variable>":
         lines.append("Inputs: variable arity (`num_args` tensors).")
-    elif op.arg_names:
-        lines.append("Inputs: %s." % ", ".join(
-            "`%s`" % a for a in op.arg_names))
-        if op.input_names_fn is not None:
-            lines.append("(the effective input list depends on attrs; "
-                         "omitted inputs auto-create Variables)")
-    else:
+    elif io["inputs"] == "<none>":
         lines.append("Inputs: none (creation op).")
-    if op.aux_names:
+    else:
+        lines.append("Inputs: %s." % ", ".join(
+            "`%s`" % a for a in io["inputs"]))
+        if io["inputs_note"]:
+            lines.append("(%s)" % io["inputs_note"])
+    if io["aux"]:
         lines.append("Auxiliary states: %s (mutated by training "
                      "forward)." % ", ".join(
-                         "`%s`" % a for a in op.aux_names))
-    if callable(op.num_outputs):
+                         "`%s`" % a for a in io["aux"]))
+    if io["outputs"] == "<attr-dependent>":
         lines.append("Outputs: attr-dependent count.")
-    elif op.num_outputs != 1:
-        names = (", ".join(op.output_names) if op.output_names
-                 else str(op.num_outputs))
+    elif io["outputs"] is not None:
+        names = (", ".join(io["outputs"])
+                 if isinstance(io["outputs"], list)
+                 else str(io["outputs"]))
         lines.append("Outputs: %s." % names)
     if op.params:
         lines.append("")

@@ -61,7 +61,8 @@ def test_generated_docs_in_sync():
 
 def test_ops_md_covers_registry():
     """The checked-in ops.md mentions every op and every alias."""
-    text = open(os.path.join(_REPO, "docs", "api", "ops.md")).read()
+    text = open(os.path.join(_REPO, "docs", "api", "ops.md"),
+                encoding="utf-8").read()
     missing = [n for n in OP_REGISTRY if "### `%s`" % n not in text]
     assert not missing, missing
     missing_alias = [a for a in _ALIAS if "`%s`" % a not in text]
@@ -72,9 +73,12 @@ def test_how_tos_present():
     """The load-bearing how_tos exist and document their subject (the
     reference's docs/how_to tree: bucketing, multi-device, env vars)."""
     docs = os.path.join(_REPO, "docs")
-    buck = open(os.path.join(docs, "how_to", "bucketing.md")).read()
+    buck = open(os.path.join(docs, "how_to", "bucketing.md"),
+                encoding="utf-8").read()
     assert "sym_gen" in buck and "BucketingModule" in buck
-    multi = open(os.path.join(docs, "how_to", "multi_devices.md")).read()
+    multi = open(os.path.join(docs, "how_to", "multi_devices.md"),
+                 encoding="utf-8").read()
     assert "context=" in multi and "dist_sync" in multi
-    env = open(os.path.join(docs, "env_vars.md")).read()
+    env = open(os.path.join(docs, "env_vars.md"),
+               encoding="utf-8").read()
     assert "MXTPU_ENGINE_TYPE" in env

@@ -1,0 +1,104 @@
+"""Head-to-head: this repo's flash-attention kernels vs jax's reference
+TPU kernel (``jax.experimental.pallas.ops.tpu.flash_attention``).
+
+VERDICT r4 #3: bound our kernels against the best-known TPU kernel at the
+bench config (d1024: H16 D64, T2048) and T4096, fwd AND fwd+bwd, and
+adopt whichever wins.  Results land in docs/PERF.md.
+
+Run on the chip:  python tools/attn_bench.py [--steps 30]
+Each timing is best-of-3 measured means (tunnel dispatch jitter; see
+bench.py's sync caveat — block_until_ready is unreliable over the
+tunnel, so we materialize one element).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(x):
+    return np.asarray(jnp.ravel(x)[0])
+
+
+def _time(fn, args, steps, warmup=3):
+    for _ in range(warmup):
+        _sync(fn(*args))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        _sync(out)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
+def attn_flops(B, H, T, D, causal=True):
+    """FLOPs of one attention forward: QK^T + PV, 2*2*B*H*T*T*D, halved
+    under causal masking."""
+    f = 4.0 * B * H * T * T * D
+    return f / 2 if causal else f
+
+
+def bench_config(B, H, T, D, steps, dtype=jnp.bfloat16):
+    from mxnet_tpu.ops import attention as ours
+    from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    v = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    sm = 1.0 / np.sqrt(D)
+    fwd_fl = attn_flops(B, H, T, D)
+    bwd_fl = fwd_fl * 3.5  # fwd (1x) + bwd (2.5x)
+
+    cands = {
+        "ours": lambda q, k, v: ours.flash_attention(
+            q, k, v, causal=True, sm_scale=sm),
+        "jax_ref": lambda q, k, v: jfa.flash_attention(
+            q, k, v, causal=True, sm_scale=sm),
+    }
+    rows = []
+    for name, fn in cands.items():
+        jit_f = jax.jit(fn)
+        t_f = _time(jit_f, (q, k, v), steps)
+
+        def loss(q, k, v, fn=fn):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+        jit_g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        t_g = _time(lambda *a: jit_g(*a)[0], (q, k, v), steps)
+        rows.append({
+            "name": name, "B": B, "H": H, "T": T, "D": D,
+            "fwd_ms": round(t_f * 1e3, 3),
+            "fwd_tflops": round(fwd_fl / t_f / 1e12, 1),
+            "fwdbwd_ms": round(t_g * 1e3, 3),
+            "fwdbwd_tflops": round(bwd_fl / t_g / 1e12, 1),
+        })
+        print(json.dumps(rows[-1]))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    assert jax.default_backend() == "tpu", "bench the chip, not the host"
+    all_rows = []
+    for T in (2048, 4096):
+        all_rows += bench_config(args.batch, 16, T, 64, args.steps)
+    print(json.dumps({"rows": all_rows}))
+
+
+if __name__ == "__main__":
+    main()
