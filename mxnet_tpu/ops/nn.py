@@ -84,6 +84,108 @@ def _conv_dnums(nd):
     return (lhs, rhs, lhs)
 
 
+def _conv1x1_pick_bm(M):
+    for bm in (4096, 2048, 1024, 512, 256, 128):
+        if M % bm == 0:
+            return bm
+    return None
+
+
+def _conv1x1_dgrad_pallas(dy2, wio, out_dtype, bm):
+    """dx = dy @ w as one Pallas MXU pass over row blocks: dy2 [M, O]
+    times wio [O, I] -> [M, I].  The r5 roofline probe
+    (tools/bottleneck_probe.py) measured XLA's 1x1 transposed-conv dgrad
+    at ~2-3x the stream floor at ResNet bottleneck shapes; this matmul
+    formulation is the experiment's positive arm."""
+    import jax.experimental.pallas as pl
+
+    M, O = dy2.shape
+    I = wio.shape[1]
+
+    def kern(dy_ref, w_ref, o_ref):
+        acc = jnp.dot(dy_ref[...], w_ref[...],
+                      preferred_element_type=jnp.float32)
+        o_ref[...] = acc.astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kern, grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, O), lambda i: (i, 0)),
+                  pl.BlockSpec((O, I), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bm, I), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, I), out_dtype),
+        interpret=jax.default_backend() != "tpu")(dy2, wio)
+
+
+@jax.custom_vjp
+def _conv1x1_nhwc(x, w):
+    """1x1 stride-1 NHWC conv with a hand-rolled backward (experiment
+    surface for the ResNet roofline attack; MXTPU_CONV1X1 selects the
+    backward implementation: 'dot' = dot_general dgrad+wgrad,
+    'pallas' = Pallas dgrad + dot wgrad; forward stays XLA's conv,
+    which already fuses its BN/ReLU/residual epilogue consumers)."""
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID",
+        dimension_numbers=("NHWC", "OHWI", "NHWC"))
+
+
+def _conv1x1_fwd(x, w):
+    return _conv1x1_nhwc(x, w), (x, w)
+
+
+def _conv1x1_bwd(res, dy):
+    import os
+
+    x, w = res
+    mode = os.environ.get("MXTPU_CONV1X1", "dot")
+    if mode not in ("dot", "pallas"):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "MXTPU_CONV1X1=%r is not a backward mode (valid: 'default' "
+            "or unset = XLA conv, 'dot', 'pallas'); refusing to guess — "
+            "a silent fallback would mislabel a benchmark" % mode)
+    B, H, W_, I = x.shape
+    O = w.shape[0]
+    M = B * H * W_
+    wio = w.reshape(O, I)  # OHWI, 1x1 kernel
+    # wgrad: dw[o, i] = sum_m dy[m, o] * x[m, i] — a single MXU matmul
+    # contracting the whole batch*spatial axis (the transposed-conv
+    # formulation XLA uses pays layout copies instead)
+    dw = jax.lax.dot_general(
+        dy.reshape(M, O), x.reshape(M, I),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    bm = _conv1x1_pick_bm(M)
+    if mode == "pallas" and bm is not None:
+        dx2 = _conv1x1_dgrad_pallas(dy.reshape(M, O), wio, x.dtype, bm)
+        dx = dx2.reshape(B, H, W_, I)
+    else:
+        dx = jax.lax.dot_general(
+            dy, wio, (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+    return dx, dw.reshape(w.shape)
+
+
+_conv1x1_nhwc.defvjp(_conv1x1_fwd, _conv1x1_bwd)
+
+
+def _conv1x1_eligible(attrs, out_dtype, nd, stride, dilate, pad, nhwc):
+    """NOTE: the env var is read at TRACE time — a jitted step keeps the
+    mode it was traced with regardless of later env changes (jit caches
+    don't key on env).  Benchmark each mode in a fresh process, as
+    docs/PERF.md's round-5 table did."""
+    import os
+
+    if os.environ.get("MXTPU_CONV1X1", "") in ("", "default"):
+        return False
+    # out_dtype is the ORIGINAL dtype (fp16 is cast to f32 before this
+    # runs; gate on what the user ran, not the upcast)
+    return (nhwc and nd == 2 and tuple(attrs["kernel"]) == (1, 1)
+            and tuple(stride) == (1, 1) and tuple(dilate) == (1, 1)
+            and tuple(pad) == (0, 0) and attrs["num_group"] == 1
+            and out_dtype in (jnp.bfloat16, jnp.float32))
+
+
 @register(
     "Convolution",
     aliases=["Convolution_v1"],  # legacy pre-NNVM registration, same math
@@ -117,18 +219,21 @@ def _convolution(attrs, data, weight, bias=None):
     if out_dtype == jnp.float16:
         data = data.astype(jnp.float32)
         weight = weight.astype(jnp.float32)
-    out = jax.lax.conv_general_dilated(
-        data,
-        weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        # NHWC: channels-last activations + OHWI weights — the TPU-preferred
-        # layout (no relayout copies around each conv)
-        dimension_numbers=("NHWC", "OHWI", "NHWC") if nhwc
-        else _conv_dnums(nd),
-        feature_group_count=attrs["num_group"],
-    ).astype(out_dtype)
+    if _conv1x1_eligible(attrs, out_dtype, nd, stride, dilate, pad, nhwc):
+        out = _conv1x1_nhwc(data, weight).astype(out_dtype)
+    else:
+        out = jax.lax.conv_general_dilated(
+            data,
+            weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            # NHWC: channels-last activations + OHWI weights — the
+            # TPU-preferred layout (no relayout copies around each conv)
+            dimension_numbers=("NHWC", "OHWI", "NHWC") if nhwc
+            else _conv_dnums(nd),
+            feature_group_count=attrs["num_group"],
+        ).astype(out_dtype)
     if not attrs["no_bias"]:
         bias = bias.astype(out_dtype)
         out = out + (bias if nhwc else bias.reshape((1, -1) + (1,) * nd))

@@ -781,3 +781,46 @@ def test_fused_lm_head_symbol_trains():
             - 0.5 * ex.grad_dict["pred_weight"].asnumpy())
     assert loss.shape == (T,)
     assert loss.mean() < first, (loss.mean(), first)
+
+
+def test_conv1x1_backward_modes_parity(monkeypatch):
+    """The MXTPU_CONV1X1 experiment surface (docs/PERF.md round-5
+    measured-negative section): every backward mode must produce the
+    default XLA conv's gradients. Forward is byte-identical (same XLA
+    conv in all modes); dgrad exactly, wgrad to accumulation order."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op("Convolution")
+    attrs = op.parse_attrs({"kernel": (1, 1), "num_filter": 48,
+                            "no_bias": True, "layout": "NHWC"})
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 8, 8, 32), jnp.float32)
+    w = jnp.asarray(rs.randn(48, 1, 1, 32) * 0.1, jnp.float32)
+
+    def f(x, w):
+        return op.apply(attrs, [x, w])[0][0]
+
+    monkeypatch.setenv("MXTPU_CONV1X1", "")
+    y0, vjp0 = jax.vjp(f, x, w)
+    dy = jnp.asarray(rs.randn(*y0.shape), jnp.float32)
+    dx0, dw0 = vjp0(dy)
+    for mode in ("dot", "pallas"):
+        monkeypatch.setenv("MXTPU_CONV1X1", mode)
+        y1, vjp1 = jax.vjp(f, x, w)
+        dx1, dw1 = vjp1(dy)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y0),
+                                      err_msg=mode)
+        np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx0),
+                                   rtol=1e-6, atol=1e-6, err_msg=mode)
+        np.testing.assert_allclose(np.asarray(dw1), np.asarray(dw0),
+                                   rtol=1e-5, atol=1e-5, err_msg=mode)
+    # ineligible shapes (stride 2) must fall back to the default conv
+    monkeypatch.setenv("MXTPU_CONV1X1", "pallas")
+    attrs2 = op.parse_attrs({"kernel": (1, 1), "num_filter": 48,
+                             "stride": (2, 2), "no_bias": True,
+                             "layout": "NHWC"})
+    out = op.apply(attrs2, [x, w])[0][0]
+    assert out.shape == (2, 4, 4, 48)
