@@ -61,11 +61,18 @@ def transformer_main():
     # BENCH_REMAT=block enables per-block __remat__ checkpoint regions
     # (docs/PERF.md "Per-block rematerialization")
     remat = os.environ.get("BENCH_REMAT", "none")
+    # BENCH_FFN=moe swaps dense FFNs for MoELayer (BENCH_EXPERTS experts,
+    # top-BENCH_TOPK routing) — the single-chip MoE row: experts fold to
+    # one device but routing/capacity/dispatch execute for real
+    ffn = os.environ.get("BENCH_FFN", "dense")
+    n_experts = int(os.environ.get("BENCH_EXPERTS", "8"))
+    moe_top_k = int(os.environ.get("BENCH_TOPK", "1"))
     sym = transformer.get_symbol(
         num_classes=vocab, seq_len=seq, num_embed=d_model,
         num_heads=heads, num_layers=layers, dtype="bfloat16" if on_tpu
         else "float32", head=head, remat=remat,
-        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "4096")))
+        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "4096")),
+        ffn=ffn, num_experts=n_experts, moe_top_k=moe_top_k)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
     # BENCH_OPT=adam benches the sharded-Adam path (2 extra state tensors
     # per param + bias correction); default stays sgd+momentum
@@ -98,8 +105,16 @@ def transformer_main():
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     # PaLM-appendix accounting: train FLOPs/token = 6N + 12*L*T*d_model
-    # (the attention quadratic term), N = parameter count
-    flops_per_token = 6.0 * n_params + 12.0 * layers * seq * d_model
+    # (the attention quadratic term), N = parameter count.  MoE: a token
+    # runs top_k experts, not all BENCH_EXPERTS — count ACTIVE params
+    # (total minus the unvisited experts' FFN weights) or the "MFU"
+    # overcounts by ~E/top_k on the FFN share
+    n_active = n_params
+    if ffn == "moe":
+        # MoELayer hidden_size is wired to 4*num_embed in get_symbol
+        per_expert = 2 * d_model * (4 * d_model)
+        n_active -= layers * (n_experts - moe_top_k) * per_expert
+    flops_per_token = 6.0 * n_active + 12.0 * layers * seq * d_model
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                 PEAK_TFLOPS_V5E)) * 1e12
     mfu = tokens_s * flops_per_token / peak
@@ -109,8 +124,11 @@ def transformer_main():
         "value": round(tokens_s, 1), "unit": "tokens/s",
         "vs_baseline": 0.0,  # the 2017 reference has no transformer
         "mfu": round(mfu, 4), "n_params": n_params,
+        **({"n_params_active": n_active} if ffn == "moe" else {}),
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
-                   "layers": layers, "head": head},
+                   "layers": layers, "head": head, "ffn": ffn,
+                   **({"experts": n_experts, "top_k": moe_top_k}
+                      if ffn == "moe" else {})},
     }))
 
 

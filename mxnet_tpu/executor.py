@@ -448,19 +448,23 @@ class Executor:
                      shared_exec=None, shapes=None):
         shapes = shapes or {}
         type_dict = type_dict or {}
-        arg_shapes, out_shapes, aux_shapes, _, _ = _infer(symbol, shapes, type_dict)
+        (arg_shapes, out_shapes, aux_shapes,
+         arg_types, aux_types) = _infer(symbol, shapes, type_dict)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if any(s is None for s in arg_shapes):
             missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
             raise MXNetError("simple_bind could not infer shapes for %s" % missing)
+        # allocate at the INFERRED dtypes (type_dict already won inside
+        # _infer; __dtype__ variable hints — e.g. int8 quantized weights —
+        # must not be clobbered back to float32 here)
         arg_dict = {
-            n: nd.zeros(s, ctx, dtype=type_dict.get(n, "float32"))
-            for n, s in zip(arg_names, arg_shapes)
+            n: nd.zeros(s, ctx, dtype=t or type_dict.get(n, "float32"))
+            for n, s, t in zip(arg_names, arg_shapes, arg_types)
         }
         aux_dict = {
-            n: nd.zeros(s, ctx, dtype=type_dict.get(n, "float32"))
-            for n, s in zip(aux_names, aux_shapes)
+            n: nd.zeros(s, ctx, dtype=t or type_dict.get(n, "float32"))
+            for n, s, t in zip(aux_names, aux_shapes, aux_types)
         }
         if isinstance(grad_req, str):
             req = {n: grad_req for n in arg_names}

@@ -18,7 +18,19 @@ from ..attribute import AttrScope
 def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
                num_layers=6, dropout=0.0, causal=True,
                context_parallel_axis="", dtype="float32", head="softmax",
-               ce_chunk=2048, remat="none", **kwargs):
+               ce_chunk=2048, remat="none", ffn="dense", num_experts=8,
+               moe_top_k=1, moe_aux_scale=0.01, **kwargs):
+    """``ffn='moe'`` swaps every block's dense FFN for a ``MoELayer``
+    (``num_experts`` experts of the same 4x hidden, top-``moe_top_k``
+    routing); the per-layer load-balancing losses sum into one
+    ``MakeLoss`` output scaled by ``moe_aux_scale``, grouped after the
+    LM head (ShardedTrainer sums all loss-op outputs).  On a mesh with
+    an ``expert`` axis the experts shard over it; on one chip the same
+    graph runs dense (routing + capacity + dispatch still execute —
+    the single-chip MoE bench row in BENCH_TABLE.md)."""
+    if ffn not in ("dense", "moe"):
+        raise ValueError("ffn must be 'dense' or 'moe', got %r" % (ffn,))
+    aux_losses = []
     data = sym.Variable("data")
     x = sym.Embedding(data=data, input_dim=num_classes, output_dim=num_embed,
                       name="embed")
@@ -47,11 +59,18 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
                 h = sym.Dropout(h, p=dropout, name="l%d_attndrop" % i)
             x = x + h
             h = sym.LayerNorm(x, name="l%d_ln2" % i)
-            h = sym.FullyConnected(h, num_hidden=4 * num_embed,
-                                   flatten=False, name="l%d_ffn1" % i)
-            h = sym.Activation(h, act_type="gelu", name="l%d_gelu" % i)
-            h = sym.FullyConnected(h, num_hidden=num_embed, flatten=False,
-                                   name="l%d_ffn2" % i)
+            if ffn == "moe":
+                m = sym.MoELayer(h, num_experts=num_experts,
+                                 hidden_size=4 * num_embed,
+                                 top_k=moe_top_k, name="l%d_moe" % i)
+                h = m[0]
+                aux_losses.append(m[1])
+            else:
+                h = sym.FullyConnected(h, num_hidden=4 * num_embed,
+                                       flatten=False, name="l%d_ffn1" % i)
+                h = sym.Activation(h, act_type="gelu", name="l%d_gelu" % i)
+                h = sym.FullyConnected(h, num_hidden=num_embed,
+                                       flatten=False, name="l%d_ffn2" % i)
             if dropout > 0:
                 h = sym.Dropout(h, p=dropout, name="l%d_ffndrop" % i)
             x = x + h
@@ -62,6 +81,16 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
     if head not in ("softmax", "fused_ce"):
         raise ValueError("head must be 'softmax' or 'fused_ce', got %r"
                          % (head,))
+    def with_aux(head_sym):
+        if not aux_losses:
+            return head_sym
+        total = aux_losses[0]
+        for a in aux_losses[1:]:
+            total = total + a
+        return sym.Group([head_sym,
+                          sym.MakeLoss(total * moe_aux_scale,
+                                       name="moe_aux")])
+
     if head == "fused_ce":
         # long-context head: chunked fused linear + softmax CE — never
         # materializes the [T, vocab] logits (O(chunk*V) live instead of
@@ -71,12 +100,12 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
         # (the softmax head's pred_bias has no fused counterpart).
         pred_w = sym.Variable("pred_weight",
                               shape=(num_classes, num_embed))
-        return sym._contrib_fused_lm_head(pred, pred_w, label, name="softmax",
-                                          chunk=ce_chunk)
+        return with_aux(sym._contrib_fused_lm_head(
+            pred, pred_w, label, name="softmax", chunk=ce_chunk))
     # vocab projection in the model dtype (the largest matmul in the
     # model — in bf16 it runs at full MXU rate with fp32 accumulation);
     # logits cast up AFTER, so softmax/loss run in fp32
     pred = sym.FullyConnected(pred, num_hidden=num_classes, name="pred")
     if dtype != "float32":
         pred = sym.Cast(pred, dtype="float32")
-    return sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+    return with_aux(sym.SoftmaxOutput(data=pred, label=label, name="softmax"))

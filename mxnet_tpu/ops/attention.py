@@ -759,6 +759,7 @@ def _default_mesh():
         "hidden_size": P("int", required=True),
         "capacity_factor": P("float", 2.0),
         "expert_axis": P("str", "expert"),
+        "top_k": P("int", 1),
     },
     mesh_aware=True,
 )
@@ -778,5 +779,6 @@ def _moe_layer(attrs, data, gate_weight, w1_weight, w2_weight):
     out, aux_loss = moe_ffn(params, data,
                             capacity_factor=attrs["capacity_factor"],
                             expert_axis=attrs["expert_axis"],
-                            mesh=get_default_mesh())
+                            mesh=get_default_mesh(),
+                            top_k=attrs["top_k"])
     return out, aux_loss[None]

@@ -520,7 +520,8 @@ def _dequantize(attrs, data, min_range, max_range):
     "_contrib_quantized_fully_connected",
     arg_names=["data", "weight", "min_data", "max_data", "min_weight",
                "max_weight"],
-    params={"num_hidden": P("int", 0, required=True)},
+    params={"num_hidden": P("int", 0, required=True),
+            "symmetric": P("bool", False)},
 )
 def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
                                min_weight, max_weight):
@@ -553,6 +554,13 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
     acc = jax.lax.dot_general(
         data, weight, (((data.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32).astype(jnp.float32)
+    if attrs.get("symmetric"):
+        # the caller PROMISES min = -max for both tensors (int8), so the
+        # zero-point terms are exactly zero; skipping their row sums
+        # matters because the ranges are traced values XLA cannot prove
+        # cancel (contrib.quantization sets this — its calibration is
+        # symmetric by construction)
+        return s_d * s_w * acc
     row_d = jnp.sum(data.astype(jnp.int32), axis=-1,
                     keepdims=True).astype(jnp.float32)
     row_w = jnp.sum(weight.astype(jnp.int32), axis=-1).astype(jnp.float32)
@@ -570,6 +578,8 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
         "num_filter": P("int", 0, required=True),
         "stride": P("shape", None),
         "pad": P("shape", None),
+        "layout": P("str", "NCHW", enum=["NCHW", "NHWC"]),
+        "symmetric": P("bool", False),
     },
 )
 def _quantized_conv(attrs, data, weight, min_data, max_data,
@@ -577,7 +587,9 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
     """Quantized 2-D Convolution on the MXU (beyond-parity; the compute
     twin of :func:`_quantized_fully_connected` for the conv zoo).
 
-    int8/uint8 NCHW data x OIHW weight accumulate int32 on the MXU.
+    int8/uint8 NCHW data x OIHW weight (or NHWC x OHWI with
+    ``layout='NHWC'`` — the TPU-preferred layout the fp conv also uses)
+    accumulate int32 on the MXU.
     Exact affine handling incl. PADDING: a padded slot is zero in
     q-space but ``b = lo - s*qmin`` in float space, so the zero-point
     cross terms must count only VALID window elements — three cheap
@@ -593,14 +605,15 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
     if weight.shape[0] != attrs["num_filter"]:
         raise ValueError("num_filter=%d but weight has %d output channels"
                          % (attrs["num_filter"], weight.shape[0]))
-    kh, kw = weight.shape[2:]
+    nhwc = attrs.get("layout") == "NHWC"
+    kh, kw = weight.shape[1:3] if nhwc else weight.shape[2:]
     if tuple(attrs["kernel"]) != (kh, kw):
         raise ValueError("kernel=%s but weight is %dx%d"
                          % (tuple(attrs["kernel"]), kh, kw))
     stride = tuple(attrs.get("stride") or (1, 1))
     ph, pw = tuple(attrs.get("pad") or (0, 0))
     padding = ((ph, ph), (pw, pw))
-    dn = ("NCHW", "OIHW", "NCHW")
+    dn = ("NHWC", "OHWI", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
 
     s_d, b_d = _qscale_bias(min_data, max_data, data.dtype)
     s_w, b_w = _qscale_bias(min_weight, max_weight, weight.dtype)
@@ -616,16 +629,28 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
             x, w, stride, padding, dimension_numbers=dn,
             preferred_element_type=jnp.int32).astype(jnp.float32)
 
-    C = data.shape[1]
-    acc = conv(data, weight)                                # (N,O,H,W)
-    ones_k = jnp.ones((1, C, kh, kw), data.dtype)
-    win_d = conv(data, ones_k)                              # (N,1,H,W)
-    ones_x = jnp.ones((1, C) + data.shape[2:], weight.dtype)
-    win_w = conv(ones_x, weight)                            # (1,O,H,W)
+    C = data.shape[3] if nhwc else data.shape[1]
+    spatial = data.shape[1:3] if nhwc else data.shape[2:]
+
+    acc = conv(data, weight)
+    if attrs.get("symmetric"):
+        # caller-promised min = -max (see the FC twin): zero-point terms
+        # vanish exactly, so the three auxiliary convs are skipped —
+        # they would otherwise run for real (the ranges are traced)
+        return s_d * s_w * acc
+
+    def k_shape(o, i):  # a kernel of o out-channels over i in-channels
+        return (o, kh, kw, i) if nhwc else (o, i, kh, kw)
+
+    def x_shape(c):     # a data tensor of c channels
+        return ((1,) + spatial + (c,)) if nhwc else ((1, c) + spatial)
+
+    win_d = conv(data, jnp.ones(k_shape(1, C), data.dtype))
+    win_w = conv(jnp.ones(x_shape(C), weight.dtype), weight)
     # channels are never padded: a single-channel count conv x C is
     # C-times cheaper than counting across all input channels
-    cnt = C * conv(jnp.ones((1, 1) + data.shape[2:], jnp.int8),
-                   jnp.ones((1, 1, kh, kw), jnp.int8))      # (1,1,H,W)
+    cnt = C * conv(jnp.ones(x_shape(1), jnp.int8),
+                   jnp.ones(k_shape(1, 1), jnp.int8))
     return (s_d * s_w * acc + s_d * b_w * win_d + s_w * b_d * win_w
             + b_d * b_w * cnt)
 

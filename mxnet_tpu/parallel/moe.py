@@ -18,71 +18,76 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["moe_ffn", "init_moe_params", "router_top1", "router_topk"]
 
 
-def router_top1(logits, capacity):
-    """Switch top-1 router.  logits (T, E) → dispatch (T, E, C) one-hot,
-    combine (T, E, C) gate-weighted, aux load-balancing loss (scalar).
-    Tokens over a full expert buffer are dropped (standard capacity
-    semantics)."""
+def _route_indexed(logits, capacity, k, renorm=None):
+    """THE routing implementation — every router spelling derives from
+    it.  Returns per rank r a tuple (expert (T,), gate (T,), pos (T,))
+    with rank-major buffer positions (all rank-0 assignments land before
+    any rank-1, each in token order; pos >= capacity means dropped), plus
+    the GShard aux load-balancing loss computed from the primary
+    assignment.  Gate semantics: ``renorm`` (default: k>1) renormalizes
+    the k gates to sum to 1 (GShard); without it the raw softmax probs
+    carry through (the Switch/router_top1 convention)."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)            # (T,)
-    gate = jnp.max(probs, axis=-1)                 # (T,)
-    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)  # (T,E)
-    # position of each token within its expert's buffer (arrival order)
-    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot     # (T,E)
-    pos = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (T,)
-    keep = pos < capacity
-    dispatch = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
-        pos, capacity, dtype=logits.dtype)[:, None, :]       # (T,E,C)
-    combine = dispatch * gate[:, None, None]
-    # GShard aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
-    density = jnp.mean(onehot, axis=0)
+    picks, gates = [], []
+    masked = probs
+    for _ in range(k):
+        expert = jnp.argmax(masked, axis=-1)
+        onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
+        picks.append((expert.astype(jnp.int32), onehot))
+        gates.append(jnp.sum(probs * onehot, axis=-1))
+        masked = masked * (1.0 - onehot)
+    if (k > 1) if renorm is None else renorm:
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+    ranks = []
+    filled = jnp.zeros((E,), logits.dtype)  # slots used by earlier ranks
+    for (expert, onehot), gate in zip(picks, gates):
+        pos = jnp.cumsum(onehot, axis=0) - onehot + filled[None, :]
+        filled = filled + jnp.sum(onehot, axis=0)
+        pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+        ranks.append((expert, gate, pos_t))
+    density = jnp.mean(picks[0][1], axis=0)
     density_proxy = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(density * density_proxy)
+    return ranks, aux_loss
+
+
+def _dense_from_ranks(ranks, E, capacity, dtype):
+    """(T, E, C) dispatch/combine tensors from the indexed assignment
+    (one_hot of an out-of-capacity position is all-zero, which IS the
+    drop)."""
+    T = ranks[0][0].shape[0]
+    dispatch = jnp.zeros((T, E, capacity), dtype)
+    combine = jnp.zeros((T, E, capacity), dtype)
+    for expert, gate, pos in ranks:
+        d = jax.nn.one_hot(expert, E, dtype=dtype)[:, :, None] * \
+            jax.nn.one_hot(pos, capacity, dtype=dtype)[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate.astype(dtype)[:, None, None]
+    return dispatch, combine
+
+
+def router_top1(logits, capacity):
+    """Switch top-1 router.  logits (T, E) → dispatch (T, E, C) one-hot,
+    combine (T, E, C) gate-weighted (raw max prob), aux load-balancing
+    loss (scalar).  Tokens over a full expert buffer are dropped
+    (standard capacity semantics)."""
+    ranks, aux_loss = _route_indexed(logits, capacity, 1)
+    dispatch, combine = _dense_from_ranks(ranks, logits.shape[1],
+                                          capacity, logits.dtype)
     return dispatch, combine, aux_loss
 
 
 def router_topk(logits, capacity, k=2):
     """GShard top-k router (k=2 is the GShard paper's setting; k=1
-    reduces exactly to :func:`router_top1`'s assignment).
-
-    logits (T, E) → dispatch (T, E, C) multi-hot (up to k slots per
-    token), combine (T, E, C) gate-weighted with gates renormalized over
-    the k selected experts, aux load-balancing loss (scalar, computed
-    from the primary assignment as in GShard).  Buffer positions fill in
-    rank-major order: all rank-0 assignments land before any rank-1
-    assignment, each in token order; tokens past a full expert buffer are
-    dropped for that rank (standard capacity semantics)."""
-    T, E = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    onehots, gates = [], []
-    masked = probs
-    for _ in range(k):
-        expert = jnp.argmax(masked, axis=-1)
-        onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
-        onehots.append(onehot)
-        gates.append(jnp.sum(probs * onehot, axis=-1))
-        masked = masked * (1.0 - onehot)
-    denom = sum(gates) + 1e-9
-    gates = [g / denom for g in gates]
-
-    dispatch = jnp.zeros((T, E, capacity), logits.dtype)
-    combine = jnp.zeros((T, E, capacity), logits.dtype)
-    filled = jnp.zeros((E,), logits.dtype)  # slots used by earlier ranks
-    for onehot, gate in zip(onehots, gates):
-        pos = jnp.cumsum(onehot, axis=0) - onehot + filled[None, :]  # (T,E)
-        filled = filled + jnp.sum(onehot, axis=0)
-        pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)     # (T,)
-        keep = (pos_t < capacity).astype(logits.dtype)
-        d = (onehot * keep[:, None])[:, :, None] * jax.nn.one_hot(
-            pos_t, capacity, dtype=logits.dtype)[:, None, :]
-        dispatch = dispatch + d
-        combine = combine + d * gate[:, None, None]
-    # GShard aux loss on the primary (rank-0) assignment
-    density = jnp.mean(onehots[0], axis=0)
-    density_proxy = jnp.mean(probs, axis=0)
-    aux_loss = E * jnp.sum(density * density_proxy)
+    matches :func:`router_top1`'s assignment with gates renormalized
+    to 1).  Dense (T, E, C) spelling of :func:`_route_indexed` — the
+    expert-parallel einsum path consumes these tensors; the
+    single-device path skips them entirely."""
+    ranks, aux_loss = _route_indexed(logits, capacity, k, renorm=True)
+    dispatch, combine = _dense_from_ranks(ranks, logits.shape[1],
+                                          capacity, logits.dtype)
     return dispatch, combine, aux_loss
 
 
@@ -96,6 +101,23 @@ def init_moe_params(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
         "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model), dtype)
         * (2.0 / d_hidden) ** 0.5,
     }
+
+
+def _moe_ffn_indexed(tokens, w1, w2, ranks, capacity, aux_loss):
+    E, d = w1.shape[0], tokens.shape[-1]
+    buf = jnp.zeros((E, capacity, d), tokens.dtype)
+    for expert_t, gate, pos_t in ranks:
+        # one token per slot by construction (rank-major disjoint
+        # positions); over-capacity tokens drop via scatter mode='drop'
+        buf = buf.at[expert_t, pos_t].add(tokens, mode="drop")
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, w1))
+    out_buf = jnp.einsum("ech,ehd->ecd", h, w2)
+    out = jnp.zeros_like(tokens)
+    for expert_t, gate, pos_t in ranks:
+        keep = (pos_t < capacity).astype(tokens.dtype)
+        picked = out_buf[expert_t, jnp.minimum(pos_t, capacity - 1)]
+        out = out + picked * (gate.astype(tokens.dtype) * keep)[:, None]
+    return out, aux_loss
 
 
 def moe_ffn(params, x, *, capacity_factor=2.0, expert_axis="expert",
@@ -113,22 +135,40 @@ def moe_ffn(params, x, *, capacity_factor=2.0, expert_axis="expert",
     B, S, d = x.shape
     E = params["w1"].shape[0]
     tokens = x.reshape(B * S, d)
+    # dtype-preserving under low precision: weights cast to the token
+    # dtype (the FC-op master-weight rule), routing decisions in fp32
+    # (GShard practice), expert buffers in the token dtype — without
+    # this an fp32 router promotes the whole residual stream to fp32
+    # downstream (measured: VMEM OOM in the attention kernel at b8 T2048)
+    w_router = params["router"].astype(tokens.dtype)
+    w1 = params["w1"].astype(tokens.dtype)
+    w2 = params["w2"].astype(tokens.dtype)
     # GShard capacity scales with k: k assignments per token need k times
     # the slot supply for the same headroom (capacity_factor keeps one
     # meaning across top_k settings)
     capacity = max(int(top_k * capacity_factor * B * S / E), 1)
-    logits = tokens @ params["router"]
+    logits = (tokens @ w_router).astype(jnp.float32)
+    if mesh is None or expert_axis not in mesh.axis_names:
+        # no expert axis to all-to-all over: use the O(T*E) indexed
+        # dispatch (scatter/gather) instead of the dense (T, E, C)
+        # einsum tensors — same assignment, pinned by parity tests
+        ranks, aux_loss = _route_indexed(logits, capacity, top_k)
+        out, aux_loss = _moe_ffn_indexed(tokens, w1, w2, ranks, capacity,
+                                         aux_loss)
+        return out.reshape(B, S, d), aux_loss
     if top_k == 1:
         dispatch, combine, aux_loss = router_top1(logits, capacity)
     else:
         dispatch, combine, aux_loss = router_topk(logits, capacity, k=top_k)
+    dispatch = dispatch.astype(tokens.dtype)
+    combine = combine.astype(tokens.dtype)
     # (T,E,C) x (T,d) → expert buffers (E,C,d)
     buf = jnp.einsum("tec,td->ecd", dispatch, tokens)
     if mesh is not None and expert_axis in mesh.axis_names:
         buf = jax.lax.with_sharding_constraint(
             buf, jax.sharding.NamedSharding(mesh, P(expert_axis, None, None)))
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, params["w1"]))
-    out_buf = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, w1))
+    out_buf = jnp.einsum("ech,ehd->ecd", h, w2)
     if mesh is not None and expert_axis in mesh.axis_names:
         out_buf = jax.lax.with_sharding_constraint(
             out_buf,

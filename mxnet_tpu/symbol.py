@@ -600,8 +600,13 @@ def _infer(symbol: Symbol, shape_dict: Dict[str, tuple], type_dict=None, partial
     # iterative local propagation: run graph with placeholders, solving unknown
     # variable shapes from op constraints where derivable (FC weight etc.)
     resolved: Dict[str, tuple] = dict(known)
+    # seed with per-variable __dtype__ hints so they survive into the
+    # default below (an unconditional float32 here would shadow them)
+    _hints = {n.name: n.extra_attrs["__dtype__"] for n in variables
+              if "__dtype__" in n.extra_attrs}
     resolved_types: Dict[str, _np.dtype] = {
-        k: _np.dtype(type_dict.get(k, _np.float32)) for k in list(resolved)
+        k: _np.dtype(type_dict.get(k, _hints.get(k, _np.float32)))
+        for k in list(resolved)
     }
 
     shapes_out: Dict[int, List] = {}  # node id -> list of ShapeDtypeStruct per output
@@ -618,7 +623,11 @@ def _infer(symbol: Symbol, shape_dict: Dict[str, tuple], type_dict=None, partial
         for node in pending:
             if node.is_variable:
                 if node.name in resolved:
-                    dt = _np.dtype(type_dict.get(node.name, resolved_types.get(node.name, _np.float32)))
+                    # __dtype__ hints (Variable(dtype=...) / graph passes
+                    # that rewrite params, e.g. int8 quantized weights)
+                    # seed the default; explicit type_dict still wins
+                    hint = node.extra_attrs.get("__dtype__", _np.float32)
+                    dt = _np.dtype(type_dict.get(node.name, resolved_types.get(node.name, hint)))
                     shapes_out[node._id] = [jax.ShapeDtypeStruct(tuple(resolved[node.name]), dt)]
                     progress = True
                 else:

@@ -280,3 +280,14 @@ def test_train_pipeline_example():
     # fully seed-deterministic data/batches: schedule equivalence must
     # hold end-to-end, not just "both converge"
     assert abs(gpipe["accuracy"] - stats["accuracy"]) < 1e-6, (stats, gpipe)
+
+
+def test_quantize_resnet_example():
+    """Model-level PTQ (contrib.quantization): BN fold + symmetric
+    calibration + int8 graph rewrite on a trained ResNet-8; int8 top-1
+    must stay within a point of fp32 (chip-measured throughput rows come
+    from the same example's --benchmark mode via tools/bench_table.py)."""
+    stats = _run_example("quantize_resnet.py",
+                         "epochs=4, n_train=512, log=False")
+    assert stats["fp32_acc"] > 0.9, stats
+    assert stats["int8_acc"] >= stats["fp32_acc"] - 0.01, stats

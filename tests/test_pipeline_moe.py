@@ -605,3 +605,36 @@ def test_1f1b_composed_mesh_dp_pp_ep_moe_parity():
         np.testing.assert_allclose(np.asarray(jax.device_get(sharded[k])),
                                    np.asarray(ref_p[k]), rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_moe_indexed_dispatch_matches_einsum():
+    """The no-expert-axis fast path (O(T*E) scatter/gather dispatch) must
+    reproduce the dense (T,E,C)-einsum formulation exactly — same
+    assignment, same gates, same drops — for top-1 AND top-2."""
+    rng = np.random.RandomState(11)
+    B, S, d, E, h = 2, 16, 8, 4, 12
+    x = jnp.asarray(rng.randn(B, S, d).astype(np.float32))
+    params = {
+        "router": jnp.asarray(rng.randn(d, E).astype(np.float32) * 0.5),
+        "w1": jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.3),
+        "w2": jnp.asarray(rng.randn(E, h, d).astype(np.float32) * 0.3),
+    }
+    for k in (1, 2):
+        # capacity small enough that drops occur (skewed router)
+        out_idx, aux_idx = moe.moe_ffn(params, x, capacity_factor=0.75,
+                                       top_k=k)  # mesh=None -> indexed
+        tokens = x.reshape(B * S, d)
+        cap = max(int(k * 0.75 * B * S / E), 1)
+        logits = tokens @ params["router"]
+        if k == 1:
+            disp, comb, aux_e = moe.router_top1(logits, cap)
+        else:
+            disp, comb, aux_e = moe.router_topk(logits, cap, k=k)
+        buf = jnp.einsum("tec,td->ecd", disp, tokens)
+        hh = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, params["w1"]))
+        ob = jnp.einsum("ech,ehd->ecd", hh, params["w2"])
+        out_e = jnp.einsum("tec,ecd->td", comb, ob).reshape(B, S, d)
+        np.testing.assert_allclose(np.asarray(out_idx), np.asarray(out_e),
+                                   rtol=1e-5, atol=1e-6, err_msg="k=%d" % k)
+        np.testing.assert_allclose(np.asarray(aux_idx), np.asarray(aux_e),
+                                   rtol=1e-6, err_msg="k=%d" % k)

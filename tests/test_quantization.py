@@ -1,0 +1,192 @@
+"""Model-level PTQ passes (mxnet_tpu.contrib.quantization): BN fold
+exactness, int8 graph rewrite vs fake-quant parity, NHWC quantized conv,
+and the __dtype__ variable-hint plumbing the rewrite relies on."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as Q
+
+
+def _fwd(sym, args, auxs, x, ctx=None):
+    exe = sym.simple_bind(ctx or mx.cpu(), grad_req="null",
+                          data=tuple(x.shape))
+    for k, v in args.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v
+    for k, v in auxs.items():
+        if k in exe.aux_dict:
+            exe.aux_dict[k][:] = v
+    exe.arg_dict["data"][:] = x
+    return exe.forward(is_train=False)[0].asnumpy()
+
+
+def _conv_bn_net(layout=None, no_bias=True):
+    kw = {"layout": layout} if layout else {}
+    net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                             num_filter=8, pad=(1, 1), no_bias=no_bias,
+                             name="conv0", **kw)
+    net = mx.sym.BatchNorm(net, name="bn0", fix_gamma=False,
+                           **({"axis": 3} if layout == "NHWC" else {}))
+    net = mx.sym.Activation(net, act_type="relu", name="relu0")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=5,
+                                name="fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(rng, layout=None, no_bias=True):
+    wshape = (8, 3, 3, 4) if layout == "NHWC" else (8, 4, 3, 3)
+    args = {"conv0_weight": mx.nd.array(rng.randn(*wshape) * 0.2),
+            "bn0_gamma": mx.nd.array(rng.rand(8) + 0.5),
+            "bn0_beta": mx.nd.array(rng.randn(8) * 0.1),
+            "fc1_weight": mx.nd.array(rng.randn(5, 8 * 36) * 0.1),
+            "fc1_bias": mx.nd.array(rng.randn(5) * 0.1)}
+    if not no_bias:
+        args["conv0_bias"] = mx.nd.array(rng.randn(8) * 0.1)
+    auxs = {"bn0_moving_mean": mx.nd.array(rng.randn(8) * 0.1),
+            "bn0_moving_var": mx.nd.array(rng.rand(8) + 0.5)}
+    return args, auxs
+
+
+def _data(rng, layout=None):
+    return (rng.randn(4, 6, 6, 4) if layout == "NHWC"
+            else rng.randn(4, 4, 6, 6)).astype(np.float32)
+
+
+@pytest.mark.parametrize("no_bias", [True, False])
+def test_fold_bn_exact(no_bias):
+    """Folded conv+bias must equal conv->BN(inference stats) to float
+    rounding; gamma/beta/moving stats disappear from the params."""
+    rng = np.random.RandomState(0)
+    net = _conv_bn_net(no_bias=no_bias)
+    args, auxs = _params(rng, no_bias=no_bias)
+    x = _data(rng)
+    y0 = _fwd(net, args, auxs, x)
+    fsym, fargs, fauxs = Q.fold_bn(net, args, auxs)
+    y1 = _fwd(fsym, fargs, fauxs, x)
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-6)
+    assert "bn0_gamma" not in fargs and "bn0_moving_mean" not in fauxs
+    assert "conv0_bias" in fargs
+    assert "bn0" not in fsym.tojson()
+
+
+def test_fold_bn_skips_shared_conv_output():
+    """A conv whose output feeds the BN AND something else must not fold
+    (the scale would corrupt the second consumer)."""
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4,
+                              no_bias=True, name="convs")
+    bn = mx.sym.BatchNorm(conv, name="bns")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(bn + conv), num_hidden=3, name="fcs"),
+        name="softmax")
+    rng = np.random.RandomState(1)
+    args = {"convs_weight": mx.nd.array(rng.randn(4, 2, 1, 1)),
+            "bns_gamma": mx.nd.array(rng.rand(4) + 0.5),
+            "bns_beta": mx.nd.array(rng.randn(4)),
+            "fcs_weight": mx.nd.array(rng.randn(3, 4 * 9) * 0.1),
+            "fcs_bias": mx.nd.array(rng.randn(3))}
+    auxs = {"bns_moving_mean": mx.nd.array(rng.randn(4) * 0.1),
+            "bns_moving_var": mx.nd.array(rng.rand(4) + 0.5)}
+    fsym, fargs, fauxs = Q.fold_bn(net, args, auxs)
+    assert "BatchNorm" in fsym.tojson()  # kept, not corrupted
+    x = rng.randn(2, 2, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(_fwd(fsym, fargs, fauxs, x),
+                               _fwd(net, args, auxs, x),
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("layout", [None, "NHWC"])
+def test_quantize_model_end_to_end(layout):
+    """Full pipeline on both conv layouts: int8 predictions track fp32
+    closely on in-range data (symmetric calib on the same batch)."""
+    rng = np.random.RandomState(2)
+    net = _conv_bn_net(layout=layout)
+    args, auxs = _params(rng, layout=layout)
+    x = _data(rng, layout=layout)
+    y0 = _fwd(net, args, auxs, x)
+    qsym, qargs, qauxs = Q.quantize_model(net, args, auxs,
+                                          [{"data": x}], mx.cpu())
+    y1 = _fwd(qsym, qargs, qauxs, x)
+    assert qargs["conv0_weight"].asnumpy().dtype == np.int8
+    assert qargs["fc1_weight"].asnumpy().dtype == np.int8
+    # int8 quantization noise on softmax probabilities
+    np.testing.assert_allclose(y1, y0, atol=0.02)
+    assert (y1.argmax(axis=1) == y0.argmax(axis=1)).mean() == 1.0
+
+
+def test_quantize_excluded_nodes_stay_float():
+    rng = np.random.RandomState(3)
+    net = _conv_bn_net()
+    args, auxs = _params(rng)
+    x = _data(rng)
+    qsym, qargs, qauxs = Q.quantize_model(
+        net, args, auxs, [{"data": x}], mx.cpu(),
+        excluded_sym_names=["conv0"])
+    assert qargs["conv0_weight"].asnumpy().dtype == np.float32
+    assert qargs["fc1_weight"].asnumpy().dtype == np.int8
+    j = qsym.tojson()
+    assert "_contrib_quantized_conv" not in j
+    assert "_contrib_quantized_fully_connected" in j
+
+
+def test_dtype_hint_drives_simple_bind_allocation():
+    """__dtype__ Variable hints must survive into simple_bind's array
+    allocation (int8 params bind as int8 without a type_dict)."""
+    v = mx.sym.Variable("w", shape=(4, 4), dtype="int8")
+    out = mx.sym.Cast(v, dtype="float32")
+    exe = out.simple_bind(mx.cpu(), grad_req="null")
+    assert exe.arg_dict["w"].asnumpy().dtype == np.int8
+
+
+def test_quantize_tied_weight_with_excluded_consumer_raises():
+    """A weight shared between a quantized node and an excluded one
+    would be silently rewritten to int8 codes under the float consumer —
+    must refuse loudly."""
+    from mxnet_tpu.base import MXNetError
+
+    rng = np.random.RandomState(5)
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("shared_w")
+    f1 = mx.sym.FullyConnected(d, weight=w, num_hidden=6, no_bias=True,
+                               name="fc1")
+    f2 = mx.sym.FullyConnected(d, weight=w, num_hidden=6, no_bias=True,
+                               name="fc2")
+    net = mx.sym.SoftmaxOutput(f1 + f2, name="softmax")
+    args = {"shared_w": mx.nd.array(rng.randn(6, 4))}
+    with pytest.raises(MXNetError, match="shared"):
+        Q.quantize_symbol(net, args, {"fc1": 1.0},
+                          excluded_sym_names=["fc2"])
+    # both quantized: legal; the tied weight quantizes once with one range
+    qsym, qargs = Q.quantize_symbol(net, args, {"fc1": 1.0, "fc2": 1.0})
+    assert qargs["shared_w"].asnumpy().dtype == np.int8
+    assert np.asarray(qargs["fc1_weight_max"].asnumpy()) \
+        == np.asarray(qargs["fc2_weight_max"].asnumpy())
+
+
+def test_quantize_shared_input_single_quantize_node():
+    """Two convs reading the same tensor (the ResNet downsample-block
+    shape) share ONE _contrib_quantize node — not one per consumer."""
+    rng = np.random.RandomState(6)
+    d = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(d, kernel=(1, 1), num_filter=4, no_bias=True,
+                            name="ca")
+    c2 = mx.sym.Convolution(d, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                            no_bias=True, name="cb")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(c1 + c2), num_hidden=3, name="fcq"),
+        name="softmax")
+    args = {"ca_weight": mx.nd.array(rng.randn(4, 2, 1, 1)),
+            "cb_weight": mx.nd.array(rng.randn(4, 2, 3, 3) * 0.2),
+            "fcq_weight": mx.nd.array(rng.randn(3, 4 * 25) * 0.1),
+            "fcq_bias": mx.nd.array(rng.randn(3))}
+    x = rng.randn(2, 2, 5, 5).astype(np.float32)
+    qsym, qargs, qauxs = Q.quantize_model(net, args, {}, [{"data": x}],
+                                          mx.cpu())
+    j = qsym.tojson()
+    # ca+cb share one quantize of `data`; the FC has its own
+    assert j.count('"_contrib_quantize"') == 2
+    y = _fwd(qsym, qargs, qauxs, x)
+    y0 = _fwd(net, args, {}, x)
+    assert (y.argmax(axis=1) == y0.argmax(axis=1)).all()

@@ -234,3 +234,31 @@ def test_kill_mxnet_finds_launcher_processes():
         for p in (straggler, bystander):
             if p.poll() is None:
                 p.kill()
+
+
+def test_bench_table_render_int8_and_moe_sections():
+    import tools.bench_table as bt
+
+    int8 = {"fp32": 1000.0, "bf16": 3000.0, "int8": 3900.0}
+    moe = {"moe": {"value": 54000.0, "mfu": 0.33, "n_params": 922000000,
+                   "n_params_active": 340000000,
+                   "config": {"batch": 8, "seq": 1024, "d_model": 1024,
+                              "layers": 12, "experts": 8, "top_k": 1}},
+           "dense": {"value": 81000.0, "mfu": 0.60,
+                     "n_params": 218000000,
+                     "config": {"batch": 8, "seq": 1024,
+                                "d_model": 1024, "layers": 12}}}
+    out = bt.render([], [], "TestChip", int8_rows=int8, moe_rows=moe)
+    assert "1.30×" in out          # int8 vs bf16
+    assert "moe 8-expert top-1" in out
+    assert "0.67×" in out          # moe vs dense
+    assert "12L d1024 T1024 b8" in out
+    # a failed DENSE baseline must not fabricate a zero row
+    out2 = bt.render([], [], "TestChip", int8_rows=int8,
+                     moe_rows={"moe": moe["moe"],
+                               "dense": {"error": "boom"}})
+    assert "MoE row FAILED" in out2 and "| dense | 0M" not in out2
+    # failed int8: error note, no numbers posing as measurements
+    out3 = bt.render([], [], "TestChip",
+                     int8_rows={"error": "no chip"})
+    assert "int8 row FAILED" in out3
