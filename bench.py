@@ -111,9 +111,14 @@ def transformer_main():
     # overcounts by ~E/top_k on the FFN share
     n_active = n_params
     if ffn == "moe":
-        # MoELayer hidden_size is wired to 4*num_embed in get_symbol
-        per_expert = 2 * d_model * (4 * d_model)
-        n_active -= layers * (n_experts - moe_top_k) * per_expert
+        # derive the expert share from the REAL param tree (no mirror of
+        # the hidden_size wiring to drift): a token visits top_k of the
+        # n_experts expert FFNs
+        expert_params = sum(
+            int(np.prod(p.shape)) for n, p in params.items()
+            if "_moe_w1_weight" in n or "_moe_w2_weight" in n)
+        n_active -= int(expert_params * (n_experts - moe_top_k)
+                        / max(n_experts, 1))
     flops_per_token = 6.0 * n_active + 12.0 * layers * seq * d_model
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                 PEAK_TFLOPS_V5E)) * 1e12

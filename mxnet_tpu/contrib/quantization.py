@@ -194,12 +194,32 @@ def calibrate_ranges(sym, arg_params, aux_params, calib_data, ctx,
     """Max-|x| of every quantizable node's DATA input over the
     calibration batches.  Returns {node_name: amax}.  ``calib_data``
     iterates dicts of input arrays (host numpy)."""
-    from .. import ndarray as nd
     from .. import symbol as _sym  # noqa: F401  (Symbol methods used)
 
     nodes, _ = _load_graph(sym)
     targets = [n for n in nodes if _quantizable(n)
                and n["name"] not in excluded_sym_names]
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+
+    def internal_name(src_name, oi):
+        """Internal-output name for (node, output idx), matching the
+        Symbol naming rules: '<n>_output' (single), '<n>_output<i>'
+        (multi), '<n>_<outname>' (declared output names — resolved
+        positionally among the node's outputs)."""
+        cands = (["%s_output" % src_name] if oi == 0 else []) \
+            + ["%s_output%d" % (src_name, oi)]
+        for c in cands:
+            if c in out_names:
+                return c
+        named = [n for n in out_names
+                 if n.startswith(src_name + "_")]
+        if len(named) > oi:
+            return named[oi]
+        raise MXNetError(
+            "calibration: no internal output for %r[%d] (outputs: %s)"
+            % (src_name, oi, named or "none"))
+
     # internal output feeding each target's data input ("data" variables
     # calibrate from the batch itself)
     want = {}
@@ -208,15 +228,9 @@ def calibrate_ranges(sym, arg_params, aux_params, calib_data, ctx,
         if src["op"] == "null":
             want[n["name"]] = ("var", src["name"])
         else:
-            want[n["name"]] = ("out", "%s_output" % src["name"], oi)
+            want[n["name"]] = ("out", internal_name(src["name"], oi))
 
-    internals = sym.get_internals()
-    out_names = internals.list_outputs()
     pick = sorted({spec[1] for spec in want.values() if spec[0] == "out"})
-    missing = [p for p in pick if p not in out_names]
-    if missing:
-        raise MXNetError("calibration: internal outputs not found: %s"
-                         % missing)
     # reduce max|x| INSIDE the calibration graph: one compile, scalar
     # outputs.  (Eager per-output nd.max(nd.abs(...)) costs one remote
     # jit compile per distinct activation shape — ~50 compiles, tens of
@@ -234,15 +248,18 @@ def calibrate_ranges(sym, arg_params, aux_params, calib_data, ctx,
             if exe is None:
                 shapes = {k: tuple(v.shape) for k, v in batch.items()}
                 exe = group.simple_bind(ctx, grad_req="null", **shapes)
+                # host-numpy assignment keeps the executor's placement
+                # (an NDArray source re-binds the dest to ITS device —
+                # a silent all-CPU calibration on a TPU ctx)
                 for k, v in arg_params.items():
                     if k in exe.arg_dict:
-                        exe.arg_dict[k][:] = v
+                        exe.arg_dict[k][:] = _asnp(v)
                 for k, v in aux_params.items():
                     if k in exe.aux_dict:
-                        exe.aux_dict[k][:] = v
+                        exe.aux_dict[k][:] = _asnp(v)
             for k, v in batch.items():
                 if k in exe.arg_dict:
-                    exe.arg_dict[k][:] = v
+                    exe.arg_dict[k][:] = _asnp(v)
             outs = exe.forward(is_train=False)
             vals = {p: o for p, o in zip(pick, outs)}
         else:
@@ -260,7 +277,8 @@ def calibrate_ranges(sym, arg_params, aux_params, calib_data, ctx,
 # pass 3: graph rewrite to int8 compute ops
 # ---------------------------------------------------------------------
 
-def quantize_symbol(sym, arg_params, act_ranges, excluded_sym_names=()):
+def quantize_symbol(sym, arg_params, act_ranges, excluded_sym_names=(),
+                    out_dtype="float32"):
     """Rewrite quantizable nodes to int8 MXU ops.
 
     Each target conv/FC becomes: ``_contrib_quantize(data)`` (symmetric
@@ -352,14 +370,16 @@ def quantize_symbol(sym, arg_params, act_ranges, excluded_sym_names=()):
             qop = {"op": "_contrib_quantized_fully_connected",
                    "name": name,
                    "attr": {"num_hidden": a["num_hidden"],
-                            "symmetric": "True"},
+                            "symmetric": "True",
+                            "out_type": out_dtype},
                    "inputs": [(q, 0), (wnode, 0), (q, 1), (q, 2),
                               (wmin_n, 0), (wmax_n, 0)]}
         else:
             qattr = {"kernel": a["kernel"],
                      "num_filter": a["num_filter"],
                      "layout": a.get("layout") or "NCHW",
-                     "symmetric": "True"}  # calib IS min=-max
+                     "symmetric": "True",  # calib IS min=-max
+                     "out_type": out_dtype}
             for k in ("stride", "pad"):
                 if a.get(k):
                     qattr[k] = a[k]
@@ -372,7 +392,11 @@ def quantize_symbol(sym, arg_params, act_ranges, excluded_sym_names=()):
         tail = qop
         if had_bias:
             bnode = node["inputs"][2][0]
-            b = args[bnode["name"]].astype(_np.float32)
+            import ml_dtypes  # numpy has no bf16; jax ships ml_dtypes
+
+            b = args[bnode["name"]].astype(
+                ml_dtypes.bfloat16 if out_dtype == "bfloat16"
+                else _np.float32)
             if not is_fc:  # pre-shape for rank-4 broadcast
                 nhwc = (a.get("layout") == "NHWC")
                 b = b.reshape((1, 1, 1, -1) if nhwc else (1, -1, 1, 1))
@@ -391,7 +415,7 @@ def quantize_symbol(sym, arg_params, act_ranges, excluded_sym_names=()):
 
 
 def quantize_model(sym, arg_params, aux_params, calib_data, ctx,
-                   excluded_sym_names=()):
+                   excluded_sym_names=(), out_dtype="float32"):
     """The full PTQ pipeline (the reference's later-version
     ``contrib.quantization.quantize_model`` role): BN fold -> symmetric
     calibration -> int8 graph rewrite.  Returns
@@ -402,7 +426,8 @@ def quantize_model(sym, arg_params, aux_params, calib_data, ctx,
     ranges = calibrate_ranges(fsym, fargs, fauxs, batches, ctx,
                               excluded_sym_names=excluded_sym_names)
     qsym, qargs = quantize_symbol(fsym, fargs, ranges,
-                                  excluded_sym_names=excluded_sym_names)
+                                  excluded_sym_names=excluded_sym_names,
+                                  out_dtype=out_dtype)
     return qsym, qargs, fauxs
 
 

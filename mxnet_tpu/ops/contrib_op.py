@@ -521,7 +521,9 @@ def _dequantize(attrs, data, min_range, max_range):
     arg_names=["data", "weight", "min_data", "max_data", "min_weight",
                "max_weight"],
     params={"num_hidden": P("int", 0, required=True),
-            "symmetric": P("bool", False)},
+            "symmetric": P("bool", False),
+            "out_type": P("str", "float32",
+                          enum=["float32", "bfloat16"])},
 )
 def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
                                min_weight, max_weight):
@@ -554,19 +556,23 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
     acc = jax.lax.dot_general(
         data, weight, (((data.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32).astype(jnp.float32)
+    out_dt = jnp.bfloat16 if attrs.get("out_type") == "bfloat16" \
+        else jnp.float32
     if attrs.get("symmetric"):
         # the caller PROMISES min = -max for both tensors (int8), so the
         # zero-point terms are exactly zero; skipping their row sums
         # matters because the ranges are traced values XLA cannot prove
         # cancel (contrib.quantization sets this — its calibration is
-        # symmetric by construction)
-        return s_d * s_w * acc
+        # symmetric by construction).  out_type=bfloat16 halves the
+        # rescaled output's write traffic (and the next quantize's read)
+        # on an HBM-bound model — see PERF.md "int8 at model level"
+        return (s_d * s_w * acc).astype(out_dt)
     row_d = jnp.sum(data.astype(jnp.int32), axis=-1,
                     keepdims=True).astype(jnp.float32)
     row_w = jnp.sum(weight.astype(jnp.int32), axis=-1).astype(jnp.float32)
     K = data.shape[-1]
     return (s_d * s_w * acc + s_d * b_w * row_d + s_w * b_d * row_w
-            + K * b_d * b_w)
+            + K * b_d * b_w).astype(out_dt)
 
 
 @register(
@@ -580,6 +586,7 @@ def _quantized_fully_connected(attrs, data, weight, min_data, max_data,
         "pad": P("shape", None),
         "layout": P("str", "NCHW", enum=["NCHW", "NHWC"]),
         "symmetric": P("bool", False),
+        "out_type": P("str", "float32", enum=["float32", "bfloat16"]),
     },
 )
 def _quantized_conv(attrs, data, weight, min_data, max_data,
@@ -632,12 +639,14 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
     C = data.shape[3] if nhwc else data.shape[1]
     spatial = data.shape[1:3] if nhwc else data.shape[2:]
 
+    out_dt = jnp.bfloat16 if attrs.get("out_type") == "bfloat16" \
+        else jnp.float32
     acc = conv(data, weight)
     if attrs.get("symmetric"):
         # caller-promised min = -max (see the FC twin): zero-point terms
         # vanish exactly, so the three auxiliary convs are skipped —
         # they would otherwise run for real (the ranges are traced)
-        return s_d * s_w * acc
+        return (s_d * s_w * acc).astype(out_dt)
 
     def k_shape(o, i):  # a kernel of o out-channels over i in-channels
         return (o, kh, kw, i) if nhwc else (o, i, kh, kw)
@@ -652,7 +661,7 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
     cnt = C * conv(jnp.ones(x_shape(1), jnp.int8),
                    jnp.ones(k_shape(1, 1), jnp.int8))
     return (s_d * s_w * acc + s_d * b_w * win_d + s_w * b_d * win_w
-            + b_d * b_w * cnt)
+            + b_d * b_w * cnt).astype(out_dt)
 
 
 # ----------------------------------------------------------------------

@@ -190,3 +190,20 @@ def test_quantize_shared_input_single_quantize_node():
     y = _fwd(qsym, qargs, qauxs, x)
     y0 = _fwd(net, args, {}, x)
     assert (y.argmax(axis=1) == y0.argmax(axis=1)).all()
+
+
+def test_quantize_bf16_outputs():
+    """out_dtype='bfloat16' (the chip-winning configuration —
+    docs/PERF.md int8-at-model-level): rescaled outputs and biases carry
+    bf16, predictions stay within bf16+int8 noise of fp32."""
+    rng = np.random.RandomState(7)
+    net = _conv_bn_net()
+    args, auxs = _params(rng)
+    x = _data(rng)
+    y0 = _fwd(net, args, auxs, x)
+    qsym, qargs, qauxs = Q.quantize_model(net, args, auxs, [{"data": x}],
+                                          mx.cpu(), out_dtype="bfloat16")
+    y1 = _fwd(qsym, qargs, qauxs, x).astype(np.float32)
+    np.testing.assert_allclose(y1, y0, atol=0.03)
+    assert (y1.argmax(axis=1) == y0.argmax(axis=1)).mean() == 1.0
+    assert str(qargs["conv0_bias"].asnumpy().dtype) == "bfloat16"
