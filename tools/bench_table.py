@@ -158,9 +158,12 @@ def render(infer_rows, train_rows, chip, lm_row=None, int8_rows=None,
         "methodology as the reference's `benchmark_score.py` / "
         "`train_imagenet.py --benchmark`).",
         "Every number below is reproducible from the machine-readable",
-        "capture written alongside (`BENCH_TABLE.json`, same run); the",
-        "driver-verified headline lives in `BENCH_r*.json` and equals the",
-        "bench-default training row (resnet-50 b128 bf16 **s2d**).",
+        "capture written alongside (`BENCH_TABLE.json`, same run).  The",
+        "driver-verified headline (`BENCH_r*.json`, from `bench.py`) is",
+        "the same config as the resnet-50 b128 bf16 **s2d** training row;",
+        "bench.py's longer captures (50 steps, repeated) land a few",
+        "percent above this table's 20-step best-of-2 samples — the",
+        "tunneled device's run-to-run spread (±5-10%, docs/PERF.md).",
         "",
         "## Inference (images/sec; P100 column is batch 32)",
         "",
