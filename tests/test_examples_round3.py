@@ -19,8 +19,12 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(name, call, func="run"):
-    """Execute examples/<name>'s entry point in a subprocess; return stats."""
+def _run_example(name, call, func="run", timeout=900):
+    """Execute examples/<name>'s entry point in a subprocess; return
+    stats.  ``timeout`` is per-gate: the heavy convergence gates get a
+    right-sized limit so the slowest gate stays under half its limit on
+    a loaded box (a gate passing only on an idle machine is a latent
+    red suite — VERDICT r4 #6)."""
     code = (
         "import sys, json\n"
         "sys.path.insert(0, %r)\n"
@@ -36,7 +40,7 @@ def _run_example(name, call, func="run"):
     )
     env = dict(os.environ, MXNET_TPU_PLATFORM="cpu")
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, env=env, timeout=900, cwd=_REPO)
+                       text=True, env=env, timeout=timeout, cwd=_REPO)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     line = [l for l in r.stdout.splitlines() if l.startswith("STATS ")]
     assert line, r.stdout
@@ -62,7 +66,8 @@ def test_autoencoder_example():
     """Layer-wise pretraining + fine-tuning beats same-width PCA on a
     curved manifold (nonlinearity is doing real work)."""
     stats = _run_example("autoencoder.py",
-                         "pretrain_epochs=10, finetune_epochs=35, log=False")
+                         "pretrain_epochs=10, finetune_epochs=35, log=False",
+                         timeout=1200)  # ~550 s measured under load
     assert stats["ae_mse"] < 0.9 * stats["pca_mse"], stats
 
 
@@ -77,7 +82,8 @@ def test_multi_task_example():
 def test_fcn_xs_example():
     """FCN with Deconvolution upsampling + Crop skip fusion segments
     per-pixel: accuracy and foreground IoU bars."""
-    stats = _run_example("fcn_xs.py", "epochs=6, log=False")
+    stats = _run_example("fcn_xs.py", "epochs=6, log=False",
+                         timeout=1200)  # ~450 s measured under load
     assert stats["pix_acc"] > 0.93, stats
     assert stats["fg_miou"] > 0.6, stats
 
@@ -112,7 +118,8 @@ def test_dec_clustering_example():
     refinement): the learned embedding clusters data whose raw Euclidean
     structure is swamped by nuisance variance, and refinement improves
     on its own k-means init."""
-    stats = _run_example("dec_clustering.py", "log=False")
+    stats = _run_example("dec_clustering.py", "log=False",
+                         timeout=1200)  # ~530 s measured under load
     assert stats["dec_acc"] > stats["raw_acc"] + 0.3, stats
     assert stats["dec_acc"] >= stats["init_acc"] - 0.02, stats
     assert stats["dec_acc"] > 0.7, stats
@@ -161,7 +168,8 @@ def test_speech_recognition_example():
     greedy-decoded character error rate drops below 12% on synthetic
     utterances with variable-duration tokens."""
     stats = _run_example("speech_recognition.py",
-                         "num_epochs=14, stop_cer=0.08, log=False")
+                         "num_epochs=14, stop_cer=0.08, log=False",
+                         timeout=1800)  # ~690 s measured under load
     assert stats["cer"] < 0.12, stats
 
 
