@@ -80,6 +80,16 @@ class KVStore(object):
         # dependency engine so the optimizer application overlaps the
         # caller's device work; pull() is the read-after-write wait
         self._key_vars = {}
+        # dist comm lane: every dist_sync collective is an engine op that
+        # ALSO writes this var, so collectives execute in program order on
+        # one worker at a time — the total order every rank shares, which
+        # is what keeps concurrent gloo/ICI collectives matched across
+        # processes.  Asynchrony (push returns before the wire round-trip)
+        # is what replaces the reference's priority-based comm/backward
+        # overlap (model.py:94-110); see docs/PERF.md "Comm/compute
+        # overlap in dist_sync".
+        self._comm_var = None
+        self._comm_error = None
         self._tpu = None     # FusedTPUStore for the dist_tpu mode
         if kind == "dist_async" and self.num_workers > 1:
             self._init_async()
@@ -164,8 +174,12 @@ class KVStore(object):
         """Aggregate values into the store (reduce + optional update).
 
         The reference overlaps comm with backward via per-layer priority
-        (``model.py:94-110``); XLA async dispatch gives the same overlap, so
-        ``priority`` is accepted and unused.
+        (``model.py:94-110``).  Here dist pushes are asynchronous engine
+        ops on a totally-ordered comm lane — the overlap comes from
+        asynchrony (measured in docs/PERF.md "Comm/compute overlap in
+        dist_sync"), while ``priority`` stays accepted-and-unused because
+        reordering collectives by priority would desynchronize the
+        cross-rank collective order that correctness requires.
         """
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
@@ -208,13 +222,16 @@ class KVStore(object):
                     self._tpu.push(idx, merged._data)
                 continue
             if self._kind.startswith("dist"):
-                # collectives involve every process: run on the caller's
-                # thread, synchronously ordered
-                merged = self._allreduce(merged)
-                if self._updater is not None:
-                    self._updater(_updater_key(k), merged, self._store[k])
-                else:
-                    self._store[k] += merged
+                # collectives involve every process and therefore must run
+                # in the same order everywhere: enqueue on the engine's
+                # comm lane (all dist ops share _comm_var, so they execute
+                # one at a time in push order — identical across ranks
+                # because every rank runs the same program).  push returns
+                # immediately; the wire round-trip overlaps the caller's
+                # next dispatch.  ``priority`` stays accepted-and-unused:
+                # reordering by priority would break the cross-rank
+                # collective order that correctness requires.
+                self._push_dist(k, merged)
                 continue
             # single-process: the update is host-side work — push it to the
             # engine keyed by this entry's var (reference: kvstore updates
@@ -228,11 +245,7 @@ class KVStore(object):
             grad_ctx = merged.context
 
             def update(k=k, grad_data=grad_data, grad_ctx=grad_ctx):
-                g = NDArray(grad_data, grad_ctx)
-                if self._updater is not None:
-                    self._updater(_updater_key(k), g, self._store[k])
-                else:
-                    self._store[k] += g
+                self._apply_update(k, NDArray(grad_data, grad_ctx))
 
             engine.push(update, mutable_vars=[self._key_var(k)],
                         name="kv_update")
@@ -270,6 +283,7 @@ class KVStore(object):
                 raise MXNetError("key %s has not been initialized" % k)
             if k in self._key_vars:
                 engine.wait_for_var(self._key_vars[k])
+            self._check_comm_error()
             src = self._store[k]
             for o in olist:
                 o._set_data(src._data.astype(o.dtype))
@@ -283,6 +297,69 @@ class KVStore(object):
 
         return NDArray(allreduce_hosts(value._data), value.context)
 
+    def _push_dist(self, k, merged):
+        """Enqueue one dist_sync reduce+update on the engine comm lane.
+
+        The op writes both the shared ``_comm_var`` (total order across
+        keys — collective order must match on every rank) and this key's
+        var (so ``pull`` waits for exactly the updates it needs).  The
+        caller gets the async overlap the reference bought with per-layer
+        ``priority=`` comm (model.py:94-110): the socket round-trip runs
+        on an engine IO thread while the trainer dispatches more work.
+        """
+        from . import engine
+
+        grad_data = merged._data
+        grad_ctx = merged.context
+
+        def comm(k=k, grad_data=grad_data, grad_ctx=grad_ctx):
+            # once any comm op fails, the lane is poisoned: initiating
+            # further collectives on this rank while peers may still be
+            # inside the failed one would desynchronize the cross-rank
+            # collective order, so every queued op becomes a no-op and
+            # the sticky error surfaces on the next pull/barrier/save
+            if self._comm_error is not None:
+                return
+            try:
+                self._apply_update(k, self._allreduce(
+                    NDArray(grad_data, grad_ctx)))
+            except BaseException as e:  # noqa: BLE001 — surface on pull
+                self._comm_error = e
+
+        if self._comm_var is None:
+            self._comm_var = engine.new_variable()
+        engine.push(comm, mutable_vars=[self._comm_var, self._key_var(k)],
+                    prop=engine.FnProperty.IO, name="kv_dist_push")
+
+    def _apply_update(self, k, reduced):
+        """Apply one reduced value to the store (shared by the dist comm
+        lane and the single-process engine update ops)."""
+        if self._updater is not None:
+            self._updater(_updater_key(k), reduced, self._store[k])
+        else:
+            self._store[k] += reduced
+
+    def _check_comm_error(self):
+        # sticky: a failed comm op leaves the store in an unknown state
+        # relative to its peers, so every later pull/barrier/save must
+        # keep failing rather than hand out silently-stale weights
+        if self._comm_error is not None:
+            raise MXNetError(
+                "dist kvstore comm op failed (store is poisoned — weights "
+                "may be stale relative to other ranks): %r"
+                % (self._comm_error,)) from self._comm_error
+
+    def _drain_comm(self):
+        """Wait out every queued comm-lane op (then surface any failure).
+        Needed before mutating state the IO thread reads at execution
+        time (e.g. the updater), or per-rank timing would decide which
+        updater a queued collective round uses."""
+        if self._comm_var is not None:
+            from . import engine
+
+            engine.wait_for_var(self._comm_var)
+            self._check_comm_error()
+
     # -- control plane -------------------------------------------------
     def set_updater(self, updater):
         if self._tpu is not None:
@@ -291,6 +368,10 @@ class KVStore(object):
                 "updater would reintroduce the per-key host round-trip. "
                 "Use set_optimizer (sgd/adam/rmsprop) or kvstore "
                 "'dist_sync'.")
+        # queued comm ops read self._updater on the IO thread; swapping
+        # it mid-flight would let per-rank timing decide which updater a
+        # collective round uses (ranks would diverge)
+        self._drain_comm()
         self._updater = updater
 
     def set_optimizer(self, optimizer):
@@ -322,8 +403,15 @@ class KVStore(object):
     def barrier(self):
         self._barrier_count += 1
         if self.num_workers > 1:
+            from . import engine
             from .parallel.collectives import barrier
 
+            # drain the comm lane first so this rank's barrier collective
+            # is initiated AFTER its queued push collectives — every rank
+            # then walks the same collective sequence
+            if self._comm_var is not None:
+                engine.wait_for_var(self._comm_var)
+                self._check_comm_error()
             barrier()
 
     def send_command_to_servers(self, head, body):
@@ -375,6 +463,7 @@ class KVStore(object):
 
         for v in self._key_vars.values():  # drain in-flight updates
             engine.wait_for_var(v)
+        self._check_comm_error()
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states())
 
@@ -396,6 +485,7 @@ class KVStore(object):
 
         for v in self._key_vars.values():  # drain in-flight updates
             engine.wait_for_var(v)
+        self._check_comm_error()
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 

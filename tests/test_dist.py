@@ -73,6 +73,14 @@ def test_dist_sync_kvstore_via_launcher(n):
     _launch_and_expect(n, "dist_sync_kvstore.py", "dist_sync kvstore OK")
 
 
+def test_dist_sync_overlap_via_launcher():
+    # the push(priority=) note measured: async comm-lane pushes return
+    # immediately, so pull(k) waits only key k — time-to-first-key is ~1
+    # stagger delay, not nkeys of them, against a straggler peer; raw
+    # compute/comm overlap numbers recorded for docs/PERF.md
+    _launch_and_expect(2, "dist_sync_overlap.py", "dist_sync overlap OK")
+
+
 def test_dist_tpu_kvstore_via_launcher():
     # the TPU-native fused sync mode: accumulate semantics + bitwise
     # update-on-push parity with dist_sync (sgd-momentum AND adam),
