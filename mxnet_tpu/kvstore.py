@@ -368,10 +368,16 @@ class KVStore(object):
                 "updater would reintroduce the per-key host round-trip. "
                 "Use set_optimizer (sgd/adam/rmsprop) or kvstore "
                 "'dist_sync'.")
-        # queued comm ops read self._updater on the IO thread; swapping
-        # it mid-flight would let per-rank timing decide which updater a
-        # collective round uses (ranks would diverge)
+        # queued engine ops (dist comm lane AND single-process kv_update
+        # ops) read self._updater when they RUN; swapping it mid-flight
+        # would let worker timing decide which updater a queued gradient
+        # gets (and in dist mode, desynchronize ranks)
         self._drain_comm()
+        if self._key_vars:
+            from . import engine
+
+            for v in self._key_vars.values():
+                engine.wait_for_var(v)
         self._updater = updater
 
     def set_optimizer(self, optimizer):
@@ -403,15 +409,12 @@ class KVStore(object):
     def barrier(self):
         self._barrier_count += 1
         if self.num_workers > 1:
-            from . import engine
             from .parallel.collectives import barrier
 
             # drain the comm lane first so this rank's barrier collective
             # is initiated AFTER its queued push collectives — every rank
             # then walks the same collective sequence
-            if self._comm_var is not None:
-                engine.wait_for_var(self._comm_var)
-                self._check_comm_error()
+            self._drain_comm()
             barrier()
 
     def send_command_to_servers(self, head, body):
