@@ -1,0 +1,208 @@
+"""Model-level int8 PTQ on the transformer LM (VERDICT r4 #2's second
+clause — the quantized FC path on the transformer: FFN pairs and the
+vocab-projection head are graph-level ``FullyConnected`` nodes, so the
+same ``contrib.quantization`` pipeline that rewrote ResNet applies
+unchanged; attention projections live inside the fused
+``MultiHeadAttention`` op and stay in the float path).
+
+Two modes (mirror of ``examples/quantize_resnet.py``):
+
+* gate (default, CPU): train a tiny LM fp32 on the synthetic
+  next-token corpus, PTQ it, and verify int8 next-token accuracy stays
+  within a point of fp32.
+* ``--benchmark``: the bench-geometry 12L d1024 LM (batch 8, T=1024)
+  on the current device — int8(out=bf16, quantized from the bf16
+  graph so the unquantized attention path is identical in both rows)
+  vs bf16 vs fp32 inference tokens/s, one JSON line per dtype.  Run on
+  the chip for the BENCH_TABLE.md int8 LM row.
+
+    python examples/quantize_transformer.py             # accuracy gate
+    python examples/quantize_transformer.py --benchmark --tpus 1
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def _want_tpu(argv):
+    return any(a == "--tpus" and argv[i + 1] != "0"
+               for i, a in enumerate(argv[:-1])) or \
+        any(a.startswith("--tpus=") and a.split("=", 1)[1] != "0"
+            for a in argv)
+
+
+if __name__ == "__main__" and not _want_tpu(sys.argv[1:]):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.contrib import quantization as Q  # noqa: E402
+from mxnet_tpu.models import transformer  # noqa: E402
+
+
+def make_corpus(rng, n, vocab, seq_len):
+    """Deterministic next-token structure: token_{t+1} = token_t + 1
+    (mod vocab) from a random start — learnable to ~1.0 accuracy."""
+    starts = rng.randint(0, vocab, (n, 1))
+    steps = np.arange(seq_len + 1)[None, :]
+    seqs = (starts + steps) % vocab
+    return seqs[:, :-1].astype(np.float32), seqs[:, 1:].astype(np.float32)
+
+
+def _next_token_accuracy(sym, args, auxs, xs, ys, ctx, batch=32):
+    T = xs.shape[1]
+    exe = sym.simple_bind(ctx, grad_req="null", data=(batch, T),
+                          softmax_label=(batch, T))
+    for k, v in args.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in auxs.items():
+        if k in exe.aux_dict:
+            exe.aux_dict[k][:] = v.asnumpy()
+    hits = tot = 0
+    for s in range(0, len(xs) - batch + 1, batch):
+        exe.arg_dict["data"][:] = xs[s:s + batch]
+        out = exe.forward(is_train=False)[0].asnumpy()
+        pred = out.reshape(batch, T, -1).argmax(-1)
+        hits += (pred == ys[s:s + batch]).sum()
+        tot += batch * T
+    return hits / float(tot)
+
+
+def run(epochs=4, n_train=512, seed=0, log=True):
+    rng = np.random.RandomState(seed)
+    vocab, T = 64, 32
+    xs, ys = make_corpus(rng, n_train, vocab, T)
+    xv, yv = make_corpus(rng, 256, vocab, T)
+    ctx = mx.cpu()
+
+    sym = transformer.get_symbol(num_classes=vocab, seq_len=T,
+                                 num_embed=64, num_heads=2, num_layers=2)
+    mod = mx.mod.Module(sym, context=ctx)
+    it = mx.io.NDArrayIter({"data": xs}, {"softmax_label": ys},
+                           batch_size=32)
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            eval_metric=mx.metric.Perplexity(None),
+            initializer=mx.initializer.Xavier())
+    args, auxs = mod.get_params()
+
+    fp32_acc = _next_token_accuracy(sym, args, auxs, xv, yv, ctx)
+    calib = [{"data": xs[s:s + 32], "softmax_label": ys[s:s + 32]}
+             for s in range(0, 128, 32)]
+    qsym, qargs, qauxs = Q.quantize_model(sym, args, auxs, calib, ctx)
+    int8_acc = _next_token_accuracy(qsym, qargs, qauxs, xv, yv, ctx)
+    if log:
+        logging.info("fp32 acc=%.3f int8 acc=%.3f", fp32_acc, int8_acc)
+    return {"fp32_acc": fp32_acc, "int8_acc": int8_acc}
+
+
+def _throughput(sym, args, auxs, ctx, batch, seq_len, vocab, batches=20):
+    import jax.numpy as jnp
+
+    exe = sym.simple_bind(ctx, grad_req="null", data=(batch, seq_len),
+                          softmax_label=(batch, seq_len))
+    # host-numpy assignment keeps the executor's placement (an NDArray
+    # source re-binds the dest to ITS device — quantize_resnet.py)
+    for k, v in args.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in auxs.items():
+        if k in exe.aux_dict:
+            exe.aux_dict[k][:] = v.asnumpy()
+    exe.arg_dict["data"][:] = np.random.randint(
+        0, vocab, (batch, seq_len)).astype(np.float32)
+
+    def sync(o):
+        return np.asarray(jnp.ravel(o[0]._data)[0])
+
+    sync(exe.forward(is_train=False))
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            out = exe.forward(is_train=False)
+        sync(out)
+        best = max(best,
+                   batch * seq_len * batches / (time.perf_counter() - t0))
+    return best
+
+
+def benchmark(batch=8, seq_len=1024, log=True):
+    """12L d1024 LM inference tokens/s: int8 PTQ (FFN + LM head on the
+    MXU int8 path, bf16 rescaled outputs) vs bf16 vs fp32."""
+    import jax
+
+    ctx = mx.tpu(0) if jax.default_backend() == "tpu" else mx.cpu()
+    rng = np.random.RandomState(0)
+    vocab, d, L = 32000, 1024, 12
+
+    def build(dtype):
+        return transformer.get_symbol(
+            num_classes=vocab, seq_len=seq_len, num_embed=d,
+            num_heads=d // 64, num_layers=L, dtype=dtype)
+
+    fsym = build("float32")
+    arg_shapes, _, _ = fsym.infer_shape(data=(batch, seq_len),
+                                        softmax_label=(batch, seq_len))
+    args = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.02)
+            for n, s in zip(fsym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    auxs = {}
+
+    # quantize the bf16 graph so attention/LN run identically in the
+    # int8 and bf16 rows — the delta isolates the int8 FC path
+    bsym = build("bfloat16")
+    calib = [{"data": rng.randint(0, vocab, (2, seq_len))
+              .astype(np.float32),
+              "softmax_label": np.zeros((2, seq_len), np.float32)}]
+    qsym, qargs, qauxs = Q.quantize_model(bsym, args, auxs, calib, ctx,
+                                          out_dtype="bfloat16")
+
+    rows = {}
+    for tag, (s, a, au) in {
+        "fp32": (fsym, args, auxs),
+        "bf16": (bsym, args, auxs),
+        "int8": (qsym, qargs, qauxs),
+    }.items():
+        rows[tag] = _throughput(s, a, au, ctx, batch, seq_len, vocab)
+        if log:
+            print(json.dumps({"metric": "lm_infer_%s" % tag,
+                              "value": round(rows[tag], 1),
+                              "unit": "tokens/s", "batch": batch,
+                              "seq": seq_len}), flush=True)
+    return rows
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--benchmark", action="store_true")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--tpus", default="0")
+    args = ap.parse_args()
+    if args.benchmark:
+        benchmark(batch=args.batch, seq_len=args.seq)
+        return
+    stats = run(epochs=args.epochs)
+    print("quantize_transformer: fp32=%.3f int8=%.3f"
+          % (stats["fp32_acc"], stats["int8_acc"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -290,6 +290,18 @@ def test_train_pipeline_example():
     assert abs(gpipe["accuracy"] - stats["accuracy"]) < 1e-6, (stats, gpipe)
 
 
+def test_quantize_transformer_example():
+    """PTQ on the transformer LM (the quantized FC path: FFN pairs +
+    vocab head; attention stays float inside the fused op) — int8
+    next-token accuracy within a point of fp32 on a trained tiny LM.
+    Chip throughput rows come from the same example's --benchmark mode
+    via tools/bench_table.py."""
+    stats = _run_example("quantize_transformer.py",
+                         "epochs=4, n_train=512, log=False")
+    assert stats["fp32_acc"] > 0.9, stats
+    assert stats["int8_acc"] >= stats["fp32_acc"] - 0.01, stats
+
+
 def test_quantize_resnet_example():
     """Model-level PTQ (contrib.quantization): BN fold + symmetric
     calibration + int8 graph rewrite on a trained ResNet-8; int8 top-1

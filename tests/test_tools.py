@@ -262,3 +262,18 @@ def test_bench_table_render_int8_and_moe_sections():
     out3 = bt.render([], [], "TestChip",
                      int8_rows={"error": "no chip"})
     assert "int8 row FAILED" in out3
+
+
+def test_bench_table_render_lm_int8_section():
+    import tools.bench_table as bt
+
+    rows = {"fp32": 170000.0, "bf16": 210000.0, "int8": 231000.0,
+            "batch": 32, "seq": 1024}
+    out = bt.render([], [], "TestChip", lm_int8_rows=rows)
+    assert "transformer LM (12L d1024, b32 T1024)" in out
+    assert "1.10×" in out              # int8 vs bf16
+    assert "| bf16 | 210000 | 1.0× |" in out
+    # a failed capture renders an error note, never fabricated rows
+    out2 = bt.render([], [], "TestChip",
+                     lm_int8_rows={"error": "partial capture"})
+    assert "int8 LM row FAILED" in out2 and "tokens/s" not in out2

@@ -104,34 +104,54 @@ def bench_transformer_row(extra_env=None):
     return row
 
 
-def bench_int8_rows():
-    """int8 PTQ ResNet-50 inference vs fp32/bf16 on the same device
-    (examples/quantize_resnet.py --benchmark; the chip-measured MODEL
-    row for the op-level int8 claim).  Returns {tag: img_s} or
-    {'error': ...}."""
+def _capture_quantize_bench(script, metric_prefix, extra_args=()):
+    """Run an examples/quantize_*.py --benchmark subprocess and parse its
+    {fp32, bf16, int8} JSON lines.  A partial capture (crash after the
+    fp32 line) must not render fabricated 0.0 rows as measurements, so
+    anything short of all three tags returns {'error': ...}."""
     import subprocess
 
     try:
         r = subprocess.run(
-            [sys.executable,
-             os.path.join(ROOT, "examples", "quantize_resnet.py"),
-             "--benchmark", "--tpus", "1"],
+            [sys.executable, os.path.join(ROOT, "examples", script),
+             "--benchmark", "--tpus", "1", *extra_args],
             capture_output=True, text=True, timeout=1800, cwd=ROOT)
     except subprocess.TimeoutExpired:
-        return {"error": "quantize_resnet --benchmark timed out"}
+        return {"error": "%s --benchmark timed out" % script}
     rows = {}
     for line in r.stdout.splitlines():
         try:
             d = json.loads(line)
         except ValueError:
             continue
-        if str(d.get("metric", "")).startswith("resnet50_infer_"):
+        if str(d.get("metric", "")).startswith(metric_prefix):
             rows[d["metric"].rsplit("_", 1)[1]] = float(d["value"])
     if set(rows) != {"fp32", "int8", "bf16"}:
-        # a partial capture (crash after the fp32 line) must not render
-        # fabricated 0.0 rows as measurements
         return {"error": "partial capture %s: %s" % (
             sorted(rows), (r.stderr or "no output").strip()[-250:])}
+    return rows
+
+
+def bench_int8_rows():
+    """int8 PTQ ResNet-50 inference vs fp32/bf16 on the same device
+    (examples/quantize_resnet.py --benchmark; the chip-measured MODEL
+    row for the op-level int8 claim).  Returns {tag: img_s} or
+    {'error': ...}."""
+    return _capture_quantize_bench("quantize_resnet.py", "resnet50_infer_")
+
+
+def bench_lm_int8_rows(batch=32, seq=1024):
+    """int8 PTQ transformer-LM inference vs fp32/bf16
+    (examples/quantize_transformer.py --benchmark: FFN pairs + the
+    vocab head on the MXU int8 path, attention bf16 in both rows).
+    b32: the throughput-oriented inference batch (the b8 bench geometry
+    is attention/HBM-dominated enough that the int8 FC delta sits
+    inside tunnel noise — measured in docs/PERF.md)."""
+    rows = _capture_quantize_bench(
+        "quantize_transformer.py", "lm_infer_",
+        ("--batch", str(batch), "--seq", str(seq)))
+    if "error" not in rows:
+        rows["batch"], rows["seq"] = batch, seq
     return rows
 
 
@@ -147,7 +167,7 @@ def bench_moe_rows():
 
 
 def render(infer_rows, train_rows, chip, lm_row=None, int8_rows=None,
-           moe_rows=None):
+           moe_rows=None, lm_int8_rows=None):
     """Render the captured rows as the BENCH_TABLE.md markdown
     (pure function so the formatting rules are unit-testable:
     None renders as fail, ratios only from real bf16 values)."""
@@ -233,6 +253,31 @@ def render(infer_rows, train_rows, chip, lm_row=None, int8_rows=None,
         ]
     elif int8_rows:
         lines += ["", "int8 row FAILED: %s" % int8_rows["error"][:200]]
+    if lm_int8_rows and "error" not in lm_int8_rows:
+        bf16 = lm_int8_rows.get("bf16")
+        i8 = lm_int8_rows.get("int8")
+        lines += [
+            "",
+            "## int8 PTQ inference — transformer LM (12L d1024, b%d "
+            "T%d)" % (lm_int8_rows.get("batch", 32),
+                      lm_int8_rows.get("seq", 1024)),
+            "",
+            "| path | tokens/s | vs bf16 |",
+            "|---|---|---|",
+            "| fp32 | %.0f | — |" % lm_int8_rows.get("fp32", 0.0),
+            "| bf16 | %.0f | 1.0× |" % (bf16 or 0.0),
+            "| int8 (PTQ FFN + vocab head; attention bf16 in both "
+            "rows) | %.0f | %s |" % (
+                i8 or 0.0,
+                "%.2f×" % (i8 / bf16) if (i8 and bf16) else "—"),
+            "",
+            "Accuracy gated in `tests/test_examples_round3.py::`",
+            "`test_quantize_transformer_example`.  Capture:",
+            "`examples/quantize_transformer.py --benchmark --batch 32`.",
+        ]
+    elif lm_int8_rows:
+        lines += ["", "int8 LM row FAILED: %s"
+                  % lm_int8_rows["error"][:200]]
     if moe_rows and "error" not in moe_rows.get("moe", {"error": 1}) \
             and "error" not in moe_rows.get("dense", {"error": 1}):
         m = moe_rows["moe"]
@@ -397,17 +442,23 @@ def main():
     print("int8 resnet-50: %s (%.0fs)" % (int8_rows, time.time() - t0),
           flush=True)
     t0 = time.time()
+    lm_int8_rows = bench_lm_int8_rows()
+    print("int8 transformer-LM: %s (%.0fs)" % (lm_int8_rows,
+                                               time.time() - t0),
+          flush=True)
+    t0 = time.time()
     moe_rows = bench_moe_rows()
     print("moe transformer: %s (%.0fs)" % (moe_rows, time.time() - t0),
           flush=True)
 
     table = render(infer_rows, train_rows, chip, lm_row=lm_row,
-                   int8_rows=int8_rows, moe_rows=moe_rows)
+                   int8_rows=int8_rows, moe_rows=moe_rows,
+                   lm_int8_rows=lm_int8_rows)
     with open(args.out, "w") as fh:
         fh.write(table)
     capture = {"chip": chip, "infer": infer_rows, "train": train_rows,
                "transformer_lm": lm_row, "int8": int8_rows,
-               "moe": moe_rows}
+               "lm_int8": lm_int8_rows, "moe": moe_rows}
     cap_path = os.path.splitext(args.out)[0] + ".json"
     with open(cap_path, "w") as fh:
         json.dump(capture, fh, indent=1, default=str)
