@@ -267,12 +267,18 @@ def test_bench_table_render_int8_and_moe_sections():
 def test_bench_table_render_lm_int8_section():
     import tools.bench_table as bt
 
-    rows = {"fp32": 170000.0, "bf16": 210000.0, "int8": 231000.0,
-            "batch": 32, "seq": 1024}
+    rows = {"fp32": 170000.0, "bf16": 210000.0, "int8": 220500.0,
+            "int8sel": 231000.0, "batch": 32, "seq": 1024}
     out = bt.render([], [], "TestChip", lm_int8_rows=rows)
     assert "transformer LM (12L d1024, b32 T1024)" in out
-    assert "1.10×" in out              # int8 vs bf16
+    assert "1.05×" in out              # int8 full vs bf16
+    assert "1.10×" in out              # int8 selective vs bf16
     assert "| bf16 | 210000 | 1.0× |" in out
+    # int8sel is optional (older captures lack it): no row, no crash
+    out_nosel = bt.render([], [], "TestChip",
+                          lm_int8_rows={k: v for k, v in rows.items()
+                                        if k != "int8sel"})
+    assert "selective" not in out_nosel
     # a failed capture renders an error note, never fabricated rows
     out2 = bt.render([], [], "TestChip",
                      lm_int8_rows={"error": "partial capture"})
