@@ -160,7 +160,29 @@ class KVStore(object):
                 engine.wait_for_var(self._key_vars[k])
             self._store[k] = vlist[0].copy()
             if self._tpu is not None:
-                self._tpu.init(_updater_key(k), vlist[0]._data)
+                # reference init semantics are rank-0-wins (worker 0
+                # pushes the value to the servers, kvstore_dist.h:40-44).
+                # dist_tpu must broadcast BEFORE seeding the fused store:
+                # host_local_array_to_global_array with a replicated spec
+                # assumes identical host-local values, so divergent rank
+                # inits would be silently undefined
+                data = self._store[k]._data
+                if self.num_workers > 1:
+                    import jax.numpy as jnp
+
+                    from .parallel.collectives import allreduce_hosts
+
+                    contrib = (data if self.rank == 0
+                               else jnp.zeros_like(data))
+                    data = jnp.asarray(allreduce_hosts(contrib))
+                    self._store[k]._set_data(data)
+                self._tpu.init(_updater_key(k), data)
+            elif (self._kind.startswith("dist") and self._async is None
+                    and self.num_workers > 1):
+                # same rank-0-wins semantics, on the comm lane so ranks
+                # with divergent local inits converge before the first
+                # pull (which waits this key's var)
+                self._init_dist_bcast(k)
         if self._async is not None:
             import numpy as _np
 
@@ -307,29 +329,51 @@ class KVStore(object):
         ``priority=`` comm (model.py:94-110): the socket round-trip runs
         on an engine IO thread while the trainer dispatches more work.
         """
-        from . import engine
-
         grad_data = merged._data
         grad_ctx = merged.context
 
         def comm(k=k, grad_data=grad_data, grad_ctx=grad_ctx):
-            # once any comm op fails, the lane is poisoned: initiating
-            # further collectives on this rank while peers may still be
-            # inside the failed one would desynchronize the cross-rank
-            # collective order, so every queued op becomes a no-op and
-            # the sticky error surfaces on the next pull/barrier/save
+            self._apply_update(k, self._allreduce(
+                NDArray(grad_data, grad_ctx)))
+
+        self._enqueue_comm(comm, k, "kv_dist_push")
+
+    def _enqueue_comm(self, fn, k, name):
+        """Enqueue one dist collective on the comm lane: skipped when the
+        lane is poisoned (no further collectives once ranks may be
+        desynchronized), failures captured as the sticky comm error, and
+        ordered by the shared ``_comm_var`` + this key's var — the ONE
+        place the lane discipline lives."""
+        from . import engine
+
+        def run():
             if self._comm_error is not None:
                 return
             try:
-                self._apply_update(k, self._allreduce(
-                    NDArray(grad_data, grad_ctx)))
+                fn()
             except BaseException as e:  # noqa: BLE001 — surface on pull
                 self._comm_error = e
 
         if self._comm_var is None:
             self._comm_var = engine.new_variable()
-        engine.push(comm, mutable_vars=[self._comm_var, self._key_var(k)],
-                    prop=engine.FnProperty.IO, name="kv_dist_push")
+        engine.push(run, mutable_vars=[self._comm_var, self._key_var(k)],
+                    prop=engine.FnProperty.IO, name=name)
+
+    def _init_dist_bcast(self, k):
+        """Enqueue a rank-0 broadcast of key ``k``'s just-stored value
+        (an allreduce where only rank 0 contributes), ordered on the
+        comm lane like every other dist collective."""
+
+        def bcast(k=k):
+            import jax.numpy as jnp
+
+            v = self._store[k]
+            contrib = (v._data if self.rank == 0
+                       else jnp.zeros_like(v._data))
+            red = self._allreduce(NDArray(contrib, v.context))
+            self._store[k]._set_data(red._data)
+
+        self._enqueue_comm(bcast, k, "kv_dist_init")
 
     def _apply_update(self, k, reduced):
         """Apply one reduced value to the store (shared by the dist comm

@@ -85,6 +85,14 @@ def main():
     _parity("adam", (2, 8), rank, nworkers, atol=2e-6,
             learning_rate=0.05, rescale_grad=1.0 / nworkers)
 
+    # -- tier 4: rank-0-wins init (kvstore_dist.h:40-44 semantics) -----
+    # ranks init DIVERGENT values; every rank must observe rank 0's
+    kv.init("b", mx.nd.ones(shape) * float(100 + rank))
+    out_b = mx.nd.zeros(shape)
+    kv.pull("b", out=out_b)
+    np.testing.assert_array_equal(out_b.asnumpy(),
+                                  np.full(shape, 100.0, np.float32))
+
     sys.stdout.write("worker %d/%d: dist_tpu kvstore OK (expected=%d)\n"
                      % (rank, nworkers, expected))
     sys.stdout.flush()

@@ -73,6 +73,13 @@ def test_dist_sync_kvstore_via_launcher(n):
     _launch_and_expect(n, "dist_sync_kvstore.py", "dist_sync kvstore OK")
 
 
+def test_dist_module_fit_via_launcher():
+    # the reference's dist_lenet.py role: real Module.fit training over
+    # dist_sync — rank-0-wins broadcast init (ranks seed divergently),
+    # bitwise-replicated weights after fit, convergence on held-out data
+    _launch_and_expect(2, "dist_module_fit.py", "dist module fit OK")
+
+
 def test_dist_sync_overlap_via_launcher():
     # the push(priority=) note measured: async comm-lane pushes return
     # immediately, so pull(k) waits only key k — time-to-first-key is ~1
