@@ -17,6 +17,7 @@ the Convolution/FullyConnected ops (the fp16-variant symbols of the reference,
 """
 
 from . import mlp, lenet, alexnet, vgg, googlenet, inception_bn, inception_v3, resnet
+from . import inception_resnet_v2
 from . import lstm
 from . import transformer
 
@@ -31,6 +32,8 @@ _REGISTRY = {
     "inception_bn": inception_bn,
     "inception-v3": inception_v3,
     "inception_v3": inception_v3,
+    "inception-resnet-v2": inception_resnet_v2,
+    "inception_resnet_v2": inception_resnet_v2,
     "resnet": resnet,
     "resnet-18": resnet,
     "resnet-34": resnet,

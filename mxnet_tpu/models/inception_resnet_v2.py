@@ -1,0 +1,148 @@
+"""Inception-ResNet-v2 (parity: reference
+``example/image-classification/symbols/inception-resnet-v2.py`` — the
+Szegedy et al. 2016 architecture: stem -> 10x Inception-ResNet-A ->
+Reduction-A -> 20x Inception-ResNet-B -> Reduction-B -> 10x
+Inception-ResNet-C -> 1x1 to 1536 -> pooled softmax head).
+
+Design notes (fresh, not a translation): the reference spells the three
+residual block types as three near-identical functions; here one
+table-driven ``_res_block`` builds all of them from tower specs, which is
+also what keeps every layer uniquely named for checkpointing.  The
+reference's behavioral quirks are preserved deliberately for parity:
+
+- block-B's first 1x1 tower has **129** channels (the reference's value —
+  kept so parameter shapes match);
+- block-B's 1x7/7x1 convs use pads (1,2)/(2,1) (net shape-preserving);
+- residual adds are ``net + scale * tower`` with post-add ReLU except the
+  final block-C, which omits the activation.
+
+TPU notes: pass ``dtype='bfloat16'`` for bf16 activations with fp32 MXU
+accumulation (the fp16-variant pattern); all convs are BN'd so XLA fuses
+the scale/shift/relu epilogues into the conv.
+"""
+
+from .. import symbol as sym
+
+
+def conv_bn(data, num_filter, kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+            name=None, with_act=True):
+    """Conv + BatchNorm (+ ReLU) — the reference's ConvFactory."""
+    c = sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, name="%s_conv" % name)
+    bn = sym.BatchNorm(data=c, name="%s_bn" % name)
+    if not with_act:
+        return bn
+    return sym.Activation(data=bn, act_type="relu", name="%s_relu" % name)
+
+
+def _tower(data, specs, name):
+    """Chain of conv_bn layers; each spec is (num_filter, kernel, pad)
+    or (num_filter, kernel, pad, stride)."""
+    out = data
+    for i, spec in enumerate(specs):
+        nf, kernel, pad = spec[:3]
+        stride = spec[3] if len(spec) > 3 else (1, 1)
+        out = conv_bn(out, nf, kernel=kernel, stride=stride, pad=pad,
+                      name="%s_c%d" % (name, i))
+    return out
+
+
+# Residual block tower tables: list of towers, each a list of conv specs.
+_BLOCK_A = [  # block35: 35x35 grid, mixes 1x1 / 3x3 / double-3x3
+    [(32, (1, 1), (0, 0))],
+    [(32, (1, 1), (0, 0)), (32, (3, 3), (1, 1))],
+    [(32, (1, 1), (0, 0)), (48, (3, 3), (1, 1)), (64, (3, 3), (1, 1))],
+]
+_BLOCK_B = [  # block17: 17x17 grid, 1x1 + factorized 7x7
+    [(192, (1, 1), (0, 0))],
+    # 129 (not 128) and the (1,2)/(2,1) pads are the reference's values
+    [(129, (1, 1), (0, 0)), (160, (1, 7), (1, 2)), (192, (7, 1), (2, 1))],
+]
+_BLOCK_C = [  # block8: 8x8 grid, 1x1 + factorized 3x3
+    [(192, (1, 1), (0, 0))],
+    [(192, (1, 1), (0, 0)), (224, (1, 3), (0, 1)), (256, (3, 1), (1, 0))],
+]
+
+
+def _res_block(net, towers, num_channels, scale, name, with_act=True):
+    """Residual scaling unit: concat(towers) -> 1x1 projection back to
+    ``num_channels`` -> ``net + scale*proj`` -> optional ReLU."""
+    outs = [_tower(net, specs, "%s_t%d" % (name, i))
+            for i, specs in enumerate(towers)]
+    mixed = sym.Concat(*outs, name="%s_concat" % name)
+    proj = conv_bn(mixed, num_channels, name="%s_proj" % name,
+                   with_act=False)
+    net = net + scale * proj
+    if with_act:
+        net = sym.Activation(data=net, act_type="relu",
+                             name="%s_relu" % name)
+    return net
+
+
+def get_symbol(num_classes=1000, dtype="float32", dropout=0.2, **kwargs):
+    data = sym.Variable(name="data")
+    if dtype != "float32":
+        data = sym.Cast(data=data, dtype=dtype)
+
+    # stem: 299x299x3 -> 35x35x320
+    net = conv_bn(data, 32, kernel=(3, 3), stride=(2, 2), name="stem1a")
+    net = conv_bn(net, 32, kernel=(3, 3), name="stem2a")
+    net = conv_bn(net, 64, kernel=(3, 3), pad=(1, 1), name="stem2b")
+    net = sym.Pooling(data=net, kernel=(3, 3), stride=(2, 2),
+                      pool_type="max", name="stem_pool3a")
+    net = conv_bn(net, 80, name="stem3b")
+    net = conv_bn(net, 192, kernel=(3, 3), name="stem4a")
+    net = sym.Pooling(data=net, kernel=(3, 3), stride=(2, 2),
+                      pool_type="max", name="stem_pool5a")
+    # mixed 5b: four towers incl. an avg-pool projection
+    t0 = conv_bn(net, 96, name="m5b_t0")
+    t1 = _tower(net, [(48, (1, 1), (0, 0)), (64, (5, 5), (2, 2))], "m5b_t1")
+    t2 = _tower(net, [(64, (1, 1), (0, 0)), (96, (3, 3), (1, 1)),
+                      (96, (3, 3), (1, 1))], "m5b_t2")
+    t3 = sym.Pooling(data=net, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                     pool_type="avg", name="m5b_pool")
+    t3 = conv_bn(t3, 64, name="m5b_t3")
+    net = sym.Concat(t0, t1, t2, t3, name="m5b_concat")
+
+    # 10x Inception-ResNet-A at 320 channels
+    for i in range(10):
+        net = _res_block(net, _BLOCK_A, 320, 0.17, "a%d" % i)
+
+    # Reduction-A: 35x35x320 -> 17x17x1088
+    r0 = conv_bn(net, 384, kernel=(3, 3), stride=(2, 2), name="ra_t0")
+    r1 = _tower(net, [(256, (1, 1), (0, 0)), (256, (3, 3), (1, 1)),
+                      (384, (3, 3), (0, 0), (2, 2))], "ra_t1")
+    rp = sym.Pooling(data=net, kernel=(3, 3), stride=(2, 2),
+                     pool_type="max", name="ra_pool")
+    net = sym.Concat(r0, r1, rp, name="ra_concat")
+
+    # 20x Inception-ResNet-B at 1088 channels
+    for i in range(20):
+        net = _res_block(net, _BLOCK_B, 1088, 0.10, "b%d" % i)
+
+    # Reduction-B: 17x17x1088 -> 8x8x2080
+    r0 = _tower(net, [(256, (1, 1), (0, 0)),
+                      (384, (3, 3), (0, 0), (2, 2))], "rb_t0")
+    r1 = _tower(net, [(256, (1, 1), (0, 0)),
+                      (288, (3, 3), (0, 0), (2, 2))], "rb_t1")
+    r2 = _tower(net, [(256, (1, 1), (0, 0)), (288, (3, 3), (1, 1)),
+                      (320, (3, 3), (0, 0), (2, 2))], "rb_t2")
+    rp = sym.Pooling(data=net, kernel=(3, 3), stride=(2, 2),
+                     pool_type="max", name="rb_pool")
+    net = sym.Concat(r0, r1, r2, rp, name="rb_concat")
+
+    # 9x Inception-ResNet-C + the final activation-less one, at 2080
+    for i in range(9):
+        net = _res_block(net, _BLOCK_C, 2080, 0.20, "c%d" % i)
+    net = _res_block(net, _BLOCK_C, 2080, 1.0, "c9", with_act=False)
+
+    net = conv_bn(net, 1536, name="final_conv")
+    net = sym.Pooling(data=net, kernel=(1, 1), global_pool=True,
+                      pool_type="avg", name="global_pool")
+    net = sym.Flatten(data=net, name="flatten")
+    if dropout > 0:
+        net = sym.Dropout(data=net, p=dropout, name="dropout")
+    fc1 = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc1")
+    if dtype != "float32":
+        fc1 = sym.Cast(data=fc1, dtype="float32")
+    return sym.SoftmaxOutput(data=fc1, name="softmax")

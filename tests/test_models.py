@@ -31,9 +31,12 @@ def test_resnet_shapes(net):
 
 
 @pytest.mark.parametrize("net", ["alexnet", "vgg", "googlenet",
-                                 "inception-bn", "inception-v3"])
+                                 "inception-bn", "inception-v3",
+                                 "inception-resnet-v2"])
 def test_big_convnets_infer(net):
-    shape = (2, 3, 299, 299) if net == "inception-v3" else (2, 3, 224, 224)
+    shape = ((2, 3, 299, 299) if net in ("inception-v3",
+                                         "inception-resnet-v2")
+             else (2, 3, 224, 224))
     sym = models.get_symbol(net, num_classes=1000)
     arg_shapes, out_shapes, aux_shapes = sym.infer_shape(
         data=shape, softmax_label=(2,))
