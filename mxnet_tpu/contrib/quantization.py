@@ -32,7 +32,7 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["fold_bn", "quantize_symbol", "calibrate_ranges",
-           "quantize_model"]
+           "quantize_model", "quantize_aware_symbol", "quantize_model_qat"]
 
 
 # ---------------------------------------------------------------------
@@ -108,12 +108,13 @@ def _null(name, shape=None, dtype=None):
 
 
 def _rewire(nodes, heads, old, new):
-    """Point every consumer of ``old``'s output 0 (and heads) at
-    ``new``'s output 0."""
+    """Point every consumer of ``old``'s output 0 (and heads) at ``new``
+    — a node (its output 0) or an explicit ``(node, out_idx)`` entry."""
+    entry = new if isinstance(new, tuple) else (new, 0)
     for n in nodes:
-        n["inputs"] = [((new, 0) if s is old and oi == 0 else (s, oi))
+        n["inputs"] = [(entry if s is old and oi == 0 else (s, oi))
                        for s, oi in n["inputs"]]
-    return [((new, 0) if h is old and oi == 0 else (h, oi))
+    return [(entry if h is old and oi == 0 else (h, oi))
             for h, oi in heads]
 
 
@@ -429,6 +430,101 @@ def quantize_model(sym, arg_params, aux_params, calib_data, ctx,
                                   excluded_sym_names=excluded_sym_names,
                                   out_dtype=out_dtype)
     return qsym, qargs, fauxs
+
+
+# ---------------------------------------------------------------------
+# QAT: fake-quant insertion (training) + export to the int8 graph
+# ---------------------------------------------------------------------
+
+def quantize_aware_symbol(sym, excluded_sym_names=(), ema_momentum=0.99,
+                          num_bits=8, quantize_weights=True):
+    """Insert fake-quant nodes for quantization-aware training.
+
+    Every quantizable Convolution/FullyConnected gets its DATA input
+    routed through a ``_contrib_fake_quant`` observer (EMA-tracked amax
+    auxiliary state, straight-through-estimator backward) and — when
+    ``quantize_weights`` — its weight through the stateless
+    ``_contrib_fake_quant_dynamic``, so training sees the same symmetric
+    int8 grids ``quantize_symbol`` will deploy.  Consumers sharing a data
+    tensor share one observer (mirroring ``quantize_symbol``'s shared
+    ``_contrib_quantize`` node).
+
+    Recommended flow for BN models (the standard QAT pipeline): train
+    fp32 -> :func:`fold_bn` -> ``quantize_aware_symbol`` -> finetune via
+    Module (observers update like BN moving stats) ->
+    :func:`quantize_model_qat`.  Returns the QAT training symbol; the
+    new ``*_fq_amax`` aux states initialize to zero ("empty"; the first
+    training batch seeds them — Initializer routes the suffix to zeros).
+    """
+    nodes, heads = _load_graph(sym)
+    targets = [n for n in nodes if _quantizable(n)
+               and n["name"] not in excluded_sym_names]
+    fq_cache = {}  # (id(src node), out_idx) -> fake-quant node (shared)
+    for n in targets:
+        src, oi = n["inputs"][0]
+        key = (id(src), oi)
+        if key not in fq_cache:
+            base = src["name"] if oi == 0 else "%s%d" % (src["name"], oi)
+            amax = _null("%s_fq_amax" % base, (1,))
+            fq_cache[key] = {
+                "op": "_contrib_fake_quant", "name": "%s_fq" % base,
+                "attr": {"ema_momentum": str(ema_momentum),
+                         "num_bits": str(num_bits)},
+                "inputs": [(src, oi), (amax, 0)]}
+        n["inputs"][0] = (fq_cache[key], 0)
+        if quantize_weights:
+            wsrc, woi = n["inputs"][1]
+            wkey = (id(wsrc), woi)
+            if wkey not in fq_cache:
+                fq_cache[wkey] = {
+                    "op": "_contrib_fake_quant_dynamic",
+                    "name": "%s_fq" % wsrc["name"],
+                    "attr": {"num_bits": str(num_bits)},
+                    "inputs": [(wsrc, woi)]}
+            n["inputs"][1] = (fq_cache[wkey], 0)
+    return _emit_graph(heads)
+
+
+def quantize_model_qat(qat_sym, arg_params, aux_params,
+                       excluded_sym_names=(), out_dtype="float32"):
+    """Export a QAT-finetuned graph to the deployable int8 graph.
+
+    Reads each conv/FC's activation range out of its observer's
+    ``*_fq_amax`` aux state, strips every fake-quant node, and hands the
+    plain graph + ranges to :func:`quantize_symbol` — so deployment uses
+    exactly the ranges training simulated (no separate calibration pass).
+    Returns ``(qsym, qarg_params, qaux_params)`` with the observer states
+    dropped from aux."""
+    nodes, heads = _load_graph(qat_sym)
+    act_ranges = {}
+    for n in nodes:
+        if not (_quantizable(n) and n["name"] not in excluded_sym_names):
+            continue
+        src, _oi = n["inputs"][0]
+        if src["op"] != "_contrib_fake_quant":
+            continue
+        amax_name = src["inputs"][1][0]["name"]
+        if amax_name not in aux_params:
+            raise MXNetError("QAT export: observer state %r missing from "
+                             "aux_params" % amax_name)
+        a = float(_asnp(aux_params[amax_name]).max())
+        if a <= 0.0:
+            raise MXNetError(
+                "QAT observer %r is empty (amax=0); run at least one "
+                "training batch before export" % amax_name)
+        act_ranges[n["name"]] = a
+    for fq in nodes:
+        if fq["op"] not in ("_contrib_fake_quant",
+                            "_contrib_fake_quant_dynamic"):
+            continue
+        heads = _rewire(nodes, heads, fq, fq["inputs"][0])
+    stripped = _emit_graph(heads)
+    qsym, qargs = quantize_symbol(stripped, arg_params, act_ranges,
+                                  excluded_sym_names=excluded_sym_names,
+                                  out_dtype=out_dtype)
+    qauxs = {k: v for k, v in aux_params.items()
+             if not k.endswith("_fq_amax")}
+    return qsym, qargs, qauxs
 
 
 # ---------------------------------------------------------------------

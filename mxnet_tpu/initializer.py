@@ -70,6 +70,10 @@ class Initializer(object):
             self._init_one(desc, arr)
         elif desc.endswith("moving_inv_var") or desc.endswith("moving_avg"):
             self._init_zero(desc, arr)
+        elif desc.endswith("_fq_amax"):
+            # QAT observer state: zero = "empty"; the first training batch
+            # seeds the range (ops/contrib_op.py _contrib_fake_quant)
+            self._init_zero(desc, arr)
         else:
             self._init_default(desc, arr)
 

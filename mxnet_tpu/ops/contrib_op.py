@@ -665,6 +665,71 @@ def _quantized_conv(attrs, data, weight, min_data, max_data,
 
 
 # ----------------------------------------------------------------------
+# fake-quant (QAT) — training-time counterpart of the quantize ops above
+# ----------------------------------------------------------------------
+
+
+def _fq_ste(x, a, qmax):
+    """Symmetric fake-quant with the clipped straight-through estimator:
+    forward snaps to the int grid in [-a, a]; backward is the identity
+    inside the clip range and zero outside (the clip's own gradient)."""
+    scale = jnp.maximum(a, 1e-12) / qmax
+    xc = jnp.clip(x, -a, a)
+    q = jnp.round(xc / scale) * scale
+    return xc + jax.lax.stop_gradient(q - xc)
+
+
+@register(
+    "_contrib_fake_quant",
+    arg_names=["data"],
+    aux_names=["amax"],
+    params={"ema_momentum": P("float", 0.99), "num_bits": P("int", 8)},
+    needs_mode=True,
+)
+def _fake_quant(attrs, data, amax, is_train=False):
+    """Quantization-aware-training observer: forward fake-quantizes to a
+    symmetric ``num_bits`` grid whose range is an EMA of max|x| tracked in
+    the ``amax`` auxiliary state (updated by training forward like
+    BatchNorm's moving stats; the first batch seeds it).  Backward is the
+    clipped straight-through estimator.  Inference uses the stored range,
+    or passes through unchanged while the observer is still empty.
+    Training-graph twin of ``_contrib_quantize``; inserted by
+    ``contrib.quantization.quantize_aware_symbol``."""
+    qmax = float(2 ** (attrs["num_bits"] - 1) - 1)
+    x = data.astype(jnp.float32)
+    a_stored = jnp.max(amax.astype(jnp.float32))
+    if is_train:
+        batch = jnp.max(jnp.abs(jax.lax.stop_gradient(x)))
+        mom = attrs["ema_momentum"]
+        a_new = jnp.where(a_stored > 0.0,
+                          mom * a_stored + (1.0 - mom) * batch, batch)
+    else:
+        a_new = a_stored
+    y = jnp.where(a_new > 0.0, _fq_ste(x, a_new, qmax), x)
+    return (y.astype(data.dtype),
+            jnp.reshape(a_new, amax.shape).astype(amax.dtype))
+
+
+@register(
+    "_contrib_fake_quant_dynamic",
+    arg_names=["data"],
+    params={"num_bits": P("int", 8)},
+)
+def _fake_quant_dynamic(attrs, data):
+    """Stateless fake-quant: symmetric ``num_bits`` grid over the
+    tensor's own current max|x| (no observer).  Used on WEIGHTS in QAT,
+    where the range must track the parameter as it trains; matches the
+    offline per-tensor symmetric weight quantization of
+    ``quantize_symbol``, so exported int8 weights see the same grid the
+    training graph simulated."""
+    qmax = float(2 ** (attrs["num_bits"] - 1) - 1)
+    x = data.astype(jnp.float32)
+    a = jnp.max(jnp.abs(jax.lax.stop_gradient(x)))
+    y = jnp.where(a > 0.0, _fq_ste(x, a, qmax), x)
+    return y.astype(data.dtype)
+
+
+# ----------------------------------------------------------------------
 # fft / ifft (reference src/operator/contrib/fft.cc — cuFFT)
 # ----------------------------------------------------------------------
 

@@ -763,6 +763,8 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
         c = dshape[ch]
         for p in ("gamma", "beta", "moving_mean", "moving_var"):
             solved[p] = (c,)
+    elif op.name == "_contrib_fake_quant":
+        solved["amax"] = (1,)
     elif op.name == "InstanceNorm":
         c = dshape[1]
         solved["gamma"] = (c,)
@@ -818,6 +820,13 @@ def _try_param_solve(node, shapes_out, resolved, resolved_types):
     progress = False
     for pname, pshape in solved.items():
         vnode = name_of.get(pname)
+        # descend through shape-preserving wrappers (QAT fake-quant) to
+        # the underlying parameter variable
+        while (vnode is not None and not vnode.is_variable
+               and vnode.op is not None
+               and vnode.op.name in ("_contrib_fake_quant",
+                                     "_contrib_fake_quant_dynamic")):
+            vnode = vnode.inputs[0][0]
         if vnode is not None and vnode.is_variable and vnode._id not in shapes_out:
             dt = _np.float32
             shapes_out[vnode._id] = [jax.ShapeDtypeStruct(tuple(pshape), dt)]

@@ -207,3 +207,174 @@ def test_quantize_bf16_outputs():
     np.testing.assert_allclose(y1, y0, atol=0.03)
     assert (y1.argmax(axis=1) == y0.argmax(axis=1)).mean() == 1.0
     assert str(qargs["conv0_bias"].asnumpy().dtype) == "bfloat16"
+
+
+# ---------------------------------------------------------------------
+# QAT: fake-quant op semantics + insert/finetune/export pipeline
+# ---------------------------------------------------------------------
+
+
+def test_fake_quant_op_ste_and_ema():
+    """Clipped STE: gradient 1 inside [-amax, amax], 0 outside; EMA
+    observer seeds from the first batch then tracks with momentum; an
+    empty observer passes eval-mode data through unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op("_contrib_fake_quant")
+    attrs = {"ema_momentum": 0.9, "num_bits": 8}
+    amax = jnp.array([1.0], jnp.float32)
+
+    def f(xx):
+        return op.apply(attrs, [xx], [amax], is_train=False)[0][0].sum()
+
+    g = jax.grad(f)(jnp.array([0.5, -2.0, 3.0, 0.01], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [1.0, 0.0, 0.0, 1.0])
+
+    # forward snaps to the 127-level grid
+    y = op.apply(attrs, [jnp.array([0.5004, 2.0])], [amax],
+                 is_train=False)[0][0]
+    np.testing.assert_allclose(
+        np.asarray(y), [np.round(0.5004 * 127) / 127, 1.0], rtol=1e-6)
+
+    # observer: first batch seeds, then EMA
+    _, aux = op.apply(attrs, [jnp.array([2.0, -4.0])],
+                      [jnp.array([0.0])], is_train=True)
+    assert float(aux[0][0]) == 4.0
+    _, aux = op.apply(attrs, [jnp.array([2.0, -4.0])],
+                      [jnp.array([8.0])], is_train=True)
+    np.testing.assert_allclose(float(aux[0][0]), 0.9 * 8 + 0.1 * 4)
+
+    # empty observer (amax=0) in eval: identity
+    y, aux = op.apply(attrs, [jnp.array([0.123, -7.0])],
+                      [jnp.array([0.0])], is_train=False)
+    np.testing.assert_allclose(np.asarray(y[0]), [0.123, -7.0])
+
+
+def _blobs(rng, n=400, d=16, k=4):
+    centers = rng.randn(k, d) * 3.0
+    labels = rng.randint(0, k, n)
+    data = (centers[labels] + rng.randn(n, d)).astype(np.float32)
+    return data, labels.astype(np.float32)
+
+
+def _mlp(k=4):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=k, name="fc2"),
+        name="softmax")
+
+
+def test_qat_pipeline_mlp():
+    """Train fp32 -> insert fake-quant -> finetune (observers fill via
+    the aux-update path) -> export: the int8 graph's outputs match the
+    QAT graph's eval-mode forward (same grids by construction) and
+    accuracy holds."""
+    rng = np.random.RandomState(0)
+    data, labels = _blobs(rng)
+    it = mx.io.NDArrayIter(data, labels, batch_size=40, shuffle=True)
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    args, _ = mod.get_params()
+
+    qat = Q.quantize_aware_symbol(net)
+    # one observer per distinct data tensor, dynamic fq per weight
+    assert sorted(qat.list_auxiliary_states()) == [
+        "activation0_fq_amax", "data_fq_amax"]
+    m2 = mx.mod.Module(qat, context=mx.cpu())
+    it.reset()
+    m2.fit(it, num_epoch=4, optimizer="sgd",
+           optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+           arg_params=args, aux_params={}, allow_missing=True,
+           initializer=mx.initializer.Xavier())
+    qargs, qauxs = m2.get_params()
+    assert all(float(v.asnumpy().max()) > 0 for v in qauxs.values())
+    acc_qat = m2.score(mx.io.NDArrayIter(data, labels, batch_size=40),
+                       "acc")[0][1]
+    assert acc_qat > 0.95, acc_qat
+
+    qsym, qa, qx = Q.quantize_model_qat(qat, qargs, qauxs)
+    ops = [n["op"] for n in __import__("json").loads(qsym.tojson())["nodes"]]
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_fake_quant" not in ops
+    m3 = mx.mod.Module(qsym, context=mx.cpu())
+    m3.bind(data_shapes=[("data", (40, 16))],
+            label_shapes=[("softmax_label", (40,))], for_training=False)
+    m3.set_params(qa, qx)
+    acc_int8 = m3.score(mx.io.NDArrayIter(data, labels, batch_size=40),
+                        "acc")[0][1]
+    assert acc_int8 > 0.95, acc_int8
+
+    # eval-mode QAT forward == int8 graph forward (shared grids)
+    m2p = mx.mod.Module(qat, context=mx.cpu())
+    m2p.bind(data_shapes=[("data", (40, 16))],
+             label_shapes=[("softmax_label", (40,))], for_training=False)
+    m2p.set_params(qargs, qauxs)
+    b = mx.io.NDArrayIter(data[:40], labels[:40], batch_size=40)
+    o_sim = m2p.predict(b).asnumpy()
+    b.reset()
+    o_int8 = m3.predict(b).asnumpy()
+    np.testing.assert_allclose(o_sim, o_int8, rtol=1e-5, atol=1e-6)
+
+
+def test_qat_conv_after_fold():
+    """The documented convnet flow: fold_bn first, then QAT-finetune the
+    folded graph (convs carry the folded bias), then export — the conv
+    becomes a quantized conv and the graph still runs."""
+    rng = np.random.RandomState(3)
+    net = _conv_bn_net()
+    args, auxs = _params(rng)
+    fsym, fargs, fauxs = Q.fold_bn(net, args, auxs)
+    qat = Q.quantize_aware_symbol(fsym)
+    x = _data(rng)
+    labels = rng.randint(0, 5, 4).astype(np.float32)
+    m = mx.mod.Module(qat, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, labels, batch_size=4)
+    m.fit(it, num_epoch=2, optimizer="sgd",
+          optimizer_params={"learning_rate": 0.01},
+          arg_params=dict(fargs), aux_params={}, allow_missing=True,
+          initializer=mx.initializer.Xavier())
+    qargs, qauxs = m.get_params()
+    qsym, qa, qx = Q.quantize_model_qat(qat, qargs, qauxs)
+    ops = [n["op"] for n in __import__("json").loads(qsym.tojson())["nodes"]]
+    assert "_contrib_quantized_conv" in ops
+    out = _fwd(qsym, {k: v.asnumpy() for k, v in qa.items()},
+               {k: v.asnumpy() for k, v in qx.items()}, x)
+    assert out.shape == (4, 5)
+    assert np.isfinite(out).all()
+
+
+def test_qat_shared_input_one_observer():
+    """Two FCs reading the same tensor share ONE observer node (the
+    shared-``_contrib_quantize`` rule's training twin)."""
+    import json as _json
+
+    d = mx.sym.Variable("data")
+    a = mx.sym.FullyConnected(d, num_hidden=4, name="fca")
+    b = mx.sym.FullyConnected(d, num_hidden=4, name="fcb")
+    qat = Q.quantize_aware_symbol(mx.sym.Group([a, b]))
+    nodes = _json.loads(qat.tojson())["nodes"]
+    fq_obs = [n for n in nodes if n["op"] == "_contrib_fake_quant"]
+    assert len(fq_obs) == 1, [n["name"] for n in fq_obs]
+
+
+def test_qat_export_empty_observer_raises():
+    """Exporting before any training batch must fail loudly, naming the
+    empty observer."""
+    net = _mlp()
+    qat = Q.quantize_aware_symbol(net)
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rng.randn(32, 16) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(4, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((4,))}
+    auxs = {k: mx.nd.zeros((1,)) for k in qat.list_auxiliary_states()}
+    with pytest.raises(mx.base.MXNetError, match="empty"):
+        Q.quantize_model_qat(qat, args, auxs)
