@@ -102,6 +102,7 @@ from . import predict
 from . import deploy
 from . import kvstore_server
 from . import engine
+from . import chaos
 from . import rtc
 from . import torch_bridge
 from . import torch_bridge as th

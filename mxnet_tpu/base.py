@@ -12,6 +12,8 @@ import numpy as _np
 
 __all__ = [
     "MXNetError",
+    "ServerDeadError",
+    "ShardFailedError",
     "string_types",
     "numeric_types",
     "DTYPE_TO_STR",
@@ -21,6 +23,19 @@ __all__ = [
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: ``base.py:MXNetError``)."""
+
+
+class ServerDeadError(MXNetError):
+    """A parameter server stayed unreachable past the retry deadline —
+    the worker's view of that shard's weights can no longer advance.
+    Raised by ``kvstore_async.AsyncClient`` after its backoff schedule
+    exhausts the overall deadline."""
+
+
+class ShardFailedError(MXNetError):
+    """A fan-out across parameter-server shards failed on one or more
+    shards.  The message names each failing shard (id + address) so a
+    multi-server outage is attributable instead of an anonymous hang."""
 
 
 string_types = (str,)

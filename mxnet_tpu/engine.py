@@ -19,6 +19,20 @@ caveat class as the reference's Python ``CustomOp`` callbacks).
 ``op_count()`` exposes the running op total so tests can assert the
 engine is load-bearing.
 
+Error propagation (parity: the reference threads an error-capable
+``on_complete`` status through ``PushAsync`` and re-raises at sync
+points): an exception inside a pushed fn **poisons** the op's mutable
+vars.  Dependent ops fail fast — they never execute, they propagate the
+poison to their own mutable vars — and the ORIGINAL exception (type and
+traceback intact) re-raises at ``wait_for_var``, ``wait_for_all``, and
+therefore at every consumer sync point built on them (kvstore ``pull``,
+``load_checkpoint`` after an async save).  Both backends share the same
+semantics: the poison bookkeeping lives in this module's ``push`` wrapper,
+not in the engines, so the serial fallback defers errors to the same sync
+points the threaded engine does.  A poisoned var stays poisoned until
+``delete_variable``/``clear_poison`` — silently reusing a var whose
+producer failed would hand out stale data.
+
 Falls back to a synchronous in-process engine when the native library is
 unavailable (semantics of the reference ``NaiveEngine``).
 """
@@ -30,10 +44,10 @@ import ctypes
 import itertools
 import threading
 
-from . import _native
+from . import _native, chaos
 
 __all__ = ["Var", "push", "new_variable", "wait_for_var", "wait_for_all",
-           "engine_type", "FnProperty"]
+           "engine_type", "FnProperty", "clear_poison"]
 
 
 class FnProperty(object):
@@ -46,10 +60,66 @@ class FnProperty(object):
 class Var(object):
     """Dependency variable (parity: ``Engine::NewVariable``)."""
 
-    __slots__ = ("handle",)
+    __slots__ = ("handle", "_poison")
 
     def __init__(self, handle):
         self.handle = handle
+        self._poison = None
+
+
+class _Poison(object):
+    """A captured async failure, carried var-to-var until surfaced."""
+
+    __slots__ = ("exc", "op_name", "noted")
+
+    def __init__(self, exc, op_name):
+        self.exc = exc
+        self.op_name = op_name
+        self.noted = False
+
+
+# --- poison bookkeeping ---------------------------------------------------
+
+_poison_lock = threading.Lock()
+# vars whose poison has not been surfaced to ANY caller yet; maps id(var)
+# -> var (the strong ref also pins the id against reuse while pending)
+_pending_poison = {}
+
+
+def _mark_poisoned(mutable_vars, poison):
+    with _poison_lock:
+        for v in mutable_vars:
+            if v._poison is None:
+                v._poison = poison
+            _pending_poison[id(v)] = v
+
+
+def _consume_pending(var):
+    with _poison_lock:
+        _pending_poison.pop(id(var), None)
+
+
+def _reraise(poison, where):
+    """Re-raise the ORIGINAL exception object: its type is preserved and
+    its traceback still points into the failed fn; the raise below only
+    appends the sync-point frame."""
+    exc = poison.exc
+    if not poison.noted and hasattr(exc, "add_note"):
+        poison.noted = True
+        try:
+            exc.add_note("raised asynchronously inside engine op %r; "
+                         "surfaced at engine.%s" % (poison.op_name, where))
+        except Exception:  # noqa: BLE001 — notes are best-effort decoration
+            pass
+    raise exc
+
+
+def clear_poison(var):
+    """Forget a var's recorded failure (recovery point: the caller is
+    about to re-initialize whatever the var guards)."""
+    with _poison_lock:
+        var._poison = None
+        _pending_poison.pop(id(var), None)
 
 
 # --- native trampoline machinery -----------------------------------------
@@ -79,6 +149,9 @@ def _run_cb(key):
         try:
             fn()
         except Exception:  # noqa: BLE001 — exceptions can't cross the C ABI
+            # unreachable for ops pushed via push() (its wrapper captures
+            # into var poison); kept as the last-resort backstop for raw
+            # registry entries
             import traceback
             traceback.print_exc()
         finally:
@@ -130,7 +203,10 @@ class _NativeEngine(object):
 
 
 class _SerialEngine(object):
-    """Pure-Python synchronous fallback (reference ``NaiveEngine``)."""
+    """Pure-Python synchronous fallback (reference ``NaiveEngine``).
+    Error semantics are identical to the threaded engine's because the
+    poison capture lives in the module-level ``push`` wrapper: a failed
+    fn surfaces at the next sync point, not at the push site."""
 
     def new_variable(self):
         return Var(None)
@@ -154,6 +230,9 @@ class _SerialEngine(object):
 
 _engine = None
 _engine_lock = threading.Lock()
+# push() publishes the latest sequence number here so op_count() needs no
+# lock; under concurrent pushes a read may briefly lag, never lead
+_push_seq = itertools.count(1)
 _pushed = 0
 
 
@@ -171,8 +250,10 @@ def _get():
                 lib = _native.lib()
                 _engine = _NativeEngine(lib) if lib else _SerialEngine()
                 # drain before interpreter teardown so worker threads never
-                # call back into a finalized interpreter
-                atexit.register(_engine.wait_for_all)
+                # call back into a finalized interpreter; poisoned vars are
+                # logged, never raised — an exception during interpreter
+                # shutdown would mask the run's real exit status
+                atexit.register(_drain_at_exit)
     return _engine
 
 
@@ -181,25 +262,97 @@ def new_variable():
 
 
 def delete_variable(var):
+    _consume_pending(var)
     _get().delete_variable(var)
 
 
 def push(fn, const_vars=(), mutable_vars=(), priority=0,
          prop=FnProperty.NORMAL, name="opr"):
     """Push async host fn with read deps ``const_vars`` and write deps
-    ``mutable_vars`` (parity: ``Engine::PushAsync``)."""
+    ``mutable_vars`` (parity: ``Engine::PushAsync``).
+
+    If ``fn`` raises, the exception is captured and every var in
+    ``mutable_vars`` is poisoned; ops depending on a poisoned var fail
+    fast (their fn never runs) and propagate the same poison.  The
+    original exception re-raises at ``wait_for_var``/``wait_for_all``.
+    """
     global _pushed
-    with _engine_lock:  # push may be called from worker threads too
-        _pushed += 1
-    _get().push(fn, const_vars, mutable_vars, priority, prop, name)
+    # lock-free hot path: the C-level next() is atomic under the GIL, so
+    # concurrent pushes never serialize on a mutex just to count
+    _pushed = next(_push_seq)
+    deps = tuple(const_vars) + tuple(mutable_vars)
+    muts = tuple(mutable_vars)
+
+    def guarded():
+        poison = None
+        for v in deps:
+            if v._poison is not None:
+                poison = v._poison  # fail fast: upstream already failed
+                break
+        if poison is None:
+            try:
+                chaos.visit("engine.op", name=name)
+                fn()
+                return
+            except chaos.ChaosDrop:
+                return  # injected silent loss: op never ran, no poison
+            except Exception as exc:  # noqa: BLE001 — captured into poison
+                poison = _Poison(exc, name)
+        _mark_poisoned(muts, poison)
+
+    _get().push(guarded, const_vars, mutable_vars, priority, prop, name)
 
 
 def wait_for_var(var):
     _get().wait_for_var(var)
+    poison = var._poison
+    if poison is not None:
+        _consume_pending(var)
+        _reraise(poison, "wait_for_var")
 
 
 def wait_for_all():
     _get().wait_for_all()
+    with _poison_lock:
+        first = next(iter(_pending_poison.values()), None)
+        if first is not None:
+            poison = first._poison
+            # one raise surfaces the whole failure, not one raise per
+            # downstream var it cascaded into
+            for vid, v in list(_pending_poison.items()):
+                if v._poison is poison:
+                    del _pending_poison[vid]
+        else:
+            poison = None
+    if poison is not None:
+        _reraise(poison, "wait_for_all")
+
+
+def _drain_at_exit():
+    """atexit drain: wait out in-flight ops, then LOG (never raise) any
+    still-unsurfaced poison — raising during interpreter teardown would
+    clobber the process's real exit path."""
+    eng = _engine
+    if eng is None:
+        return
+    try:
+        eng.wait_for_all()
+    except Exception:  # noqa: BLE001 — teardown must not raise
+        pass
+    with _poison_lock:
+        pending = {}
+        for v in _pending_poison.values():
+            if v._poison is not None:
+                pending.setdefault(id(v._poison), v._poison)
+        _pending_poison.clear()
+    if pending:
+        import logging
+
+        log = logging.getLogger(__name__)
+        for poison in pending.values():
+            log.error(
+                "engine: async op %r failed and its error was never "
+                "consumed before exit: %r", poison.op_name, poison.exc)
 
 
 def engine_type():

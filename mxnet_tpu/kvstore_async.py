@@ -40,6 +40,7 @@ import hmac as _hmaclib
 import json as _json
 import os
 import pickle
+import random as _random
 import secrets as _secrets
 import socket
 import socketserver
@@ -50,12 +51,40 @@ import zlib
 
 import numpy as _np
 
+from . import chaos as _chaos
+from .base import ServerDeadError, ShardFailedError
+
 __all__ = ["AsyncServer", "AsyncClient", "ServerGroup",
+           "ServerDeadError", "ShardFailedError",
            "publish_address", "lookup_address"]
 
 _KV_KEY = "mxtpu_async_ps_addr"
-_DEAD_AFTER_S = float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
-_MAX_MSG = int(os.environ.get("MXNET_TPU_PS_MAX_MSG_MB", "1024")) << 20
+
+
+# -- tunables, read LAZILY so jobs and tests can reconfigure timeouts
+# through the environment without re-importing the module ------------------
+
+def _dead_after_s():
+    """Seconds without a heartbeat before a worker counts as dead."""
+    return float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
+
+
+def _max_msg_bytes():
+    """Wire-frame size cap."""
+    return int(os.environ.get("MXNET_TPU_PS_MAX_MSG_MB", "1024")) << 20
+
+
+def _call_timeout_s():
+    """Per-attempt socket timeout for one RPC round trip."""
+    return float(os.environ.get("MXNET_TPU_PS_CALL_TIMEOUT", "60"))
+
+
+def _deadline_s():
+    """Overall per-RPC deadline across all retries; when it expires the
+    server is declared dead (``ServerDeadError``)."""
+    return float(os.environ.get("MXNET_TPU_PS_DEADLINE", "120"))
+
+
 # ops whose effect is not idempotent: dedup must cache their responses so
 # a retry is answered from cache, never re-applied.  pulls/stats re-execute.
 _MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command"})
@@ -150,13 +179,17 @@ class _MessageTooBig(ValueError):
 
 def _send_msg(sock, obj):
     payload = _encode_msg(obj)
-    if len(payload) > _MAX_MSG:
+    cap = _max_msg_bytes()
+    if len(payload) > cap:
         # refuse locally: the peer would cut the connection mid-frame and
         # a blind retry would just resend the same oversized message
         raise _MessageTooBig(
             "message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB=%d — "
             "raise the cap or shrink/stripe the arrays"
-            % (len(payload), _MAX_MSG >> 20))
+            % (len(payload), cap >> 20))
+    # chaos site: drop raises ConnectionResetError (the retry path's
+    # exception), corrupt garbles the outgoing frame payload
+    payload = _chaos.visit("kvstore.send", payload)
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -168,7 +201,7 @@ def _recv_msg(sock):
             raise EOFError("peer closed")
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
-    if n > _MAX_MSG:
+    if n > _max_msg_bytes():
         raise ValueError("message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB"
                          % n)
     buf = bytearray()
@@ -177,6 +210,10 @@ def _recv_msg(sock):
         if not chunk:
             raise EOFError("peer closed mid-message")
         buf += chunk
+    # chaos site AFTER the frame is fully consumed: a drop models the
+    # response lost in flight (the socket is torn down either way), a
+    # corrupt models bit-rot — decode rejects it via length/JSON checks
+    buf = _chaos.visit("kvstore.recv", bytes(buf))
     return _decode_msg(bytes(buf))
 
 
@@ -187,6 +224,7 @@ def _optimizer_mac(secret, raw):
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         srv: AsyncServer = self.server.owner  # type: ignore[attr-defined]
+        srv._track_conn(self.request)
         try:
             while True:
                 msg = _recv_msg(self.request)
@@ -197,8 +235,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     # tell the client WHY instead of dying mid-frame (a
                     # bare cut would read as 'peer closed' after retries)
                     _send_msg(self.request, {"ok": False, "err": str(exc)})
-        except (EOFError, ConnectionError, ValueError):
+        except (EOFError, ConnectionError, ValueError, OSError):
             return
+        finally:
+            srv._untrack_conn(self.request)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -255,6 +295,16 @@ class AsyncServer:
         # per worker (round-2 advisor finding).
         self._last_seq = {}
         self._shutdown = threading.Event()
+        # in-flight dispatch tracking so stop() can drain gracefully: a
+        # handler mid-update must finish (and its response flush) before
+        # the listener is torn down, or the worker sees a half-applied
+        # push it will retry against nothing
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        # open handler sockets: stop() severs them after the drain so a
+        # stopped server is actually gone, not lingering on old
+        # connections its daemon handler threads still serve
+        self._conns = set()
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
         self._thread = threading.Thread(
@@ -269,9 +319,39 @@ class AsyncServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, drain_timeout=5.0):
+        """Stop accepting work, then DRAIN: wait (bounded) for in-flight
+        dispatches to complete before closing the listener, so a handler
+        mid-optimizer-update finishes and its response reaches the
+        worker instead of being cut mid-frame."""
         self._tcp.shutdown()
+        deadline = time.monotonic() + drain_timeout
+        with self._inflight_cv:
+            while self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "AsyncServer.stop: %d handler(s) still in flight "
+                        "after %.1fs drain timeout", self._inflight,
+                        drain_timeout)
+                    break
+                self._inflight_cv.wait(remaining)
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._tcp.server_close()
+
+    def _track_conn(self, conn):
+        with self._inflight_cv:
+            self._conns.add(conn)
+
+    def _untrack_conn(self, conn):
+        with self._inflight_cv:
+            self._conns.discard(conn)
 
     def wait_shutdown(self):
         """Block until a worker sends the ``shutdown`` op (server-process
@@ -280,6 +360,16 @@ class AsyncServer:
 
     # -- message dispatch (runs on handler threads) --------------------
     def dispatch(self, msg):
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            return self._dispatch(msg)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _dispatch(self, msg):
         op = msg["op"]
         rank = msg.get("rank", -1)
         seq = msg.get("seq")
@@ -353,7 +443,7 @@ class AsyncServer:
         if op == "stats":
             now = time.time()
             dead = [r for r, t in self._heartbeat.items()
-                    if now - t > _DEAD_AFTER_S]
+                    if now - t > _dead_after_s()]
             return {"ok": True, "server_id": self.server_id,
                     "push_counts": [[r, c] for r, c
                                     in sorted(self._push_counts.items())],
@@ -391,17 +481,31 @@ class AsyncClient:
     dropped connection is re-dialed transparently and the in-flight
     request retried with the SAME sequence number; the server's
     per-worker dedup returns the cached response if the first attempt
-    actually completed, so gradients are applied at most once."""
+    actually completed, so gradients are applied at most once.
 
-    _RECONNECT_TRIES = 5
+    Retry policy: exponential backoff with jitter (base 50 ms, cap 2 s),
+    a per-attempt socket timeout (``call_timeout`` /
+    ``MXNET_TPU_PS_CALL_TIMEOUT``), and an overall per-RPC deadline
+    (``deadline`` / ``MXNET_TPU_PS_DEADLINE``) after which the server is
+    declared dead with a typed :class:`ServerDeadError` — a worker never
+    hangs forever on a shard that will not come back."""
+
+    _BACKOFF_BASE_S = 0.05
+    _BACKOFF_CAP_S = 2.0
 
     def __init__(self, address, rank, heartbeat=True, secret=None,
-                 dial_timeout=60):
+                 dial_timeout=60, call_timeout=None, deadline=None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._rank = rank
         self._secret = secret or os.environ.get("MXNET_TPU_PS_SECRET")
         self._seq = 0
+        # None defers to the env at CALL time (lazy, reconfigurable)
+        self._call_timeout = call_timeout
+        self._deadline = deadline
+        # backoff jitter: deterministic per rank so a test's retry
+        # schedule replays, while distinct ranks still decorrelate
+        self._backoff_rng = _random.Random(0x5EED ^ (rank & 0xFFFF))
         self._sock = self._dial(dial_timeout)
         self._lock = threading.Lock()
         if heartbeat:
@@ -410,9 +514,8 @@ class AsyncClient:
             t.start()
 
     def _heartbeat_loop(self):
-        period = max(_DEAD_AFTER_S / 3.0, 1.0)
         while True:
-            time.sleep(period)
+            time.sleep(max(_dead_after_s() / 3.0, 1.0))
             try:
                 self._call({"op": "heartbeat"})
             except Exception:
@@ -424,28 +527,51 @@ class AsyncClient:
         deadline = time.time() + timeout_s
         while True:
             try:
-                return socket.create_connection(self._addr, timeout=60)
+                return socket.create_connection(
+                    self._addr, timeout=self._effective_call_timeout())
             except (ConnectionError, OSError):
                 if time.time() >= deadline:
                     raise
                 time.sleep(0.3)
+
+    def _effective_call_timeout(self):
+        return (self._call_timeout if self._call_timeout is not None
+                else _call_timeout_s())
+
+    def _effective_deadline(self):
+        return (self._deadline if self._deadline is not None
+                else _deadline_s())
 
     def _reconnect(self):
         try:
             self._sock.close()
         except OSError:
             pass
-        self._sock = socket.create_connection(self._addr, timeout=60)
+        self._sock = socket.create_connection(
+            self._addr, timeout=self._effective_call_timeout())
+
+    def _backoff_sleep(self, attempt):
+        """Exponential backoff with multiplicative jitter in [0.5, 1.5):
+        retries from many workers against a recovering server spread out
+        instead of arriving as a thundering herd."""
+        base = min(self._BACKOFF_CAP_S,
+                   self._BACKOFF_BASE_S * (2 ** attempt))
+        return base * (0.5 + self._backoff_rng.random())
 
     def _call(self, msg):
         msg["rank"] = self._rank
         with self._lock:
             self._seq += 1
             msg["seq"] = self._seq
-            for attempt in range(self._RECONNECT_TRIES):
+            call_timeout = self._effective_call_timeout()
+            deadline = time.monotonic() + self._effective_deadline()
+            attempt = 0
+            while True:
                 try:
                     if attempt:  # re-dial failures count as attempts too
                         self._reconnect()
+                    _chaos.visit("kvstore.call", name=msg.get("op"))
+                    self._sock.settimeout(call_timeout)
                     _send_msg(self._sock, msg)
                     resp = _recv_msg(self._sock)
                     break
@@ -457,10 +583,19 @@ class AsyncClient:
                     self._reconnect()
                     raise
                 except (EOFError, ConnectionError, socket.timeout,
-                        OSError):
-                    if attempt == self._RECONNECT_TRIES - 1:
-                        raise
-                    time.sleep(0.2 * (attempt + 1))
+                        OSError) as exc:
+                    attempt += 1
+                    pause = self._backoff_sleep(attempt - 1)
+                    if time.monotonic() + pause >= deadline:
+                        raise ServerDeadError(
+                            "async PS %s:%d unreachable after %d "
+                            "attempt(s) within the %.1fs deadline "
+                            "(op=%r, last error: %r) — set "
+                            "MXNET_TPU_PS_DEADLINE to wait longer"
+                            % (self._addr[0], self._addr[1], attempt,
+                               self._effective_deadline(),
+                               msg.get("op"), exc)) from exc
+                    time.sleep(pause)
                     # retry (same seq: the server dedups completed requests)
         if not resp.get("ok"):
             from .base import MXNetError
@@ -526,19 +661,56 @@ class ServerGroup:
         self._striped = {}  # base key -> (shape, n_chunks)
         self._pool = None  # lazy persistent fan-out pool (hot path)
 
-    def _fanout(self, thunks):
+    def _shard_label(self, server):
+        try:
+            host, port = self._clients[server]._addr
+            return "shard %d (%s:%d)" % (server, host, port)
+        except Exception:  # noqa: BLE001 — labels are best-effort
+            return "shard %d" % server
+
+    def _fanout(self, jobs):
         """Run shard requests CONCURRENTLY (each client has its own
         socket+lock); one blocking RTT per server in sequence would make
-        PS latency grow linearly with -s N.  Returns results in order.
-        The pool is persistent: push/pull run per training step."""
-        if len(thunks) <= 1:
-            return [t() for t in thunks]
+        PS latency grow linearly with -s N.  ``jobs`` is a list of
+        ``(server_index, thunk)``; returns thunk results in order.  The
+        pool is persistent: push/pull run per training step.
+
+        Error surfacing: every shard's outcome is collected (no
+        fail-on-first-``result()``, which would leave later shards'
+        errors unobserved), then one :class:`ShardFailedError` names
+        each failing shard by index AND address, chained to the first
+        underlying exception — a multi-server outage is attributable
+        instead of an anonymous hang or a bare socket error."""
+        if len(jobs) == 1:
+            server, thunk = jobs[0]
+            try:
+                return [thunk()]
+            except (ServerDeadError, ConnectionError, OSError,
+                    EOFError) as exc:
+                raise ShardFailedError(
+                    "async PS fan-out failed at %s: %r"
+                    % (self._shard_label(server), exc)) from exc
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(
                 max_workers=self._n, thread_name_prefix="mxtpu-ps-fanout")
-        return [f.result() for f in [self._pool.submit(t) for t in thunks]]
+        futures = [(server, self._pool.submit(thunk))
+                   for server, thunk in jobs]
+        results, failures = [], []
+        for server, fut in futures:
+            try:
+                results.append(fut.result())
+            except Exception as exc:  # noqa: BLE001 — per-shard triage
+                results.append(None)
+                failures.append((server, exc))
+        if failures:
+            raise ShardFailedError(
+                "async PS fan-out failed on %d/%d shard(s): %s"
+                % (len(failures), len(jobs),
+                   "; ".join("%s: %r" % (self._shard_label(s), e)
+                             for s, e in failures))) from failures[0][1]
+        return results
 
     @property
     def num_servers(self):
@@ -585,7 +757,7 @@ class ServerGroup:
             self.wait_for_init([(k, _np.asarray(v).shape)
                                 for k, v in pairs])
             return
-        self._fanout([lambda s=s, p=p: self._clients[s].init(p)
+        self._fanout([(s, lambda s=s, p=p: self._clients[s].init(p))
                       for s, p in self._scatter(pairs).items()])
 
     def wait_for_init(self, key_shapes, timeout=None):
@@ -617,7 +789,7 @@ class ServerGroup:
             delay = min(delay * 2, 0.5)
 
     def push(self, pairs):
-        self._fanout([lambda s=s, p=p: self._clients[s].push(p)
+        self._fanout([(s, lambda s=s, p=p: self._clients[s].push(p))
                       for s, p in self._scatter(pairs).items()])
 
     def pull(self, keys, shapes=None):
@@ -653,7 +825,7 @@ class ServerGroup:
                 requests[server].append(key)
         ordered = sorted(requests)
         resp_list = self._fanout(
-            [lambda s=s: self._clients[s].pull(requests[s])
+            [(s, lambda s=s: self._clients[s].pull(requests[s]))
              for s in ordered])
         responses = dict(zip(ordered, resp_list))
         out = []
@@ -672,21 +844,22 @@ class ServerGroup:
         return out
 
     def set_optimizer(self, pickled):
-        self._fanout([lambda c=c: c.set_optimizer(pickled)
-                      for c in self._clients])
+        self._fanout([(i, lambda c=c: c.set_optimizer(pickled))
+                      for i, c in enumerate(self._clients)])
 
     def command(self, head, body):
-        self._fanout([lambda c=c: c.command(head, body)
-                      for c in self._clients])
+        self._fanout([(i, lambda c=c: c.command(head, body))
+                      for i, c in enumerate(self._clients)])
 
     def shutdown(self):
-        self._fanout([lambda c=c: c.shutdown() for c in self._clients])
+        self._fanout([(i, lambda c=c: c.shutdown())
+                      for i, c in enumerate(self._clients)])
 
     def stats(self):
         """Aggregate across shards; ``per_server`` keeps the raw shard
         stats (key placement etc.) observable."""
-        per_server = self._fanout([lambda c=c: c.stats()
-                                   for c in self._clients])
+        per_server = self._fanout([(i, lambda c=c: c.stats())
+                                   for i, c in enumerate(self._clients)])
         push_counts = {}
         dead, workers = set(), set()
         for s in per_server:

@@ -14,6 +14,7 @@ from collections import namedtuple
 
 import numpy as _np
 
+from . import chaos
 from . import ndarray as nd
 from . import symbol as sym
 from .base import MXNetError
@@ -85,8 +86,14 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
     def write():
         try:
+            # chaos site: drop = the write silently never lands (surfaced
+            # as a missing file at load), raise = a failed write captured
+            # into _ckpt_errors like any real IO failure
+            chaos.visit("checkpoint.write", name=param_name)
             nd._save_npz(param_name, arrays, "dict")  # atomic temp+rename
             logging.info("Saved checkpoint to \"%s\"", param_name)
+        except chaos.ChaosDrop:
+            logging.warning("chaos: checkpoint write %r dropped", param_name)
         except BaseException as exc:  # surfaced at the next save/load
             _ckpt_errors[param_name] = exc
 

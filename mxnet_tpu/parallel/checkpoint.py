@@ -16,11 +16,15 @@ this is the scale path.
 from __future__ import annotations
 
 import atexit
+import json as _json
 import os
 
 import jax
 
-__all__ = ["save_sharded", "restore_sharded", "latest_step", "close_all"]
+from .. import chaos as _chaos
+
+__all__ = ["save_sharded", "restore_sharded", "latest_step", "all_steps",
+           "save_fit_meta", "load_fit_meta", "close_all"]
 
 # one live CheckpointManager per directory: retention (max_to_keep) applies,
 # async saves overlap training, and manager startup is amortized
@@ -60,11 +64,26 @@ def save_sharded(directory, step, params, moms=None, aux=None, wait=True,
     """
     import orbax.checkpoint as ocp
 
+    try:
+        # chaos site: a drop models the write silently never happening (a
+        # crash just before the save) — resume must cope with the gap
+        _chaos.visit("checkpoint.write", name="step-%d" % step)
+    except _chaos.ChaosDrop:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "chaos: checkpoint write for step %d dropped", step)
+        return
     state = {"params": params, "moms": moms or {}, "aux": aux or {}}
     mgr = _manager(directory, max_to_keep=max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(state))
     if wait:
         mgr.wait_until_finished()
+        # corrupt-mode counterpart (bit-rot / torn write): garble the
+        # written step's largest shard so restore-time validation and the
+        # previous-checkpoint fallback are testable
+        _chaos.corrupt_file("checkpoint.write",
+                            os.path.join(directory, str(step)))
 
 
 def latest_step(directory):
@@ -73,6 +92,41 @@ def latest_step(directory):
     if not os.path.isdir(directory):
         return None
     return _manager(directory).latest_step()
+
+
+def all_steps(directory):
+    """Every checkpointed step in ``directory``, ascending ([] when
+    absent/empty) — the fallback ladder for resume-time validation."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(_manager(directory).all_steps())
+
+
+def _meta_path(directory, step):
+    return os.path.join(directory, "fit-meta-%d.json" % int(step))
+
+
+def save_fit_meta(directory, step, meta):
+    """Write the fit-loop position for ``step`` as a JSON sidecar next to
+    the orbax step directory (kept OUT of the orbax tree so old
+    checkpoints without it still restore).  Atomic rename so a crash
+    mid-write leaves no torn sidecar."""
+    os.makedirs(directory, exist_ok=True)
+    path = _meta_path(directory, step)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        _json.dump(meta, f)
+    os.replace(tmp, path)
+
+
+def load_fit_meta(directory, step):
+    """The fit-loop position saved for ``step``, or None (pre-sidecar
+    checkpoint / torn file)."""
+    try:
+        with open(_meta_path(directory, step), encoding="utf-8") as f:
+            return _json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _ckpt_tree(mgr, step):

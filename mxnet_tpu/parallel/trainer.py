@@ -189,7 +189,7 @@ class ShardedTrainer:
                  data_axis="data", dtype="float32",
                  remat=False, remat_policy=None, zero_stage=0,
                  optimizer="sgd", optimizer_params=None, lr_scheduler=None,
-                 grad_accum=1, multi_precision=False):
+                 grad_accum=1, multi_precision=False, skip_nonfinite=False):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -309,6 +309,14 @@ class ShardedTrainer:
         }
         self._use_momentum = (self._n_states > 0
                               or self._mp_dtype is not None)
+        # -- non-finite guard: when enabled the step checks loss + every
+        # gradient for NaN/Inf IN-GRAPH and, on a bad batch, keeps the old
+        # (params, moms, aux) via jnp.where — the step's inputs are donated,
+        # so a host-side revert is impossible by construction.  The step
+        # then reports the verdict as one extra trailing scalar output
+        # (1.0 ok / 0.0 skipped) that ``fit`` consumes for its
+        # skip-count/abort policy.  Opt-in: the trace changes shape.
+        self._skip_nonfinite = bool(skip_nonfinite)
         self._jit_step = None
         self._jit_fwd = None
 
@@ -466,24 +474,25 @@ class ShardedTrainer:
 
             dparams = {n: params[n] for n in diff}
             if accum == 1:
-                (_, (outs, new_aux)), grads = micro_grads(
+                (loss_total, (outs, new_aux)), grads = micro_grads(
                     dparams, aux, batch, rng)
                 grads = constrain(grads)
             else:
                 def body(carry, xs):
-                    gacc, aux_c = carry
+                    gacc, aux_c, lsum = carry
                     mb, i = xs
-                    (_, (outs_i, aux_n)), g = micro_grads(
+                    (lv, (outs_i, aux_n)), g = micro_grads(
                         dparams, aux_c, mb, jax.random.fold_in(rng, i))
                     gacc = constrain({
                         n: gacc[n] + g[n].astype(jnp.float32) for n in g})
-                    return (gacc, aux_n), outs_i
+                    return (gacc, aux_n, lsum + lv), outs_i
 
                 gacc0 = constrain({
                     n: jnp.zeros(dparams[n].shape, jnp.float32)
                     for n in diff})
-                (gacc, new_aux), outs_stack = jax.lax.scan(
-                    body, (gacc0, aux), (batch, jnp.arange(accum)))
+                (gacc, new_aux, loss_total), outs_stack = jax.lax.scan(
+                    body, (gacc0, aux, jnp.float32(0)),
+                    (batch, jnp.arange(accum)))
                 # multi-precision updates consume fp32 grads directly;
                 # otherwise return to the parameter dtype
                 grads = {n: (gacc[n] if n in mp_set
@@ -496,6 +505,10 @@ class ShardedTrainer:
                 # mean-normalized losses over the equal row-major split
                 outs = [o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
                         if o.ndim >= 2 else o.mean(0) for o in outs_stack]
+            if guard:
+                ok = jnp.isfinite(loss_total)
+                for n in diff:
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(grads[n])))
             new_params, new_moms = dict(params), dict(moms)
             attrs = opt_attrs
             if needs_count:
@@ -528,8 +541,20 @@ class ShardedTrainer:
                         new_moms[n] = upd[1]
                     elif slots:
                         new_moms[n] = tuple(upd[1:])
+            if guard:
+                # bad batch: keep EVERY piece of old state (weights, momenta,
+                # the schedule counter, aux) — the skipped step never happened
+                keep = jax.tree_util.tree_map
+                new_params = keep(lambda a, b: jnp.where(ok, a, b),
+                                  new_params, params)
+                new_moms = keep(lambda a, b: jnp.where(ok, a, b),
+                                new_moms, moms)
+                new_aux = keep(lambda a, b: jnp.where(ok, a, b),
+                               new_aux, aux)
+                outs = list(outs) + [ok.astype(jnp.float32)]
             return outs, new_params, new_moms, new_aux
 
+        guard = self._skip_nonfinite
         zero = self.zero_stage >= 1
         zero_shard = {n: self._sharding(self.opt_specs[n])
                       for n in self.param_names}
@@ -596,13 +621,42 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, num_epoch=1, seed=0,
             eval_metric="accuracy", initializer=None, state=None,
-            begin_epoch=0, checkpoint_dir=None, log_every=50, logger=None,
+            begin_epoch=0, checkpoint_dir=None, checkpoint_every=None,
+            resume=None, max_bad_steps=5, log_every=50, logger=None,
             batch_end_callback=None):
         """Mesh-native training loop — ``Module.fit``'s role
         (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
         epochs over a ``DataIter``, metric updates, throughput logging
         (``Speedometer``, reference ``callback.py:89``), optional eval pass
-        and per-epoch sharded checkpoints.
+        and sharded checkpoints.
+
+        Fault tolerance
+        ---------------
+        ``checkpoint_every=N`` saves every N global steps (numbered by
+        global step) in addition to epoch ends; without it, epoch-end
+        saves keep the historical ``epoch + 1`` numbering.  Every save
+        made by this loop also writes a ``fit-meta-<step>.json`` sidecar
+        recording the loop position (global step, epoch, batch offset,
+        RNG anchor).
+
+        ``resume="auto"`` restarts from the newest restorable checkpoint
+        in ``checkpoint_dir``: the newest one is validated by actually
+        restoring it, and on failure (torn write, corrupt shard) the loop
+        falls back to the previous step, then the one before, starting
+        fresh only when none restore.  A resumed run re-enters the
+        interrupted epoch at the saved batch offset with the SAME
+        per-step RNG stream, so an interrupted+resumed run reproduces the
+        uninterrupted run's parameters at every later checkpoint
+        boundary.  (Resume replaces ``state``/``begin_epoch``;
+        ``num_epoch`` stays the TOTAL epoch target, so a run killed at
+        epoch 3 of 10 resumes and finishes the remaining 7.)
+
+        When the trainer was built with ``skip_nonfinite=True``, each
+        step's non-finite verdict feeds a skip policy: a bad batch leaves
+        the state untouched and is excluded from the metric;
+        ``max_bad_steps`` CONSECUTIVE bad batches abort with
+        ``MXNetError`` (a diverged run re-reading the same poison forever
+        is worse than a crash).
 
         ``state`` resumes from an existing ``(params, moms, aux)`` (e.g. a
         ``checkpoint.restore_sharded`` result); pass ``begin_epoch`` so
@@ -618,10 +672,46 @@ class ShardedTrainer:
         import jax as _jax
 
         from .. import metric as _metric_mod
+        from . import checkpoint as _ckpt
 
         log = logger or logging.getLogger(__name__)
         metric = (eval_metric if isinstance(eval_metric, _metric_mod.EvalMetric)
                   else _metric_mod.create(eval_metric))
+
+        # -- resume="auto": newest RESTORABLE checkpoint wins ------------
+        resume_meta = None
+        if resume not in (None, False, "auto"):
+            raise MXNetError("resume must be None or 'auto', got %r"
+                             % (resume,))
+        if resume == "auto" and checkpoint_dir is not None:
+            for ckpt_step in reversed(_ckpt.all_steps(checkpoint_dir)):
+                try:
+                    state = _ckpt.restore_sharded(checkpoint_dir, ckpt_step,
+                                                  trainer=self)
+                except Exception as exc:  # noqa: BLE001 — fall back a step
+                    log.warning(
+                        "resume: checkpoint step %d failed validation "
+                        "(%r); falling back to the previous checkpoint",
+                        ckpt_step, exc)
+                    continue
+                resume_meta = _ckpt.load_fit_meta(checkpoint_dir, ckpt_step)
+                if resume_meta is None:
+                    # pre-sidecar checkpoint: its step number is an epoch
+                    # boundary (the historical epoch+1 numbering) and the
+                    # historical RNG anchoring applies
+                    resume_meta = {"global_step": 0, "epoch": ckpt_step,
+                                   "batch_in_epoch": 0, "seed": seed,
+                                   "base_epoch": ckpt_step}
+                log.info("resume: restored checkpoint step %d (epoch %d, "
+                         "batch %d, global step %d)", ckpt_step,
+                         resume_meta["epoch"],
+                         resume_meta.get("batch_in_epoch", 0),
+                         resume_meta.get("global_step", 0))
+                break
+            else:
+                log.info("resume: no restorable checkpoint under %r — "
+                         "starting fresh", checkpoint_dir)
+
         params, moms, aux = (state if state is not None
                              else self.init(initializer=initializer,
                                             seed=seed))
@@ -655,27 +745,89 @@ class ShardedTrainer:
         speedo = None  # built from the first batch's row count
 
         history = {}
-        global_step = 0
-        # fold begin_epoch in so a resumed run continues a fresh key stream
-        # instead of replaying the original run's dropout masks
-        base_key = _jax.random.fold_in(_jax.random.PRNGKey(seed),
-                                       begin_epoch)
-        for epoch in range(begin_epoch, begin_epoch + num_epoch):
+        if checkpoint_every is not None:
+            checkpoint_every = int(checkpoint_every)
+            if checkpoint_every < 1:
+                raise MXNetError("checkpoint_every must be >= 1")
+            if checkpoint_dir is None:
+                raise MXNetError(
+                    "checkpoint_every needs a checkpoint_dir to save into")
+        if resume_meta is not None:
+            start_epoch = int(resume_meta["epoch"])
+            global_step = int(resume_meta.get("global_step", 0))
+            skip_batches = int(resume_meta.get("batch_in_epoch", 0))
+            rng_seed = int(resume_meta.get("seed", seed))
+            rng_anchor = int(resume_meta.get("base_epoch", 0))
+        else:
+            start_epoch = begin_epoch
+            global_step = 0
+            skip_batches = 0
+            rng_seed = seed
+            # fold begin_epoch in so a manually-resumed run (state= +
+            # begin_epoch=) continues a fresh key stream instead of
+            # replaying the original run's dropout masks
+            rng_anchor = begin_epoch
+        end_epoch = begin_epoch + num_epoch
+        # per-step keys are fold_in(anchor, global_step): because BOTH the
+        # anchor and the step index persist across resume (via the meta
+        # sidecar), a resumed run draws exactly the keys the uninterrupted
+        # run would have
+        base_key = _jax.random.fold_in(_jax.random.PRNGKey(rng_seed),
+                                       rng_anchor)
+
+        def fit_meta(epoch, batch_in_epoch):
+            return {"global_step": global_step, "epoch": epoch,
+                    "batch_in_epoch": batch_in_epoch, "seed": rng_seed,
+                    "base_epoch": rng_anchor}
+
+        guard = self._skip_nonfinite
+        bad_streak = 0
+        skipped_total = 0
+        last_saved = None
+        for epoch in range(start_epoch, end_epoch):
             metric.reset()
             train_data.reset()
             nbatch = 0
             for batch in train_data:
+                if skip_batches:
+                    # resumed mid-epoch: replay the iterator up to the
+                    # checkpointed batch offset without stepping
+                    skip_batches -= 1
+                    nbatch += 1
+                    continue
                 arrays, data_names = batch_arrays(batch, train_data)
                 placed = self.place_batch(arrays)
                 outs, params, moms, aux = step(
                     params, moms, aux, placed,
                     _jax.random.fold_in(base_key, global_step))
-                labels = [v for n, v in arrays.items()
-                          if n not in data_names]
-                metric.update([_np.asarray(v) for v in labels],
-                              [_np.asarray(o) for o in outs])
+                ok = True
+                if guard:
+                    # trailing scalar = the step's in-graph verdict; the
+                    # asnumpy read syncs, which the skip policy needs anyway
+                    ok = bool(_np.asarray(outs[-1]))
+                    outs = outs[:-1]
                 global_step += 1
                 nbatch += 1
+                if ok:
+                    bad_streak = 0
+                    labels = [v for n, v in arrays.items()
+                              if n not in data_names]
+                    metric.update([_np.asarray(v) for v in labels],
+                                  [_np.asarray(o) for o in outs])
+                else:
+                    bad_streak += 1
+                    skipped_total += 1
+                    log.warning(
+                        "non-finite loss/grad at global step %d — step "
+                        "skipped, state unchanged (%d consecutive, %d "
+                        "total)", global_step - 1, bad_streak,
+                        skipped_total)
+                    if bad_streak >= max_bad_steps:
+                        raise MXNetError(
+                            "aborting fit: %d consecutive non-finite "
+                            "steps (last at global step %d) — the run "
+                            "has diverged or the input data is bad"
+                            % (bad_streak, global_step - 1))
                 if speedo is None and log_every:
                     # windowed samples/s (metric=None so the epoch metric
                     # is not reset mid-epoch by the logger)
@@ -688,6 +840,12 @@ class ShardedTrainer:
                     speedo(bep._replace(eval_metric=None))
                 for cb in callbacks:
                     cb(bep)
+                if checkpoint_every and global_step % checkpoint_every == 0:
+                    _ckpt.save_sharded(checkpoint_dir, global_step, params,
+                                       moms, aux)
+                    _ckpt.save_fit_meta(checkpoint_dir, global_step,
+                                        fit_meta(epoch, nbatch))
+                    last_saved = global_step
             history.setdefault(epoch, {})["train"] = metric.get()
             log.info("epoch %d train: %s", epoch, history[epoch]["train"])
 
@@ -707,10 +865,23 @@ class ShardedTrainer:
                 log.info("epoch %d eval: %s", epoch, history[epoch]["eval"])
 
             if checkpoint_dir is not None:
-                from . import checkpoint as _ckpt
-
-                _ckpt.save_sharded(checkpoint_dir, epoch + 1, params, moms,
-                                   aux)
+                if checkpoint_every:
+                    # global-step numbering throughout (the historical
+                    # epoch+1 numbering would collide with step numbers)
+                    if last_saved != global_step:
+                        _ckpt.save_sharded(checkpoint_dir, global_step,
+                                           params, moms, aux)
+                        last_saved = global_step
+                    # (re)write the meta to point at the NEXT epoch's first
+                    # batch — a periodic save at the epoch's last batch
+                    # would otherwise resume into a fully-skipped epoch
+                    _ckpt.save_fit_meta(checkpoint_dir, global_step,
+                                        fit_meta(epoch + 1, 0))
+                else:
+                    _ckpt.save_sharded(checkpoint_dir, epoch + 1, params,
+                                       moms, aux)
+                    _ckpt.save_fit_meta(checkpoint_dir, epoch + 1,
+                                        fit_meta(epoch + 1, 0))
         return (params, moms, aux), history
 
     def _with_mesh(self, jitted):

@@ -19,6 +19,23 @@ import numpy as _np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test (seeded, deterministic)")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Programmatic chaos rules never leak across tests."""
+    import mxnet_tpu.chaos as chaos
+
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     _np.random.seed(42)

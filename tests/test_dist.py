@@ -10,6 +10,18 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Every test here spawns a multi-process cluster whose barrier/bcast init
+# runs cross-process collectives (jax multihost allgather).  The XLA CPU
+# backend does not implement multiprocess computations, so under a forced
+# CPU platform each worker fails after its full launch-retry budget —
+# minutes of guaranteed failure per test.  Skip up front instead.
+_PLAT = (os.environ.get("MXNET_TPU_PLATFORM")
+         or os.environ.get("JAX_PLATFORMS") or "").strip().lower()
+pytestmark = pytest.mark.skipif(
+    _PLAT == "cpu",
+    reason="cross-process collectives are not implemented on the XLA "
+           "CPU backend (JAX_PLATFORMS=cpu)")
+
 
 def _launch(n, script, timeout=240, extra_env=None, servers=0):
     env = {k: v for k, v in os.environ.items()

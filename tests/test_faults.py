@@ -1,0 +1,492 @@
+"""Fault-tolerance: engine error propagation, chaos injection, KVStore
+retry/dedup semantics, and auto-resume training.
+
+Every injection test uses a fixed seed (the chaos registry draws from a
+rule-private RNG, so the failure schedule is a pure function of the seed
+and the visit sequence) and sub-second delays.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import traceback
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, engine
+from mxnet_tpu.base import MXNetError, ServerDeadError, ShardFailedError
+
+SHAPE = (4, 4)
+
+
+class BoomError(Exception):
+    pass
+
+
+def _boom():
+    raise BoomError("async op exploded")
+
+
+# ---------------------------------------------------------------------------
+# engine error propagation
+# ---------------------------------------------------------------------------
+
+def test_error_surfaces_at_wait_for_var():
+    v = engine.new_variable()
+    engine.push(_boom, mutable_vars=[v], name="failing_op")
+    with pytest.raises(BoomError) as ei:
+        engine.wait_for_var(v)
+    # the ORIGINAL traceback: it still points into the failing fn
+    tb = "".join(traceback.format_exception(
+        type(ei.value), ei.value, ei.value.__traceback__))
+    assert "_boom" in tb
+    # poison is sticky until explicitly cleared
+    with pytest.raises(BoomError):
+        engine.wait_for_var(v)
+    engine.clear_poison(v)
+    engine.wait_for_var(v)  # clean after recovery
+    engine.delete_variable(v)
+
+
+def test_dependent_ops_fail_fast():
+    v1, v2 = engine.new_variable(), engine.new_variable()
+    ran = []
+    engine.push(_boom, mutable_vars=[v1], name="producer")
+    engine.push(lambda: ran.append(1), const_vars=[v1], mutable_vars=[v2],
+                name="consumer")
+    # the consumer never executes; it propagates the producer's poison
+    with pytest.raises(BoomError):
+        engine.wait_for_var(v2)
+    assert ran == []
+    with pytest.raises(BoomError):
+        engine.wait_for_var(v1)
+    for v in (v1, v2):
+        engine.delete_variable(v)
+
+
+def test_wait_for_all_raises_once_then_clean():
+    v = engine.new_variable()
+    engine.push(_boom, mutable_vars=[v], name="failing_op")
+    with pytest.raises(BoomError):
+        engine.wait_for_all()
+    # the failure was surfaced (consumed); the next barrier is clean
+    engine.wait_for_all()
+    engine.delete_variable(v)
+
+
+@pytest.fixture
+def serial_engine(monkeypatch):
+    """Run the module-level push/wait wrappers over the serial backend;
+    the poison bookkeeping is backend-agnostic, so semantics must match."""
+    engine.wait_for_all()
+    monkeypatch.setattr(engine, "_engine", engine._SerialEngine())
+    yield
+
+
+def test_serial_engine_same_error_semantics(serial_engine):
+    assert engine.engine_type() == "SerialEngine"
+    v1, v2 = engine.new_variable(), engine.new_variable()
+    ran = []
+    # the serial engine runs fns inline, but the error must STILL defer
+    # to the sync point, exactly like the threaded engine
+    engine.push(_boom, mutable_vars=[v1], name="producer")
+    engine.push(lambda: ran.append(1), const_vars=[v1], mutable_vars=[v2],
+                name="consumer")
+    assert ran == []  # fail-fast: consumer skipped
+    with pytest.raises(BoomError):
+        engine.wait_for_var(v2)
+    with pytest.raises(BoomError):
+        engine.wait_for_var(v1)
+    v3 = engine.new_variable()
+    engine.push(_boom, mutable_vars=[v3], name="other")
+    with pytest.raises(BoomError):
+        engine.wait_for_all()
+    engine.wait_for_all()
+    for v in (v1, v2, v3):
+        engine.delete_variable(v)
+
+
+def test_kv_pull_surfaces_updater_error():
+    """Consumer sync point: a failing kvstore updater poisons the key's
+    var and the original exception re-raises at pull."""
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(SHAPE))
+
+    def bad_updater(key, recv, stored):
+        raise BoomError("updater died on key %r" % key)
+
+    kv.set_updater(bad_updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    with pytest.raises(BoomError):
+        kv.pull(3, out=out)
+
+
+@pytest.mark.chaos
+def test_load_checkpoint_surfaces_write_failure(tmp_path):
+    """Consumer sync point: an async checkpoint write failure surfaces at
+    load_checkpoint, chained to the original injected error."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    prefix = str(tmp_path / "model")
+    args = {"fc_weight": mx.nd.ones((4, 3)), "fc_bias": mx.nd.zeros((4,))}
+    with chaos.inject("checkpoint.write", "raise", seed=0):
+        mx.model.save_checkpoint(prefix, 1, net, args, {})
+        with pytest.raises(IOError) as ei:
+            mx.model.load_checkpoint(prefix, 1)
+    assert isinstance(ei.value.__cause__, chaos.ChaosError)
+    # the registry is clean again: the same round-trip now succeeds
+    mx.model.save_checkpoint(prefix, 1, net, args, {})
+    sym2, args2, _ = mx.model.load_checkpoint(prefix, 1)
+    np.testing.assert_allclose(args2["fc_weight"].asnumpy(),
+                               np.ones((4, 3), np.float32))
+
+
+def test_atexit_drain_never_raises():
+    """An unsurfaced async failure at interpreter exit is logged, not
+    raised — the process's real exit status must survive teardown."""
+    code = (
+        "from mxnet_tpu import engine\n"
+        "v = engine.new_variable()\n"
+        "engine.push(lambda: 1/0, mutable_vars=[v], name='doomed')\n"
+        "print('reached-exit')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "reached-exit" in proc.stdout
+    assert "doomed" in proc.stderr  # the drain logged the lost failure
+
+
+def test_push_counter_lock_free():
+    before = engine.op_count()
+    v = engine.new_variable()
+    for _ in range(25):
+        engine.push(lambda: None, mutable_vars=[v])
+    engine.wait_for_var(v)
+    assert engine.op_count() >= before + 25
+    engine.delete_variable(v)
+
+
+# ---------------------------------------------------------------------------
+# chaos registry
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_schedule_is_deterministic():
+    def realized(seed):
+        fires = []
+        with chaos.inject("engine.op", "raise", prob=0.5, seed=seed) as inj:
+            for _ in range(40):
+                try:
+                    chaos.visit("engine.op", name="op")
+                    fires.append(0)
+                except chaos.ChaosError:
+                    fires.append(1)
+            assert inj.visits == 40
+        return fires
+
+    a, b = realized(7), realized(7)
+    assert a == b  # same seed, same visit sequence -> same schedule
+    assert 0 < sum(a) < 40
+    assert realized(8) != a  # and the seed actually matters
+
+
+@pytest.mark.chaos
+def test_chaos_engine_drop_skips_op():
+    ran = []
+    v = engine.new_variable()
+    with chaos.inject("engine.op", "drop", seed=0, limit=1,
+                      match="maybe_lost"):
+        engine.push(lambda: ran.append(1), mutable_vars=[v],
+                    name="maybe_lost")
+        engine.push(lambda: ran.append(2), mutable_vars=[v],
+                    name="maybe_lost")
+        engine.wait_for_var(v)  # a drop is silent loss, NOT an error
+    assert ran == [2]  # first op dropped (limit=1), second ran
+    engine.delete_variable(v)
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_preserves_length_and_match_filters():
+    payload = bytes(range(64))
+    with chaos.inject("kvstore.send", "corrupt", seed=3):
+        garbled = chaos.visit("kvstore.send", payload)
+    assert len(garbled) == len(payload) and garbled != payload
+    # match= keeps unrelated ops untouched
+    with chaos.inject("engine.op", "raise", match="only_this") as inj:
+        chaos.visit("engine.op", name="something_else")
+        assert inj.fires == 0
+        with pytest.raises(chaos.ChaosError):
+            chaos.visit("engine.op", name="only_this_one")
+        assert inj.fires == 1
+
+
+@pytest.mark.chaos
+def test_chaos_env_config(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "engine.op:raise:1.0:limit=2")
+    with pytest.raises(chaos.ChaosError):
+        chaos.visit("engine.op", name="x")
+    with pytest.raises(chaos.ChaosError):
+        chaos.visit("engine.op", name="x")
+    chaos.visit("engine.op", name="x")  # limit reached
+    # reconfiguring the env is picked up lazily, no re-import
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "")
+    chaos.visit("engine.op", name="x")
+
+
+# ---------------------------------------------------------------------------
+# kvstore hardening
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.kvstore_async import AsyncClient, AsyncServer, ServerGroup
+
+
+@pytest.fixture
+def fast_retries(monkeypatch):
+    """Sub-second retry envelope for injected-failure tests; exercises the
+    lazy env reads (no re-import) along the way."""
+    monkeypatch.setattr(AsyncClient, "_BACKOFF_CAP_S", 0.1)
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "5")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "30")
+
+
+def _sgd_pickle(lr=0.1):
+    from mxnet_tpu import optimizer as opt
+
+    return pickle.dumps(opt.SGD(learning_rate=lr, wd=0.0))
+
+
+@pytest.mark.chaos
+def test_retry_dedup_single_drop(fast_retries):
+    """Satellite: a retried mutating op is answered from the response
+    cache and never applied twice — pinned with a GUARANTEED drop."""
+    srv = AsyncServer(secret="s").start()
+    try:
+        cli = AsyncClient(srv.address, rank=0, heartbeat=False, secret="s")
+        cli.init([("w", np.zeros(4, np.float32))])
+        cli.set_optimizer(_sgd_pickle())
+        # drop exactly the response of the next push: the retry resends
+        # the SAME seq and must be answered from the dedup cache
+        with chaos.inject("kvstore.recv", "drop", seed=0, limit=1) as inj:
+            cli.push([("w", np.ones(4, np.float32))])
+        assert inj.fires == 1
+        assert cli.stats()["push_counts"][0] == 1  # applied exactly once
+        np.testing.assert_allclose(cli.pull(["w"])[0],
+                                   np.full(4, -0.1, np.float32), rtol=1e-6)
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_server_group_converges_under_30pct_drop(fast_retries):
+    """Acceptance: under 30% message drop a ServerGroup workload
+    converges via retries with ZERO double-applied gradients — server
+    apply-count equals client push-count."""
+    servers = [AsyncServer(secret="g", server_id=i).start()
+               for i in range(2)]
+    try:
+        grp = ServerGroup([s.address for s in servers], rank=0,
+                          heartbeat=False, secret="g")
+        keys = ["k0", "k1", "k2", "k3"]
+        grp.init([(k, np.zeros(4, np.float32)) for k in keys])
+        grp.set_optimizer(_sgd_pickle(lr=0.1))
+        # each group push fans out one RPC per server that owns keys
+        servers_touched = len({grp.server_of(k) for k in keys})
+        n_push = 25
+        with chaos.inject("kvstore.recv", "drop", prob=0.3, seed=7) as inj:
+            for _ in range(n_push):
+                grp.push([(k, np.ones(4, np.float32)) for k in keys])
+        assert inj.fires > 0  # the schedule actually exercised retries
+        stats = grp.stats()
+        assert stats["push_counts"][0] == n_push * servers_touched
+        # and the weights prove it: exactly n_push SGD updates per key
+        for v in grp.pull(keys):
+            np.testing.assert_allclose(
+                v, np.full(4, -0.1 * n_push, np.float32), rtol=1e-5)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_server_dead_error_is_typed_and_bounded(fast_retries, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "1.5")
+    srv = AsyncServer(secret="s").start()
+    cli = AsyncClient(srv.address, rank=0, heartbeat=False, secret="s")
+    cli.init([("w", np.zeros(2, np.float32))])
+    srv.stop()  # severs established connections too
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(ServerDeadError) as ei:
+        cli.pull(["w"])
+    assert time.monotonic() - t0 < 10  # bounded, not a hang
+    assert isinstance(ei.value, MXNetError)  # typed under the family root
+    assert "unreachable" in str(ei.value)
+
+
+def test_shard_failure_names_the_shard(fast_retries, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "1.0")
+    servers = [AsyncServer(secret="g", server_id=i).start()
+               for i in range(2)]
+    grp = ServerGroup([s.address for s in servers], rank=0,
+                      heartbeat=False, secret="g")
+    grp.init([("a", np.zeros(2, np.float32)),
+              ("b", np.zeros(2, np.float32))])
+    servers[1].stop()
+    with pytest.raises(ShardFailedError) as ei:
+        grp.stats()
+    msg = str(ei.value)
+    assert "shard 1" in msg and servers[1].address.rsplit(":", 1)[1] in msg
+    servers[0].stop()
+
+
+def test_lazy_env_tunables(monkeypatch):
+    """Satellite: timeouts/caps re-read the environment per use."""
+    from mxnet_tpu import kvstore_async as kva
+
+    monkeypatch.setenv("MXNET_TPU_PS_DEAD_AFTER", "3.5")
+    assert kva._dead_after_s() == 3.5
+    monkeypatch.setenv("MXNET_TPU_PS_MAX_MSG_MB", "1")
+    assert kva._max_msg_bytes() == 1 << 20
+    srv = AsyncServer(secret="s").start()
+    try:
+        cli = AsyncClient(srv.address, rank=0, heartbeat=False, secret="s")
+        with pytest.raises(ValueError):  # _MessageTooBig is a ValueError
+            cli.init([("big", np.zeros((1 << 19,), np.float32))])  # 2 MB
+        monkeypatch.setenv("MXNET_TPU_PS_MAX_MSG_MB", "64")
+        cli.init([("big", np.zeros((1 << 19,), np.float32))])  # now fits
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# auto-resume training
+# ---------------------------------------------------------------------------
+
+import jax
+from jax.sharding import Mesh
+
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.parallel import checkpoint as ckpt
+from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+B, D = 8, 6
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=32, seed=3):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, D).astype(np.float32),
+            rs.randint(0, 8, (n,)).astype(np.float32))
+
+
+def _iter(X, Y):
+    return NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=B)
+
+
+def _trainer(**kw):
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    return ShardedTrainer(_mlp(), mesh, data_shapes={"data": (B, D)},
+                          label_shapes={"softmax_label": (B,)},
+                          momentum=0.9, rescale_grad=1.0 / B, **kw)
+
+
+class _Kill(Exception):
+    pass
+
+
+def _kill_after(n):
+    count = [0]
+
+    def cb(_bep):
+        count[0] += 1
+        if count[0] >= n:
+            raise _Kill()
+
+    return cb
+
+
+def test_kill_then_resume_matches_uninterrupted(tmp_path):
+    """Acceptance: a mid-epoch kill + resume='auto' reproduces the
+    uninterrupted run's parameters exactly."""
+    X, Y = _data()
+    full_dir, kill_dir = str(tmp_path / "full"), str(tmp_path / "kill")
+    (p_full, _, _), _ = _trainer().fit(
+        _iter(X, Y), num_epoch=3, seed=5, checkpoint_dir=full_dir,
+        checkpoint_every=2, log_every=0)
+    # killed mid-epoch-1 (4 batches/epoch; killed at global step 5)
+    with pytest.raises(_Kill):
+        _trainer().fit(_iter(X, Y), num_epoch=3, seed=5,
+                       checkpoint_dir=kill_dir, checkpoint_every=2,
+                       log_every=0, batch_end_callback=_kill_after(5))
+    assert ckpt.all_steps(kill_dir)  # something was saved before the kill
+    ckpt.close_all()  # the kill left an open manager on the directory
+    (p_res, _, _), _ = _trainer().fit(
+        _iter(X, Y), num_epoch=3, seed=5, checkpoint_dir=kill_dir,
+        checkpoint_every=2, resume="auto", log_every=0)
+    for n in p_full:
+        np.testing.assert_allclose(np.asarray(p_full[n]),
+                                   np.asarray(p_res[n]),
+                                   rtol=1e-6, atol=1e-7, err_msg=n)
+
+
+@pytest.mark.chaos
+def test_resume_falls_back_past_corrupt_checkpoint(tmp_path):
+    X, Y = _data()
+    d = str(tmp_path / "ck")
+    _trainer().fit(_iter(X, Y), num_epoch=2, seed=5, checkpoint_dir=d,
+                   checkpoint_every=4, log_every=0)
+    steps = ckpt.all_steps(d)
+    assert len(steps) >= 2
+    ckpt.close_all()
+    # garble the NEWEST checkpoint's largest shard file
+    with chaos.inject("checkpoint.write", "corrupt", seed=1):
+        assert chaos.corrupt_file("checkpoint.write",
+                                  os.path.join(d, str(steps[-1])))
+    # resume survives by validating and falling back to the previous step
+    (p, _, _), _ = _trainer().fit(_iter(X, Y), num_epoch=2, seed=5,
+                                  checkpoint_dir=d, checkpoint_every=4,
+                                  resume="auto", log_every=0)
+    for n in p:
+        assert np.isfinite(np.asarray(p[n])).all()
+
+
+def test_nonfinite_guard_skips_and_aborts():
+    X, Y = _data()
+    Xbad = X.copy()
+    Xbad[8:16] = np.nan  # poison exactly batch index 1
+    tr = _trainer(skip_nonfinite=True)
+    (p, _, _), _ = tr.fit(_iter(Xbad, Y), num_epoch=1, seed=5, log_every=0)
+    for n in p:
+        assert np.isfinite(np.asarray(p[n])).all(), n
+    # every batch bad -> abort after max_bad_steps CONSECUTIVE skips
+    Xall = np.full_like(X, np.nan)
+    with pytest.raises(MXNetError, match="consecutive non-finite"):
+        _trainer(skip_nonfinite=True).fit(
+            _iter(Xall, Y), num_epoch=2, seed=5, max_bad_steps=3,
+            log_every=0)
+
+
+def test_guard_step_matches_unguarded_on_clean_data():
+    X, Y = _data()
+    (p0, _, _), _ = _trainer().fit(_iter(X, Y), num_epoch=1, seed=5,
+                                   log_every=0)
+    (p1, _, _), _ = _trainer(skip_nonfinite=True).fit(
+        _iter(X, Y), num_epoch=1, seed=5, log_every=0)
+    for n in p0:
+        np.testing.assert_allclose(np.asarray(p0[n]), np.asarray(p1[n]),
+                                   rtol=1e-6, atol=1e-7, err_msg=n)
