@@ -162,6 +162,10 @@ def main():
     stem = os.environ.get(
         "BENCH_STEM",
         "s2d" if platform == "tpu" and layout == "NHWC" else "conv7")
+    # BENCH_PIPELINE=K fuses K optimizer steps into ONE dispatch
+    # (ShardedTrainer.pipeline_steps): the tunnel's ~1-2 ms/call dispatch
+    # tax is paid once per K steps — docs/PERF.md "Pipelined training"
+    pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
     sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
                             image_shape=(3, image, image), dtype="bfloat16",
                             layout=layout, stem=stem)
@@ -171,13 +175,13 @@ def main():
         data_shapes={"data": (batch, 3, image, image)},
         label_shapes={"softmax_label": (batch,)},
         momentum=0.9, learning_rate=0.1, wd=1e-4, rescale_grad=1.0 / batch,
+        pipeline_steps=pipeline,
     )
     params, moms, aux = tr.init(seed=0)
-    data = tr.place_batch({
+    host = {
         "data": np.random.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32),
         "softmax_label": np.random.randint(0, 1000, (batch,)).astype(np.float32),
-    })
-    step = tr.step_fn()
+    }
     key = jax.random.PRNGKey(0)
 
     # warmup / compile.  NOTE: on remote-tunneled devices block_until_ready
@@ -187,22 +191,38 @@ def main():
         leaf = jax.tree_util.tree_leaves(tree)[0]
         return np.asarray(jax.numpy.ravel(leaf)[0])
 
-    outs, params, moms, aux = step(params, moms, aux, data, key)
-    sync(outs)
-
-    t0 = time.perf_counter()
-    for i in range(steps):
+    if pipeline > 1:
+        sb = tr.place_superbatch([host] * pipeline)
+        pipe = tr.pipeline_fn(pipeline)
+        outs, params, moms, aux = pipe(params, moms, aux, sb, key,
+                                       np.int32(0))
+        sync(outs)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            outs, params, moms, aux = pipe(
+                params, moms, aux, sb, key, np.int32((i + 1) * pipeline))
+        sync(outs)
+        dt = time.perf_counter() - t0
+        img_s = batch * steps * pipeline / dt
+    else:
+        data = tr.place_batch(host)
+        step = tr.step_fn()
         outs, params, moms, aux = step(params, moms, aux, data, key)
-    sync(outs)
-    dt = time.perf_counter() - t0
+        sync(outs)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            outs, params, moms, aux = step(params, moms, aux, data, key)
+        sync(outs)
+        dt = time.perf_counter() - t0
+        img_s = batch * steps / dt
 
-    img_s = batch * steps / dt
     print(json.dumps({
         "metric": "resnet50_train_throughput" if platform == "tpu"
                   else "resnet8_cpu_smoke_throughput",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        **({"pipeline_steps": pipeline} if pipeline > 1 else {}),
     }))
 
 

@@ -459,10 +459,14 @@ def quantize_aware_symbol(sym, excluded_sym_names=(), ema_momentum=0.99,
     nodes, heads = _load_graph(sym)
     targets = [n for n in nodes if _quantizable(n)
                and n["name"] not in excluded_sym_names]
-    fq_cache = {}  # (id(src node), out_idx) -> fake-quant node (shared)
+    # keyed by role too: a tensor consumed both as someone's data and as
+    # someone's weight needs BOTH observer types (EMA-stateful for the
+    # data edge, dynamic for the weight edge), not whichever was built
+    # first
+    fq_cache = {}  # (id(src node), out_idx, role) -> fake-quant node (shared)
     for n in targets:
         src, oi = n["inputs"][0]
-        key = (id(src), oi)
+        key = (id(src), oi, "data")
         if key not in fq_cache:
             base = src["name"] if oi == 0 else "%s%d" % (src["name"], oi)
             amax = _null("%s_fq_amax" % base, (1,))
@@ -474,11 +478,14 @@ def quantize_aware_symbol(sym, excluded_sym_names=(), ema_momentum=0.99,
         n["inputs"][0] = (fq_cache[key], 0)
         if quantize_weights:
             wsrc, woi = n["inputs"][1]
-            wkey = (id(wsrc), woi)
+            wkey = (id(wsrc), woi, "weight")
             if wkey not in fq_cache:
+                # "_fqw" keeps the name distinct from a data observer on
+                # the same tensor; dynamic nodes own no params/aux, so no
+                # stored name depends on this
                 fq_cache[wkey] = {
                     "op": "_contrib_fake_quant_dynamic",
-                    "name": "%s_fq" % wsrc["name"],
+                    "name": "%s_fqw" % wsrc["name"],
                     "attr": {"num_bits": str(num_bits)},
                     "inputs": [(wsrc, woi)]}
             n["inputs"][1] = (fq_cache[wkey], 0)
@@ -493,8 +500,14 @@ def quantize_model_qat(qat_sym, arg_params, aux_params,
     ``*_fq_amax`` aux state, strips every fake-quant node, and hands the
     plain graph + ranges to :func:`quantize_symbol` — so deployment uses
     exactly the ranges training simulated (no separate calibration pass).
+    The graph must have been trained with ``num_bits=8``: the deployed
+    grid (:func:`quantize_symbol`) is hard int8/127, so exporting a
+    different trained width would silently change the simulated
+    quantization — that raises :class:`MXNetError` instead.
     Returns ``(qsym, qarg_params, qaux_params)`` with the observer states
     dropped from aux."""
+    import logging
+
     nodes, heads = _load_graph(qat_sym)
     act_ranges = {}
     for n in nodes:
@@ -502,6 +515,16 @@ def quantize_model_qat(qat_sym, arg_params, aux_params,
             continue
         src, _oi = n["inputs"][0]
         if src["op"] != "_contrib_fake_quant":
+            # a quantizable node this export will int8-convert, but whose
+            # data edge was never observed during training — usually an
+            # excluded_sym_names mismatch between insertion and export;
+            # quantize_symbol will fall back to skipping it, silently
+            # shipping a float node the user believes is quantized
+            logging.warning(
+                "QAT export: quantizable node %r has no fake-quant "
+                "observer on its data input (trained with it in "
+                "excluded_sym_names?); it will stay float in the "
+                "exported graph", n["name"])
             continue
         amax_name = src["inputs"][1][0]["name"]
         if amax_name not in aux_params:
@@ -517,6 +540,15 @@ def quantize_model_qat(qat_sym, arg_params, aux_params,
         if fq["op"] not in ("_contrib_fake_quant",
                             "_contrib_fake_quant_dynamic"):
             continue
+        bits = int(fq.get("attr", {}).get("num_bits", 8))
+        if bits != 8:
+            # quantize_symbol deploys a hard int8/127 grid; exporting a
+            # graph trained at another width would quantize differently
+            # than training simulated
+            raise MXNetError(
+                "QAT export: %r was trained with num_bits=%d but the "
+                "deployable graph uses the int8 (127-step) grid; retrain "
+                "with num_bits=8 or exclude the node" % (fq["name"], bits))
         heads = _rewire(nodes, heads, fq, fq["inputs"][0])
     stripped = _emit_graph(heads)
     qsym, qargs = quantize_symbol(stripped, arg_params, act_ranges,

@@ -267,7 +267,7 @@ def delete_variable(var):
 
 
 def push(fn, const_vars=(), mutable_vars=(), priority=0,
-         prop=FnProperty.NORMAL, name="opr"):
+         prop=FnProperty.NORMAL, name="opr", on_drop=None):
     """Push async host fn with read deps ``const_vars`` and write deps
     ``mutable_vars`` (parity: ``Engine::PushAsync``).
 
@@ -275,6 +275,14 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
     ``mutable_vars`` is poisoned; ops depending on a poisoned var fail
     fast (their fn never runs) and propagate the same poison.  The
     original exception re-raises at ``wait_for_var``/``wait_for_all``.
+
+    ``on_drop`` (optional) is invoked when chaos injection silently drops
+    the op (``ChaosDrop``: ``fn`` never ran, vars stay unpoisoned).  A
+    producer that pre-stages state keyed on the op completing — e.g. a
+    prefetcher whose slot would otherwise keep serving its PREVIOUS batch
+    — uses it to record the loss so the consumer fails loudly instead of
+    reading stale data.  If ``on_drop`` itself raises, the error is
+    captured into var poison like a failing ``fn``.
     """
     global _pushed
     # lock-free hot path: the C-level next() is atomic under the GIL, so
@@ -295,7 +303,15 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
                 fn()
                 return
             except chaos.ChaosDrop:
-                return  # injected silent loss: op never ran, no poison
+                # injected silent loss: op never ran, no poison — but give
+                # the producer its say (stale-slot bookkeeping)
+                if on_drop is not None:
+                    try:
+                        on_drop()
+                    except Exception as exc:  # noqa: BLE001 — into poison
+                        poison = _Poison(exc, name)
+                        _mark_poisoned(muts, poison)
+                return
             except Exception as exc:  # noqa: BLE001 — captured into poison
                 poison = _Poison(exc, name)
         _mark_poisoned(muts, poison)

@@ -24,7 +24,36 @@ from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
-           "MXDataIter"]
+           "MXDataIter", "batch_arrays"]
+
+
+def batch_arrays(batch, data_iter=None, input_names=None):
+    """Flatten a ``DataBatch`` into ``(arrays, data_names)`` — the hook
+    training loops and the async prefetch feeder share for turning iterator
+    output into graph feeds.
+
+    ``arrays`` maps input name -> host ``numpy`` array (data then label,
+    descriptor order); ``data_names`` is the subset of names that came from
+    ``provide_data`` (so callers can split labels back out for metrics).
+    Descriptors are taken from the batch when set, else from ``data_iter``
+    (``NDArrayIter`` populates only the iter-level ``provide_*``).  When
+    ``input_names`` is given, names outside it are dropped — a loop feeding
+    a graph passes the graph's input set so extra iterator outputs (e.g.
+    unused labels) don't become unexpected feeds."""
+    ddescs = list(batch.provide_data
+                  or getattr(data_iter, "provide_data", None) or [])
+    ldescs = list(batch.provide_label
+                  or getattr(data_iter, "provide_label", None) or [])
+    arrays, data_names = {}, set()
+    vals = list(batch.data or []) + list(batch.label or [])
+    for i, (desc, v) in enumerate(zip(ddescs + ldescs, vals)):
+        name = desc[0] if isinstance(desc, (tuple, list)) else desc.name
+        if input_names is None or name in input_names:
+            arrays[name] = (v.asnumpy() if hasattr(v, "asnumpy")
+                            else _np.asarray(v))
+            if i < len(ddescs):
+                data_names.add(name)
+    return arrays, data_names
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -300,6 +329,14 @@ class PrefetchingIter(DataIter):
                 self.next_batch[i] = None
                 self._errors[i] = exc
 
+        def lost():
+            # chaos dropped the fetch op: the slot still holds its PREVIOUS
+            # batch, which iter_next would silently re-serve — record the
+            # loss so the consumer raises instead (reset() recovers)
+            self._errors[i] = RuntimeError(
+                "prefetch op for slot %d was lost before running (chaos "
+                "injection / silent drop) — the slot's data is stale" % i)
+
         if self._engine.in_worker():
             # nested prefetchers: running on the bounded IO pool already —
             # scheduling another IO op and waiting on it could starve the
@@ -308,7 +345,7 @@ class PrefetchingIter(DataIter):
             return
         self._engine.push(fetch, mutable_vars=[self._vars[i]],
                           prop=self._engine.FnProperty.IO,
-                          name="prefetch%d" % i)
+                          name="prefetch%d" % i, on_drop=lost)
 
     def _push_all(self):
         for i in range(self.n_iter):

@@ -16,6 +16,8 @@ from . import pipeline
 from .moe import init_moe_params, moe_ffn
 from .pipeline import PipelinedTrainer, pipeline_apply, stack_stage_params
 from . import checkpoint
+from . import prefetch
+from .prefetch import PrefetchFeeder
 from . import trainer
 from .trainer import ShardedTrainer
 

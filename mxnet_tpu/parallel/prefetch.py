@@ -1,0 +1,200 @@
+"""Async host→device prefetch for the pipelined training loop.
+
+The paper's thesis is overlap: the dependency engine orders host-side
+work so data movement hides behind compute (reference ``dmlc::ThreadedIter``
+feeding ``PrefetcherIter``, ``iter_prefetcher.h:129``).  ``PrefetchFeeder``
+is that idea for the sharded trainer's superbatch pipeline: while the
+device runs flush ``k``'s ``lax.scan``, an engine IO worker is already
+pulling flush ``k+1``'s batches from the ``DataIter``, stacking them and
+``device_put``-ing the superbatch onto the mesh — so when the trainer asks
+for the next chunk, the H2D copy has (best case) already happened.
+
+Built on the engine's var machinery rather than ad-hoc threads:
+
+- each buffer slot has a write var; ``next_chunk`` is ``wait_for_var`` —
+  the consume-side sync point, exactly like ``io.PrefetchingIter``;
+- ONE shared order var is a mutable dep of every fetch op, so the engine
+  runs fetches in push order and the (stateful, unlocked) ``DataIter`` is
+  only ever touched by one op at a time, in deterministic order;
+- a fetch that raises (bad record, transform bug) poisons its slot var;
+  the ORIGINAL exception re-raises at the consumer's ``next_chunk`` and
+  every later fetch fails fast on the poisoned order var;
+- a fetch silently dropped by chaos injection (``engine.push(on_drop=)``)
+  marks the feeder broken: batches it should have pulled are gone, so
+  serving the later slots would silently skip data.  ``reset()`` is the
+  recovery point for both failure modes.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .. import engine as _engine
+
+__all__ = ["PrefetchFeeder", "Chunk"]
+
+
+#: One prefetched pipeline flush: ``placed`` is the device superbatch (the
+#: ``place`` callback's result), ``host`` the per-batch ``extract`` results
+#: (kept for labels/metrics/callbacks), ``count`` how many batches were
+#: actually pulled (the epoch tail may come up short).
+Chunk = namedtuple("Chunk", ["placed", "host", "count"])
+
+_PENDING = object()  # slot pre-mark: its fetch op has not completed
+_END = object()      # slot result: iterator exhausted before this chunk
+
+
+class PrefetchFeeder(object):
+    """Double-buffered background chunk feeder over a ``DataIter``.
+
+    Parameters
+    ----------
+    data_iter : iterator yielding ``DataBatch``
+        Consumed exclusively by engine IO ops (serialized in push order).
+        The feeder drains it; epoch restart is the CALLER's reset of the
+        underlying iter followed by this feeder's ``reset()``.
+    extract : callable(batch) -> host payload
+        Runs on the IO worker; typically ``io.batch_arrays`` — pure host
+        work (asnumpy, dict building).
+    place : callable(list of host payloads) -> device chunk
+        Runs on the IO worker; typically stacks the payloads and
+        ``device_put``s the superbatch (``ShardedTrainer.place_superbatch``).
+    sizes : int or callable() -> int
+        Chunk size; a callable is invoked once per fetch op AT PUSH TIME in
+        push order, so a training loop can plan sizes that land flush
+        boundaries on checkpoint boundaries.  The epoch tail returns a
+        short chunk; after exhaustion every later fetch yields END.
+    depth : int
+        Buffer depth (2 = classic double buffering: one chunk computing,
+        one staging).
+    """
+
+    def __init__(self, data_iter, extract, place, sizes, depth=2,
+                 name="prefetch_feeder"):
+        self._it = data_iter
+        self._extract = extract
+        self._place = place
+        self._sizes = sizes if callable(sizes) else (lambda k=int(sizes): k)
+        self._depth = int(depth)
+        if self._depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._name = name
+        self._slots = [_PENDING] * self._depth
+        self._vars = [_engine.new_variable() for _ in range(self._depth)]
+        # the iterator-order var: mutable dep of EVERY fetch, so the engine
+        # serializes iterator access in push order across slots
+        self._order = _engine.new_variable()
+        self._exhausted = False   # producer side: data_iter ran dry
+        self._done = False        # consumer side: END chunk was consumed
+        self._broken = None       # sticky error after a lost fetch op
+        self._cursor = 0          # consumer's next slot
+        self._closed = False
+        for i in range(self._depth):
+            self._push(i)
+
+    # -- producer side (engine IO workers) -----------------------------
+    def _push(self, i):
+        size = int(self._sizes())
+        if size < 1:
+            raise ValueError("chunk size must be >= 1, got %d" % size)
+        self._slots[i] = _PENDING
+
+        def fetch():
+            if self._exhausted:
+                self._slots[i] = _END
+                return
+            host = []
+            try:
+                while len(host) < size:
+                    host.append(self._extract(next(self._it)))
+            except StopIteration:
+                self._exhausted = True
+            if not host:
+                self._slots[i] = _END
+                return
+            self._slots[i] = Chunk(self._place(host), host, len(host))
+
+        def lost():
+            # the op (and the iterator positions it would have consumed)
+            # is gone; later slots hold batches from FURTHER ahead, so
+            # continuing would silently skip data
+            self._broken = RuntimeError(
+                "%s: fetch op for slot %d was lost before running (chaos "
+                "injection / silent drop) — batches it should have pulled "
+                "are missing; reset() to recover" % (self._name, i))
+
+        if _engine.in_worker():
+            # nested on the bounded IO pool already (feeder inside an
+            # engine op): pushing + waiting could starve the pool —
+            # degrade to a synchronous fetch
+            fetch()
+            return
+        _engine.push(fetch, mutable_vars=[self._vars[i], self._order],
+                     prop=_engine.FnProperty.IO,
+                     name="%s.fetch%d" % (self._name, i), on_drop=lost)
+
+    # -- consumer side (training loop thread) --------------------------
+    def next_chunk(self):
+        """Block until the next chunk is staged; return it, or ``None``
+        once the iterator is exhausted.  Re-raises (at this sync point) the
+        ORIGINAL exception of a failed fetch; raises ``RuntimeError`` when
+        a fetch op was silently dropped.  Consuming a chunk immediately
+        pushes the refill fetch for its slot."""
+        if self._closed:
+            raise RuntimeError("%s is closed" % self._name)
+        if self._done:
+            return None
+        i = self._cursor
+        _engine.wait_for_var(self._vars[i])  # poison re-raises here
+        if self._broken is not None:
+            raise self._broken
+        chunk = self._slots[i]
+        if chunk is _PENDING:
+            # backstop: op lost without on_drop firing (shouldn't happen —
+            # every loss path above marks the feeder)
+            self._broken = RuntimeError(
+                "%s: slot %d never completed its fetch" % (self._name, i))
+            raise self._broken
+        if chunk is _END:
+            self._done = True
+            return None
+        self._cursor = (i + 1) % self._depth
+        self._push(i)
+        return chunk
+
+    def reset(self):
+        """Recovery/restart point: drain in-flight fetches (swallowing
+        their errors), clear poison, and start prefetching afresh from the
+        iterator's CURRENT position — the caller resets the underlying
+        iterator first when it wants a new epoch."""
+        self._drain()
+        for v in self._vars + [self._order]:
+            _engine.clear_poison(v)
+        self._exhausted = False
+        self._done = False
+        self._broken = None
+        self._cursor = 0
+        for i in range(self._depth):
+            self._push(i)
+
+    def close(self):
+        """Drain and release engine vars; the feeder is dead afterwards."""
+        if self._closed:
+            return
+        self._closed = True
+        self._drain()
+        for v in self._vars + [self._order]:
+            _engine.delete_variable(v)
+
+    def _drain(self):
+        for v in self._vars:
+            try:
+                _engine.wait_for_var(v)
+            except Exception:  # noqa: BLE001 — drained errors are dropped
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

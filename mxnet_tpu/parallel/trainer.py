@@ -189,7 +189,8 @@ class ShardedTrainer:
                  data_axis="data", dtype="float32",
                  remat=False, remat_policy=None, zero_stage=0,
                  optimizer="sgd", optimizer_params=None, lr_scheduler=None,
-                 grad_accum=1, multi_precision=False, skip_nonfinite=False):
+                 grad_accum=1, multi_precision=False, skip_nonfinite=False,
+                 pipeline_steps=1):
         from ..executor import _graph_fn
         from ..symbol import _infer
 
@@ -208,6 +209,15 @@ class ShardedTrainer:
         self.grad_accum = int(grad_accum)
         if self.grad_accum < 1:
             raise MXNetError("grad_accum must be >= 1")
+        # multi-step fusion: pipeline_steps=K runs K optimizer steps inside
+        # ONE jitted lax.scan over a stacked superbatch, so the host→device
+        # dispatch (the ~1-2 ms/call tunnel tax — docs/PERF.md "Batch-32
+        # inference") is paid once per K steps.  Semantics are the per-step
+        # path's exactly: per-step RNG keys, LR schedule, skip_nonfinite
+        # verdicts, and grad_accum all evaluate per scanned step.
+        self.pipeline_steps = int(pipeline_steps)
+        if self.pipeline_steps < 1:
+            raise MXNetError("pipeline_steps must be >= 1")
         if self.grad_accum > 1:
             def _micro(name, shp):
                 if not shp or shp[0] % self.grad_accum:
@@ -317,8 +327,11 @@ class ShardedTrainer:
         # (1.0 ok / 0.0 skipped) that ``fit`` consumes for its
         # skip-count/abort policy.  Opt-in: the trace changes shape.
         self._skip_nonfinite = bool(skip_nonfinite)
+        self._step_raw = None  # untraced step body, shared with pipeline_fn
         self._jit_step = None
         self._jit_fwd = None
+        self._jit_pipe = {}  # n-step pipelines keyed by (n, unroll) —
+        # partial epoch-tail flushes get their own cached trace
 
     def _param_dtype(self, name):
         """On-device storage dtype for a parameter (the working copy)."""
@@ -427,11 +440,12 @@ class ShardedTrainer:
         return out
 
     # ------------------------------------------------------------------
-    def step_fn(self):
-        """The fused train step: (params, moms, aux, batch, rng) ->
-        (outputs, new_params, new_moms, new_aux)."""
-        if self._jit_step is not None:
-            return self._jit_step
+    def _build_step(self):
+        """The raw (untraced) fused step body — the ONE spelling of the
+        train-step math, traced standalone by ``step_fn`` and under
+        ``lax.scan`` by ``pipeline_fn`` so the two paths cannot drift."""
+        if self._step_raw is not None:
+            return self._step_raw
         run = self._run
         use_mom = self._use_momentum
         update_op = self._update_op
@@ -558,20 +572,39 @@ class ShardedTrainer:
         zero = self.zero_stage >= 1
         zero_shard = {n: self._sharding(self.opt_specs[n])
                       for n in self.param_names}
-        pshard = {n: self._sharding(self.param_specs[n]) for n in self.param_names}
+        self._step_raw = step
+        return step
+
+    def _step_shardings(self):
+        """NamedSharding trees ``(pshard, mshard, ashard, dshard)`` for the
+        fused step's arguments — one spelling shared by ``step_fn`` and
+        ``pipeline_fn`` so their placement contracts cannot diverge."""
+        zero_shard = {n: self._sharding(self.opt_specs[n])
+                      for n in self.param_names}
+        pshard = {n: self._sharding(self.param_specs[n])
+                  for n in self.param_names}
         mshard = {}
-        if use_mom:
+        if self._use_momentum:
             for n in self.param_names:
-                slots, _, bare = layouts[n]
+                slots, _, bare = self._state_layout(n)
                 if not slots:
                     continue
                 mshard[n] = (zero_shard[n] if bare
                              else (zero_shard[n],) * slots)
-        if needs_count:
+        if self._needs_count:
             mshard[_STEP_COUNT] = self._sharding(P())
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self._batch_spec(n))
                   for n in self._input_names}
+        return pshard, mshard, ashard, dshard
+
+    def step_fn(self):
+        """The fused train step: (params, moms, aux, batch, rng) ->
+        (outputs, new_params, new_moms, new_aux)."""
+        if self._jit_step is not None:
+            return self._jit_step
+        step = self._build_step()
+        pshard, mshard, ashard, dshard = self._step_shardings()
         self._jit_step_raw = jax.jit(
             step,
             in_shardings=(pshard, mshard, ashard, dshard, None),
@@ -580,6 +613,100 @@ class ShardedTrainer:
         )
         self._jit_step = self._with_mesh(self._jit_step_raw)
         return self._jit_step
+
+    # ------------------------------------------------------------------
+    def _superbatch_spec(self, name):
+        """Input spec for the stacked pipeline axis: ``[K, ...]`` with the
+        leading (scanned) step axis unsharded on top of ``_batch_spec``."""
+        return P(None, *self._batch_spec(name))
+
+    def place_superbatch(self, batches):
+        """Stack K host batches into one ``[K, ...]`` superbatch sharded on
+        the mesh — ``pipeline_fn``'s input.  Each element of ``batches`` is
+        a ``name -> host array`` dict; under ``grad_accum`` each batch is
+        first split row-major exactly as ``place_batch`` would (so the
+        scanned layout is ``[K, grad_accum, mb, ...]``)."""
+        if not batches:
+            raise MXNetError("place_superbatch needs at least one batch")
+        out = {}
+        ga = self.grad_accum
+        for n in batches[0]:
+            vs = []
+            for b in batches:
+                v = _np.asarray(b[n])
+                if ga > 1:
+                    if v.shape[0] % ga:
+                        raise MXNetError(
+                            "batch %r dim0 %d not divisible by grad_accum=%d"
+                            % (n, v.shape[0], ga))
+                    v = v.reshape((ga, v.shape[0] // ga) + v.shape[1:])
+                vs.append(v)
+            out[n] = jax.device_put(
+                _np.stack(vs), self._sharding(self._superbatch_spec(n)))
+        return out
+
+    def pipeline_fn(self, n=None, unroll=None):
+        """``n`` fused steps in ONE dispatch: ``(params, moms, aux,
+        superbatch, base_key, step0) -> (stacked_outs, params, moms, aux)``.
+
+        ``lax.scan`` over the superbatch's leading axis runs the SAME raw
+        step body ``step_fn`` traces; scanned step ``i`` draws
+        ``fold_in(base_key, step0 + i)`` — ``fold_in`` of a traced counter
+        is bitwise the eager per-step stream, so pipelined parameter
+        evolution is the per-step path's exactly.  Outputs come back
+        stacked ``[n, ...]`` (the trailing skip_nonfinite verdict, when
+        enabled, as an ``[n]`` vector) and are fetched once per flush —
+        the tunnel is crossed once per ``n`` steps.  Jitted per
+        ``(n, unroll)`` and cached, so epoch-tail partial flushes reuse
+        their own trace.
+
+        ``unroll`` defaults to full (the scan emits ``n`` copies of the
+        step): pipeline depths are small, and the rolled while-loop
+        measured ~5x slower per step on XLA:CPU (the loop carries the
+        whole parameter tree through per-iteration buffer shuffles that
+        straight-line code avoids).  Pass ``unroll=1`` to trade that for
+        an ``n``-independent compile time at large depths — or when
+        bitwise-exact parity with the per-step path matters for
+        multi-state optimizers: full unroll lets XLA fuse across
+        iterations, which moved adam by ~1e-8 in testing (sgd/momentum/
+        multi-precision stayed exact either way)."""
+        if n is None:
+            n = self.pipeline_steps
+        n = int(n)
+        if n < 1:
+            raise MXNetError("pipeline_fn needs n >= 1")
+        unroll = n if unroll is None else int(unroll)
+        cached = self._jit_pipe.get((n, unroll))
+        if cached is not None:
+            return cached
+        step = self._build_step()
+
+        def pipe(params, moms, aux, superbatch, base_key, step0):
+            def body(carry, xs):
+                p, m, a = carry
+                batch, i = xs
+                key = jax.random.fold_in(base_key, step0 + i)
+                outs, p, m, a = step(p, m, a, batch, key)
+                return (p, m, a), outs
+
+            (p, m, a), outs_stack = jax.lax.scan(
+                body, (params, moms, aux),
+                (superbatch, jnp.arange(n, dtype=jnp.int32)),
+                unroll=unroll)
+            return outs_stack, p, m, a
+
+        pshard, mshard, ashard, _ = self._step_shardings()
+        sshard = {nm: self._sharding(self._superbatch_spec(nm))
+                  for nm in self._input_names}
+        fn = jax.jit(
+            pipe,
+            in_shardings=(pshard, mshard, ashard, sshard, None, None),
+            out_shardings=(None, pshard, mshard, ashard),
+            donate_argnums=(0, 1),
+        )
+        wrapped = self._with_mesh(fn)
+        self._jit_pipe[(n, unroll)] = wrapped
+        return wrapped
 
     def _batch_spec(self, name):
         """Input spec as the step receives it (microbatch axis prepended
@@ -623,12 +750,30 @@ class ShardedTrainer:
             eval_metric="accuracy", initializer=None, state=None,
             begin_epoch=0, checkpoint_dir=None, checkpoint_every=None,
             resume=None, max_bad_steps=5, log_every=50, logger=None,
-            batch_end_callback=None):
+            batch_end_callback=None, metric_every=1):
         """Mesh-native training loop — ``Module.fit``'s role
         (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
         epochs over a ``DataIter``, metric updates, throughput logging
         (``Speedometer``, reference ``callback.py:89``), optional eval pass
         and sharded checkpoints.
+
+        Pipelined execution
+        -------------------
+        With ``pipeline_steps=K > 1`` each dispatch runs a K-step
+        ``pipeline_fn`` flush over a superbatch that a background
+        ``PrefetchFeeder`` (engine IO lane) staged while the previous
+        flush computed — dispatch and host-feed latency hide behind
+        device work, and parameter evolution stays bitwise the per-step
+        path's (same per-step RNG keys, LR schedule, skip policy).
+        Chunk sizes are planned so flush boundaries land exactly on
+        ``checkpoint_every`` multiples — checkpoints and their resume
+        metas are identical to the per-step path's, including resume
+        from a checkpoint that falls mid-superbatch.  ``metric_every=F``
+        fetches step outputs for the metric only every F-th flush (the
+        non-blocking-metrics knob: the skipped flushes never sync on a
+        readback); the epoch metric then samples 1/F of the flushes.
+        The trailing short flush of an epoch reuses a cached smaller
+        trace, so tails cost one extra compile, not wrong math.
 
         Fault tolerance
         ---------------
@@ -673,6 +818,7 @@ class ShardedTrainer:
 
         from .. import metric as _metric_mod
         from . import checkpoint as _ckpt
+        from . import prefetch as _prefetch
 
         log = logger or logging.getLogger(__name__)
         metric = (eval_metric if isinstance(eval_metric, _metric_mod.EvalMetric)
@@ -715,25 +861,15 @@ class ShardedTrainer:
         params, moms, aux = (state if state is not None
                              else self.init(initializer=initializer,
                                             seed=seed))
-        step = self.step_fn()
+        K = self.pipeline_steps
+        step = self.step_fn() if K == 1 else None
         fwd = self.forward_fn()
 
+        from ..io import batch_arrays as _io_batch_arrays
+
         def batch_arrays(batch, it):
-            # descriptors live on the batch when set, else on the iterator
-            # (NDArrayIter populates only the iter-level provide_*)
-            ddescs = list(batch.provide_data or it.provide_data or [])
-            ldescs = list(batch.provide_label or it.provide_label or [])
-            arrays, data_names = {}, set()
-            vals = list(batch.data or []) + list(batch.label or [])
-            for i, (desc, v) in enumerate(zip(ddescs + ldescs, vals)):
-                name = desc[0] if isinstance(desc, (tuple, list)) \
-                    else desc.name
-                if name in self._input_names:
-                    arrays[name] = v.asnumpy() if hasattr(v, "asnumpy") \
-                        else _np.asarray(v)
-                    if i < len(ddescs):
-                        data_names.add(name)
-            return arrays, data_names
+            # the shared iterator hook, restricted to this graph's inputs
+            return _io_batch_arrays(batch, it, self._input_names)
 
         from ..callback import Speedometer
         from ..model import BatchEndParam
@@ -784,68 +920,157 @@ class ShardedTrainer:
         bad_streak = 0
         skipped_total = 0
         last_saved = None
+        flushes = 0
+        metric_every = int(metric_every)
+        if metric_every < 1:
+            raise MXNetError("metric_every must be >= 1")
+
+        def after_step(epoch, arrays, data_names, ok, outs_host,
+                       can_ckpt=True):
+            """Per-step host bookkeeping shared by the per-step and
+            pipelined paths: skip policy, metric, speedometer, callbacks,
+            periodic checkpoint.  ``outs_host=None`` = this step's flush
+            skipped its metric fetch (``metric_every``); ``can_ckpt`` is
+            False for mid-flush steps — the in-hand (params, moms, aux)
+            are END-of-flush state, valid to save only at the flush's
+            last step (chunk planning puts every checkpoint boundary
+            there)."""
+            nonlocal bad_streak, skipped_total, speedo, last_saved
+            if ok:
+                bad_streak = 0
+                if outs_host is not None:
+                    labels = [v for n, v in arrays.items()
+                              if n not in data_names]
+                    metric.update([_np.asarray(v) for v in labels],
+                                  outs_host)
+            else:
+                bad_streak += 1
+                skipped_total += 1
+                log.warning(
+                    "non-finite loss/grad at global step %d — step "
+                    "skipped, state unchanged (%d consecutive, %d "
+                    "total)", global_step - 1, bad_streak,
+                    skipped_total)
+                if bad_streak >= max_bad_steps:
+                    raise MXNetError(
+                        "aborting fit: %d consecutive non-finite "
+                        "steps (last at global step %d) — the run "
+                        "has diverged or the input data is bad"
+                        % (bad_streak, global_step - 1))
+            if speedo is None and log_every:
+                # windowed samples/s (metric=None so the epoch metric
+                # is not reset mid-epoch by the logger)
+                speedo = Speedometer(
+                    next(iter(arrays.values())).shape[0],
+                    frequent=log_every)
+            bep = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=metric, locals=None)
+            if speedo is not None:
+                speedo(bep._replace(eval_metric=None))
+            for cb in callbacks:
+                cb(bep)
+            if (can_ckpt and checkpoint_every
+                    and global_step % checkpoint_every == 0):
+                _ckpt.save_sharded(checkpoint_dir, global_step, params,
+                                   moms, aux)
+                _ckpt.save_fit_meta(checkpoint_dir, global_step,
+                                    fit_meta(epoch, nbatch))
+                last_saved = global_step
+
         for epoch in range(start_epoch, end_epoch):
             metric.reset()
             train_data.reset()
             nbatch = 0
-            for batch in train_data:
-                if skip_batches:
-                    # resumed mid-epoch: replay the iterator up to the
-                    # checkpointed batch offset without stepping
+            if K == 1:
+                for batch in train_data:
+                    if skip_batches:
+                        # resumed mid-epoch: replay the iterator up to the
+                        # checkpointed batch offset without stepping
+                        skip_batches -= 1
+                        nbatch += 1
+                        continue
+                    arrays, data_names = batch_arrays(batch, train_data)
+                    placed = self.place_batch(arrays)
+                    outs, params, moms, aux = step(
+                        params, moms, aux, placed,
+                        _jax.random.fold_in(base_key, global_step))
+                    ok = True
+                    if guard:
+                        # trailing scalar = the step's in-graph verdict;
+                        # the asnumpy read syncs, which the skip policy
+                        # needs anyway
+                        ok = bool(_np.asarray(outs[-1]))
+                        outs = outs[:-1]
+                    global_step += 1
+                    nbatch += 1
+                    flushes += 1
+                    outs_host = ([_np.asarray(o) for o in outs]
+                                 if flushes % metric_every == 0 else None)
+                    after_step(epoch, arrays, data_names, ok, outs_host)
+            else:
+                # -- pipelined path: K fused steps per dispatch over a
+                # feeder-staged superbatch -------------------------------
+                while skip_batches:
+                    # resumed mid-epoch: replay BEFORE the feeder starts
+                    # prefetching, so chunk 0 begins at the right batch
+                    try:
+                        next(train_data)
+                    except StopIteration:
+                        break
                     skip_batches -= 1
                     nbatch += 1
-                    continue
-                arrays, data_names = batch_arrays(batch, train_data)
-                placed = self.place_batch(arrays)
-                outs, params, moms, aux = step(
-                    params, moms, aux, placed,
-                    _jax.random.fold_in(base_key, global_step))
-                ok = True
-                if guard:
-                    # trailing scalar = the step's in-graph verdict; the
-                    # asnumpy read syncs, which the skip policy needs anyway
-                    ok = bool(_np.asarray(outs[-1]))
-                    outs = outs[:-1]
-                global_step += 1
-                nbatch += 1
-                if ok:
-                    bad_streak = 0
-                    labels = [v for n, v in arrays.items()
-                              if n not in data_names]
-                    metric.update([_np.asarray(v) for v in labels],
-                                  [_np.asarray(o) for o in outs])
-                else:
-                    bad_streak += 1
-                    skipped_total += 1
-                    log.warning(
-                        "non-finite loss/grad at global step %d — step "
-                        "skipped, state unchanged (%d consecutive, %d "
-                        "total)", global_step - 1, bad_streak,
-                        skipped_total)
-                    if bad_streak >= max_bad_steps:
-                        raise MXNetError(
-                            "aborting fit: %d consecutive non-finite "
-                            "steps (last at global step %d) — the run "
-                            "has diverged or the input data is bad"
-                            % (bad_streak, global_step - 1))
-                if speedo is None and log_every:
-                    # windowed samples/s (metric=None so the epoch metric
-                    # is not reset mid-epoch by the logger)
-                    speedo = Speedometer(
-                        next(iter(arrays.values())).shape[0],
-                        frequent=log_every)
-                bep = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                    eval_metric=metric, locals=None)
-                if speedo is not None:
-                    speedo(bep._replace(eval_metric=None))
-                for cb in callbacks:
-                    cb(bep)
-                if checkpoint_every and global_step % checkpoint_every == 0:
-                    _ckpt.save_sharded(checkpoint_dir, global_step, params,
-                                       moms, aux)
-                    _ckpt.save_fit_meta(checkpoint_dir, global_step,
-                                        fit_meta(epoch, nbatch))
-                    last_saved = global_step
+                # plan chunk sizes at push time so every flush END lands
+                # on a checkpoint boundary (never crosses one mid-flush):
+                # the feeder calls plan_size once per fetch, in push order
+                planned = [global_step]
+
+                def plan_size():
+                    k = K
+                    if checkpoint_every:
+                        k = min(k, checkpoint_every
+                                - planned[0] % checkpoint_every)
+                    planned[0] += k
+                    return k
+
+                feeder = _prefetch.PrefetchFeeder(
+                    iter(train_data),
+                    extract=lambda b: batch_arrays(b, train_data),
+                    place=lambda host: self.place_superbatch(
+                        [a for a, _ in host]),
+                    sizes=plan_size, depth=2, name="fit.prefetch")
+                try:
+                    while True:
+                        chunk = feeder.next_chunk()
+                        if chunk is None:
+                            break
+                        n = chunk.count
+                        outs_stack, params, moms, aux = self.pipeline_fn(n)(
+                            params, moms, aux, chunk.placed, base_key,
+                            _np.int32(global_step))
+                        flushes += 1
+                        verdicts = None
+                        if guard:
+                            # one [n] readback per flush drives the skip
+                            # policy for all n steps
+                            verdicts = _np.asarray(outs_stack[-1])
+                            outs_stack = outs_stack[:-1]
+                        outs_host = None
+                        if flushes % metric_every == 0:
+                            outs_host = [_np.asarray(o)
+                                         for o in outs_stack]
+                        for j in range(n):
+                            arrays, data_names = chunk.host[j]
+                            ok = (True if verdicts is None
+                                  else bool(verdicts[j]))
+                            global_step += 1
+                            nbatch += 1
+                            after_step(
+                                epoch, arrays, data_names, ok,
+                                None if outs_host is None
+                                else [o[j] for o in outs_host],
+                                can_ckpt=(j == n - 1))
+                finally:
+                    feeder.close()
             history.setdefault(epoch, {})["train"] = metric.get()
             log.info("epoch %d train: %s", epoch, history[epoch]["train"])
 

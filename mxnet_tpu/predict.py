@@ -51,6 +51,7 @@ class Predictor(object):
             raise MXNetError("input_shapes required")
         self._input_shapes = dict(input_shapes)
         self._exec_cache = {}
+        self._pipe_cache = {}  # jitted device-loop traces, per (shapes, N)
         self._inputs = {n: None for n in self._input_shapes}
         self._output_index = output_index
         self._bind()
@@ -113,6 +114,75 @@ class Predictor(object):
             self.set_input(n, v)
         self._exec.forward(is_train=False)
         return self
+
+    def forward_pipeline(self, batches):
+        """Run N batches in ONE device dispatch — serving's version of the
+        trainer's ``pipeline_steps``: a jitted ``lax.scan`` over stacked
+        ``[N, ...]`` inputs pays the host→device dispatch (the ~1-2 ms
+        tunnel tax per call — docs/PERF.md "Batch-32 inference") once per
+        window instead of once per batch.
+
+        ``batches`` is a list of ``{input: array}`` dicts, each matching
+        ``input_shapes``, or a dict of pre-stacked ``[N, ...]`` arrays.
+        Returns the outputs as a list of ``[N, ...]``-stacked numpy arrays
+        (scoped to a single output when the Predictor was built with
+        ``output_index``, like ``get_output``).  The scan trace is cached
+        per ``(input shapes, N)``, so serving at a fixed window size
+        compiles once."""
+        import jax
+
+        if isinstance(batches, dict):
+            stacked = {n: _np.asarray(v) for n, v in batches.items()}
+        else:
+            if not batches:
+                raise MXNetError("forward_pipeline needs >= 1 batch")
+            stacked = {n: _np.stack([_np.asarray(b[n]) for b in batches])
+                       for n in batches[0]}
+        missing = set(self._input_shapes) - set(stacked)
+        if missing:
+            raise MXNetError("forward_pipeline missing inputs %r"
+                             % sorted(missing))
+        for n, v in stacked.items():
+            if n not in self._input_shapes:
+                raise MXNetError("unknown input %r" % n)
+            if tuple(v.shape[1:]) != tuple(self._input_shapes[n]):
+                raise MXNetError(
+                    "input %r batches have shape %r, declared %r"
+                    % (n, tuple(v.shape[1:]),
+                       tuple(self._input_shapes[n])))
+        depths = {v.shape[0] for v in stacked.values()}
+        if len(depths) != 1:
+            raise MXNetError(
+                "inputs disagree on pipeline depth: %r" % sorted(depths))
+        depth = depths.pop()
+        ex = self._exec
+        stacked = {n: v.astype(ex.arg_dict[n].dtype, copy=False)
+                   for n, v in stacked.items()}
+        shape_key = tuple(sorted((n, tuple(s))
+                                 for n, s in self._input_shapes.items()))
+        fn = self._pipe_cache.get((shape_key, depth))
+        if fn is None:
+            run = ex._run
+
+            def pipe(params, aux, stacked):
+                def body(key, batch):
+                    args = dict(params)
+                    args.update(batch)
+                    outs, _ = run(args, aux, key, False)
+                    return key, outs
+
+                _, outs = jax.lax.scan(body, jax.random.PRNGKey(0), stacked)
+                return outs
+
+            fn = jax.jit(pipe)
+            self._pipe_cache[(shape_key, depth)] = fn
+        params = {k: v._data for k, v in ex.arg_dict.items()
+                  if k not in self._input_shapes}
+        aux = {k: v._data for k, v in ex.aux_dict.items()}
+        outs = fn(params, aux, stacked)
+        if self._output_index is not None:
+            outs = [outs[self._output_index]]
+        return [_np.asarray(o) for o in outs]
 
     def get_output(self, index=0):
         """(parity: ``MXPredGetOutput``) → numpy array.  When the Predictor

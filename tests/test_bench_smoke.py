@@ -1,0 +1,38 @@
+"""bench.py CPU smoke: the benchmark must keep its one-line JSON
+contract (driver-parsed) in both per-step and BENCH_PIPELINE modes.
+Tiny shapes + BENCH_STEPS=2 keep each subprocess a few seconds."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_INNER="1",
+               BENCH_STEPS="2", BENCH_BATCH="2", **extra_env)
+    out = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=240, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert lines, out.stdout
+    return json.loads(lines[-1])
+
+
+@pytest.mark.parametrize("pipeline", [1, 4])
+def test_bench_json_contract(pipeline):
+    rec = _run_bench({"BENCH_PIPELINE": str(pipeline)})
+    assert rec["metric"] == "resnet8_cpu_smoke_throughput"
+    assert rec["unit"] == "img/s"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+    # pipeline_steps only appears when the pipelined path actually ran
+    if pipeline > 1:
+        assert rec["pipeline_steps"] == pipeline
+    else:
+        assert "pipeline_steps" not in rec

@@ -378,3 +378,71 @@ def test_qat_export_empty_observer_raises():
     auxs = {k: mx.nd.zeros((1,)) for k in qat.list_auxiliary_states()}
     with pytest.raises(mx.base.MXNetError, match="empty"):
         Q.quantize_model_qat(qat, args, auxs)
+
+
+def test_qat_dual_role_tensor_gets_both_fq_types():
+    """A tensor consumed as one node's DATA and another's WEIGHT needs
+    both fake-quant flavors: an EMA observer on the data edge and a
+    dynamic fq on the weight edge — the cache must key on role, not just
+    on the source tensor."""
+    import json as _json
+
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("shared")
+    fca = mx.sym.FullyConnected(d, weight=w, num_hidden=16, no_bias=True,
+                                name="fca")
+    fcb = mx.sym.FullyConnected(w, num_hidden=4, no_bias=True, name="fcb")
+    qat = Q.quantize_aware_symbol(mx.sym.Group([fca, fcb]))
+    nodes = _json.loads(qat.tojson())["nodes"]
+    by_name = {n["name"]: n for n in nodes}
+    names = [n["name"] for n in nodes]
+
+    def _input_op(consumer, idx):
+        return nodes[by_name[consumer]["inputs"][idx][0]]["op"]
+
+    # fcb reads `shared` as data -> EMA observer (with amax aux);
+    # fca reads `shared` as weight -> dynamic fq; both must exist
+    assert _input_op("fcb", 0) == "_contrib_fake_quant"
+    assert _input_op("fca", 1) == "_contrib_fake_quant_dynamic"
+    assert "shared_fq" in names and "shared_fqw" in names
+    assert "shared_fq_amax" in qat.list_auxiliary_states()
+
+
+def test_qat_export_num_bits_mismatch_raises():
+    """quantize_symbol deploys a hard int8/127 grid; a graph finetuned at
+    another width must refuse to export rather than silently change the
+    quantization the training simulated."""
+    net = _mlp()
+    qat = Q.quantize_aware_symbol(net, num_bits=4)
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rng.randn(32, 16) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(4, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((4,))}
+    auxs = {k: mx.nd.array([1.0]) for k in qat.list_auxiliary_states()}
+    with pytest.raises(mx.base.MXNetError, match="num_bits=4"):
+        Q.quantize_model_qat(qat, args, auxs)
+
+
+def test_qat_export_missing_observer_warns(caplog):
+    """Excluding a node at insertion but not at export leaves it with no
+    observer: the export must warn (the node silently stays float)
+    instead of skipping it without a trace."""
+    import json as _json
+    import logging
+
+    net = _mlp()
+    qat = Q.quantize_aware_symbol(net, excluded_sym_names=("fc2",))
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rng.randn(32, 16) * 0.1),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.array(rng.randn(4, 32) * 0.1),
+            "fc2_bias": mx.nd.zeros((4,))}
+    auxs = {k: mx.nd.array([1.0]) for k in qat.list_auxiliary_states()}
+    with caplog.at_level(logging.WARNING):
+        qsym, _qa, _qx = Q.quantize_model_qat(qat, args, auxs)
+    assert any("fc2" in r.message and "observer" in r.message
+               for r in caplog.records), caplog.records
+    ops = {n["name"]: n["op"] for n in _json.loads(qsym.tojson())["nodes"]}
+    assert ops["fc2"] == "FullyConnected"  # stayed float
+    assert ops["fc1"].startswith("_contrib_quantized")
