@@ -22,7 +22,10 @@ dryrun:
 dist-test:
 	python tools/launch.py -n 2 python tests/dist/dist_sync_kvstore.py
 
+chaos:
+	python -m pytest tests/ -q -m chaos
+
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-fast bench dryrun dist-test clean
+.PHONY: all native test test-fast bench dryrun dist-test chaos clean

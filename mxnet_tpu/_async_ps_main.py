@@ -4,13 +4,17 @@ reference's ``DMLC_ROLE=server`` processes running
 
 The launcher hands this process its port/identity/secret via env
 (``MXNET_TPU_SERVER_PORT``, ``MXNET_TPU_SERVER_ID``,
-``MXNET_TPU_PS_SECRET``) — the dmlc tracker env contract.  The process
-serves until a worker sends the ``shutdown`` op or the launcher reaps it
-after the workers exit.
+``MXNET_TPU_PS_SECRET``) — the dmlc tracker env contract.  With
+``MXNET_TPU_SERVER_PRIMARY=<addr>`` set (``tools/launch.py -r N``), the
+process enters that primary's replica group as a hot standby: snapshot
+state transfer, then the live update stream.  The process serves until a
+worker sends the ``shutdown`` op or the launcher reaps it after the
+workers exit.
 """
 
 import logging
 import os
+import time
 
 from .kvstore_async import AsyncServer
 
@@ -28,7 +32,23 @@ def main():
         with open(tmp, "w") as f:
             f.write(server.address)
         os.replace(tmp, addr_file)
-    logging.info("async PS shard %d serving on %s", server_id, server.address)
+    primary = os.environ.get("MXNET_TPU_SERVER_PRIMARY")
+    if primary:
+        # hot standby: state-transfer from the shard's primary, then ride
+        # its update stream.  A restarted replica uses the same path to
+        # REJOIN a running job — retry briefly in case the primary is
+        # still binding.
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                server.rejoin(primary)
+                break
+            except (ConnectionError, OSError, EOFError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+    logging.info("async PS shard %d serving on %s (%s)", server_id,
+                 server.address, server.role)
     server.wait_shutdown()
     server.stop()
 

@@ -14,6 +14,8 @@ __all__ = [
     "MXNetError",
     "ServerDeadError",
     "ShardFailedError",
+    "StaleEpochError",
+    "TruncatedMessageError",
     "string_types",
     "numeric_types",
     "DTYPE_TO_STR",
@@ -36,6 +38,28 @@ class ShardFailedError(MXNetError):
     """A fan-out across parameter-server shards failed on one or more
     shards.  The message names each failing shard (id + address) so a
     multi-server outage is attributable instead of an anonymous hang."""
+
+
+class StaleEpochError(MXNetError):
+    """A replica-group server rejected a request because the caller's
+    view of the group is out of date: either the request carried an
+    epoch older than the server's (a fenced zombie primary, or a worker
+    that missed a failover), or it was a mutation sent to a follower
+    (``not_primary``).  Carries the server's ``epoch`` so the caller can
+    refresh its membership view and retry."""
+
+    def __init__(self, msg, epoch=None, not_primary=False):
+        super().__init__(msg)
+        self.epoch = epoch
+        self.not_primary = not_primary
+
+
+class TruncatedMessageError(MXNetError, EOFError):
+    """A length-framed PS wire message ended before its declared size —
+    the peer died (or the stream was cut) mid-frame.  Subclasses
+    ``EOFError`` so the client retry path treats it like any other
+    connection loss, but the type distinguishes a half-read frame from a
+    clean close."""
 
 
 string_types = (str,)

@@ -12,6 +12,14 @@ site                      planted at
 ``kvstore.send``          PS wire send (``kvstore_async._send_msg``)
 ``kvstore.recv``          PS wire receive (``kvstore_async._recv_msg``)
 ``kvstore.call``          worker RPC attempt (``AsyncClient._call``)
+``kvstore.server_kill``   PS server dispatch entry (``AsyncServer.dispatch``)
+                          — a fired rule KILLS that server abruptly (op
+                          names are ``s<id>:<role>:<op>`` so ``match`` can
+                          target e.g. ``s0:primary:push``)
+``kvstore.repl_drop``     primary→follower replication send (one lost
+                          stream frame; re-sent and deduped by log seqno)
+``kvstore.repl_delay``    primary→follower replication send (stretches
+                          the replication-lag window)
 ``checkpoint.write``      sharded + two-file checkpoint writes
 ========================  ==================================================
 
@@ -56,6 +64,7 @@ __all__ = ["ChaosError", "ChaosDrop", "inject", "clear", "visit",
 
 SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
+    "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
     "checkpoint.write",
 })
 
@@ -81,6 +90,8 @@ def _drop_exc(site):
         return EOFError("chaos: dropped on receive")
     if site == "kvstore.call":
         return socket.timeout("chaos: call timed out")
+    if site == "kvstore.repl_drop":
+        return ConnectionResetError("chaos: replication frame dropped")
     return ChaosDrop("chaos: dropped at %s" % site)
 
 

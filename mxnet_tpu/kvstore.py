@@ -91,7 +91,8 @@ class KVStore(object):
         self._comm_var = None
         self._comm_error = None
         self._tpu = None     # FusedTPUStore for the dist_tpu mode
-        if kind == "dist_async" and self.num_workers > 1:
+        self._async_replicas = ()  # in-process replica servers (rank 0)
+        if kind == "dist_async" and self._wants_async():
             self._init_async()
         elif kind == "dist_tpu":
             from .parallel.dist_tpu import FusedTPUStore
@@ -105,6 +106,17 @@ class KVStore(object):
             self._key_vars[k] = engine.new_variable()
         return self._key_vars[k]
 
+    def _wants_async(self):
+        """Whether dist_async should run the real PS data plane: always
+        with multiple workers; single-process only when the job opted
+        into explicit servers (env address list) or an in-process
+        replica group (``MXNET_TPU_KV_REPLICAS > 1``)."""
+        import os
+
+        return (self.num_workers > 1
+                or bool(os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS"))
+                or int(os.environ.get("MXNET_TPU_KV_REPLICAS", "1")) > 1)
+
     def _init_async(self):
         import os
 
@@ -113,14 +125,31 @@ class KVStore(object):
         addrs_env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS")
         if addrs_env:
             # launcher-provided server processes (`launch.py -s N`): keys
-            # shard across them, big arrays stripe (kvstore_dist.h:269-300)
+            # shard across them, big arrays stripe (kvstore_dist.h:269-300).
+            # Each comma-separated shard may itself be a ``|``-separated
+            # replica group ("a|b,c|d"): ServerGroup then routes that
+            # shard through its current primary with automatic failover.
             self._async = ka.ServerGroup(addrs_env.split(","), self.rank)
             return
-        # degenerate single-server layout: a thread inside rank 0
+        # degenerate in-process layout: rank 0 hosts the server thread(s)
+        # — one primary plus MXNET_TPU_KV_REPLICAS-1 hot standbys that
+        # snapshot from it and ride its replication stream
         if self.rank == 0:
-            self._async_server = ka.AsyncServer().start()
-            ka.publish_address(self._async_server.address,
-                               self._async_server.secret)
+            primary = ka.AsyncServer(server_id=0).start()
+            servers = [primary]
+            for _ in range(ka._replicas() - 1):
+                follower = ka.AsyncServer(
+                    server_id=0, secret=primary.secret).start()
+                follower.rejoin(primary.address)
+                servers.append(follower)
+            self._async_server = primary
+            self._async_replicas = tuple(servers)
+            addr = "|".join(s.address for s in servers)
+            if self.num_workers > 1:
+                ka.publish_address(addr, primary.secret)
+            self._async = ka.ServerGroup([addr], self.rank,
+                                         secret=primary.secret)
+            return
         addr, secret = ka.lookup_address()
         if addr is None:
             raise MXNetError(

@@ -22,22 +22,82 @@ Observable semantics match the reference's async mode:
   without it, a thread inside rank-0 hosts a single server (the TPU-native
   degenerate layout — sync mode needs no host data plane at all).
 
+**Replication (hot standby)**: each logical shard may be a replica
+*group* — one primary plus hot-standby follower(s).  The primary applies
+every mutating op, stamps a per-key sequence number, appends the op to a
+replication log, and streams it to each follower over a dedicated sender
+thread (``MXNET_TPU_KV_REPL_SYNC=1`` makes the primary wait for follower
+acks before answering the worker, trading latency for zero-loss
+failover).  Membership is epoch-numbered: a promotion bumps the epoch,
+and both stale clients and zombie ex-primaries are *fenced* — their
+writes are rejected with a typed ``StaleEpochError`` rather than
+silently forking the weights.  :class:`ReplicatedClient` routes a
+worker's traffic to the current primary, detects death via heartbeats or
+failed RPCs, promotes a follower, and transparently retries the
+in-flight request with the SAME sequence number (the replicated
+per-worker dedup cache makes the retry at-most-once even across a
+failover).  A restarted server calls :meth:`AsyncServer.rejoin` to
+state-transfer a snapshot (weights + per-key seqnos + optimizer state)
+from the current primary and re-enter the group as a follower.
+
 Wire format (hardened, round-3): length-framed **JSON header + raw tensor
 buffers** — nothing on the data path is executable, unlike pickle.  Tensor
 byte-lengths are derived from dtype+shape, so a corrupt header cannot
-over-read.  The ONE pickle left is the ``set_optimizer`` payload (the
-reference ships a pickled optimizer too); it is gated by an HMAC-SHA256
-with a per-job shared secret carried over the same trusted channel as the
-server address (launcher env / jax.distributed coordination KV), so a bare
+over-read.  The ONE pickle left is the ``set_optimizer`` payload and the
+optimizer state inside a replication snapshot (the reference ships a
+pickled optimizer too); both are gated by an HMAC-SHA256 with a per-job
+shared secret carried over the same trusted channel as the server
+address (launcher env / jax.distributed coordination KV), so a bare
 TCP connection cannot inject code.  Message size is capped
-(``MXNET_TPU_PS_MAX_MSG_MB``).
+(``MXNET_TPU_PS_MAX_MSG_MB``).  A frame cut mid-read surfaces as a typed
+:class:`TruncatedMessageError` (an ``EOFError`` subclass, so the retry
+path handles it), never as garbage handed to the decoder.
+
+Environment tunables (all read LAZILY, per call — a test or job can
+reconfigure any of them without re-importing the module):
+
+=============================  =========  ==================================
+variable                       default    meaning
+=============================  =========  ==================================
+``MXNET_TPU_PS_DEAD_AFTER``    ``30``     seconds without contact before a
+                                          peer (worker or primary) counts
+                                          as dead
+``MXNET_TPU_PS_HEARTBEAT``     dead/3     worker heartbeat base interval
+                                          (floor 1 s unless set explicitly)
+``MXNET_TPU_PS_CALL_TIMEOUT``  ``60``     per-attempt socket timeout for
+                                          one RPC round trip
+``MXNET_TPU_PS_DEADLINE``      ``120``    overall per-RPC deadline across
+                                          retries → ``ServerDeadError``
+``MXNET_TPU_PS_INIT_TIMEOUT``  ``120``    init-barrier poll timeout
+``MXNET_TPU_PS_MAX_MSG_MB``    ``1024``   wire-frame size cap
+``MXNET_TPU_KV_REPLICAS``      ``1``      replicas per logical shard in the
+                                          degenerate in-process layout
+``MXNET_TPU_KV_REPL_SYNC``     ``0``      1 = primary waits for follower
+                                          acks before answering a mutation
+                                          (exact failover, ~1 RTT extra)
+``MXNET_TPU_KV_REPL_TIMEOUT``  ``10``     sync-mode ack wait bound; past
+                                          it the primary answers anyway
+                                          and the entry stays queued
+``MXNET_KVSTORE_BIGARRAY_``    ``1e6``    striping threshold in elements
+``BOUND``                                 (job-wide; decides routing)
+``MXNET_TPU_PS_SECRET``        —          per-job HMAC secret for the
+                                          pickled-optimizer payloads
+``MXNET_TPU_PS_HOST``          —          opt-in non-loopback bind host
+``MXNET_TPU_ASYNC_PS_ADDR``    —          explicit server address override
+``MXNET_TPU_ASYNC_PS_ADDRS``   —          comma-separated shard list; each
+                                          shard may be a ``|``-separated
+                                          replica group (``a|b,c|d``)
+=============================  =========  ==================================
 """
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import hmac as _hmaclib
+import itertools
 import json as _json
+import logging
 import os
 import pickle
 import random as _random
@@ -52,21 +112,35 @@ import zlib
 import numpy as _np
 
 from . import chaos as _chaos
-from .base import ServerDeadError, ShardFailedError
+from .base import (MXNetError, ServerDeadError, ShardFailedError,
+                   StaleEpochError, TruncatedMessageError)
 
-__all__ = ["AsyncServer", "AsyncClient", "ServerGroup",
-           "ServerDeadError", "ShardFailedError",
-           "publish_address", "lookup_address"]
+__all__ = ["AsyncServer", "AsyncClient", "ReplicatedClient", "ServerGroup",
+           "ServerDeadError", "ShardFailedError", "StaleEpochError",
+           "TruncatedMessageError",
+           "publish_address", "lookup_address", "reset_membership"]
 
 _KV_KEY = "mxtpu_async_ps_addr"
 
+_LOG = logging.getLogger(__name__)
+
 
 # -- tunables, read LAZILY so jobs and tests can reconfigure timeouts
-# through the environment without re-importing the module ------------------
+# through the environment without re-importing the module (see the table
+# in the module docstring) -------------------------------------------------
 
 def _dead_after_s():
     """Seconds without a heartbeat before a worker counts as dead."""
     return float(os.environ.get("MXNET_TPU_PS_DEAD_AFTER", "30"))
+
+
+def _heartbeat_interval_s():
+    """Worker heartbeat base interval; defaults to a third of the death
+    window (floored at 1 s so idle workers don't spin)."""
+    env = os.environ.get("MXNET_TPU_PS_HEARTBEAT")
+    if env:
+        return float(env)
+    return max(_dead_after_s() / 3.0, 1.0)
 
 
 def _max_msg_bytes():
@@ -85,9 +159,29 @@ def _deadline_s():
     return float(os.environ.get("MXNET_TPU_PS_DEADLINE", "120"))
 
 
+def _replicas():
+    """Replicas per logical shard in the degenerate in-process layout."""
+    return max(1, int(os.environ.get("MXNET_TPU_KV_REPLICAS", "1")))
+
+
+def _repl_sync():
+    """Whether the primary waits for follower acks before responding."""
+    return os.environ.get("MXNET_TPU_KV_REPL_SYNC", "0").lower() \
+        not in ("0", "false", "")
+
+
+def _repl_timeout_s():
+    """Sync-mode bound on the follower-ack wait."""
+    return float(os.environ.get("MXNET_TPU_KV_REPL_TIMEOUT", "10"))
+
+
 # ops whose effect is not idempotent: dedup must cache their responses so
 # a retry is answered from cache, never re-applied.  pulls/stats re-execute.
+# promote/sync_follower are membership ops and idempotent by construction
+# (same-epoch promote acks; re-sync re-snapshots), so they stay out.
 _MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command"})
+# the same four ops are what a primary appends to its replication log
+_REPLICATED_OPS = _MUTATING_OPS
 
 
 # -- wire codec: JSON header + raw buffers, nothing executable -----------
@@ -177,6 +271,40 @@ class _MessageTooBig(ValueError):
     pass
 
 
+def _sendall(sock, data):
+    """``sendall`` with explicit partial-write bookkeeping: an ``EINTR``
+    mid-frame resumes from the exact byte reached, never re-sends a
+    prefix (which would desynchronize the length-framed stream)."""
+    view = memoryview(data)
+    sent = 0
+    while sent < len(view):
+        try:
+            sent += sock.send(view[sent:])
+        except InterruptedError:
+            continue  # PEP 475 covers most of these; belt and braces
+
+
+def _recv_exact(sock, n, what):
+    """Read exactly ``n`` bytes, retrying short reads and ``EINTR``.
+    A peer that dies mid-frame raises :class:`TruncatedMessageError`
+    (typed, retriable) instead of handing a short buffer to the
+    decoder."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+        except InterruptedError:
+            continue
+        if not chunk:
+            if not buf and what == "frame header":
+                raise EOFError("peer closed")  # clean close between frames
+            raise TruncatedMessageError(
+                "peer closed after %d of %d bytes of %s — frame truncated"
+                % (len(buf), n, what))
+        buf += chunk
+    return bytes(buf)
+
+
 def _send_msg(sock, obj):
     payload = _encode_msg(obj)
     cap = _max_msg_bytes()
@@ -190,30 +318,20 @@ def _send_msg(sock, obj):
     # chaos site: drop raises ConnectionResetError (the retry path's
     # exception), corrupt garbles the outgoing frame payload
     payload = _chaos.visit("kvstore.send", payload)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    _sendall(sock, struct.pack("<Q", len(payload)) + payload)
 
 
 def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise EOFError("peer closed")
-        hdr += chunk
+    hdr = _recv_exact(sock, 8, "frame header")
     (n,) = struct.unpack("<Q", hdr)
     if n > _max_msg_bytes():
         raise ValueError("message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB"
                          % n)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise EOFError("peer closed mid-message")
-        buf += chunk
+    buf = _recv_exact(sock, n, "frame body")
     # chaos site AFTER the frame is fully consumed: a drop models the
     # response lost in flight (the socket is torn down either way), a
     # corrupt models bit-rot — decode rejects it via length/JSON checks
-    buf = _chaos.visit("kvstore.recv", bytes(buf))
+    buf = _chaos.visit("kvstore.recv", buf)
     return _decode_msg(bytes(buf))
 
 
@@ -270,20 +388,197 @@ def _advertise_host(bind_host):
         return "127.0.0.1"
 
 
+class _AckLatch:
+    """Completion latch for one replicated entry in sync mode: released
+    once every (live) follower has acked the entry."""
+
+    def __init__(self, n, rseq):
+        self.rseq = rseq
+        self._n = n
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+        if n <= 0:
+            self._evt.set()
+
+    def ack(self):
+        with self._lock:
+            self._n -= 1
+            if self._n <= 0:
+                self._evt.set()
+
+    def wait(self, timeout):
+        return self._evt.wait(timeout)
+
+
+class _FollowerLink:
+    """Primary-side replication channel to ONE follower: an ordered queue
+    of applied-entry messages drained by a dedicated sender thread.
+    Entries are popped only on follower ack, so a dropped frame is simply
+    re-sent (the follower dedups by log seqno); a follower unreachable
+    past the death window is dropped from the group and the primary
+    continues solo."""
+
+    _RETRY_BASE_S = 0.05
+    _RETRY_CAP_S = 1.0
+
+    def __init__(self, owner, addr):
+        self.addr = addr
+        self.alive = True
+        self.acked_rseq = 0
+        self._owner = owner
+        host, port = addr.rsplit(":", 1)
+        self._peer = (host, int(port))
+        self._q = collections.deque()
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-ps-repl-s%d" % owner.server_id,
+            daemon=True)
+        self._thread.start()
+
+    def enqueue(self, entry, latch):
+        with self._cv:
+            if not self.alive:
+                if latch is not None:
+                    latch.ack()
+                return
+            self._q.append((entry, latch))
+            self._cv.notify()
+
+    def close(self):
+        """Stop the sender; pending sync latches are released (the
+        caller's wait must not outlive the follower)."""
+        with self._cv:
+            self.alive = False
+            for _entry, latch in self._q:
+                if latch is not None:
+                    latch.ack()
+            self._q.clear()
+            self._cv.notify()
+
+    @staticmethod
+    def _close_sock(sock):
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _run(self):
+        sock = None
+        failures = 0
+        down_since = None
+        while True:
+            with self._cv:
+                while self.alive and not self._q:
+                    self._cv.wait(0.5)
+                if not self.alive:
+                    break
+                entry, latch = self._q[0]
+            label = "s%d>%s" % (self._owner.server_id, self.addr)
+            try:
+                # chaos sites: a delay stretches the replication lag
+                # window, a drop loses this frame (retried, deduped by
+                # rseq on the follower)
+                _chaos.visit("kvstore.repl_delay", name=label)
+                _chaos.visit("kvstore.repl_drop", name=label)
+                if sock is None:
+                    sock = socket.create_connection(
+                        self._peer, timeout=_call_timeout_s())
+                sock.settimeout(_call_timeout_s())
+                out = dict(entry)
+                out["epoch"] = self._owner.epoch
+                _send_msg(sock, out)
+                resp = _recv_msg(sock)
+            except (EOFError, ConnectionError, OSError, ValueError) as exc:
+                self._close_sock(sock)
+                sock = None
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                failures += 1
+                if now - down_since >= _dead_after_s():
+                    _LOG.warning(
+                        "replication: follower %s unreachable for %.1fs — "
+                        "dropping it from the group (last error: %r)",
+                        self.addr, _dead_after_s(), exc)
+                    break
+                time.sleep(min(self._RETRY_CAP_S,
+                               self._RETRY_BASE_S * (2 ** min(failures, 6))))
+                continue
+            failures = 0
+            down_since = None
+            if resp.get("ok"):
+                with self._cv:
+                    if self._q and self._q[0][0] is entry:
+                        self._q.popleft()
+                self.acked_rseq = max(
+                    self.acked_rseq,
+                    int(resp.get("rseq", entry.get("rseq", 0))))
+                if latch is not None:
+                    latch.ack()
+            elif resp.get("resync"):
+                # the follower has a gap (or diverged): ship a full
+                # snapshot, then resend the entry — it dup-acks anything
+                # the snapshot already covers
+                with self._owner._lock:
+                    snap = self._owner._snapshot_locked()
+                snap["op"] = "replicate"
+                snap["rop"] = "snapshot"
+                try:
+                    _send_msg(sock, snap)
+                    sresp = _recv_msg(sock)
+                except (EOFError, ConnectionError, OSError,
+                        ValueError):
+                    self._close_sock(sock)
+                    sock = None
+                    continue
+                if not sresp.get("ok"):
+                    _LOG.warning(
+                        "replication: follower %s rejected resync "
+                        "snapshot: %s", self.addr, sresp.get("err"))
+                    break
+            elif resp.get("stale_epoch"):
+                # the follower outranks us: this primary was deposed
+                # while it still thought it owned the shard — fence it
+                self._owner._fence(int(resp.get("epoch", 0)))
+                break
+            else:
+                _LOG.warning(
+                    "replication: follower %s rejected entry rseq=%s: %s",
+                    self.addr, entry.get("rseq"), resp.get("err"))
+                break
+        self._close_sock(sock)
+        self.close()
+        self._owner._drop_follower(self.addr, self)
+
+
 class AsyncServer:
     """One async PS shard: owns its keys' weights, applies updates on
-    arrival.  ``server_id`` identifies the shard in a multi-server group."""
+    arrival.  ``server_id`` identifies the shard in a multi-server group.
+
+    Replication roles: a server starts as the ``primary`` of a 1-replica
+    group; :meth:`rejoin` turns it into a ``follower`` of an existing
+    primary (snapshot transfer + live update stream), and a ``promote``
+    RPC with a higher epoch turns a follower back into a primary.  A
+    deposed primary that learns of a newer epoch becomes ``fenced``: it
+    rejects all client traffic so a zombie cannot fork the weights."""
 
     def __init__(self, host=None, port=0, secret=None, server_id=0):
         host = host if host is not None else _default_bind_host()
         self._bind_host = host
         self.server_id = server_id
-        # per-job shared secret gating the one executable payload
-        # (set_optimizer pickle); generated fresh unless the job hands one
-        # out (launcher env / coordination KV)
+        # per-job shared secret gating the executable payloads
+        # (set_optimizer pickle, snapshot optimizer state); generated
+        # fresh unless the job hands one out (launcher env /
+        # coordination KV).  Replicas of one shard must share it.
         self.secret = secret or os.environ.get("MXNET_TPU_PS_SECRET") \
             or _secrets.token_hex(16)
+        self.role = "primary"
+        self.epoch = 0
         self._store = {}
+        self._seqnos = {}  # key -> per-key update sequence number
+        self._applied_seq = 0  # replication log position
+        self._followers = {}  # follower addr -> _FollowerLink
         self._updater = None
         self._commands = []
         self._lock = threading.Lock()
@@ -292,7 +587,8 @@ class AsyncServer:
         # at-most-once RPC dedup for MUTATING ops only: rank -> (last seq,
         # cached response).  Pulls are idempotent and re-execute on retry,
         # so the server never retains a full response copy of the weights
-        # per worker (round-2 advisor finding).
+        # per worker (round-2 advisor finding).  Replicated to followers,
+        # so a request retried across a failover is still at-most-once.
         self._last_seq = {}
         self._shutdown = threading.Event()
         # in-flight dispatch tracking so stop() can drain gracefully: a
@@ -305,6 +601,10 @@ class AsyncServer:
         # stopped server is actually gone, not lingering on old
         # connections its daemon handler threads still serve
         self._conns = set()
+        self._started = False
+        self._stopped = False
+        self._killed = False
+        self._stop_lock = threading.Lock()
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
         self._thread = threading.Thread(
@@ -316,6 +616,7 @@ class AsyncServer:
         return "%s:%d" % (_advertise_host(self._bind_host), port)
 
     def start(self):
+        self._started = True
         self._thread.start()
         return self
 
@@ -323,27 +624,50 @@ class AsyncServer:
         """Stop accepting work, then DRAIN: wait (bounded) for in-flight
         dispatches to complete before closing the listener, so a handler
         mid-optimizer-update finishes and its response reaches the
-        worker instead of being cut mid-frame."""
-        self._tcp.shutdown()
-        deadline = time.monotonic() + drain_timeout
-        with self._inflight_cv:
-            while self._inflight:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    import logging
+        worker instead of being cut mid-frame.
 
-                    logging.getLogger(__name__).warning(
-                        "AsyncServer.stop: %d handler(s) still in flight "
-                        "after %.1fs drain timeout", self._inflight,
-                        drain_timeout)
-                    break
-                self._inflight_cv.wait(remaining)
+        Idempotent: a second call (or a call on a server whose
+        ``start()`` never ran / failed) returns immediately instead of
+        hanging in ``socketserver.shutdown`` or double-closing the
+        listener."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        with self._lock:
+            links = list(self._followers.values())
+            self._followers = {}
+        for link in links:
+            link.close()
+        # shutdown() blocks on serve_forever's exit handshake, which
+        # never happens if the serve thread was never started
+        if self._started and self._thread.is_alive():
+            self._tcp.shutdown()
+        if drain_timeout > 0:
+            deadline = time.monotonic() + drain_timeout
+            with self._inflight_cv:
+                while self._inflight:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        _LOG.warning(
+                            "AsyncServer.stop: %d handler(s) still in flight "
+                            "after %.1fs drain timeout", self._inflight,
+                            drain_timeout)
+                        break
+                    self._inflight_cv.wait(remaining)
         for conn in list(self._conns):
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
         self._tcp.server_close()
+
+    def kill(self):
+        """Abrupt crash (chaos / failover tests): no drain — in-flight
+        handlers are cut mid-RPC, exactly what a process death looks
+        like to the workers."""
+        self._killed = True
+        self.stop(drain_timeout=0.0)
 
     def _track_conn(self, conn):
         with self._inflight_cv:
@@ -358,38 +682,311 @@ class AsyncServer:
         main loop)."""
         self._shutdown.wait()
 
+    # -- replication ----------------------------------------------------
+
+    def rejoin(self, primary_addr, dial_timeout=10):
+        """State-transfer a snapshot from the CURRENT primary at
+        ``primary_addr`` and re-enter its replica group as a follower.
+        Registration and snapshot are atomic on the primary (one op
+        under its lock), so every post-snapshot mutation reaches this
+        server through the live update stream — there is no gap to
+        catch up by other means."""
+        cli = AsyncClient("%s" % primary_addr, -next(_rejoin_ranks),
+                          heartbeat=False, secret=self.secret,
+                          dial_timeout=dial_timeout)
+        try:
+            resp = cli._call({"op": "sync_follower", "addr": self.address})
+        finally:
+            cli.close()
+        with self._lock:
+            self._install_snapshot_locked(resp)
+            self.role = "follower"
+        _membership_note_replica(primary_addr, self.address)
+        return self
+
+    def _snapshot_locked(self):
+        """Full state snapshot: weights, per-key seqnos, log position,
+        dedup cache, push counts, and (HMAC-gated) optimizer state."""
+        snap = {"pairs": [(k, _np.array(v)) for k, v in self._store.items()],
+                "seqlist": [[_wire_key(k), int(n)]
+                            for k, n in self._seqnos.items()],
+                "rseq": self._applied_seq,
+                "epoch": self.epoch,
+                "last_seq": [[r, s, resp]
+                             for r, (s, resp) in self._last_seq.items()],
+                "push_counts": [[r, c]
+                                for r, c in sorted(self._push_counts.items())]}
+        if self._updater is not None:
+            raw = pickle.dumps(self._updater._updater)
+            snap["optimizer"] = raw
+            snap["mac"] = _optimizer_mac(self.secret, raw)
+        return snap
+
+    def _install_snapshot_locked(self, msg):
+        raw = msg.get("optimizer")
+        if raw is not None:
+            if not _hmaclib.compare_digest(
+                    msg.get("mac", ""), _optimizer_mac(self.secret, raw)):
+                raise MXNetError(
+                    "replication snapshot rejected: bad or missing HMAC on "
+                    "the optimizer-state payload (replicas must share the "
+                    "per-job secret)")
+            self._updater = _NumpyUpdater(pickle.loads(raw))
+        self._store = {k: _np.array(v, copy=True) for k, v in msg["pairs"]}
+        self._seqnos = {_unwire_key(k): int(n)
+                        for k, n in msg.get("seqlist", [])}
+        self._applied_seq = int(msg.get("rseq", 0))
+        self.epoch = max(self.epoch, int(msg.get("epoch", 0)))
+        self._last_seq = {int(r): (s, resp)
+                          for r, s, resp in msg.get("last_seq", [])}
+        self._push_counts = {int(r): int(c)
+                             for r, c in msg.get("push_counts", [])}
+
+    def _append_entry_locked(self, op, rank, seq, msg, resp):
+        """Advance the replication log with one applied mutation and fan
+        it out to the follower queues.  Returns the sync-mode ack latch
+        (or None when async / no followers)."""
+        self._applied_seq += 1
+        entry = {"op": "replicate", "rop": op, "rseq": self._applied_seq,
+                 "orank": rank, "oseq": seq, "resp": resp}
+        if op in ("init", "push"):
+            entry["pairs"] = msg["pairs"]
+        elif op == "set_optimizer":
+            entry["optimizer"] = msg["optimizer"]
+            entry["mac"] = msg.get("mac", "")
+        else:  # command
+            entry["head"] = msg["head"]
+            entry["body"] = msg["body"]
+        links = [l for l in self._followers.values() if l.alive]
+        if not links:
+            return None
+        latch = _AckLatch(len(links), self._applied_seq) \
+            if _repl_sync() else None
+        for link in links:
+            link.enqueue(entry, latch)
+        return latch
+
+    def _replicate_apply_locked(self, msg):
+        """Follower side of the update stream: apply in log order, ack
+        duplicates, request a resync on a gap, and fence primaries whose
+        epoch is behind ours."""
+        e = int(msg.get("epoch", 0))
+        if e < self.epoch:
+            return {"ok": False, "stale_epoch": True, "epoch": self.epoch,
+                    "err": "replication from a deposed primary "
+                           "(epoch %d < %d)" % (e, self.epoch)}
+        if e > self.epoch:
+            self.epoch = e
+            if self.role == "primary":
+                # a newer primary is streaming to us: it owns the shard
+                self.role = "follower"
+        if msg.get("rop") == "snapshot":
+            self._install_snapshot_locked(msg)
+            return {"ok": True, "snapshot": True, "rseq": self._applied_seq}
+        rseq = int(msg["rseq"])
+        if rseq <= self._applied_seq:
+            return {"ok": True, "dup": True, "rseq": self._applied_seq}
+        if rseq != self._applied_seq + 1:
+            return {"ok": False, "resync": True, "rseq": self._applied_seq,
+                    "err": "replication gap: have %d, got %d"
+                           % (self._applied_seq, rseq)}
+        orank = int(msg.get("orank", -1))
+        resp = self._dispatch_locked(msg["rop"], orank, msg)
+        if not resp.get("ok"):
+            # local apply diverged from the primary's (e.g. optimizer not
+            # installed yet): ask for a snapshot instead of silently
+            # skipping the entry and forking the weights
+            return {"ok": False, "resync": True, "rseq": self._applied_seq,
+                    "err": "replica apply failed: %s" % resp.get("err")}
+        self._applied_seq = rseq
+        oseq = msg.get("oseq")
+        if oseq is not None and msg["rop"] in _MUTATING_OPS:
+            self._last_seq[orank] = (oseq, msg.get("resp", resp))
+        return {"ok": True, "rseq": rseq}
+
+    def _promote_locked(self, msg):
+        e = int(msg.get("epoch", 0))
+        if e > self.epoch:
+            self.epoch = e
+            self.role = "primary"
+            return {"ok": True, "epoch": self.epoch,
+                    "rseq": self._applied_seq}
+        if e == self.epoch and self.role == "primary":
+            # retried promote (client lost the first response): ack
+            return {"ok": True, "epoch": self.epoch,
+                    "rseq": self._applied_seq}
+        return {"ok": False, "stale_epoch": True, "epoch": self.epoch,
+                "err": "promote to epoch %d rejected (server epoch %d)"
+                       % (e, self.epoch)}
+
+    def _sync_follower_locked(self, msg):
+        if self.role != "primary":
+            return {"ok": False, "not_primary": True, "epoch": self.epoch,
+                    "err": "sync_follower: server s%d is %s, not primary"
+                           % (self.server_id, self.role)}
+        addr = msg.get("addr")
+        if not addr:
+            return {"ok": False, "err": "sync_follower: missing addr"}
+        # snapshot + registration are atomic under the server lock: every
+        # mutation after this point flows through the new follower link
+        snap = self._snapshot_locked()
+        old = self._followers.pop(addr, None)
+        if old is not None:
+            old.close()
+        self._followers[addr] = _FollowerLink(self, addr)
+        resp = {"ok": True}
+        resp.update(snap)
+        return resp
+
+    def _fence(self, new_epoch):
+        """Demote a deposed primary: reject all client traffic from now
+        on.  Called when a follower (or client) proves a newer epoch
+        exists."""
+        with self._lock:
+            if new_epoch > self.epoch:
+                self.epoch = new_epoch
+            if self.role == "fenced":
+                return
+            _LOG.warning("AsyncServer s%d: fenced at epoch %d (a newer "
+                         "primary owns this shard)", self.server_id,
+                         self.epoch)
+            self.role = "fenced"
+            links = list(self._followers.values())
+            self._followers = {}
+        for link in links:
+            link.close()
+
+    def _drop_follower(self, addr, link):
+        with self._lock:
+            if self._followers.get(addr) is link:
+                del self._followers[addr]
+
     # -- message dispatch (runs on handler threads) --------------------
     def dispatch(self, msg):
+        op = msg.get("op")
+        try:
+            _chaos.visit("kvstore.server_kill",
+                         name="s%d:%s:%s" % (self.server_id, self.role, op))
+        except Exception as exc:
+            # a fired rule IS this server's crash: die abruptly (no
+            # drain) and cut the caller mid-RPC so the client-side
+            # retry/failover path — not a test-only path — runs
+            self.kill()
+            raise ConnectionResetError(
+                "chaos: server s%d killed (op=%r)"
+                % (self.server_id, op)) from exc
         with self._inflight_cv:
             self._inflight += 1
         try:
-            return self._dispatch(msg)
+            resp, latch = self._dispatch(msg)
         finally:
             with self._inflight_cv:
                 self._inflight -= 1
                 self._inflight_cv.notify_all()
+        if latch is not None and not latch.wait(_repl_timeout_s()):
+            # availability over strictness: answer the worker anyway; the
+            # entry stays queued and still reaches the follower unless
+            # the primary dies first (which sync mode exists to bound)
+            _LOG.warning(
+                "AsyncServer s%d: follower ack for entry rseq=%d timed out "
+                "after %.1fs (replication lagging)", self.server_id,
+                latch.rseq, _repl_timeout_s())
+        return resp
 
     def _dispatch(self, msg):
         op = msg["op"]
         rank = msg.get("rank", -1)
         seq = msg.get("seq")
+        cep = msg.get("epoch")
         dedup = seq is not None and op in _MUTATING_OPS
         with self._lock:
-            self._heartbeat[rank] = time.time()
+            if rank >= 0:
+                # negative ranks are internal (rejoin handshakes) and
+                # must not pollute worker liveness accounting
+                self._heartbeat[rank] = time.time()
+            if op == "heartbeat":
+                return {"ok": True, "epoch": self.epoch,
+                        "role": self.role}, None
+            if op == "stats":
+                return self._stats_locked(), None
+            if op == "shutdown":
+                self._shutdown.set()
+                return {"ok": True}, None
+            if op == "replicate":
+                return self._replicate_apply_locked(msg), None
+            if op == "promote":
+                return self._promote_locked(msg), None
+            if op == "sync_follower":
+                return self._sync_follower_locked(msg), None
+            if self.role == "fenced":
+                return {"ok": False, "stale_epoch": True,
+                        "not_primary": True, "epoch": self.epoch,
+                        "err": "server s%d fenced at epoch %d — a newer "
+                               "primary owns this shard"
+                               % (self.server_id, self.epoch)}, None
+            if op == "pull":
+                return self._pull_locked(msg), None
+            if op not in _REPLICATED_OPS:
+                return {"ok": False, "err": "unknown op %r" % op}, None
+            # mutating client ops: primary-only, epoch-fenced
+            if self.role != "primary":
+                return {"ok": False, "not_primary": True,
+                        "epoch": self.epoch,
+                        "err": "server s%d is a follower (epoch %d) — "
+                               "mutations go to the primary"
+                               % (self.server_id, self.epoch)}, None
+            if cep is not None and cep < self.epoch:
+                return {"ok": False, "stale_epoch": True,
+                        "epoch": self.epoch,
+                        "err": "stale client epoch %d < server epoch %d — "
+                               "refresh membership and retry"
+                               % (cep, self.epoch)}, None
             if dedup:
                 last = self._last_seq.get(rank)
                 if last is not None and last[0] == seq:
-                    return last[1]  # duplicate of a completed request
+                    return last[1], None  # duplicate of a completed request
             resp = self._dispatch_locked(op, rank, msg)
             if dedup:
                 self._last_seq[rank] = (seq, resp)
-            return resp
+            latch = None
+            if resp.get("ok") and op in _REPLICATED_OPS:
+                latch = self._append_entry_locked(op, rank, seq, msg, resp)
+            return resp, latch
+
+    def _pull_locked(self, msg):
+        # copy under the lock: handlers serialize the response after
+        # release, and push handlers mutate weights in place — a
+        # live reference could serialize a torn (mid-update) tensor
+        resp = {"ok": True, "epoch": self.epoch,
+                "vals": [None if self._store.get(k) is None
+                         else _np.array(self._store[k])
+                         for k in msg["keys"]]}
+        if msg.get("seqnos"):
+            resp["seqnos"] = [int(self._seqnos.get(k, 0))
+                              for k in msg["keys"]]
+        return resp
+
+    def _stats_locked(self):
+        now = time.time()
+        dead = [r for r, t in self._heartbeat.items()
+                if now - t > _dead_after_s()]
+        return {"ok": True, "server_id": self.server_id,
+                "role": self.role, "epoch": self.epoch,
+                "applied_seq": self._applied_seq,
+                "followers": [[a, l.acked_rseq]
+                              for a, l in sorted(self._followers.items())],
+                "push_counts": [[r, c] for r, c
+                                in sorted(self._push_counts.items())],
+                "dead": dead, "workers": sorted(self._heartbeat),
+                "keys": sorted((repr(k) for k in self._store))}
 
     def _dispatch_locked(self, op, rank, msg):
         if op == "init":
             # first writer wins (matches reference init-once semantics)
             for k, v in msg["pairs"]:
-                self._store.setdefault(k, _np.array(v, copy=True))
+                if k not in self._store:
+                    self._store[k] = _np.array(v, copy=True)
+                    self._seqnos[k] = self._seqnos.get(k, 0) + 1
             return {"ok": True}
         if op == "push":
             if self._updater is None:
@@ -406,16 +1003,9 @@ class AsyncServer:
             for k, g in msg["pairs"]:
                 # update-on-push: no aggregation, no barrier
                 self._updater(k, g, self._store[k])
+                self._seqnos[k] = self._seqnos.get(k, 0) + 1
             self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
             return {"ok": True}
-        if op == "pull":
-            # copy under the lock: handlers serialize the response after
-            # release, and push handlers mutate weights in place — a
-            # live reference could serialize a torn (mid-update) tensor
-            return {"ok": True,
-                    "vals": [None if self._store.get(k) is None
-                             else _np.array(self._store[k])
-                             for k in msg["keys"]]}
         if op == "set_optimizer":
             raw = msg["optimizer"]
             mac = msg.get("mac", "")
@@ -435,20 +1025,6 @@ class AsyncServer:
             # reference kController escape hatch: kept for inspection
             self._commands.append((msg["head"], msg["body"]))
             return {"ok": True}
-        if op == "heartbeat":
-            return {"ok": True}
-        if op == "shutdown":
-            self._shutdown.set()
-            return {"ok": True}
-        if op == "stats":
-            now = time.time()
-            dead = [r for r, t in self._heartbeat.items()
-                    if now - t > _dead_after_s()]
-            return {"ok": True, "server_id": self.server_id,
-                    "push_counts": [[r, c] for r, c
-                                    in sorted(self._push_counts.items())],
-                    "dead": dead, "workers": sorted(self._heartbeat),
-                    "keys": sorted((repr(k) for k in self._store))}
         return {"ok": False, "err": "unknown op %r" % op}
 
 
@@ -470,12 +1046,21 @@ class _NumpyUpdater:
         weight[...] = _np.asarray(w._data)
 
 
+# internal ranks for rejoin handshakes: unique, negative (excluded from
+# worker liveness accounting and from the per-worker dedup seq streams)
+_rejoin_ranks = itertools.count(1)
+
+
 class AsyncClient:
     """Worker-side connection to ONE async PS shard.
 
     A daemon thread heartbeats independently of application pushes (the
     ps-lite model), so liveness is not conflated with push frequency — a
-    worker spending minutes in compute stays alive.
+    worker spending minutes in compute stays alive.  The heartbeat backs
+    off exponentially after consecutive failures and EXITS once the
+    server has been unreachable for the full death window (setting
+    ``self.dead`` and firing ``on_dead``), instead of hammering a dead
+    socket at a fixed interval forever.
 
     Recovery (parity: ps-lite resend + ``Postoffice::is_recovery``): a
     dropped connection is re-dialed transparently and the in-flight
@@ -494,7 +1079,8 @@ class AsyncClient:
     _BACKOFF_CAP_S = 2.0
 
     def __init__(self, address, rank, heartbeat=True, secret=None,
-                 dial_timeout=60, call_timeout=None, deadline=None):
+                 dial_timeout=60, call_timeout=None, deadline=None,
+                 on_dead=None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._rank = rank
@@ -503,6 +1089,10 @@ class AsyncClient:
         # None defers to the env at CALL time (lazy, reconfigurable)
         self._call_timeout = call_timeout
         self._deadline = deadline
+        self.dead = False
+        self._closed = False
+        self._on_dead = on_dead
+        self._hb_stop = threading.Event()
         # backoff jitter: deterministic per rank so a test's retry
         # schedule replays, while distinct ranks still decorrelate
         self._backoff_rng = _random.Random(0x5EED ^ (rank & 0xFFFF))
@@ -513,13 +1103,57 @@ class AsyncClient:
                                  name="mxtpu-ps-heartbeat", daemon=True)
             t.start()
 
+    def close(self):
+        """Release the socket and stop the heartbeat thread.  Any call
+        in flight (or made after) fails fast instead of retrying into a
+        connection the owner has abandoned."""
+        self._closed = True
+        self._hb_stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
     def _heartbeat_loop(self):
+        failures = 0
+        down_since = None
         while True:
-            time.sleep(max(_dead_after_s() / 3.0, 1.0))
+            base = max(_heartbeat_interval_s(), 0.05)
+            if failures:
+                # exponential backoff against an unresponsive server —
+                # probing a dead socket at the base rate buys nothing
+                delay = min(base * (2 ** (failures - 1)),
+                            max(_dead_after_s(), base))
+            else:
+                delay = base
+            if self._hb_stop.wait(delay):
+                return
+            if self._closed or self.dead:
+                return
             try:
-                self._call({"op": "heartbeat"})
-            except Exception:
-                return  # server gone for good; process is exiting
+                # short per-probe deadline: one probe must not eat the
+                # whole death window in internal retries
+                self._call({"op": "heartbeat"}, deadline=base)
+            except Exception:  # noqa: BLE001 — any failure is a miss
+                if self._closed:
+                    return
+                failures += 1
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                if now - down_since >= _dead_after_s():
+                    # declared dead: surface it and STOP probing
+                    self.dead = True
+                    cb = self._on_dead
+                    if cb is not None:
+                        try:
+                            cb(self)
+                        except Exception:  # noqa: BLE001 — observer only
+                            _LOG.exception("on_dead callback failed")
+                    return
+            else:
+                failures = 0
+                down_since = None
 
     def _dial(self, timeout_s):
         """Connect with patience: launcher-spawned server processes may
@@ -558,15 +1192,28 @@ class AsyncClient:
                    self._BACKOFF_BASE_S * (2 ** attempt))
         return base * (0.5 + self._backoff_rng.random())
 
-    def _call(self, msg):
+    def _call(self, msg, seq=None, deadline=None):
+        """One at-most-once RPC.  ``seq`` lets an owner with a longer
+        lifetime than this connection (``ReplicatedClient``) keep ONE
+        monotonic per-worker stream across failovers, so a retry through
+        a new primary still dedups; ``deadline`` overrides the overall
+        retry budget (heartbeat probes use a short one)."""
         msg["rank"] = self._rank
         with self._lock:
-            self._seq += 1
-            msg["seq"] = self._seq
+            if seq is None:
+                self._seq += 1
+                seq = self._seq
+            msg["seq"] = seq
             call_timeout = self._effective_call_timeout()
-            deadline = time.monotonic() + self._effective_deadline()
+            overall = (deadline if deadline is not None
+                       else self._effective_deadline())
+            hard_deadline = time.monotonic() + overall
             attempt = 0
             while True:
+                if self._closed:
+                    raise ServerDeadError(
+                        "async PS client for %s:%d is closed"
+                        % self._addr)
                 try:
                     if attempt:  # re-dial failures count as attempts too
                         self._reconnect()
@@ -586,20 +1233,22 @@ class AsyncClient:
                         OSError) as exc:
                     attempt += 1
                     pause = self._backoff_sleep(attempt - 1)
-                    if time.monotonic() + pause >= deadline:
+                    if time.monotonic() + pause >= hard_deadline:
                         raise ServerDeadError(
                             "async PS %s:%d unreachable after %d "
                             "attempt(s) within the %.1fs deadline "
                             "(op=%r, last error: %r) — set "
                             "MXNET_TPU_PS_DEADLINE to wait longer"
                             % (self._addr[0], self._addr[1], attempt,
-                               self._effective_deadline(),
-                               msg.get("op"), exc)) from exc
+                               overall, msg.get("op"), exc)) from exc
                     time.sleep(pause)
                     # retry (same seq: the server dedups completed requests)
         if not resp.get("ok"):
-            from .base import MXNetError
-
+            if resp.get("stale_epoch") or resp.get("not_primary"):
+                raise StaleEpochError(
+                    "async kvstore: %s" % resp.get("err"),
+                    epoch=resp.get("epoch"),
+                    not_primary=bool(resp.get("not_primary")))
             raise MXNetError("async kvstore: %s" % resp.get("err"))
         return resp
 
@@ -614,8 +1263,266 @@ class AsyncClient:
 
     def set_optimizer(self, pickled):
         if not self._secret:
-            from .base import MXNetError
+            raise MXNetError(
+                "set_optimizer needs the per-job PS secret (launcher env "
+                "MXNET_TPU_PS_SECRET or coordination-KV discovery)")
+        self._call({"op": "set_optimizer", "optimizer": pickled,
+                    "mac": _optimizer_mac(self._secret, pickled)})
 
+    def command(self, head, body):
+        self._call({"op": "command", "head": head, "body": body})
+
+    def shutdown(self):
+        self._call({"op": "shutdown"})
+
+    def stats(self):
+        resp = self._call({"op": "stats"})
+        resp["push_counts"] = {r: c for r, c in resp.get("push_counts", [])}
+        return resp
+
+
+# -- replica-group membership -------------------------------------------
+#
+# The directory maps a replica group (identified by its ORIGINAL address
+# set, which every worker was configured with) to the current epoch,
+# primary, and replica list.  It is process-local state guarded by one
+# lock — exactly right for the in-process thread-backed layout the
+# forced-CPU tier-1 uses (workers and servers share the process); for
+# cross-process jobs the epoch also rides in the coordination-KV address
+# record (``publish_address(epoch=)``) so late workers start from the
+# promoted view.
+
+_DIR_LOCK = threading.Lock()
+_DIRECTORY = {}  # group key (sorted addr tuple) -> {epoch, replicas, primary}
+
+
+def reset_membership():
+    """Forget every replica-group membership record (test isolation)."""
+    with _DIR_LOCK:
+        _DIRECTORY.clear()
+
+
+def _membership_key(addresses):
+    return tuple(sorted(addresses))
+
+
+def _membership_lookup(group):
+    with _DIR_LOCK:
+        rec = _DIRECTORY.get(group)
+        if rec is None:
+            return None
+        return {"epoch": rec["epoch"], "replicas": list(rec["replicas"]),
+                "primary": rec["primary"]}
+
+
+def _membership_publish(group, epoch, replicas, primary):
+    """Record a (possibly promoted) view; replica lists merge so rejoined
+    servers stay visible to every worker.  Older epochs never overwrite
+    newer ones — publishing is monotonic."""
+    with _DIR_LOCK:
+        rec = _DIRECTORY.get(group)
+        if rec is not None and epoch < rec["epoch"]:
+            return
+        merged = list(dict.fromkeys(
+            (rec["replicas"] if rec else []) + list(replicas)))
+        _DIRECTORY[group] = {"epoch": int(epoch), "replicas": merged,
+                             "primary": primary}
+
+
+def _membership_note_replica(member_addr, new_addr):
+    """A server rejoined under ``member_addr``'s primary: append its
+    (new) address to every group record that contains the primary, so
+    workers can fail over to it later."""
+    with _DIR_LOCK:
+        for rec in _DIRECTORY.values():
+            if member_addr in rec["replicas"] \
+                    and new_addr not in rec["replicas"]:
+                rec["replicas"].append(new_addr)
+
+
+class ReplicatedClient:
+    """Worker-side routing for ONE logical shard backed by a replica
+    group.  Presents the same surface as :class:`AsyncClient`, but:
+
+    * requests go to the group's current **primary**, stamped with the
+      worker's membership epoch (stale views get a typed reject and a
+      refresh, never a silent apply on a zombie);
+    * the logical per-worker sequence stream is owned HERE, not by the
+      per-connection client, so an RPC retried across a failover keeps
+      its seq and the (replicated) server-side dedup still applies it
+      at most once;
+    * on a dead primary (heartbeat verdict or exhausted RPC retries) it
+      refreshes the membership view — another worker may have already
+      promoted — else promotes the first reachable follower at
+      ``epoch+1`` and retries the in-flight request; only a whole-group
+      loss surfaces, as :class:`ServerDeadError`."""
+
+    def __init__(self, addresses, rank, heartbeat=True, secret=None,
+                 dial_timeout=60):
+        addrs = [a.strip() for a in addresses if a and a.strip()]
+        if not addrs:
+            raise ValueError("ReplicatedClient needs at least one address")
+        self._group = _membership_key(addrs)
+        self._rank = rank
+        self._secret = secret or os.environ.get("MXNET_TPU_PS_SECRET")
+        self._hb = heartbeat
+        self._dial_timeout = dial_timeout
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._dead_flag = False
+        rec = _membership_lookup(self._group)
+        if rec is None:
+            _membership_publish(self._group, 0, addrs, addrs[0])
+            rec = _membership_lookup(self._group)
+        self.epoch = rec["epoch"]
+        self._replicas = list(rec["replicas"])
+        self._primary = rec["primary"]
+        self._cli = self._connect(self._primary)
+
+    @property
+    def _addr(self):
+        """(host, port) of the current primary — label parity with
+        :class:`AsyncClient` for ``ServerGroup`` diagnostics."""
+        host, port = self._primary.rsplit(":", 1)
+        return (host, int(port))
+
+    def close(self):
+        self._cli.close()
+
+    def _connect(self, addr):
+        return AsyncClient(addr, self._rank, heartbeat=self._hb,
+                           secret=self._secret,
+                           dial_timeout=self._dial_timeout,
+                           on_dead=self._note_primary_dead)
+
+    def _note_primary_dead(self, _cli):
+        # heartbeat thread context: flag only; the next call (under the
+        # client lock) runs the failover
+        self._dead_flag = True
+
+    def _adopt(self, rec):
+        """Switch to the directory's view of the group."""
+        self.epoch = rec["epoch"]
+        self._replicas = list(rec["replicas"])
+        if rec["primary"] != self._primary:
+            old = self._cli
+            self._primary = rec["primary"]
+            self._cli = self._connect(self._primary)
+            self._dead_flag = False
+            old.close()
+
+    def _refresh_membership(self):
+        """Adopt any newer membership view; True if it changed routing."""
+        rec = _membership_lookup(self._group)
+        if rec is None:
+            return False
+        changed = (rec["epoch"] > self.epoch
+                   or rec["primary"] != self._primary)
+        self._replicas = list(dict.fromkeys(
+            self._replicas + list(rec["replicas"])))
+        if changed:
+            self._adopt(rec)
+        return changed
+
+    def _failover(self, last_exc=None):
+        """Route around a dead primary: adopt a newer published view if
+        one exists, else promote the first reachable standby at
+        ``epoch+1`` and publish the new view."""
+        if self._refresh_membership():
+            return
+        target_epoch = self.epoch + 1
+        for addr in [a for a in self._replicas if a != self._primary]:
+            try:
+                cand = AsyncClient(addr, self._rank, heartbeat=False,
+                                   secret=self._secret, dial_timeout=0)
+            except (ConnectionError, OSError):
+                continue
+            try:
+                resp = cand._call({"op": "promote", "epoch": target_epoch},
+                                  seq=self._next_seq(),
+                                  deadline=_call_timeout_s())
+            except StaleEpochError:
+                # that replica already outranks our view: re-read the
+                # directory (the promoter published) and try again
+                cand.close()
+                if self._refresh_membership():
+                    return
+                continue
+            except (ServerDeadError, MXNetError, ConnectionError,
+                    OSError) as exc:
+                cand.close()
+                last_exc = exc
+                continue
+            cand.close()
+            old = self._cli
+            self.epoch = int(resp.get("epoch", target_epoch))
+            self._primary = addr
+            self._cli = self._connect(addr)
+            self._dead_flag = False
+            _membership_publish(self._group, self.epoch, self._replicas,
+                                addr)
+            old.close()
+            _LOG.warning(
+                "ReplicatedClient rank %d: failed over shard group %s to "
+                "%s at epoch %d", self._rank, ",".join(self._group), addr,
+                self.epoch)
+            return
+        raise ServerDeadError(
+            "replica group [%s]: no reachable standby to promote past "
+            "epoch %d%s" % (",".join(self._replicas), self.epoch,
+                            " — last error: %r" % (last_exc,)
+                            if last_exc else ""))
+
+    def _next_seq(self):
+        self._seq += 1
+        return self._seq
+
+    def _call(self, msg):
+        with self._lock:
+            seq = self._next_seq()
+            failovers = 0
+            cap = max(2 * len(self._replicas), 4)
+            last = None
+            while True:
+                if self._dead_flag:
+                    self._dead_flag = False
+                    self._failover(last)
+                try:
+                    m = dict(msg)
+                    m["epoch"] = self.epoch
+                    return self._cli._call(m, seq=seq)
+                except ServerDeadError as exc:
+                    last = exc
+                    failovers += 1
+                    if failovers > cap:
+                        raise
+                    self._failover(exc)
+                except StaleEpochError as exc:
+                    last = exc
+                    failovers += 1
+                    if failovers > cap:
+                        raise ServerDeadError(
+                            "replica group [%s]: still fenced after %d "
+                            "failover attempt(s): %s"
+                            % (",".join(self._replicas), failovers,
+                               exc)) from exc
+                    if not self._refresh_membership():
+                        self._failover(exc)
+
+    def init(self, pairs):
+        self._call({"op": "init", "pairs": pairs})
+
+    def push(self, pairs):
+        self._call({"op": "push", "pairs": pairs})
+
+    def pull(self, keys, seqnos=False):
+        resp = self._call({"op": "pull", "keys": keys, "seqnos": seqnos})
+        if seqnos:
+            return resp["vals"], resp.get("seqnos")
+        return resp["vals"]
+
+    def set_optimizer(self, pickled):
+        if not self._secret:
             raise MXNetError(
                 "set_optimizer needs the per-job PS secret (launcher env "
                 "MXNET_TPU_PS_SECRET or coordination-KV discovery)")
@@ -643,13 +1550,25 @@ class ServerGroup:
       flat chunks, chunk *i* on server *i* (``bigarray_bound_`` analog,
       env ``MXNET_KVSTORE_BIGARRAY_BOUND``, default 1e6 elements);
     * presents the same init/push/pull/stats surface as one client.
-    """
+
+    Each shard address may be a replica GROUP — ``"host:p|host:q"`` (or
+    a list of addresses): traffic then routes through a
+    :class:`ReplicatedClient`, and the routing above (hash + striping)
+    is over *logical* shards, so keys keep their placement across a
+    failover inside any group."""
 
     def __init__(self, addresses, rank, heartbeat=True, secret=None,
                  bigarray_bound=None):
-        self._clients = [AsyncClient(a, rank, heartbeat=heartbeat,
-                                     secret=secret)
-                         for a in addresses]
+        self._clients = []
+        for a in addresses:
+            reps = a.split("|") if isinstance(a, str) else list(a)
+            reps = [r.strip() for r in reps if r and r.strip()]
+            if len(reps) > 1:
+                self._clients.append(ReplicatedClient(
+                    reps, rank, heartbeat=heartbeat, secret=secret))
+            else:
+                self._clients.append(AsyncClient(
+                    reps[0], rank, heartbeat=heartbeat, secret=secret))
         self._rank = rank
         self._n = len(self._clients)
         # NOTE: the bound decides routing, so it must agree across all
@@ -680,7 +1599,9 @@ class ServerGroup:
         errors unobserved), then one :class:`ShardFailedError` names
         each failing shard by index AND address, chained to the first
         underlying exception — a multi-server outage is attributable
-        instead of an anonymous hang or a bare socket error."""
+        instead of an anonymous hang or a bare socket error.  For a
+        replicated shard, reaching this point means the WHOLE group is
+        gone (``ReplicatedClient`` absorbs single-replica deaths)."""
         if len(jobs) == 1:
             server, thunk = jobs[0]
             try:
@@ -857,7 +1778,7 @@ class ServerGroup:
 
     def stats(self):
         """Aggregate across shards; ``per_server`` keeps the raw shard
-        stats (key placement etc.) observable."""
+        stats (key placement, replica role/epoch etc.) observable."""
         per_server = self._fanout([(i, lambda c=c: c.stats())
                                    for i, c in enumerate(self._clients)])
         push_counts = {}
@@ -874,12 +1795,17 @@ class ServerGroup:
 
 # -- address discovery over the jax.distributed coordination KV ---------
 
-def publish_address(address, secret=None):
+def publish_address(address, secret=None, epoch=0):
+    """Publish the server address record.  ``address`` may be a full
+    shard list (comma-separated) where each shard is a ``|``-separated
+    replica group; ``epoch`` stamps the membership view so late-joining
+    workers start from the promoted topology, not the original one."""
     from jax._src import distributed
 
     client = distributed.global_state.client
     if client is not None:
-        record = _json.dumps({"addr": address, "secret": secret})
+        record = _json.dumps({"addr": address, "secret": secret,
+                              "epoch": int(epoch)})
         client.key_value_set(_KV_KEY, record)
 
 

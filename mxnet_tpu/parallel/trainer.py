@@ -330,6 +330,7 @@ class ShardedTrainer:
         self._step_raw = None  # untraced step body, shared with pipeline_fn
         self._jit_step = None
         self._jit_fwd = None
+        self._jit_grad = None  # gradient-only step for kvstore-backed fit
         self._jit_pipe = {}  # n-step pipelines keyed by (n, unroll) —
         # partial epoch-tail flushes get their own cached trace
 
@@ -723,6 +724,45 @@ class ShardedTrainer:
         with default_mesh(self.mesh):
             return self._jit_step_raw.lower(params, moms, aux, batch, rng)
 
+    def grad_fn(self):
+        """Jitted gradient-only step for parameter-server training:
+        ``(params, aux, batch, rng) -> (outputs, grads, new_aux)``.
+
+        Where ``step_fn`` fuses forward + backward + optimizer update,
+        this stops at the gradients: the optimizer runs wherever the
+        authoritative weights live — for ``kvstore='dist_async'`` that is
+        the (replicated) parameter server, which applies the update the
+        moment the pushed gradient arrives (``set_optimizer`` contract).
+        Inputs are NOT donated: the caller re-feeds the same ``params``
+        until the next pull replaces them."""
+        if self._jit_grad is not None:
+            return self._jit_grad
+        run = self._run
+        graph = run
+        if self._remat:
+            graph = jax.checkpoint(
+                run, policy=self._remat_policy, static_argnums=(3,))
+        diff = [n for n in self.param_names if n in self._diff_set]
+
+        def gstep(params, aux, batch, rng):
+            def loss_fn(p):
+                args = dict(batch)
+                args.update(params)
+                args.update(p)
+                outs, new_aux = graph(args, aux, rng, True)
+                total = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+                return total, (outs, new_aux)
+
+            dparams = {n: params[n] for n in diff}
+            (_, (outs, new_aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(dparams)
+            return outs, grads, new_aux
+
+        pshard, _, ashard, dshard = self._step_shardings()
+        self._jit_grad = self._with_mesh(jax.jit(
+            gstep, in_shardings=(pshard, ashard, dshard, None)))
+        return self._jit_grad
+
     def forward_fn(self):
         """Jitted inference forward: (params, aux, batch) -> outputs."""
         if self._jit_fwd is not None:
@@ -750,7 +790,7 @@ class ShardedTrainer:
             eval_metric="accuracy", initializer=None, state=None,
             begin_epoch=0, checkpoint_dir=None, checkpoint_every=None,
             resume=None, max_bad_steps=5, log_every=50, logger=None,
-            batch_end_callback=None, metric_every=1):
+            batch_end_callback=None, metric_every=1, kvstore=None):
         """Mesh-native training loop — ``Module.fit``'s role
         (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
         epochs over a ``DataIter``, metric updates, throughput logging
@@ -811,6 +851,15 @@ class ShardedTrainer:
         one restore must re-restore (or copy) per run.
         Returns ``((params, moms, aux), history)`` where ``history[epoch]``
         maps ``"train"``/``"eval"`` to the metric's ``get()`` result.
+
+        ``kvstore=`` switches to parameter-server-backed training: each
+        step computes gradients locally (``grad_fn``), pushes them to
+        the kvstore (whose server-side optimizer — ``set_optimizer``,
+        called by the caller beforehand — applies the update), and pulls
+        the fresh weights back.  A replicated ``dist_async`` store rides
+        out single-server failures transparently inside push/pull
+        (heartbeat failover + same-seq retry), so a mid-epoch primary
+        kill neither aborts the loop nor trips any resume machinery.
         """
         import logging
 
@@ -819,6 +868,16 @@ class ShardedTrainer:
         from .. import metric as _metric_mod
         from . import checkpoint as _ckpt
         from . import prefetch as _prefetch
+
+        if kvstore is not None:
+            return self._fit_kvstore(
+                kvstore, train_data, eval_data=eval_data,
+                num_epoch=num_epoch, seed=seed, eval_metric=eval_metric,
+                initializer=initializer, state=state,
+                begin_epoch=begin_epoch, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every, resume=resume,
+                log_every=log_every, logger=logger,
+                batch_end_callback=batch_end_callback)
 
         log = logger or logging.getLogger(__name__)
         metric = (eval_metric if isinstance(eval_metric, _metric_mod.EvalMetric)
@@ -1107,6 +1166,130 @@ class ShardedTrainer:
                                        moms, aux)
                     _ckpt.save_fit_meta(checkpoint_dir, epoch + 1,
                                         fit_meta(epoch + 1, 0))
+        return (params, moms, aux), history
+
+    def _fit_kvstore(self, kv, train_data, eval_data=None, num_epoch=1,
+                     seed=0, eval_metric="accuracy", initializer=None,
+                     state=None, begin_epoch=0, checkpoint_dir=None,
+                     checkpoint_every=None, resume=None, log_every=50,
+                     logger=None, batch_end_callback=None):
+        """Parameter-server-backed loop behind ``fit(kvstore=)``: local
+        gradients (``grad_fn``) pushed to the kvstore, whose server-side
+        optimizer owns weights and state; fresh weights pulled back each
+        step.  Requires the caller to have called ``kv.set_optimizer``."""
+        import logging
+
+        import jax as _jax
+
+        from .. import metric as _metric_mod
+        from ..callback import Speedometer
+        from ..io import batch_arrays as _io_batch_arrays
+        from ..model import BatchEndParam
+        from ..ndarray import NDArray
+
+        if self.pipeline_steps != 1 or self.grad_accum != 1:
+            raise MXNetError(
+                "kvstore-backed fit pushes one gradient per step: "
+                "pipeline_steps and grad_accum must both be 1 (the server "
+                "applies updates per arriving push)")
+        if self._skip_nonfinite:
+            raise MXNetError(
+                "skip_nonfinite guards the fused LOCAL update; with "
+                "kvstore= the optimizer runs server-side where the verdict "
+                "cannot gate it — not supported")
+        if checkpoint_dir is not None or checkpoint_every or resume:
+            raise MXNetError(
+                "kvstore-backed fit: weights and optimizer state live on "
+                "the parameter server (replicated shards are the "
+                "durability story) — checkpoint_dir/checkpoint_every/"
+                "resume are not supported here")
+
+        log = logger or logging.getLogger(__name__)
+        metric = (eval_metric
+                  if isinstance(eval_metric, _metric_mod.EvalMetric)
+                  else _metric_mod.create(eval_metric))
+        params, moms, aux = (state if state is not None
+                             else self.init(initializer=initializer,
+                                            seed=seed))
+        diff = [n for n in self.param_names if n in self._diff_set]
+        # seed the server: rank-0-wins first-writer semantics, so every
+        # worker calling this converges on one initial state
+        kv.init(diff, [NDArray(jnp.asarray(params[n])) for n in diff])
+        # pull buffers reused across steps (pull writes them in place)
+        bufs = [NDArray(jnp.asarray(params[n])) for n in diff]
+        kv.pull(diff, out=bufs)
+        pshard = {n: self._sharding(self.param_specs[n]) for n in diff}
+        for n, b in zip(diff, bufs):
+            params[n] = jax.device_put(
+                jnp.asarray(b._data).astype(self._param_dtype(n)),
+                pshard[n])
+        gradf = self.grad_fn()
+        fwd = self.forward_fn()
+
+        def batch_arrays(batch, it):
+            return _io_batch_arrays(batch, it, self._input_names)
+
+        callbacks = (list(batch_end_callback)
+                     if isinstance(batch_end_callback, (list, tuple))
+                     else [batch_end_callback] if batch_end_callback
+                     else [])
+        speedo = None
+        history = {}
+        global_step = 0
+        base_key = _jax.random.fold_in(_jax.random.PRNGKey(seed),
+                                       begin_epoch)
+        end_epoch = begin_epoch + num_epoch
+        for epoch in range(begin_epoch, end_epoch):
+            metric.reset()
+            train_data.reset()
+            nbatch = 0
+            for batch in train_data:
+                arrays, data_names = batch_arrays(batch, train_data)
+                placed = self.place_batch(arrays)
+                outs, grads, aux = gradf(
+                    params, aux, placed,
+                    _jax.random.fold_in(base_key, global_step))
+                # the push may ride out a shard failover internally
+                # (promote + same-seq retry); only whole-group loss
+                # escapes, as ShardFailedError
+                kv.push(diff, [NDArray(grads[n]) for n in diff])
+                kv.pull(diff, out=bufs)
+                for n, b in zip(diff, bufs):
+                    params[n] = jax.device_put(
+                        jnp.asarray(b._data).astype(self._param_dtype(n)),
+                        pshard[n])
+                global_step += 1
+                nbatch += 1
+                labels = [v for n, v in arrays.items()
+                          if n not in data_names]
+                metric.update([_np.asarray(v) for v in labels],
+                              [_np.asarray(o) for o in outs])
+                if speedo is None and log_every:
+                    speedo = Speedometer(
+                        next(iter(arrays.values())).shape[0],
+                        frequent=log_every)
+                bep = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=metric, locals=None)
+                if speedo is not None:
+                    speedo(bep._replace(eval_metric=None))
+                for cb in callbacks:
+                    cb(bep)
+            history.setdefault(epoch, {})["train"] = metric.get()
+            log.info("epoch %d train: %s", epoch, history[epoch]["train"])
+            if eval_data is not None:
+                metric.reset()
+                eval_data.reset()
+                for batch in eval_data:
+                    arrays, data_names = batch_arrays(batch, eval_data)
+                    placed = self.place_batch(arrays, train=False)
+                    outs = fwd(params, aux, placed, _jax.random.PRNGKey(0))
+                    labels = [v for n, v in arrays.items()
+                              if n not in data_names]
+                    metric.update([_np.asarray(v) for v in labels],
+                                  [_np.asarray(o) for o in outs])
+                history[epoch]["eval"] = metric.get()
+                log.info("epoch %d eval: %s", epoch,
+                         history[epoch]["eval"])
         return (params, moms, aux), history
 
     def _with_mesh(self, jitted):

@@ -1,0 +1,83 @@
+"""Replicated dist_async worker script: ``launch.py -n 2 -s 2 -r 2``
+runs 2 parameter-server shards, each a primary + one hot-standby replica
+process (the standby snapshots from the primary and rides its update
+stream).
+
+Mid-training, rank 0 terminates shard 0's primary process.  Asserts:
+* both workers transparently fail over to the promoted standby (no
+  ShardFailedError, training completes),
+* the shard reports role=primary at a bumped epoch afterwards,
+* striped big-array chunks keep their shard placement across failover,
+* update-on-push training still converges.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore_async import AsyncClient
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    addrs_env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS")
+    assert addrs_env, "launcher must provide server addresses (-s N -r R)"
+    groups = [g.split("|") for g in addrs_env.split(",")]
+    assert len(groups) == 2 and all(len(g) == 2 for g in groups), groups
+    init_process_group()
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    group = kv._async
+    assert group.num_servers == 2, group.num_servers
+
+    # force a tiny stripe bound so 'big' stripes across the two shards
+    group._bound = 64
+    shape_small, shape_big = (3, 4), (16, 16)
+    target = 3.0
+    kv.init("alpha", mx.nd.ones(shape_small))
+    kv.init("big", mx.nd.ones(shape_big))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                      rescale_grad=1.0, wd=0.0))
+
+    for step in range(30):
+        if step == 5 and rank == 0:
+            # terminate shard 0's primary process mid-training: workers
+            # must promote the standby and keep going
+            doomed = AsyncClient(groups[0][0], rank=-1, heartbeat=False)
+            try:
+                doomed._call({"op": "shutdown"})
+            finally:
+                doomed.close()
+        for key, shape in (("alpha", shape_small), ("big", shape_big)):
+            w = mx.nd.zeros(shape)
+            kv.pull(key, out=w)
+            kv.push(key, mx.nd.array(w.asnumpy() - target))
+
+    kv.barrier()
+    if rank == 0:
+        stats = group.stats()
+        s0 = stats["per_server"][0]
+        # the shard answers through its PROMOTED standby now
+        assert s0["role"] == "primary", s0
+        assert s0["epoch"] >= 1, s0
+        # striping survived the failover: chunk 0 still on shard 0
+        assert repr(("stripe", "big", 0)) in s0["keys"], s0["keys"]
+        assert repr(("stripe", "big", 1)) not in s0["keys"]
+
+    for key, shape in (("alpha", shape_small), ("big", shape_big)):
+        w = mx.nd.zeros(shape)
+        kv.pull(key, out=w)
+        err = float(np.abs(w.asnumpy() - target).max())
+        assert err < 0.5, (key, err)
+
+    sys.stdout.write("worker %d: dist_async replicated OK\n" % rank)
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ pytestmark = pytest.mark.skipif(
            "CPU backend (JAX_PLATFORMS=cpu)")
 
 
-def _launch(n, script, timeout=240, extra_env=None, servers=0):
+def _launch(n, script, timeout=240, extra_env=None, servers=0, replicas=0):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("MXNET_TPU_", "XLA_FLAGS"))}
     env.update(extra_env or {})
@@ -31,13 +31,15 @@ def _launch(n, script, timeout=240, extra_env=None, servers=0):
             "-n", str(n)]
     if servers:
         argv += ["-s", str(servers)]
+    if replicas:
+        argv += ["-r", str(replicas)]
     argv += [sys.executable, script]
     return subprocess.run(argv, capture_output=True, text=True,
                           timeout=timeout, env=env, cwd=_REPO)
 
 
 def _launch_and_expect(n, script, marker, attempts=4, extra_env=None,
-                       servers=0):
+                       servers=0, replicas=0):
     """Launch + assert all ranks print ``marker``.  Retries: on a loaded
     single-core box the 30 s gloo handshake occasionally times out; a
     genuine regression fails every attempt.  Attempts used are appended
@@ -50,7 +52,8 @@ def _launch_and_expect(n, script, marker, attempts=4, extra_env=None,
     for attempt in range(attempts):
         try:
             r = _launch(n, os.path.join(_REPO, "tests", "dist", script),
-                        extra_env=extra_env, servers=servers)
+                        extra_env=extra_env, servers=servers,
+                        replicas=replicas)
         except subprocess.TimeoutExpired as e:
             # a hang is the most common flake mode — record it and retry
             # like any other failed attempt instead of escaping the loop
@@ -126,6 +129,17 @@ def test_dist_async_multiserver_via_launcher():
     _launch_and_expect(4, "dist_async_multiserver.py",
                        "dist_async multiserver OK", servers=2,
                        extra_env={"MXNET_TPU_PS_DEAD_AFTER": "60"})
+
+
+def test_dist_async_replicated_failover_via_launcher():
+    # `-s 2 -r 2`: each shard is a primary + hot-standby process pair;
+    # rank 0 terminates shard 0's primary mid-training and both workers
+    # must fail over to the promoted standby and converge
+    _launch_and_expect(2, "dist_async_replicated.py",
+                       "dist_async replicated OK", servers=2, replicas=2,
+                       extra_env={"MXNET_TPU_PS_DEAD_AFTER": "3",
+                                  "MXNET_TPU_PS_CALL_TIMEOUT": "3",
+                                  "MXNET_TPU_PS_DEADLINE": "8"})
 
 
 def test_dist_async_liveness_detects_dead_worker():

@@ -56,7 +56,8 @@ def _free_port():
 
 def launch_servers(args, coordinator=None):
     """Start ``-s N`` parameter-server shard processes (the reference's
-    ``DMLC_ROLE=server`` topology, ``kvstore_dist_server.h``).  Returns
+    ``DMLC_ROLE=server`` topology, ``kvstore_dist_server.h``), each
+    optionally backed by ``-r R - 1`` hot-standby replicas.  Returns
     (server procs, env entries workers need to find them).
     ``coordinator`` stamps the cluster id (as the inert
     ``MXNET_TPU_CLUSTER_ID``) into each server's env so
@@ -64,7 +65,10 @@ def launch_servers(args, coordinator=None):
 
     Each server binds port 0 and reports its actual address through a
     file — the launcher never pre-allocates ports, so there is no
-    probe-then-bind race with other jobs on the host."""
+    probe-then-bind race with other jobs on the host.  Replica addresses
+    reach the workers ``|``-joined inside the shard's slot of
+    ``MXNET_TPU_ASYNC_PS_ADDRS``, so the worker-side ``ServerGroup``
+    routes the shard through a failover-capable ``ReplicatedClient``."""
     import secrets
     import tempfile
     import time
@@ -72,45 +76,60 @@ def launch_servers(args, coordinator=None):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     secret = secrets.token_hex(16)
     addr_dir = tempfile.mkdtemp(prefix="mxtpu_ps_")
-    procs, addr_files = [], []
-    for i in range(args.num_servers):
-        addr_file = os.path.join(addr_dir, "server_%d.addr" % i)
-        addr_files.append(addr_file)
+    replicas = max(1, getattr(args, "num_replicas", 1))
+    procs = []
+
+    def spawn(shard, tag, primary_addr=None):
+        addr_file = os.path.join(addr_dir, "server_%s.addr" % tag)
         env = dict(os.environ)
         # servers are host-side: never let one grab (or hang on) a chip
         env["JAX_PLATFORMS"] = "cpu"
         env["MXNET_TPU_PLATFORM"] = "cpu"
         env["MXNET_TPU_SERVER_PORT"] = "0"
         env["MXNET_TPU_SERVER_ADDR_FILE"] = addr_file
-        env["MXNET_TPU_SERVER_ID"] = str(i)
+        env["MXNET_TPU_SERVER_ID"] = str(shard)
         env["MXNET_TPU_NUM_SERVERS"] = str(args.num_servers)
         env["MXNET_TPU_PS_SECRET"] = secret
+        if primary_addr:
+            env["MXNET_TPU_SERVER_PRIMARY"] = primary_addr
         if coordinator:
             # inert cluster-identity marker (NOT MXNET_TPU_COORDINATOR —
             # that one makes jax.distributed join the worker cluster, and
             # a server registering as a phantom task aborts every worker)
             env["MXNET_TPU_CLUSTER_ID"] = coordinator
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "mxnet_tpu._async_ps_main"], env=env))
-    addrs = []
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu._async_ps_main"], env=env)
+        procs.append(proc)
+        return proc, addr_file
+
+    def collect(proc, addr_file, what, deadline):
+        while True:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    addr = f.read().strip()
+                if addr:
+                    return addr
+            if proc.poll() is not None:
+                raise RuntimeError("PS %s exited rc=%d before binding"
+                                   % (what, proc.returncode))
+            if time.time() > deadline:
+                raise RuntimeError("PS %s did not report an address "
+                                   "within 90s" % what)
+            time.sleep(0.1)
+
     deadline = time.time() + 90
     try:
-        for i, addr_file in enumerate(addr_files):
-            while True:
-                if os.path.exists(addr_file):
-                    with open(addr_file) as f:
-                        addr = f.read().strip()
-                    if addr:
-                        addrs.append(addr)
-                        break
-                if procs[i].poll() is not None:
-                    raise RuntimeError("PS server %d exited rc=%d before "
-                                       "binding" % (i, procs[i].returncode))
-                if time.time() > deadline:
-                    raise RuntimeError("PS server %d did not report an "
-                                       "address within 90s" % i)
-                time.sleep(0.1)
+        # primaries first: followers need the primary address to rejoin
+        primaries = [spawn(i, "%d" % i) for i in range(args.num_servers)]
+        shard_addrs = [[collect(p, f, "server %d" % i, deadline)]
+                       for i, (p, f) in enumerate(primaries)]
+        for i in range(args.num_servers):
+            for j in range(1, replicas):
+                p, f = spawn(i, "%d_%d" % (i, j),
+                             primary_addr=shard_addrs[i][0])
+                shard_addrs[i].append(
+                    collect(p, f, "server %d replica %d" % (i, j), deadline))
     except Exception:
         # don't orphan the shards that DID start
         for p in procs:
@@ -118,7 +137,8 @@ def launch_servers(args, coordinator=None):
                 p.kill()
         raise
     worker_env = {
-        "MXNET_TPU_ASYNC_PS_ADDRS": ",".join(addrs),
+        "MXNET_TPU_ASYNC_PS_ADDRS": ",".join("|".join(group)
+                                             for group in shard_addrs),
         "MXNET_TPU_NUM_SERVERS": str(args.num_servers),
         "MXNET_TPU_PS_SECRET": secret,
     }
@@ -205,19 +225,31 @@ def launch_ssh(args, cmd):
     secret = secrets.token_hex(16) if args.num_servers > 0 else ""
     if args.num_servers > 0:
         # remote servers bind operator-chosen ports (no addr-file channel
-        # across hosts): server i on hosts[i % len], port base + i
-        placements = [(hosts[i % len(hosts)], args.server_port_base + i)
-                      for i in range(args.num_servers)]
-        for i, (host, port) in enumerate(placements):
-            env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
-                   "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
-                   "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s"
-                   % (port, i, args.num_servers, host))
-            remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
-                os.getcwd(), env, sys.executable)
-            procs.append(_ssh_with_secret(host, remote, secret))
-        server_env = ("MXNET_TPU_ASYNC_PS_ADDRS=%s MXNET_TPU_NUM_SERVERS=%d "
-                      % (",".join("%s:%d" % p for p in placements),
+        # across hosts): shard i replica j on hosts[(i*R + j) % len],
+        # port base + i*R + j; replica 0 is the shard's initial primary
+        # and replicas j > 0 rejoin it as hot standbys
+        replicas = max(1, args.num_replicas)
+        shard_addrs = []
+        for i in range(args.num_servers):
+            group = []
+            for j in range(replicas):
+                slot = i * replicas + j
+                host = hosts[slot % len(hosts)]
+                port = args.server_port_base + slot
+                env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
+                       "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
+                       "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s"
+                       % (port, i, args.num_servers, host))
+                if j > 0:
+                    env += " MXNET_TPU_SERVER_PRIMARY=%s" % group[0]
+                remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
+                    os.getcwd(), env, sys.executable)
+                procs.append(_ssh_with_secret(host, remote, secret))
+                group.append("%s:%d" % (host, port))
+            shard_addrs.append(group)
+        # quoted: '|' is a replica separator here, not a shell pipe
+        server_env = ("MXNET_TPU_ASYNC_PS_ADDRS='%s' MXNET_TPU_NUM_SERVERS=%d "
+                      % (",".join("|".join(g) for g in shard_addrs),
                          args.num_servers))
     workers = []
     for i in range(args.num_workers):
@@ -247,6 +279,11 @@ def main():
                         help="parameter-server shard processes (dist_async "
                              "multi-server topology; 0 = rank-0 hosts one "
                              "server thread)")
+    parser.add_argument("-r", "--num-replicas", type=int, default=1,
+                        help="replicas per PS shard (1 = no replication; "
+                             "R > 1 adds R-1 hot standbys per shard — "
+                             "workers fail over to a promoted standby if "
+                             "the shard's primary dies)")
     parser.add_argument("--server-port-base", type=int, default=9700,
                         help="first PS port for --launcher ssh (server i "
                              "listens on base+i; local mode self-assigns)")
