@@ -25,7 +25,10 @@ dist-test:
 chaos:
 	python -m pytest tests/ -q -m chaos
 
+trace:
+	python tools/trace_fit.py
+
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-fast bench dryrun dist-test chaos clean
+.PHONY: all native test test-fast bench dryrun dist-test chaos trace clean

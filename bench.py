@@ -31,6 +31,24 @@ def _sync_leaf(tree):
     return np.asarray(jax.numpy.ravel(leaf)[0])
 
 
+def _step_percentiles(run_step, sync, reps, per_call_steps=1):
+    """step_ms p50/p99 from a short per-step-synced loop.
+
+    The headline loop stays fetch-free between steps (per-step syncing
+    would serialize the very dispatch overlap being measured), so the
+    latency distribution comes from this separate, smaller loop:
+    ``run_step()`` dispatches one step (or one K-step flush; pass
+    ``per_call_steps=K``) and ``sync`` forces its result."""
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = run_step()
+        sync(outs)
+        lat.append((time.perf_counter() - t0) / per_call_steps)
+    p50, p99 = np.percentile(np.asarray(lat), [50, 99])
+    return round(float(p50) * 1e3, 3), round(float(p99) * 1e3, 3)
+
+
 def transformer_main():
     """Transformer-LM training throughput (the Pallas flash-attention
     path) + MFU.  Select with BENCH_MODEL=transformer; prints the same
@@ -102,6 +120,14 @@ def transformer_main():
     dt = time.perf_counter() - t0
 
     tokens_s = batch * seq * steps / dt
+
+    def _one_step():
+        nonlocal params, moms, aux
+        outs, params, moms, aux = step(params, moms, aux, arrays, key)
+        return outs
+
+    p50_ms, p99_ms = _step_percentiles(_one_step, _sync_leaf,
+                                       min(steps, 10))
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     # PaLM-appendix accounting: train FLOPs/token = 6N + 12*L*T*d_model
@@ -128,6 +154,8 @@ def transformer_main():
                   else "transformer_lm_cpu_smoke_throughput",
         "value": round(tokens_s, 1), "unit": "tokens/s",
         "vs_baseline": 0.0,  # the 2017 reference has no transformer
+        "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
+        "tokens_per_sec": round(tokens_s, 1),
         "mfu": round(mfu, 4), "n_params": n_params,
         **({"n_params_active": n_active} if ffn == "moe" else {}),
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
@@ -204,6 +232,16 @@ def main():
         sync(outs)
         dt = time.perf_counter() - t0
         img_s = batch * steps * pipeline / dt
+
+        def _one_flush():
+            nonlocal params, moms, aux
+            outs, params, moms, aux = pipe(
+                params, moms, aux, sb, key, np.int32(0))
+            return outs
+
+        p50_ms, p99_ms = _step_percentiles(_one_flush, sync,
+                                           min(steps, 10),
+                                           per_call_steps=pipeline)
     else:
         data = tr.place_batch(host)
         step = tr.step_fn()
@@ -216,12 +254,24 @@ def main():
         dt = time.perf_counter() - t0
         img_s = batch * steps / dt
 
+        def _one_step():
+            nonlocal params, moms, aux
+            outs, params, moms, aux = step(params, moms, aux, data, key)
+            return outs
+
+        p50_ms, p99_ms = _step_percentiles(_one_step, sync,
+                                           min(steps, 10))
+
     print(json.dumps({
         "metric": "resnet50_train_throughput" if platform == "tpu"
                   else "resnet8_cpu_smoke_throughput",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        # additive contract keys: per-step latency distribution from the
+        # synced percentile loop; tokens == samples for the image bench
+        "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
+        "tokens_per_sec": round(img_s, 2),
         **({"pipeline_steps": pipeline} if pipeline > 1 else {}),
     }))
 

@@ -90,6 +90,7 @@ from . import module as mod
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor
+from . import observability
 from . import profiler
 from . import visualization
 from . import visualization as viz

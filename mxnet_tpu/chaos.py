@@ -59,8 +59,13 @@ import random
 import threading
 import time
 
+from .observability import metrics as _metrics
+
 __all__ = ["ChaosError", "ChaosDrop", "inject", "clear", "visit",
            "corrupt_file", "rules", "SITES"]
+
+_M_FIRED = _metrics.counter(
+    "chaos_fired_total", "Chaos-injection rules fired, by site", ["site"])
 
 SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
@@ -278,6 +283,7 @@ def visit(site, payload=None, name=None):
                 continue
             if not rule.should_fire(name):
                 continue
+            _M_FIRED.labels(site).inc()
             if rule.mode == "delay":
                 time.sleep(rule.delay)
             elif rule.mode == "raise":
@@ -303,6 +309,7 @@ def corrupt_file(site, path):
         rule = next((r for r in matched if r.should_fire(None)), None)
         if rule is None:
             return None
+        _M_FIRED.labels(site).inc()
         target = path
         if os.path.isdir(path):
             best = None

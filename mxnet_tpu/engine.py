@@ -45,6 +45,8 @@ import itertools
 import threading
 
 from . import _native, chaos
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
 
 __all__ = ["Var", "push", "new_variable", "wait_for_var", "wait_for_all",
            "engine_type", "FnProperty", "clear_poison"]
@@ -55,6 +57,24 @@ class FnProperty(object):
     NORMAL = 0
     IO = 1
     COPY = 2
+
+
+# pre-resolved per-lane handles: the push/run hot path records with one
+# tuple index + method call, no registry or label lookup
+_LANE_NAMES = ("normal", "io", "copy")
+_M_PUSH = tuple(
+    _metrics.counter("engine_push_total",
+                     "Ops pushed into the dependency engine",
+                     ["lane"]).labels(n) for n in _LANE_NAMES)
+_M_RUN = tuple(
+    _metrics.counter("engine_run_total",
+                     "Engine ops that ran to completion", ["lane"]).labels(n)
+    for n in _LANE_NAMES)
+_M_POISON = tuple(
+    _metrics.counter("engine_poison_total",
+                     "Engine ops that failed (or inherited a poisoned "
+                     "dependency) and poisoned their mutable vars",
+                     ["lane"]).labels(n) for n in _LANE_NAMES)
 
 
 class Var(object):
@@ -288,6 +308,11 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
     # lock-free hot path: the C-level next() is atomic under the GIL, so
     # concurrent pushes never serialize on a mutex just to count
     _pushed = next(_push_seq)
+    _M_PUSH[prop].inc()
+    # capture the pusher's span context NOW (None while tracing is off):
+    # the op may run on a worker thread, where spans it opens must still
+    # parent under whoever scheduled it
+    trace_ctx = _tracing.capture_context()
     deps = tuple(const_vars) + tuple(mutable_vars)
     muts = tuple(mutable_vars)
 
@@ -299,8 +324,16 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
                 break
         if poison is None:
             try:
-                chaos.visit("engine.op", name=name)
-                fn()
+                if trace_ctx is None:
+                    chaos.visit("engine.op", name=name)
+                    fn()
+                else:
+                    with _tracing.attach_context(trace_ctx), \
+                            _tracing.span(name, cat="engine",
+                                          lane=_LANE_NAMES[prop]):
+                        chaos.visit("engine.op", name=name)
+                        fn()
+                _M_RUN[prop].inc()
                 return
             except chaos.ChaosDrop:
                 # injected silent loss: op never ran, no poison — but give
@@ -311,10 +344,12 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
                     except Exception as exc:  # noqa: BLE001 — into poison
                         poison = _Poison(exc, name)
                         _mark_poisoned(muts, poison)
+                        _M_POISON[prop].inc()
                 return
             except Exception as exc:  # noqa: BLE001 — captured into poison
                 poison = _Poison(exc, name)
         _mark_poisoned(muts, poison)
+        _M_POISON[prop].inc()
 
     _get().push(guarded, const_vars, mutable_vars, priority, prop, name)
 

@@ -114,6 +114,7 @@ import numpy as _np
 from . import chaos as _chaos
 from .base import (MXNetError, ServerDeadError, ShardFailedError,
                    StaleEpochError, TruncatedMessageError)
+from .observability import metrics as _metrics
 
 __all__ = ["AsyncServer", "AsyncClient", "ReplicatedClient", "ServerGroup",
            "ServerDeadError", "ShardFailedError", "StaleEpochError",
@@ -123,6 +124,30 @@ __all__ = ["AsyncServer", "AsyncClient", "ReplicatedClient", "ServerGroup",
 _KV_KEY = "mxtpu_async_ps_addr"
 
 _LOG = logging.getLogger(__name__)
+
+# -- observability families (handles resolved once at import; labeled
+# children are cached inside the family, so per-event cost is one dict
+# lookup + method call, and zero work under MXNET_TPU_METRICS=0) -----------
+_M_RPC = _metrics.histogram(
+    "kv_rpc_seconds", "Worker-side RPC latency (retries included)",
+    ["op"])
+_M_HB_AGE = _metrics.gauge(
+    "kv_heartbeat_age_seconds",
+    "Seconds since this worker's last successful heartbeat probe",
+    ["server"])
+_M_FAILOVER = _metrics.counter(
+    "kv_failover_total",
+    "Successful client-driven failovers (standby promoted to primary)")
+_M_FENCED = _metrics.counter(
+    "kv_fenced_total",
+    "Primaries demoted to role 'fenced' after meeting a higher epoch")
+_M_REJOIN = _metrics.counter(
+    "kv_rejoin_total",
+    "Servers that re-entered a replica group via live state transfer")
+_M_REPL_LAG = _metrics.gauge(
+    "kv_replication_lag",
+    "Primary log entries not yet acked by the follower (seqno delta)",
+    ["follower"])
 
 
 # -- tunables, read LAZILY so jobs and tests can reconfigure timeouts
@@ -514,6 +539,8 @@ class _FollowerLink:
                 self.acked_rseq = max(
                     self.acked_rseq,
                     int(resp.get("rseq", entry.get("rseq", 0))))
+                _M_REPL_LAG.labels(self.addr).set(
+                    max(self._owner._applied_seq - self.acked_rseq, 0))
                 if latch is not None:
                     latch.ack()
             elif resp.get("resync"):
@@ -702,6 +729,7 @@ class AsyncServer:
             self._install_snapshot_locked(resp)
             self.role = "follower"
         _membership_note_replica(primary_addr, self.address)
+        _M_REJOIN.inc()
         return self
 
     def _snapshot_locked(self):
@@ -853,6 +881,9 @@ class AsyncServer:
             self.role = "fenced"
             links = list(self._followers.values())
             self._followers = {}
+        # outside the lock; the role guard above makes this exactly-once
+        # per demotion no matter how many streams report the new epoch
+        _M_FENCED.inc()
         for link in links:
             link.close()
 
@@ -1117,6 +1148,8 @@ class AsyncClient:
     def _heartbeat_loop(self):
         failures = 0
         down_since = None
+        last_ok = time.monotonic()
+        hb_age = _M_HB_AGE.labels("%s:%d" % self._addr)
         while True:
             base = max(_heartbeat_interval_s(), 0.05)
             if failures:
@@ -1139,6 +1172,7 @@ class AsyncClient:
                     return
                 failures += 1
                 now = time.monotonic()
+                hb_age.set(now - last_ok)
                 if down_since is None:
                     down_since = now
                 if now - down_since >= _dead_after_s():
@@ -1154,6 +1188,8 @@ class AsyncClient:
             else:
                 failures = 0
                 down_since = None
+                last_ok = time.monotonic()
+                hb_age.set(0.0)
 
     def _dial(self, timeout_s):
         """Connect with patience: launcher-spawned server processes may
@@ -1199,6 +1235,7 @@ class AsyncClient:
         a new primary still dedups; ``deadline`` overrides the overall
         retry budget (heartbeat probes use a short one)."""
         msg["rank"] = self._rank
+        t_rpc = time.monotonic()
         with self._lock:
             if seq is None:
                 self._seq += 1
@@ -1243,6 +1280,7 @@ class AsyncClient:
                                overall, msg.get("op"), exc)) from exc
                     time.sleep(pause)
                     # retry (same seq: the server dedups completed requests)
+        _M_RPC.labels(msg.get("op", "?")).observe(time.monotonic() - t_rpc)
         if not resp.get("ok"):
             if resp.get("stale_epoch") or resp.get("not_primary"):
                 raise StaleEpochError(
@@ -1462,6 +1500,7 @@ class ReplicatedClient:
             _membership_publish(self._group, self.epoch, self._replicas,
                                 addr)
             old.close()
+            _M_FAILOVER.inc()
             _LOG.warning(
                 "ReplicatedClient rank %d: failed over shard group %s to "
                 "%s at epoch %d", self._rank, ",".join(self._group), addr,

@@ -13,8 +13,13 @@ from __future__ import annotations
 import logging
 import re
 
+from .observability import metrics as _metrics
 
 __all__ = ["Monitor"]
+
+_M_STAT = _metrics.gauge(
+    "monitor_stat",
+    "Latest per-tensor statistic captured by mx.mon.Monitor", ["tensor"])
 
 
 class Monitor(object):
@@ -58,6 +63,12 @@ class Monitor(object):
         if self.sort:
             self.queue.sort(key=lambda x: x[1])
         for n, k, v_list in self.queue:
+            try:
+                # scalar stats become live gauge series (one per tensor);
+                # non-scalar stat_func results stay string-only
+                _M_STAT.labels(k).set(float(v_list))
+            except (TypeError, ValueError):
+                pass
             res.append((n, k, str(v_list)))
         self.queue = []
         return res

@@ -1,0 +1,43 @@
+"""Unified observability: metrics registry, trace spans, exporters.
+
+The runtime's telemetry layer (the subsystem the paper's
+``OprExecStat``-based engine profiler grew into here):
+
+- :mod:`~mxnet_tpu.observability.metrics` — process-global
+  counters/gauges/histograms with labels; O(1) pre-resolved handles on
+  the hot path; gated by ``MXNET_TPU_METRICS``;
+  :func:`dump_metrics` renders Prometheus text exposition.
+- :mod:`~mxnet_tpu.observability.tracing` — ``span("name")`` context
+  manager with cross-thread parenting (``engine.push`` carries the
+  pusher's context onto worker threads) into a bounded ring buffer.
+- :mod:`~mxnet_tpu.observability.exporters` — ``/metrics`` HTTP
+  endpoint (:func:`start_metrics_server`) and
+  :func:`export_chrome_trace`, which merges Python spans with the
+  native engine profiler dump on one aligned CLOCK_MONOTONIC timeline.
+
+Instrumented out of the box: engine push/run/poison per lane, prefetch
+occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
+latency / heartbeat age / replication lag / failover-fencing-rejoin
+events, chaos fires per site.  ``mx.profiler`` remains the
+parity-facing façade over this package.
+"""
+
+from __future__ import annotations
+
+from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
+                      dump_metrics, reset_metrics, metrics_enabled,
+                      DEFAULT_BUCKETS)
+from .tracing import (span, capture_context, attach_context,
+                      enable_tracing, disable_tracing, tracing_enabled,
+                      spans, clear_spans, Span)
+from .exporters import (render_prometheus, start_metrics_server,
+                        export_chrome_trace, MetricsServer)
+
+__all__ = [
+    "Registry", "REGISTRY", "counter", "gauge", "histogram",
+    "dump_metrics", "reset_metrics", "metrics_enabled", "DEFAULT_BUCKETS",
+    "span", "capture_context", "attach_context", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "spans", "clear_spans", "Span",
+    "render_prometheus", "start_metrics_server", "export_chrome_trace",
+    "MetricsServer",
+]

@@ -1,0 +1,150 @@
+"""Exporters: Prometheus text exposition + chrome://tracing JSON.
+
+Two pull surfaces over the in-process registry/ring buffer:
+
+- :func:`start_metrics_server` — a tiny stdlib HTTP endpoint serving
+  ``/metrics`` in Prometheus text format (scrape target; loopback-bound
+  by default, same posture as the PS wire protocol).
+  :func:`render_prometheus` / ``dump_metrics()`` give the same text as
+  a snapshot without the socket.
+- :func:`export_chrome_trace` — the span ring buffer as
+  chrome://tracing / Perfetto JSON, MERGED with the native engine
+  profiler's dump (``mxtpu_profiler_dump``) when one is available:
+  both stamp CLOCK_MONOTONIC microseconds, so engine ops, prefetch
+  fetches, scan-step dispatches and KV RPCs line up on one timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ["render_prometheus", "start_metrics_server",
+           "export_chrome_trace", "MetricsServer"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def render_prometheus(registry=None):
+    """Prometheus text exposition of ``registry`` (default: the global
+    one)."""
+    return (registry or _metrics.REGISTRY).render()
+
+
+class MetricsServer(object):
+    """Handle for a running /metrics endpoint: ``.port``, ``.url``,
+    ``.close()``.  Also a context manager."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = httpd.server_address[1]
+        self.url = "http://%s:%d/metrics" % (httpd.server_address[0],
+                                             self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_metrics_server(port=None, addr="127.0.0.1", registry=None):
+    """Serve ``/metrics`` on a daemon thread; returns a
+    :class:`MetricsServer`.
+
+    ``port=None`` reads ``MXNET_TPU_METRICS_PORT`` (default 0 = a
+    kernel-assigned free port, reported via ``.port``).  Binds loopback
+    unless ``addr`` says otherwise — the exposition is unauthenticated.
+    """
+    import http.server
+
+    if port is None:
+        port = int(os.environ.get("MXNET_TPU_METRICS_PORT", "0"))
+    reg = registry or _metrics.REGISTRY
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = reg.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes don't belong on stderr
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, int(port)), _Handler)
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mxtpu-metrics-http", daemon=True)
+    thread.start()
+    return MetricsServer(httpd, thread)
+
+
+def _native_events():
+    """The native engine profiler's traceEvents (dumped through a temp
+    file — the C ABI only writes files), or [] when the library is
+    absent or has recorded nothing."""
+    from .. import _native
+
+    lib = _native.lib()
+    if lib is None:
+        return []
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="mxtpu_engine_")
+    os.close(fd)
+    try:
+        n = lib.mxtpu_profiler_dump(path.encode())
+        if n <= 0:
+            return []
+        with open(path, encoding="utf-8") as f:
+            return json.load(f).get("traceEvents", [])
+    except (OSError, ValueError):
+        return []
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def export_chrome_trace(path=None, include_native=True):
+    """Build one chrome://tracing / Perfetto JSON view of the run.
+
+    Python spans (ring buffer) become complete ("X") events carrying
+    ``span_id``/``parent`` in ``args``; when ``include_native``, the
+    native engine dump's events are merged in unchanged (same monotonic
+    µs clock).  Writes to ``path`` when given; returns the trace dict.
+    """
+    pid = os.getpid()
+    events = []
+    for s in _tracing.spans():
+        args = dict(s.attrs)
+        args["span_id"] = s.span_id
+        if s.parent_id:
+            args["parent"] = s.parent_id
+        events.append({"name": s.name, "cat": s.cat, "ph": "X",
+                       "ts": s.start_us,
+                       "dur": max(s.end_us - s.start_us, 1),
+                       "pid": pid, "tid": s.tid, "args": args})
+    if include_native:
+        events.extend(_native_events())
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,404 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+The paper's runtime measures itself through the engine profiler alone
+(``OprExecStat``); this registry is the aggregate-side complement —
+cheap, always-on numeric series for every subsystem (engine lanes,
+prefetch, trainer steps, kvstore RPCs, chaos injections), rendered in
+Prometheus text exposition format by :func:`dump_metrics`.
+
+Design points:
+
+- **Pre-resolved handles.**  ``counter(...)`` / ``.labels(...)`` return
+  a handle object once; the per-event call (``inc``/``set``/``observe``)
+  is a method on that handle — no registry or label-dict lookup on the
+  hot path.  Hot seams (``engine.push``) resolve their handles at import
+  time.
+- **Env gate.**  ``MXNET_TPU_METRICS=0`` disables recording: every
+  handle method is then a constant-time guard (one cached-env check and
+  return, nothing else — asserted by call-count in
+  ``tests/test_observability.py``).  The env var is re-read lazily by
+  cache comparison, chaos-style, so tests and jobs can flip it without
+  re-importing.
+- **Reset keeps handles live.**  ``reset()`` zeroes values but never
+  discards families or label children, so module-level pre-resolved
+  handles stay wired after a test-suite reset.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["Registry", "REGISTRY", "counter", "gauge", "histogram",
+           "dump_metrics", "reset_metrics", "metrics_enabled",
+           "DEFAULT_BUCKETS"]
+
+#: Prometheus's conventional latency buckets (seconds).
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+# --- env gate (lazy, cache-compared like chaos._active_rules) -------------
+
+_env_lock = threading.Lock()
+_env_cache = object()   # never equal to a str/None: first call refreshes
+_env_enabled = True
+
+
+def metrics_enabled():
+    """True unless ``MXNET_TPU_METRICS`` is 0/false/off.  This is the
+    single guard every handle method checks first; keep it one dict.get
+    plus an identity compare on the cached string."""
+    global _env_cache, _env_enabled
+    env = os.environ.get("MXNET_TPU_METRICS")
+    if env != _env_cache:
+        with _env_lock:
+            _env_cache = env
+            _env_enabled = ((env or "1").strip().lower()
+                            not in ("0", "false", "off"))
+    return _env_enabled
+
+
+# --- value formatting ------------------------------------------------------
+
+def _fmt_value(v):
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return "%d" % int(f) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _fmt_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _series(name, label_names, label_values, suffix="", extra=()):
+    pairs = list(zip(label_names, label_values)) + list(extra)
+    if not pairs:
+        return name + suffix
+    return "%s%s{%s}" % (name, suffix, ",".join(
+        '%s="%s"' % (k, _fmt_label(v)) for k, v in pairs))
+
+
+# --- handles ---------------------------------------------------------------
+
+class Counter(object):
+    """Monotone counter handle (one label-value combination)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, v=1.0):
+        if not metrics_enabled():
+            return
+        self._record(v)
+
+    def _record(self, v):
+        if v < 0:
+            raise ValueError("counters only go up (got %r)" % v)
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0.0
+
+    def _render(self, name, label_names, label_values, w):
+        w("%s %s\n" % (_series(name, label_names, label_values),
+                       _fmt_value(self._value)))
+
+
+class Gauge(object):
+    """Set/inc/dec gauge handle."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v):
+        if not metrics_enabled():
+            return
+        self._record(v, "set")
+
+    def inc(self, v=1.0):
+        if not metrics_enabled():
+            return
+        self._record(v, "inc")
+
+    def dec(self, v=1.0):
+        if not metrics_enabled():
+            return
+        self._record(v, "dec")
+
+    def _record(self, v, op):
+        with self._lock:
+            if op == "set":
+                self._value = float(v)
+            elif op == "inc":
+                self._value += v
+            else:
+                self._value -= v
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0.0
+
+    def _render(self, name, label_names, label_values, w):
+        w("%s %s\n" % (_series(name, label_names, label_values),
+                       _fmt_value(self._value)))
+
+
+class Histogram(object):
+    """Cumulative-bucket histogram handle (Prometheus semantics)."""
+
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self._buckets = buckets        # sorted upper bounds, no +Inf
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        if not metrics_enabled():
+            return
+        self._record(v)
+
+    def _record(self, v):
+        v = float(v)
+        with self._lock:
+            for i, ub in enumerate(self._buckets):
+                if v <= ub:
+                    self._counts[i] += 1
+                    break
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, q):
+        """Bucket-resolution quantile estimate in [0, 1] (upper bound of
+        the bucket holding the q-th observation); None when empty."""
+        with self._lock:
+            total = self._count
+            if not total:
+                return None
+            rank = q * total
+            seen = 0
+            for i, ub in enumerate(self._buckets):
+                seen += self._counts[i]
+                if seen >= rank:
+                    return ub
+            return float("inf")
+
+    def _reset(self):
+        with self._lock:
+            self._counts = [0] * len(self._buckets)
+            self._sum = 0.0
+            self._count = 0
+
+    def _render(self, name, label_names, label_values, w):
+        with self._lock:
+            counts, total, ssum = list(self._counts), self._count, self._sum
+        cum = 0
+        for ub, n in zip(self._buckets, counts):
+            cum += n
+            w("%s %d\n" % (_series(name, label_names, label_values,
+                                   "_bucket", [("le", _fmt_value(ub))]),
+                           cum))
+        w("%s %d\n" % (_series(name, label_names, label_values, "_bucket",
+                               [("le", "+Inf")]), total))
+        w("%s %s\n" % (_series(name, label_names, label_values, "_sum"),
+                       _fmt_value(ssum)))
+        w("%s %d\n" % (_series(name, label_names, label_values, "_count"),
+                       total))
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Family(object):
+    """One metric name: kind, help text, label schema, and the child
+    handles (one per label-value combination).  ``labels()`` caches, so
+    repeated resolution of the same combination returns the SAME handle
+    and callers may pre-resolve once and record forever."""
+
+    def __init__(self, name, help, kind, label_names=(), buckets=None):
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.label_names = tuple(label_names)
+        self.buckets = (tuple(sorted(buckets)) if buckets is not None
+                        else DEFAULT_BUCKETS) if kind == "histogram" \
+            else None
+        self._lock = threading.Lock()
+        self._children = {}
+        if not self.label_names:
+            self._default = self._make()
+            self._children[()] = self._default
+        else:
+            self._default = None
+
+    def _make(self):
+        if self.kind == "histogram":
+            return Histogram(self.buckets)
+        return _KINDS[self.kind]()
+
+    def labels(self, *values):
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                "%s expects labels %s, got %d value(s)"
+                % (self.name, self.label_names, len(key)))
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._make()
+                    self._children[key] = child
+        return child
+
+    # unlabeled families proxy the single child so the family object IS
+    # the hot-path handle
+    def inc(self, v=1.0):
+        self._default.inc(v)
+
+    def set(self, v):
+        self._default.set(v)
+
+    def dec(self, v=1.0):
+        self._default.dec(v)
+
+    def observe(self, v):
+        self._default.observe(v)
+
+    @property
+    def value(self):
+        return self._default.value
+
+    @property
+    def count(self):
+        return self._default.count
+
+    def percentile(self, q):
+        return self._default.percentile(q)
+
+    def _reset(self):
+        with self._lock:
+            for child in self._children.values():
+                child._reset()
+
+    def _render(self, w):
+        w("# HELP %s %s\n" % (self.name,
+                              self.help.replace("\n", " ").strip()))
+        w("# TYPE %s %s\n" % (self.name, self.kind))
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            child._render(self.name, self.label_names, key, w)
+
+
+class Registry(object):
+    """Thread-safe family registry.  Registering an existing name with a
+    matching (kind, labels) signature returns the SAME family, so every
+    module can declare the metrics it emits without coordination."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families = {}
+
+    def _register(self, name, help, kind, label_names, buckets=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.label_names != tuple(label_names):
+                    raise ValueError(
+                        "metric %r re-registered as %s%s but exists as %s%s"
+                        % (name, kind, tuple(label_names), fam.kind,
+                           fam.label_names))
+                return fam
+            fam = Family(name, help, kind, label_names, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name, help, labels=()):
+        return self._register(name, help, "counter", labels)
+
+    def gauge(self, name, help, labels=()):
+        return self._register(name, help, "gauge", labels)
+
+    def histogram(self, name, help, labels=(), buckets=None):
+        return self._register(name, help, "histogram", labels, buckets)
+
+    def get(self, name):
+        return self._families.get(name)
+
+    def render(self):
+        """Prometheus text exposition (version 0.0.4) of every family."""
+        import io
+
+        buf = io.StringIO()
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in fams:
+            fam._render(buf.write)
+        return buf.getvalue()
+
+    def reset(self):
+        """Zero every recorded value; families and pre-resolved handles
+        survive (tests isolate state without unwiring instrumentation)."""
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            fam._reset()
+
+
+#: The process-global registry all runtime instrumentation records into.
+REGISTRY = Registry()
+
+
+def counter(name, help, labels=()):
+    """Register (or fetch) a process-global counter family."""
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name, help, labels=()):
+    """Register (or fetch) a process-global gauge family."""
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name, help, labels=(), buckets=None):
+    """Register (or fetch) a process-global histogram family."""
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+def dump_metrics():
+    """Snapshot the global registry as Prometheus text exposition."""
+    return REGISTRY.render()
+
+
+def reset_metrics():
+    """Zero the global registry (handles stay live — see
+    :meth:`Registry.reset`)."""
+    REGISTRY.reset()

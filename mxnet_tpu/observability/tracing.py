@@ -1,0 +1,183 @@
+"""Trace spans: nested, cross-thread, ring-buffered.
+
+``span("name")`` times a region on whatever thread it runs on; spans
+nest through a thread-local stack, and a parent context can be carried
+ACROSS threads — ``engine.push`` captures the pusher's context with
+:func:`capture_context` and re-attaches it on the worker thread with
+:func:`attach_context`, so an engine op's span is a child of the
+``trainer.flush`` (or ``prefetch``/RPC) span that scheduled it even
+though they run on different threads.
+
+Finished spans land in a bounded ring buffer (capacity
+``MXNET_TPU_METRICS_TRACE_BUFFER``, default 65536; oldest evicted
+first).  Timestamps are ``time.monotonic()`` microseconds — the same
+CLOCK_MONOTONIC the native engine profiler stamps
+(``native/src/profiler.cc NowUs``), so Python spans and native engine
+ops merge onto ONE aligned timeline in
+``exporters.export_chrome_trace``.
+
+Recording is off by default; the profiler façade
+(``profiler_set_state('run')``) or :func:`enable_tracing` turns it on.
+When off, ``span()`` is a no-op context manager (constant-time guard).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+
+__all__ = ["span", "capture_context", "attach_context", "enable_tracing",
+           "disable_tracing", "tracing_enabled", "spans", "clear_spans",
+           "Span"]
+
+_enabled = False
+_lock = threading.Lock()
+_ids = itertools.count(1)
+_buffer = None       # created lazily so the env cap is read at first use
+_tls = threading.local()
+
+
+class Span(object):
+    """One finished span record."""
+
+    __slots__ = ("name", "cat", "start_us", "end_us", "tid", "span_id",
+                 "parent_id", "attrs")
+
+    def __init__(self, name, cat, start_us, end_us, tid, span_id,
+                 parent_id, attrs):
+        self.name = name
+        self.cat = cat
+        self.start_us = start_us
+        self.end_us = end_us
+        self.tid = tid
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+
+
+def _buf():
+    global _buffer
+    if _buffer is None:
+        with _lock:
+            if _buffer is None:
+                cap = int(os.environ.get(
+                    "MXNET_TPU_METRICS_TRACE_BUFFER", "65536"))
+                _buffer = collections.deque(maxlen=max(cap, 1))
+    return _buffer
+
+
+def enable_tracing():
+    """Start recording spans (cleared of nothing: the buffer keeps any
+    prior session's spans until :func:`clear_spans`)."""
+    global _enabled
+    _buf()
+    _enabled = True
+
+
+def disable_tracing():
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled():
+    return _enabled
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def capture_context():
+    """The calling thread's current span id (0 = tracing on, no open
+    span), or ``None`` when tracing is off.  Pass the result to
+    :func:`attach_context` on another thread to parent spans across the
+    hop — this pair is what ``engine.push`` threads through to worker
+    threads."""
+    if not _enabled:
+        return None
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else 0
+
+
+class attach_context(object):
+    """Context manager installing a captured parent context on THIS
+    thread; spans opened inside become its children.  A ``None`` context
+    (tracing was off at capture time) is a no-op."""
+
+    __slots__ = ("_ctx", "_pushed")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._pushed = False
+
+    def __enter__(self):
+        if self._ctx is not None and self._ctx != 0:
+            _stack().append(self._ctx)
+            self._pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            _stack().pop()
+        return False
+
+
+class span(object):
+    """Record a named span over the ``with`` body.
+
+    ``cat`` groups spans in the trace viewer (engine / prefetch /
+    kvstore / frontend...); extra keyword attrs land in the chrome-trace
+    ``args``.  No-op (constant-time guard) while tracing is off.
+    """
+
+    __slots__ = ("_name", "_cat", "_attrs", "_t0", "_id", "_parent",
+                 "_live")
+
+    def __init__(self, name, cat="frontend", **attrs):
+        self._name = name
+        self._cat = cat
+        self._attrs = attrs
+        self._live = False
+
+    def __enter__(self):
+        if not _enabled:
+            return self
+        self._live = True
+        st = _stack()
+        self._parent = st[-1] if st else 0
+        self._id = next(_ids)
+        st.append(self._id)
+        self._t0 = int(time.monotonic() * 1e6)
+        return self
+
+    def __exit__(self, *exc):
+        if not self._live:
+            return False
+        self._live = False
+        end = int(time.monotonic() * 1e6)
+        st = _stack()
+        if st and st[-1] == self._id:
+            st.pop()
+        _buf().append(Span(self._name, self._cat, self._t0, end,
+                           threading.get_ident() % 100000, self._id,
+                           self._parent, self._attrs))
+        return False
+
+
+def spans():
+    """Snapshot (list) of the recorded spans, oldest first."""
+    buf = _buf()
+    with _lock:
+        return list(buf)
+
+
+def clear_spans():
+    buf = _buf()
+    with _lock:
+        buf.clear()

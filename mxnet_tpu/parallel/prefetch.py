@@ -27,11 +27,25 @@ Built on the engine's var machinery rather than ad-hoc threads:
 
 from __future__ import annotations
 
+import time as _time
 from collections import namedtuple
 
 from .. import engine as _engine
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 
 __all__ = ["PrefetchFeeder", "Chunk"]
+
+# pre-resolved handles; one feeder at a time per name is the normal shape,
+# so the series are unlabeled process aggregates
+_M_OCCUPANCY = _metrics.gauge(
+    "prefetch_occupancy", "Staged chunks ready and not yet consumed")
+_M_STALL = _metrics.counter(
+    "prefetch_stall_seconds_total",
+    "Seconds the consumer spent blocked in next_chunk waiting for a "
+    "fetch that had not finished staging")
+_M_CHUNKS = _metrics.counter(
+    "prefetch_chunks_total", "Chunks served to the consumer")
 
 
 #: One prefetched pipeline flush: ``placed`` is the device superbatch (the
@@ -88,6 +102,7 @@ class PrefetchFeeder(object):
         self._done = False        # consumer side: END chunk was consumed
         self._broken = None       # sticky error after a lost fetch op
         self._cursor = 0          # consumer's next slot
+        self._ready = 0           # staged-not-consumed chunks (occupancy)
         self._closed = False
         for i in range(self._depth):
             self._push(i)
@@ -113,6 +128,8 @@ class PrefetchFeeder(object):
                 self._slots[i] = _END
                 return
             self._slots[i] = Chunk(self._place(host), host, len(host))
+            self._ready += 1
+            _M_OCCUPANCY.set(self._ready)
 
         def lost():
             # the op (and the iterator positions it would have consumed)
@@ -145,7 +162,10 @@ class PrefetchFeeder(object):
         if self._done:
             return None
         i = self._cursor
-        _engine.wait_for_var(self._vars[i])  # poison re-raises here
+        t0 = _time.monotonic()
+        with _tracing.span("prefetch.wait", cat="prefetch", slot=i):
+            _engine.wait_for_var(self._vars[i])  # poison re-raises here
+        _M_STALL.inc(_time.monotonic() - t0)
         if self._broken is not None:
             raise self._broken
         chunk = self._slots[i]
@@ -159,6 +179,9 @@ class PrefetchFeeder(object):
             self._done = True
             return None
         self._cursor = (i + 1) % self._depth
+        self._ready = max(self._ready - 1, 0)
+        _M_OCCUPANCY.set(self._ready)
+        _M_CHUNKS.inc()
         self._push(i)
         return chunk
 
@@ -174,6 +197,8 @@ class PrefetchFeeder(object):
         self._done = False
         self._broken = None
         self._cursor = 0
+        self._ready = 0
+        _M_OCCUPANCY.set(0)
         for i in range(self._depth):
             self._push(i)
 

@@ -862,10 +862,12 @@ class ShardedTrainer:
         kill neither aborts the loop nor trips any resume machinery.
         """
         import logging
+        import time as _time
 
         import jax as _jax
 
         from .. import metric as _metric_mod
+        from .. import observability as _obs
         from . import checkpoint as _ckpt
         from . import prefetch as _prefetch
 
@@ -975,6 +977,19 @@ class ShardedTrainer:
                     "batch_in_epoch": batch_in_epoch, "seed": rng_seed,
                     "base_epoch": rng_anchor}
 
+        # observability: handles resolved ONCE here; the loop pays one
+        # method call per event (MXNET_TPU_METRICS=0 short-circuits it)
+        _m_step = _obs.histogram(
+            "trainer_step_seconds",
+            "Optimizer-step wall time seen by the fit loop; pipelined "
+            "flushes are amortized over their K fused steps")
+        _m_steps = _obs.counter("trainer_steps_total",
+                                "Optimizer steps applied by fit")
+        _m_tokens = _obs.gauge(
+            "trainer_tokens_per_sec",
+            "Training throughput (batch rows per second) of the most "
+            "recent step or flush")
+
         guard = self._skip_nonfinite
         bad_streak = 0
         skipped_total = 0
@@ -1048,24 +1063,32 @@ class ShardedTrainer:
                         skip_batches -= 1
                         nbatch += 1
                         continue
+                    t_step = _time.monotonic()
                     arrays, data_names = batch_arrays(batch, train_data)
-                    placed = self.place_batch(arrays)
-                    outs, params, moms, aux = step(
-                        params, moms, aux, placed,
-                        _jax.random.fold_in(base_key, global_step))
-                    ok = True
-                    if guard:
-                        # trailing scalar = the step's in-graph verdict;
-                        # the asnumpy read syncs, which the skip policy
-                        # needs anyway
-                        ok = bool(_np.asarray(outs[-1]))
-                        outs = outs[:-1]
+                    with _obs.span("trainer.step", step=global_step):
+                        placed = self.place_batch(arrays)
+                        outs, params, moms, aux = step(
+                            params, moms, aux, placed,
+                            _jax.random.fold_in(base_key, global_step))
+                        ok = True
+                        if guard:
+                            # trailing scalar = the step's in-graph
+                            # verdict; the asnumpy read syncs, which the
+                            # skip policy needs anyway
+                            ok = bool(_np.asarray(outs[-1]))
+                            outs = outs[:-1]
                     global_step += 1
                     nbatch += 1
                     flushes += 1
                     outs_host = ([_np.asarray(o) for o in outs]
                                  if flushes % metric_every == 0 else None)
                     after_step(epoch, arrays, data_names, ok, outs_host)
+                    dt = _time.monotonic() - t_step
+                    _m_step.observe(dt)
+                    _m_steps.inc()
+                    if dt > 0:
+                        _m_tokens.set(
+                            next(iter(arrays.values())).shape[0] / dt)
             else:
                 # -- pipelined path: K fused steps per dispatch over a
                 # feeder-staged superbatch -------------------------------
@@ -1091,21 +1114,27 @@ class ShardedTrainer:
                     planned[0] += k
                     return k
 
-                feeder = _prefetch.PrefetchFeeder(
-                    iter(train_data),
-                    extract=lambda b: batch_arrays(b, train_data),
-                    place=lambda host: self.place_superbatch(
-                        [a for a, _ in host]),
-                    sizes=plan_size, depth=2, name="fit.prefetch")
+                with _obs.span("trainer.prefetch_start"):
+                    # fetch ops pushed by the constructor inherit this
+                    # span as their cross-thread parent
+                    feeder = _prefetch.PrefetchFeeder(
+                        iter(train_data),
+                        extract=lambda b: batch_arrays(b, train_data),
+                        place=lambda host: self.place_superbatch(
+                            [a for a, _ in host]),
+                        sizes=plan_size, depth=2, name="fit.prefetch")
                 try:
                     while True:
-                        chunk = feeder.next_chunk()
-                        if chunk is None:
-                            break
-                        n = chunk.count
-                        outs_stack, params, moms, aux = self.pipeline_fn(n)(
-                            params, moms, aux, chunk.placed, base_key,
-                            _np.int32(global_step))
+                        t_flush = _time.monotonic()
+                        with _obs.span("trainer.flush", flush=flushes):
+                            chunk = feeder.next_chunk()
+                            if chunk is None:
+                                break
+                            n = chunk.count
+                            outs_stack, params, moms, aux = \
+                                self.pipeline_fn(n)(
+                                    params, moms, aux, chunk.placed,
+                                    base_key, _np.int32(global_step))
                         flushes += 1
                         verdicts = None
                         if guard:
@@ -1128,6 +1157,14 @@ class ShardedTrainer:
                                 None if outs_host is None
                                 else [o[j] for o in outs_host],
                                 can_ckpt=(j == n - 1))
+                        dt = _time.monotonic() - t_flush
+                        _m_steps.inc(n)
+                        for _ in range(n):  # amortized per-step latency
+                            _m_step.observe(dt / n)
+                        if dt > 0:
+                            rows = next(iter(
+                                chunk.host[0][0].values())).shape[0]
+                            _m_tokens.set(rows * n / dt)
                 finally:
                     feeder.close()
             history.setdefault(epoch, {})["train"] = metric.get()

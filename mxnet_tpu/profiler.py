@@ -1,15 +1,19 @@
 """Profiler (parity: reference ``python/mxnet/profiler.py`` +
-``src/engine/profiler.cc``).
+``src/engine/profiler.cc``) — now a façade over
+:mod:`mxnet_tpu.observability`.
 
-Two lanes, merged under one API:
+Three lanes, merged under one API:
  - **device**: the jax/XLA profiler (xplane) — ``profiler_set_state('run')``
    starts a trace viewable in TensorBoard/Perfetto.  This is the TPU
    equivalent of the reference's GPU op timing.
  - **host engine**: the native engine profiler (``native/src/profiler.cc``)
-   records per-op start/end/thread for host-side engine work and dumps
-   chrome://tracing JSON — the direct equivalent of the reference's
-   ``OprExecStat`` → ``DumpProfile`` path
+   records per-op start/end/thread for host-side engine work — the direct
+   equivalent of the reference's ``OprExecStat`` → ``DumpProfile`` path
    (``src/engine/profiler.h:20-141``, hook ``threaded_engine.h:294-308``).
+ - **frontend spans**: ``scope()`` and every instrumented runtime seam
+   record through :func:`observability.span` into the cross-thread ring
+   buffer; ``dump_profile`` merges them with the native dump into ONE
+   chrome://tracing JSON (shared CLOCK_MONOTONIC µs timeline).
 """
 
 from __future__ import annotations
@@ -17,75 +21,117 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 
-from . import _native
+from . import _native, observability as _obs
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "scope"]
 
-_STATE = {"mode": "symbolic", "dir": "profile_output", "running": False}
+
+class _ProfilerState(object):
+    """Lock-guarded profiler session state.  The old module-global dict
+    let two threads racing ``profiler_set_state('run')`` both observe
+    ``running=False`` and double-start the xplane trace; here the
+    check-and-flip happens under one lock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.mode = "symbolic"
+        self.dir = "profile_output"
+        self.running = False
+
+
+_STATE = _ProfilerState()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """(parity: ``profiler.py:profiler_set_config``)"""
-    _STATE["mode"] = mode
-    _STATE["dir"] = os.path.splitext(filename)[0]
+    with _STATE.lock:
+        _STATE.mode = mode
+        _STATE.dir = os.path.splitext(filename)[0]
 
 
 def profiler_set_state(state="stop"):
-    """'run' starts the xplane trace + native engine recording; 'stop' ends
-    both (parity: ``profiler.py:profiler_set_state``)."""
+    """'run' starts the xplane trace, the native engine recording, and
+    frontend span recording; 'stop' ends all three (parity:
+    ``profiler.py:profiler_set_state``).  Idempotent and thread-safe:
+    concurrent or repeated 'run' calls start ONE session."""
     import jax
 
     lib = _native.lib()
-    if state == "run" and not _STATE["running"]:
-        os.makedirs(_STATE["dir"], exist_ok=True)
-        jax.profiler.start_trace(_STATE["dir"])
-        if lib is not None:
-            lib.mxtpu_profiler_clear()  # fresh session, drop stale events
-            lib.mxtpu_profiler_set_state(1)
-        _STATE["running"] = True
-    elif state == "stop" and _STATE["running"]:
-        jax.profiler.stop_trace()
-        if lib is not None:
-            lib.mxtpu_profiler_set_state(0)
-        _STATE["running"] = False
-    else:
-        logging.debug("profiler state change to %r ignored", state)
+    with _STATE.lock:
+        if state == "run" and not _STATE.running:
+            os.makedirs(_STATE.dir, exist_ok=True)
+            jax.profiler.start_trace(_STATE.dir)
+            if lib is not None:
+                lib.mxtpu_profiler_clear()  # fresh session, no stale events
+                lib.mxtpu_profiler_set_state(1)
+            _obs.clear_spans()
+            _obs.enable_tracing()
+            _STATE.running = True
+        elif state == "stop" and _STATE.running:
+            jax.profiler.stop_trace()
+            if lib is not None:
+                lib.mxtpu_profiler_set_state(0)
+            _obs.disable_tracing()
+            _STATE.running = False
+        else:
+            logging.debug("profiler state change to %r ignored", state)
 
 
 def dump_profile():
-    """Stop + flush both traces; the host-engine chrome trace lands at
+    """Stop + flush all traces.  The host-engine chrome trace lands at
     ``<dir>/engine_trace.json`` (parity: ``profiler.py:dump_profile`` /
-    ``Profiler::DumpProfile``)."""
+    ``Profiler::DumpProfile``); the MERGED view — frontend/engine/
+    prefetch/kvstore spans plus the native engine ops on one timeline —
+    lands at ``<dir>/trace.json``.  Returns the merged path."""
     profiler_set_state("stop")
+    with _STATE.lock:
+        out_dir = _STATE.dir
+    os.makedirs(out_dir, exist_ok=True)
     lib = _native.lib()
     if lib is not None:
-        os.makedirs(_STATE["dir"], exist_ok=True)
-        path = os.path.join(_STATE["dir"], "engine_trace.json")
+        path = os.path.join(out_dir, "engine_trace.json")
         n = lib.mxtpu_profiler_dump(path.encode())
         logging.info("dumped %d engine events to %s", n, path)
-        return path
-    return None
+    merged = os.path.join(out_dir, "trace.json")
+    trace = _obs.export_chrome_trace(merged)
+    logging.info("dumped merged trace (%d events) to %s",
+                 len(trace["traceEvents"]), merged)
+    return merged
 
 
 class scope(object):
-    """Context manager recording a named frontend span into the host trace
-    (the ``mx.profiler``-visible analog of engine op events)."""
+    """Context manager recording a named frontend span (the
+    ``mx.profiler``-visible analog of engine op events).  Routed through
+    the observability span API — nested scopes parent correctly, engine
+    ops pushed inside inherit the scope across threads — and mirrored
+    into the native event table for the legacy ``engine_trace.json``."""
 
     def __init__(self, name, cat="frontend"):
         self.name = name
         self.cat = cat
+        self._span = _obs.span(name, cat=cat)
 
     def __enter__(self):
+        import time
+
         self._t0 = int(time.monotonic() * 1e6)
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
+        import time
+
+        self._span.__exit__(*exc)
+        if _obs.tracing_enabled():
+            return False  # the span IS the record; don't double-emit
         lib = _native.lib()
         if lib is not None and lib.mxtpu_profiler_state():
+            # legacy path: native profiler driven directly, span
+            # recording off — mirror into the native event table
             lib.mxtpu_profiler_add_event(
                 self.name.encode(), self.cat.encode(), self._t0,
-                int(time.monotonic() * 1e6), threading.get_ident() % 100000)
+                int(time.monotonic() * 1e6),
+                threading.get_ident() % 100000)
         return False

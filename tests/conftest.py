@@ -37,6 +37,19 @@ def _chaos_clean():
 
 
 @pytest.fixture(autouse=True)
+def _metrics_clean():
+    """Metric values and trace spans never leak across tests.  reset()
+    zeroes values but keeps families + pre-resolved handles wired, so
+    module-level instrumentation (engine lanes, kvstore) stays live."""
+    yield
+    from mxnet_tpu import observability as obs
+
+    obs.reset_metrics()
+    obs.disable_tracing()
+    obs.clear_spans()
+
+
+@pytest.fixture(autouse=True)
 def _seed():
     _np.random.seed(42)
     import mxnet_tpu as mx

@@ -31,6 +31,10 @@ def test_bench_json_contract(pipeline):
     assert rec["unit"] == "img/s"
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
+    # additive observability keys (same contract, new fields)
+    assert rec["step_ms_p50"] > 0
+    assert rec["step_ms_p99"] >= rec["step_ms_p50"]
+    assert rec["tokens_per_sec"] > 0
     # pipeline_steps only appears when the pipelined path actually ran
     if pipeline > 1:
         assert rec["pipeline_steps"] == pipeline
