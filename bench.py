@@ -49,6 +49,22 @@ def _step_percentiles(run_step, sync, reps, per_call_steps=1):
     return round(float(p50) * 1e3, 3), round(float(p99) * 1e3, 3)
 
 
+def _obs_counters():
+    """Additive observability keys for the one-line JSON contract:
+    chaos injections fired and trace spans lost to ring-buffer eviction
+    during the run (both 0 on a clean bench — nonzero values flag that
+    the headline number was taken under fault injection or with a
+    truncated trace)."""
+    from mxnet_tpu import observability as obs
+
+    fired = obs.REGISTRY.get("chaos_fired_total")
+    dropped = obs.REGISTRY.get("spans_dropped_total")
+    return {
+        "chaos_fired_total": int(fired.total()) if fired else 0,
+        "spans_dropped_total": int(dropped.total()) if dropped else 0,
+    }
+
+
 def transformer_main():
     """Transformer-LM training throughput (the Pallas flash-attention
     path) + MFU.  Select with BENCH_MODEL=transformer; prints the same
@@ -156,6 +172,7 @@ def transformer_main():
         "vs_baseline": 0.0,  # the 2017 reference has no transformer
         "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
         "tokens_per_sec": round(tokens_s, 1),
+        **_obs_counters(),
         "mfu": round(mfu, 4), "n_params": n_params,
         **({"n_params_active": n_active} if ffn == "moe" else {}),
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
@@ -272,6 +289,7 @@ def main():
         # synced percentile loop; tokens == samples for the image bench
         "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
         "tokens_per_sec": round(img_s, 2),
+        **_obs_counters(),
         **({"pipeline_steps": pipeline} if pipeline > 1 else {}),
     }))
 

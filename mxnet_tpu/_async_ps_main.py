@@ -7,7 +7,10 @@ The launcher hands this process its port/identity/secret via env
 ``MXNET_TPU_PS_SECRET``) — the dmlc tracker env contract.  With
 ``MXNET_TPU_SERVER_PRIMARY=<addr>`` set (``tools/launch.py -r N``), the
 process enters that primary's replica group as a hot standby: snapshot
-state transfer, then the live update stream.  The process serves until a
+state transfer, then the live update stream.  With
+``MXNET_TPU_METRICS_PORT`` set (``tools/launch.py
+--metrics-port-base``), the process also serves its own ``/metrics``
+endpoint as a federation scrape target.  The process serves until a
 worker sends the ``shutdown`` op or the launcher reaps it after the
 workers exit.
 """
@@ -24,6 +27,21 @@ def main():
     port = int(os.environ.get("MXNET_TPU_SERVER_PORT", "0"))
     server_id = int(os.environ.get("MXNET_TPU_SERVER_ID", "0"))
     server = AsyncServer(port=port, server_id=server_id).start()
+    # federation scrape target: every server process exposes its own
+    # /metrics when the launcher (--metrics-port-base) or the job hands
+    # it a port; failure to bind must not take down the shard
+    metrics = None
+    if os.environ.get("MXNET_TPU_METRICS_PORT"):
+        try:
+            from .observability import start_metrics_server
+
+            metrics = start_metrics_server()
+            logging.info("async PS shard %d metrics at %s", server_id,
+                         metrics.url)
+        except OSError:
+            logging.exception("async PS shard %d: /metrics endpoint "
+                              "failed to bind (continuing without)",
+                              server_id)
     addr_file = os.environ.get("MXNET_TPU_SERVER_ADDR_FILE")
     if addr_file:
         # port 0 = kernel-assigned (no probe-then-bind race); report the
@@ -51,6 +69,8 @@ def main():
                  server.address, server.role)
     server.wait_shutdown()
     server.stop()
+    if metrics is not None:
+        metrics.close()
 
 
 if __name__ == "__main__":

@@ -47,6 +47,7 @@ import threading
 from . import _native, chaos
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
+from .observability import flight_recorder as _flight
 
 __all__ = ["Var", "push", "new_variable", "wait_for_var", "wait_for_all",
            "engine_type", "FnProperty", "clear_poison"]
@@ -345,11 +346,18 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0,
                         poison = _Poison(exc, name)
                         _mark_poisoned(muts, poison)
                         _M_POISON[prop].inc()
+                        _flight.record_failure(
+                            "engine_poison", exc, op=name,
+                            lane=_LANE_NAMES[prop])
                 return
             except Exception as exc:  # noqa: BLE001 — captured into poison
                 poison = _Poison(exc, name)
         _mark_poisoned(muts, poison)
         _M_POISON[prop].inc()
+        # inherited poison carries the ORIGINAL exception object, whose
+        # recorded-mark keeps the bundle to one per root cause
+        _flight.record_failure("engine_poison", poison.exc,
+                               op=poison.op_name, lane=_LANE_NAMES[prop])
 
     _get().push(guarded, const_vars, mutable_vars, priority, prop, name)
 

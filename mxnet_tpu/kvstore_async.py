@@ -115,6 +115,8 @@ from . import chaos as _chaos
 from .base import (MXNetError, ServerDeadError, ShardFailedError,
                    StaleEpochError, TruncatedMessageError)
 from .observability import metrics as _metrics
+from .observability import tracing as _tracing
+from .observability import flight_recorder as _flight
 
 __all__ = ["AsyncServer", "AsyncClient", "ReplicatedClient", "ServerGroup",
            "ServerDeadError", "ShardFailedError", "StaleEpochError",
@@ -777,6 +779,12 @@ class AsyncServer:
         self._applied_seq += 1
         entry = {"op": "replicate", "rop": op, "rseq": self._applied_seq,
                  "orank": rank, "oseq": seq, "resp": resp}
+        # handler-thread context: the serve span (itself a child of the
+        # worker's RPC span) parents the follower's replicate handling,
+        # so replication shows up in the same trace tree
+        trace_tok = _tracing.capture_wire_context()
+        if trace_tok is not None:
+            entry["trace"] = trace_tok
         if op in ("init", "push"):
             entry["pairs"] = msg["pairs"]
         elif op == "set_optimizer":
@@ -884,6 +892,8 @@ class AsyncServer:
         # outside the lock; the role guard above makes this exactly-once
         # per demotion no matter how many streams report the new epoch
         _M_FENCED.inc()
+        _flight.record_failure("fenced", server_id=self.server_id,
+                               address=self.address, epoch=self.epoch)
         for link in links:
             link.close()
 
@@ -895,6 +905,11 @@ class AsyncServer:
     # -- message dispatch (runs on handler threads) --------------------
     def dispatch(self, msg):
         op = msg.get("op")
+        # the pusher's span context travels as an OPTIONAL header field;
+        # a frame without one (old peer) or with a corrupt one attaches
+        # nothing — tracing must never fail the RPC (attach_wire_context
+        # swallows bad tokens)
+        trace_tok = msg.pop("trace", None)
         try:
             _chaos.visit("kvstore.server_kill",
                          name="s%d:%s:%s" % (self.server_id, self.role, op))
@@ -909,7 +924,10 @@ class AsyncServer:
         with self._inflight_cv:
             self._inflight += 1
         try:
-            resp, latch = self._dispatch(msg)
+            with _tracing.attach_wire_context(trace_tok), \
+                    _tracing.span("kv.serve.%s" % op, cat="kvstore",
+                                  server=self.server_id, role=self.role):
+                resp, latch = self._dispatch(msg)
         finally:
             with self._inflight_cv:
                 self._inflight -= 1
@@ -1233,7 +1251,23 @@ class AsyncClient:
         lifetime than this connection (``ReplicatedClient``) keep ONE
         monotonic per-worker stream across failovers, so a retry through
         a new primary still dedups; ``deadline`` overrides the overall
-        retry budget (heartbeat probes use a short one)."""
+        retry budget (heartbeat probes use a short one).
+
+        When tracing is on, the RPC runs inside a ``kv.rpc`` span whose
+        context rides in the frame header's OPTIONAL ``trace`` field
+        (old peers decode frames without it unchanged); the server
+        re-attaches it so push/pull handling appears as this span's
+        child in the merged trace."""
+        if not _tracing.tracing_enabled():
+            return self._call_impl(msg, seq, deadline)
+        with _tracing.span("kv.rpc", cat="kvstore", op=msg.get("op"),
+                           server="%s:%d" % self._addr):
+            tok = _tracing.capture_wire_context()
+            if tok is not None:
+                msg["trace"] = tok
+            return self._call_impl(msg, seq, deadline)
+
+    def _call_impl(self, msg, seq=None, deadline=None):
         msg["rank"] = self._rank
         t_rpc = time.monotonic()
         with self._lock:
@@ -1506,11 +1540,16 @@ class ReplicatedClient:
                 "%s at epoch %d", self._rank, ",".join(self._group), addr,
                 self.epoch)
             return
-        raise ServerDeadError(
+        exc = ServerDeadError(
             "replica group [%s]: no reachable standby to promote past "
             "epoch %d%s" % (",".join(self._replicas), self.epoch,
                             " — last error: %r" % (last_exc,)
                             if last_exc else ""))
+        exc.__cause__ = last_exc
+        _flight.record_failure("replica_group_lost", exc,
+                               group=",".join(self._group),
+                               epoch=self.epoch, rank=self._rank)
+        raise exc
 
     def _next_seq(self):
         self._seq += 1
@@ -1534,6 +1573,11 @@ class ReplicatedClient:
                     last = exc
                     failovers += 1
                     if failovers > cap:
+                        _flight.record_failure(
+                            "replica_group_lost", exc,
+                            group=",".join(self._group),
+                            epoch=self.epoch, rank=self._rank,
+                            failovers=failovers)
                         raise
                     self._failover(exc)
                 except StaleEpochError as exc:
@@ -1647,9 +1691,14 @@ class ServerGroup:
                 return [thunk()]
             except (ServerDeadError, ConnectionError, OSError,
                     EOFError) as exc:
-                raise ShardFailedError(
+                err = ShardFailedError(
                     "async PS fan-out failed at %s: %r"
-                    % (self._shard_label(server), exc)) from exc
+                    % (self._shard_label(server), exc))
+                err.__cause__ = exc
+                _flight.record_failure("shard_failed", err,
+                                       shards=self._shard_label(server),
+                                       rank=self._rank)
+                raise err from exc
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -1665,11 +1714,17 @@ class ServerGroup:
                 results.append(None)
                 failures.append((server, exc))
         if failures:
-            raise ShardFailedError(
+            err = ShardFailedError(
                 "async PS fan-out failed on %d/%d shard(s): %s"
                 % (len(failures), len(jobs),
                    "; ".join("%s: %r" % (self._shard_label(s), e)
-                             for s, e in failures))) from failures[0][1]
+                             for s, e in failures)))
+            err.__cause__ = failures[0][1]
+            _flight.record_failure(
+                "shard_failed", err, rank=self._rank,
+                shards="; ".join(self._shard_label(s)
+                                 for s, _ in failures))
+            raise err from failures[0][1]
         return results
 
     @property
@@ -1834,18 +1889,23 @@ class ServerGroup:
 
 # -- address discovery over the jax.distributed coordination KV ---------
 
-def publish_address(address, secret=None, epoch=0):
+def publish_address(address, secret=None, epoch=0, metrics_port=None):
     """Publish the server address record.  ``address`` may be a full
     shard list (comma-separated) where each shard is a ``|``-separated
     replica group; ``epoch`` stamps the membership view so late-joining
-    workers start from the promoted topology, not the original one."""
+    workers start from the promoted topology, not the original one;
+    ``metrics_port`` (when the server also runs a ``/metrics``
+    endpoint) travels with the record so a federation collector can
+    find every shard's exposition — old readers ignore the extra key
+    (``lookup_address`` only picks the fields it knows)."""
     from jax._src import distributed
 
     client = distributed.global_state.client
     if client is not None:
-        record = _json.dumps({"addr": address, "secret": secret,
-                              "epoch": int(epoch)})
-        client.key_value_set(_KV_KEY, record)
+        rec = {"addr": address, "secret": secret, "epoch": int(epoch)}
+        if metrics_port is not None:
+            rec["metrics_port"] = int(metrics_port)
+        client.key_value_set(_KV_KEY, _json.dumps(rec))
 
 
 def lookup_address(timeout_s=60):

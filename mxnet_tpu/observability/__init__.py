@@ -11,9 +11,16 @@ The runtime's telemetry layer (the subsystem the paper's
   manager with cross-thread parenting (``engine.push`` carries the
   pusher's context onto worker threads) into a bounded ring buffer.
 - :mod:`~mxnet_tpu.observability.exporters` — ``/metrics`` HTTP
-  endpoint (:func:`start_metrics_server`) and
-  :func:`export_chrome_trace`, which merges Python spans with the
-  native engine profiler dump on one aligned CLOCK_MONOTONIC timeline.
+  endpoint (:func:`start_metrics_server`), :func:`export_chrome_trace`
+  (merges Python spans with the native engine profiler dump on one
+  aligned CLOCK_MONOTONIC timeline), and :func:`merge_chrome_traces`
+  (concatenates per-process dumps onto one cluster-wide timeline).
+- :mod:`~mxnet_tpu.observability.federation` — scrape every shard's
+  ``/metrics`` endpoint and render one cluster-wide exposition with
+  ``shard``/``role``/``epoch`` labels plus derived health series.
+- :mod:`~mxnet_tpu.observability.flight_recorder` — atomically dump a
+  postmortem bundle (span tail, metrics snapshot, chaos rules,
+  membership epochs, exception chain) when a terminal fault surfaces.
 
 Instrumented out of the box: engine push/run/poison per lane, prefetch
 occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
@@ -28,16 +35,23 @@ from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       dump_metrics, reset_metrics, metrics_enabled,
                       DEFAULT_BUCKETS)
 from .tracing import (span, capture_context, attach_context,
+                      capture_wire_context, attach_wire_context,
                       enable_tracing, disable_tracing, tracing_enabled,
                       spans, clear_spans, Span)
 from .exporters import (render_prometheus, start_metrics_server,
-                        export_chrome_trace, MetricsServer)
+                        export_chrome_trace, merge_chrome_traces,
+                        MetricsServer)
+from .federation import FederatedCollector, federate
+from .flight_recorder import record_failure, flight_enabled
 
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
     "dump_metrics", "reset_metrics", "metrics_enabled", "DEFAULT_BUCKETS",
-    "span", "capture_context", "attach_context", "enable_tracing",
-    "disable_tracing", "tracing_enabled", "spans", "clear_spans", "Span",
+    "span", "capture_context", "attach_context", "capture_wire_context",
+    "attach_wire_context", "enable_tracing", "disable_tracing",
+    "tracing_enabled", "spans", "clear_spans", "Span",
     "render_prometheus", "start_metrics_server", "export_chrome_trace",
-    "MetricsServer",
+    "merge_chrome_traces", "MetricsServer",
+    "FederatedCollector", "federate",
+    "record_failure", "flight_enabled",
 ]

@@ -12,6 +12,14 @@ Two pull surfaces over the in-process registry/ring buffer:
   profiler's dump (``mxtpu_profiler_dump``) when one is available:
   both stamp CLOCK_MONOTONIC microseconds, so engine ops, prefetch
   fetches, scan-step dispatches and KV RPCs line up on one timeline.
+- :func:`merge_chrome_traces` — concatenate per-process dumps
+  (workers + servers + standbys) onto ONE timeline: CLOCK_MONOTONIC is
+  system-wide on Linux, so timestamps from different processes on one
+  host already align; each dump carries a ``process_name`` metadata
+  event, so every process gets its own named track.  Cross-process
+  span parentage survives the merge through ``args.span_uid`` /
+  ``args.parent_uid`` (``"pid:span_id"`` strings, globally unique
+  where bare span ids are only per-process).
 """
 
 from __future__ import annotations
@@ -25,7 +33,7 @@ from . import metrics as _metrics
 from . import tracing as _tracing
 
 __all__ = ["render_prometheus", "start_metrics_server",
-           "export_chrome_trace", "MetricsServer"]
+           "export_chrome_trace", "merge_chrome_traces", "MetricsServer"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -122,27 +130,68 @@ def _native_events():
             pass
 
 
-def export_chrome_trace(path=None, include_native=True):
+def export_chrome_trace(path=None, include_native=True, track=None):
     """Build one chrome://tracing / Perfetto JSON view of the run.
 
     Python spans (ring buffer) become complete ("X") events carrying
-    ``span_id``/``parent`` in ``args``; when ``include_native``, the
-    native engine dump's events are merged in unchanged (same monotonic
-    µs clock).  Writes to ``path`` when given; returns the trace dict.
+    ``span_id``/``parent`` in ``args`` plus globally-unique
+    ``span_uid``/``parent_uid`` (``"pid:span_id"`` strings) so
+    parentage survives :func:`merge_chrome_traces` across processes; a
+    remote parent attached via ``tracing.attach_wire_context`` shows up
+    as ``parent_uid`` pointing into the peer's dump.  When
+    ``include_native``, the native engine dump's events are merged in
+    unchanged (same monotonic µs clock).  ``track`` names this
+    process's track in a merged view (default
+    ``MXNET_TPU_TRACE_TRACK`` or ``"pid <pid>"``) via a
+    ``process_name`` metadata event.  Writes to ``path`` when given;
+    returns the trace dict.
     """
     pid = os.getpid()
-    events = []
+    if track is None:
+        track = os.environ.get("MXNET_TPU_TRACE_TRACK") or "pid %d" % pid
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
+               "args": {"name": str(track)}}]
     for s in _tracing.spans():
         args = dict(s.attrs)
         args["span_id"] = s.span_id
-        if s.parent_id:
+        args["span_uid"] = "%d:%d" % (pid, s.span_id)
+        if isinstance(s.parent_id, str):
+            # remote parent: the wire token already IS the peer's uid
+            args["parent_uid"] = s.parent_id
+        elif s.parent_id:
             args["parent"] = s.parent_id
+            args["parent_uid"] = "%d:%d" % (pid, s.parent_id)
         events.append({"name": s.name, "cat": s.cat, "ph": "X",
                        "ts": s.start_us,
                        "dur": max(s.end_us - s.start_us, 1),
                        "pid": pid, "tid": s.tid, "args": args})
     if include_native:
         events.extend(_native_events())
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def merge_chrome_traces(inputs, path=None):
+    """Merge per-process chrome-trace dumps onto one timeline.
+
+    ``inputs`` is an iterable of trace dicts (as returned by
+    :func:`export_chrome_trace`) and/or paths to JSON files of the same
+    shape.  Events are concatenated unchanged: all processes on one
+    host stamp the same system-wide CLOCK_MONOTONIC, so their
+    timestamps already align, and per-process ``pid`` +
+    ``process_name`` metadata keep the tracks apart.  Cross-process
+    parentage is preserved by the ``span_uid``/``parent_uid`` args.
+    Writes to ``path`` when given; returns the merged trace dict.
+    """
+    events = []
+    for src in inputs:
+        if isinstance(src, (str, os.PathLike)):
+            with open(src, encoding="utf-8") as f:
+                src = json.load(f)
+        events.extend(src.get("traceEvents", []))
     trace = {"traceEvents": events, "displayTimeUnit": "ms"}
     if path is not None:
         with open(path, "w", encoding="utf-8") as f:

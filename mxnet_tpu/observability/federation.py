@@ -1,0 +1,223 @@
+"""Cluster metrics federation: scrape every shard, render one view.
+
+The pull-based federation model (Prometheus/Borgmon): each
+``AsyncServer`` / standby / worker process serves its own ``/metrics``
+endpoint (``exporters.start_metrics_server``; the port travels with
+``publish_address``), and a :class:`FederatedCollector` scrapes them
+all and re-renders a single cluster-wide exposition in which every
+series carries ``shard`` / ``role`` / ``epoch`` labels identifying the
+member it came from.
+
+A federation **target** is a dict with the three identity labels plus
+exactly one source::
+
+    {"shard": 0, "role": "primary", "epoch": 1,
+     "url": "http://127.0.0.1:9100/metrics"}   # scrape over HTTP
+    {"shard": 0, "role": "standby", "epoch": 1,
+     "registry": obs.REGISTRY}                 # in-process registry
+    {"shard": 1, "role": "primary", "epoch": 0,
+     "text": "...exposition..."}               # pre-scraped text
+
+Sources are deduplicated **by identity**: the in-process test layout
+runs every server thread against ONE process-global registry, so
+listing that registry once per member would repeat process-wide
+counters (``kv_failover_total``) once per row.  A shared source is
+scraped and emitted exactly once — under the labels of the first
+member naming it — while every member still contributes its
+``cluster_server_info{shard,role,epoch} 1`` identity series, keeping
+failover/fence counters exactly-once in the federated view.
+
+On top of the relabeled series the collector derives cluster health:
+``cluster_replication_lag_max`` (max follower seqno delta anywhere),
+``cluster_heartbeat_age_max_seconds``, summed
+``cluster_failover_total`` / ``cluster_fenced_total``, and
+``cluster_scrape_errors_total`` (unreachable members, labeled by
+shard).  With ``MXNET_TPU_METRICS=0`` :meth:`FederatedCollector.render`
+returns an empty exposition WITHOUT scraping anything — a constant-time
+guard like every other handle.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.request
+
+from . import metrics as _metrics
+
+__all__ = ["FederatedCollector", "federate"]
+
+_IDENTITY = ("shard", "role", "epoch")
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+
+def _scrape_one(target, timeout):
+    """Raw exposition text from one target's source.  Module-level so
+    tests can monkeypatch it to count calls on the disabled path."""
+    if "text" in target:
+        return target["text"]
+    if "registry" in target:
+        return target["registry"].render()
+    req = urllib.request.Request(target["url"])
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _source_key(target):
+    """Identity of the underlying source, for exactly-once dedup."""
+    if "text" in target:
+        return ("text", id(target["text"]))
+    if "registry" in target:
+        return ("registry", id(target["registry"]))
+    if "url" in target:
+        return ("url", target["url"])
+    raise ValueError("federation target needs one of url/registry/text: %r"
+                     % (sorted(target),))
+
+
+def _parse(text):
+    """Exposition text → ordered ``{family: {help, type, series}}``
+    where ``series`` is ``[(name, labelbody_or_None, value_str)]``.
+    Tolerant: lines that don't parse are skipped (a half-written peer
+    exposition must not take down the federated view)."""
+    fams = {}
+    cur = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            try:
+                _, kw, name, rest = line.split(" ", 3)
+            except ValueError:
+                continue
+            fam = fams.setdefault(name, {"help": "", "type": "untyped",
+                                         "series": []})
+            fam["help" if kw == "HELP" else "type"] = rest
+            cur = name
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            series_id, value = line.rsplit(" ", 1)
+        except ValueError:
+            continue
+        if "{" in series_id:
+            name, rest = series_id.split("{", 1)
+            if not rest.endswith("}"):
+                continue
+            labels = rest[:-1]
+        else:
+            name, labels = series_id, None
+        if not _NAME_RE.match(name):
+            continue
+        fam_name = cur if (cur and name.startswith(cur)) else name
+        fam = fams.setdefault(fam_name, {"help": "", "type": "untyped",
+                                         "series": []})
+        fam["series"].append((name, labels, value))
+    return fams
+
+
+def _identity_pairs(target):
+    return ",".join('%s="%s"' % (k, _metrics._fmt_label(target.get(k, "")))
+                    for k in _IDENTITY)
+
+
+def _relabel(name, labels, ident):
+    body = ident if not labels else labels + "," + ident
+    return "%s{%s}" % (name, body)
+
+
+class FederatedCollector(object):
+    """Scrape a list of federation targets and render one cluster-wide
+    exposition.  Has a ``render()`` method, so it can be served
+    directly: ``start_metrics_server(registry=collector)``."""
+
+    def __init__(self, targets, timeout=2.0):
+        self.targets = list(targets)
+        self.timeout = timeout
+        for t in self.targets:
+            _source_key(t)   # validate eagerly
+
+    def render(self):
+        if not _metrics.metrics_enabled():
+            return ""
+        out = []
+        w = out.append
+        w("# HELP cluster_server_info Federation membership "
+          "(one series per member)\n")
+        w("# TYPE cluster_server_info gauge\n")
+        for t in self.targets:
+            w("cluster_server_info{%s} 1\n" % _identity_pairs(t))
+
+        merged = {}          # family -> {help, type, lines: [...]}
+        values = {}          # bare series name -> [float] across members
+        errors = []          # identity pair strings of failed scrapes
+        seen = {}            # source key -> True
+        for t in self.targets:
+            key = _source_key(t)
+            if key in seen:
+                continue
+            seen[key] = True
+            try:
+                text = _scrape_one(t, self.timeout)
+            except Exception:
+                errors.append(_identity_pairs(t))
+                continue
+            ident = _identity_pairs(t)
+            for fam_name, fam in _parse(text).items():
+                slot = merged.setdefault(
+                    fam_name, {"help": fam["help"], "type": fam["type"],
+                               "lines": []})
+                if slot["type"] == "untyped" and fam["type"] != "untyped":
+                    slot["help"], slot["type"] = fam["help"], fam["type"]
+                for name, labels, value in fam["series"]:
+                    slot["lines"].append(
+                        "%s %s\n" % (_relabel(name, labels, ident), value))
+                    try:
+                        values.setdefault(name, []).append(float(value))
+                    except ValueError:
+                        pass
+
+        # families sorted by name; series keep scrape order (histogram
+        # buckets must stay in ascending-le order, which lexical
+        # sorting would scramble) — deterministic because both the
+        # target list and each registry's render are
+        for fam_name in sorted(merged):
+            slot = merged[fam_name]
+            if slot["help"]:
+                w("# HELP %s %s\n" % (fam_name, slot["help"]))
+            w("# TYPE %s %s\n" % (fam_name, slot["type"]))
+            for line in slot["lines"]:
+                w(line)
+
+        def derived(name, help, kind, value):
+            w("# HELP %s %s\n" % (name, help))
+            w("# TYPE %s %s\n" % (name, kind))
+            w("%s %s\n" % (name, _metrics._fmt_value(value)))
+
+        derived("cluster_replication_lag_max",
+                "Max follower seqno delta across all members", "gauge",
+                max(values.get("kv_replication_lag", [0]) or [0]))
+        derived("cluster_heartbeat_age_max_seconds",
+                "Max heartbeat age across all members", "gauge",
+                max(values.get("kv_heartbeat_age_seconds", [0]) or [0]))
+        derived("cluster_failover_total",
+                "Failovers summed across all members", "counter",
+                sum(values.get("kv_failover_total", [])))
+        derived("cluster_fenced_total",
+                "Fenced primaries summed across all members", "counter",
+                sum(values.get("kv_fenced_total", [])))
+        w("# HELP cluster_scrape_errors_total Members whose source "
+          "could not be scraped this pass\n")
+        w("# TYPE cluster_scrape_errors_total counter\n")
+        w("cluster_scrape_errors_total %d\n" % len(errors))
+        for ident in errors:
+            w("cluster_scrape_errors_total{%s} 1\n" % ident)
+        return "".join(out)
+
+
+def federate(targets, timeout=2.0):
+    """One-shot federation: scrape ``targets`` and return the
+    cluster-wide exposition text (see :class:`FederatedCollector`)."""
+    return FederatedCollector(targets, timeout=timeout).render()

@@ -1,0 +1,178 @@
+"""Failure flight recorder: a postmortem bundle dumped at crash time.
+
+Debugging a failover after the fact needs the state that existed AT
+the failure, not whatever a human can reconstruct an hour later.  When
+a terminal fault surfaces — ``ShardFailedError`` escaping the client,
+an engine op poisoning its vars, a primary fenced by a higher epoch —
+:func:`record_failure` atomically writes a timestamped bundle
+directory::
+
+    $MXNET_TPU_FLIGHT_DIR/flight_<kind>_<utc-stamp>_<pid>/
+        manifest.json   # kind, exception chain, chaos rules fired,
+                        # membership epochs, extra context, pid, time
+        spans.json      # last-N spans from the trace ring buffer
+        metrics.prom    # full Prometheus snapshot of the registry
+
+The recorder is **off by default**: it activates only when
+``MXNET_TPU_FLIGHT_DIR`` names a directory AND metrics are enabled
+(``MXNET_TPU_METRICS`` gate), so chaos-heavy test suites don't litter
+bundles.  When off, :func:`record_failure` is a constant-time guard
+(call-count asserted in tests).  Bundles appear atomically: everything
+is written into a ``.tmp`` sibling first, then ``os.rename``\\ d into
+place, so a watcher never sees a half-written bundle.
+
+The same exception often crosses several instrumented seams on its way
+out (``ReplicatedClient`` → ``ShardedTrainer.fit``); the recorder
+marks the exception object (``_mxtpu_flight_recorded``) after the
+first dump so nested hooks record it once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ["record_failure", "flight_enabled"]
+
+#: How many trailing spans of the ring buffer land in ``spans.json``.
+_SPAN_TAIL = 512
+
+_MARK = "_mxtpu_flight_recorded"
+
+_M_BUNDLES = _metrics.counter(
+    "flight_bundles_total", "Flight-recorder bundles written", ["kind"])
+
+
+def flight_enabled():
+    """True when bundles would be written: ``MXNET_TPU_FLIGHT_DIR`` is
+    set (re-read per call, so tests can flip it) and metrics are on."""
+    return bool(os.environ.get("MXNET_TPU_FLIGHT_DIR")) \
+        and _metrics.metrics_enabled()
+
+
+def _exc_chain(exc):
+    """The exception and its ``__cause__``/``__context__`` chain as
+    JSON-safe records, outermost first."""
+    chain, seen = [], set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        chain.append({
+            "type": type(exc).__name__,
+            "message": str(exc),
+            "traceback": traceback.format_exception(
+                type(exc), exc, exc.__traceback__),
+        })
+        exc = exc.__cause__ or exc.__context__
+    return chain
+
+
+def _membership():
+    """Snapshot of the process-local replica-group directory (imported
+    lazily: kvstore_async itself records failures through here)."""
+    try:
+        from .. import kvstore_async as ka
+        with ka._DIR_LOCK:
+            return [{"group": list(k), "epoch": v["epoch"],
+                     "primary": v["primary"],
+                     "replicas": list(v["replicas"])}
+                    for k, v in ka._DIRECTORY.items()]
+    except Exception:
+        return []
+
+
+def _chaos_rules():
+    try:
+        from .. import chaos
+        return chaos.rules()
+    except Exception:
+        return []
+
+
+def _span_tail():
+    tail = _tracing.spans()[-_SPAN_TAIL:]
+    return [{"name": s.name, "cat": s.cat, "start_us": s.start_us,
+             "end_us": s.end_us, "tid": s.tid, "span_id": s.span_id,
+             "parent_id": s.parent_id,
+             "attrs": {k: repr(v) if not isinstance(
+                 v, (str, int, float, bool, type(None))) else v
+                 for k, v in s.attrs.items()}}
+            for s in tail]
+
+
+def _write_bundle(kind, exc, extra):
+    """Assemble and atomically publish one bundle; returns its path.
+    Module-level seam so tests can monkeypatch it to count calls."""
+    root = os.environ["MXNET_TPU_FLIGHT_DIR"]
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    name = "flight_%s_%s_%d" % (kind.replace("/", "_"), stamp, os.getpid())
+    final = os.path.join(root, name)
+    n = 0
+    while os.path.exists(final):       # same kind+second+pid: suffix
+        n += 1
+        final = os.path.join(root, "%s_%d" % (name, n))
+    tmp = final + ".tmp"
+    os.makedirs(tmp)
+    manifest = {
+        "kind": kind,
+        "time_unix": time.time(),
+        "time_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "exception_chain": _exc_chain(exc),
+        "chaos_rules": _chaos_rules(),
+        "membership": _membership(),
+        "extra": {k: repr(v) if not isinstance(
+            v, (str, int, float, bool, type(None))) else v
+            for k, v in extra.items()},
+    }
+    with open(os.path.join(tmp, "manifest.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2)
+    with open(os.path.join(tmp, "spans.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"spans": _span_tail()}, f)
+    with open(os.path.join(tmp, "metrics.prom"), "w",
+              encoding="utf-8") as f:
+        f.write(_metrics.dump_metrics())
+    os.rename(tmp, final)
+    return final
+
+
+def record_failure(kind, exc=None, **extra):
+    """Dump a postmortem bundle for a terminal fault; returns the
+    bundle path, or ``None`` when the recorder is off (constant-time
+    guard), the exception was already recorded by a nested hook, or the
+    dump itself failed (a recorder must never mask the real error).
+
+    ``kind`` names the seam (``"shard_failed"``, ``"engine_poison"``,
+    ``"fenced"``, ``"trainer.fit"``...); ``exc`` is the triggering
+    exception (its cause/context chain is serialized); ``extra``
+    keyword args land in the manifest verbatim.
+    """
+    if not flight_enabled():
+        return None
+    if exc is not None:
+        # one bundle per ROOT cause: a wrapper raised around an
+        # already-recorded exception (ShardFailedError chaining the
+        # ServerDeadError the ReplicatedClient just recorded) is the
+        # same failure climbing the stack, not a new one
+        node, seen = exc, set()
+        while node is not None and id(node) not in seen:
+            if getattr(node, _MARK, False):
+                return None
+            seen.add(id(node))
+            node = node.__cause__ or node.__context__
+        try:
+            setattr(exc, _MARK, True)
+        except (AttributeError, TypeError):
+            pass
+    try:
+        path = _write_bundle(kind, exc, extra)
+    except Exception:
+        return None
+    _M_BUNDLES.labels(kind).inc()
+    return path

@@ -304,6 +304,14 @@ class Family(object):
     def percentile(self, q):
         return self._default.percentile(q)
 
+    def total(self):
+        """Sum of every label-child's value (counters/gauges) — the
+        family-wide aggregate, e.g. ``chaos_fired_total`` over all
+        sites."""
+        with self._lock:
+            children = list(self._children.values())
+        return sum(c.value for c in children)
+
     def _reset(self):
         with self._lock:
             for child in self._children.values():

@@ -8,12 +8,23 @@ ACROSS threads — ``engine.push`` captures the pusher's context with
 ``trainer.flush`` (or ``prefetch``/RPC) span that scheduled it even
 though they run on different threads.
 
+A context can also be carried ACROSS processes: the kvstore client
+serializes its context with :func:`capture_wire_context` into the RPC
+frame header, and the server re-attaches it with
+:func:`attach_wire_context`, so push/pull/replication handling shows
+up as children of the worker's RPC span.  The wire token is
+``"<pid>:<span_id>"``; a same-pid token (the in-process test layout)
+parents locally, a cross-pid token is kept as a remote parent and
+stitched at export time via ``args.parent_uid``.  Corrupt tokens are
+silently ignored — tracing must never fail an RPC.
+
 Finished spans land in a bounded ring buffer (capacity
 ``MXNET_TPU_METRICS_TRACE_BUFFER``, default 65536; oldest evicted
-first).  Timestamps are ``time.monotonic()`` microseconds — the same
-CLOCK_MONOTONIC the native engine profiler stamps
-(``native/src/profiler.cc NowUs``), so Python spans and native engine
-ops merge onto ONE aligned timeline in
+first — each eviction of an unexported span counts in
+``spans_dropped_total``).  Timestamps are ``time.monotonic()``
+microseconds — the same CLOCK_MONOTONIC the native engine profiler
+stamps (``native/src/profiler.cc NowUs``), so Python spans and native
+engine ops merge onto ONE aligned timeline in
 ``exporters.export_chrome_trace``.
 
 Recording is off by default; the profiler façade
@@ -29,9 +40,18 @@ import os
 import threading
 import time
 
-__all__ = ["span", "capture_context", "attach_context", "enable_tracing",
-           "disable_tracing", "tracing_enabled", "spans", "clear_spans",
-           "Span"]
+from . import metrics as _metrics
+
+__all__ = ["span", "capture_context", "attach_context",
+           "capture_wire_context", "attach_wire_context",
+           "enable_tracing", "disable_tracing", "tracing_enabled",
+           "spans", "clear_spans", "Span"]
+
+#: Ring-buffer evictions of spans that were never exported (satellite:
+#: silent truncation makes merged traces misleading).
+_M_DROPPED = _metrics.counter(
+    "spans_dropped_total",
+    "Trace spans evicted from the ring buffer before export")
 
 _enabled = False
 _lock = threading.Lock()
@@ -128,6 +148,60 @@ class attach_context(object):
         return False
 
 
+def capture_wire_context():
+    """The calling thread's span context as a wire token
+    (``"<pid>:<span_id>"``), or ``None`` when tracing is off or no span
+    is open.  The token is a plain string so it rides in the kvstore
+    JSON frame header as an OPTIONAL field — old peers that do not know
+    it decode the frame unchanged."""
+    if not _enabled:
+        return None
+    st = getattr(_tls, "stack", None)
+    if not st:
+        return None
+    top = st[-1]
+    # under a foreign attach the top may already be a remote token;
+    # forward it unchanged so the chain keeps its true origin
+    if isinstance(top, str):
+        return top
+    return "%d:%d" % (os.getpid(), top)
+
+
+class attach_wire_context(object):
+    """Install a wire token received from a peer as the parent context
+    on THIS thread.  A same-pid token becomes a true local parent
+    (spans nest exactly as if in-thread); a cross-pid token is pushed
+    as-is and recorded as the child span's remote parent, stitched at
+    export time through ``args.parent_uid``.  ``None``, non-string, or
+    corrupt tokens are silently ignored (constant-time no-op) — a bad
+    trace header must never fail the RPC carrying it."""
+
+    __slots__ = ("_tok", "_pushed")
+
+    def __init__(self, token):
+        self._tok = token
+        self._pushed = False
+
+    def __enter__(self):
+        if not _enabled or not isinstance(self._tok, str):
+            return self
+        try:
+            pid_s, span_s = self._tok.split(":", 1)
+            pid, sid = int(pid_s), int(span_s)
+        except ValueError:
+            return self
+        if pid <= 0 or sid <= 0:
+            return self
+        _stack().append(sid if pid == os.getpid() else self._tok)
+        self._pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            _stack().pop()
+        return False
+
+
 class span(object):
     """Record a named span over the ``with`` body.
 
@@ -164,9 +238,12 @@ class span(object):
         st = _stack()
         if st and st[-1] == self._id:
             st.pop()
-        _buf().append(Span(self._name, self._cat, self._t0, end,
-                           threading.get_ident() % 100000, self._id,
-                           self._parent, self._attrs))
+        buf = _buf()
+        if len(buf) == buf.maxlen:
+            _M_DROPPED.inc()
+        buf.append(Span(self._name, self._cat, self._t0, end,
+                        threading.get_ident() % 100000, self._id,
+                        self._parent, self._attrs))
         return False
 
 

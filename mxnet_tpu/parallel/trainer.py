@@ -860,7 +860,36 @@ class ShardedTrainer:
         out single-server failures transparently inside push/pull
         (heartbeat failover + same-seq retry), so a mid-epoch primary
         kill neither aborts the loop nor trips any resume machinery.
+
+        A terminal failure escaping the loop (``ShardFailedError`` after
+        a whole-group loss, poison surfacing at a sync point, divergence
+        abort) triggers the flight recorder on its way out — with
+        ``MXNET_TPU_FLIGHT_DIR`` set, a postmortem bundle (span tail,
+        metrics snapshot, chaos rules, membership epochs, exception
+        chain) lands there before the exception reaches the caller.
         """
+        try:
+            return self._fit_impl(
+                train_data, eval_data=eval_data, num_epoch=num_epoch,
+                seed=seed, eval_metric=eval_metric,
+                initializer=initializer, state=state,
+                begin_epoch=begin_epoch, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every, resume=resume,
+                max_bad_steps=max_bad_steps, log_every=log_every,
+                logger=logger, batch_end_callback=batch_end_callback,
+                metric_every=metric_every, kvstore=kvstore)
+        except Exception as exc:
+            from ..observability import flight_recorder as _flight
+
+            _flight.record_failure("trainer.fit", exc)
+            raise
+
+    def _fit_impl(self, train_data, eval_data=None, num_epoch=1, seed=0,
+                  eval_metric="accuracy", initializer=None, state=None,
+                  begin_epoch=0, checkpoint_dir=None,
+                  checkpoint_every=None, resume=None, max_bad_steps=5,
+                  log_every=50, logger=None, batch_end_callback=None,
+                  metric_every=1, kvstore=None):
         import logging
         import time as _time
 

@@ -35,6 +35,10 @@ def test_bench_json_contract(pipeline):
     assert rec["step_ms_p50"] > 0
     assert rec["step_ms_p99"] >= rec["step_ms_p50"]
     assert rec["tokens_per_sec"] > 0
+    # additive observability counters: a clean bench fires no chaos and
+    # drops no spans, but the keys must always be present
+    assert rec["chaos_fired_total"] == 0
+    assert rec["spans_dropped_total"] == 0
     # pipeline_steps only appears when the pipelined path actually ran
     if pipeline > 1:
         assert rec["pipeline_steps"] == pipeline

@@ -1,0 +1,781 @@
+"""Distributed observability plane: cross-process trace propagation over
+the kvstore wire, cluster metrics federation, and the failure flight
+recorder — plus the satellites (span-drop accounting, launcher metrics
+ports, wire backward compatibility, federation golden file).
+
+Everything runs IN-PROCESS with thread-backed servers, same strategy as
+test_kvstore_replication.py: the wire format and the span machinery are
+identical across processes (tokens are ``"pid:span_id"`` strings), so a
+fabricated foreign pid exercises the true cross-process path.
+"""
+
+import collections
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos
+from mxnet_tpu import kvstore_async as ka
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import ServerDeadError, ShardFailedError
+from mxnet_tpu.kvstore_async import AsyncClient, AsyncServer
+from mxnet_tpu.observability import federation
+from mxnet_tpu.observability import flight_recorder
+from mxnet_tpu.observability import metrics as omet
+from mxnet_tpu.observability import tracing
+
+_GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "golden", "metrics_federated.txt")
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_isolated(monkeypatch):
+    """Sub-second retry/liveness envelope + a clean membership directory
+    for every test (mirrors test_kvstore_replication.py)."""
+    monkeypatch.setattr(AsyncClient, "_BACKOFF_CAP_S", 0.1)
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "2")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "3")
+    monkeypatch.setenv("MXNET_TPU_PS_DEAD_AFTER", "2")
+    monkeypatch.setenv("MXNET_TPU_KV_REPL_SYNC", "1")
+    ka.reset_membership()
+    yield
+    ka.reset_membership()
+
+
+def _sgd_pickle(lr=0.1):
+    import pickle
+
+    from mxnet_tpu import optimizer as opt
+
+    return pickle.dumps(opt.SGD(learning_rate=lr, wd=0.0))
+
+
+def _wait_until(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() >= deadline:
+            raise AssertionError("timed out waiting for %s" % what)
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# wire propagation: backward compatibility (satellite)
+# ---------------------------------------------------------------------------
+
+def test_frame_without_trace_decodes_identically():
+    """A frame encoded WITHOUT the optional trace field — what every
+    pre-existing peer sends — round-trips byte-exactly as before: no
+    trace key materializes anywhere."""
+    msg = {"op": "push", "rank": 3, "seq": 7,
+           "pairs": [("w", np.arange(4, dtype=np.float32))]}
+    payload = ka._encode_msg(dict(msg))
+    header = json.loads(payload[4:4 + int.from_bytes(payload[:4],
+                                                     "little")])
+    assert "trace" not in header
+    out = ka._decode_msg(payload)
+    assert out["op"] == "push" and out["rank"] == 3 and out["seq"] == 7
+    assert "trace" not in out
+    np.testing.assert_array_equal(out["pairs"][0][1], msg["pairs"][0][1])
+
+
+def test_frame_with_trace_rides_as_plain_header_field():
+    msg = {"op": "pull", "keys": ["w"], "trace": "1234:56"}
+    out = ka._decode_msg(ka._encode_msg(dict(msg)))
+    assert out["trace"] == "1234:56" and out["keys"] == ["w"]
+
+
+def test_corrupt_trace_never_fails_the_rpc():
+    """A garbled (or wrong-typed) trace header is ignored by the server:
+    the RPC succeeds and handling proceeds untraced."""
+    s = AsyncServer(secret="t").start()
+    try:
+        cli = AsyncClient(s.address, rank=0, heartbeat=False, secret="t")
+        obs.enable_tracing()
+        for bad in ("garbage", ":::", "12:xx", "-3:9", 123, ["7:7"]):
+            resp = cli._call_impl({"op": "stats", "trace": bad})
+            assert resp["applied_seq"] == 0
+        cli.close()
+    finally:
+        s.stop()
+
+
+def test_attach_wire_context_rejects_corrupt_tokens_silently():
+    obs.enable_tracing()
+    for bad in (None, 42, "nope", "a:b", "1", "-1:5", "0:0"):
+        with tracing.attach_wire_context(bad):
+            with tracing.span("child"):
+                pass
+        assert tracing.spans()[-1].parent_id == 0
+        obs.clear_spans()
+
+
+# ---------------------------------------------------------------------------
+# wire propagation: stitching
+# ---------------------------------------------------------------------------
+
+def test_rpc_span_parents_server_side_handling():
+    """The client's kv.rpc span context rides the frame header and the
+    server's kv.serve span becomes its child (same-pid: a true local
+    parent)."""
+    s = AsyncServer(secret="t").start()
+    try:
+        cli = AsyncClient(s.address, rank=0, heartbeat=False, secret="t")
+        obs.enable_tracing()
+        cli._call({"op": "init", "pairs": [("w", np.zeros(2,
+                                                          np.float32))]})
+        cli.close()
+    finally:
+        s.stop()
+    by_name = {}
+    for sp in tracing.spans():
+        by_name.setdefault(sp.name, []).append(sp)
+    (rpc,) = by_name["kv.rpc"]
+    (serve,) = by_name["kv.serve.init"]
+    assert rpc.attrs["op"] == "init"
+    assert serve.parent_id == rpc.span_id
+
+
+def test_replication_chains_under_the_serve_span():
+    """With a hot standby attached, the follower's replicate handling
+    parents under the primary's serve span — one tree for the whole
+    write path."""
+    p = AsyncServer(secret="t").start()
+    f = AsyncServer(secret="t").start()
+    try:
+        f.rejoin(p.address)
+        cli = AsyncClient(p.address, rank=0, heartbeat=False, secret="t")
+        obs.enable_tracing()
+        cli._call({"op": "init", "pairs": [("w", np.zeros(2,
+                                                          np.float32))]})
+        cli.close()
+    finally:
+        p.stop()
+        f.stop()
+    spans = {sp.name: sp for sp in tracing.spans()}
+    serve = spans["kv.serve.init"]
+    repl = spans["kv.serve.replicate"]
+    assert repl.parent_id == serve.span_id
+    assert serve.parent_id == spans["kv.rpc"].span_id
+
+
+def test_cross_pid_token_stitches_through_parent_uid():
+    """A token from a FOREIGN pid cannot be a local parent: the span
+    records it verbatim and the exporter emits it as args.parent_uid, so
+    merged per-process dumps stitch on span_uid == parent_uid."""
+    obs.enable_tracing()
+    with tracing.attach_wire_context("424242:7"):
+        # the remote parent is forwarded unchanged if re-captured here
+        assert tracing.capture_wire_context() == "424242:7"
+        with tracing.span("kv.serve.push", cat="kvstore"):
+            pass
+    child = tracing.spans()[-1]
+    assert child.parent_id == "424242:7"
+
+    ours = obs.export_chrome_trace(include_native=False, track="server")
+    peer = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 424242,
+         "args": {"name": "worker"}},
+        {"name": "kv.rpc", "cat": "kvstore", "ph": "X", "ts": 1, "dur": 9,
+         "pid": 424242, "tid": 1, "args": {"span_uid": "424242:7"}}]}
+    merged = obs.merge_chrome_traces([peer, ours])
+    events = merged["traceEvents"]
+    uid_of = {e["args"]["span_uid"]: e for e in events
+              if e.get("ph") == "X" and "span_uid" in e.get("args", {})}
+    stitched = [e for e in events if e.get("ph") == "X"
+                and e.get("args", {}).get("parent_uid") == "424242:7"]
+    assert stitched and stitched[0]["name"] == "kv.serve.push"
+    assert uid_of["424242:7"]["name"] == "kv.rpc"
+    tracks = {e["args"]["name"] for e in events
+              if e.get("name") == "process_name"}
+    assert tracks == {"worker", "server"}
+
+
+def test_merge_chrome_traces_accepts_files(tmp_path):
+    obs.enable_tracing()
+    with tracing.span("a"):
+        pass
+    path = str(tmp_path / "one.json")
+    obs.export_chrome_trace(path=path, include_native=False)
+    merged = obs.merge_chrome_traces(
+        [path, {"traceEvents": [{"name": "b", "ph": "X", "ts": 0,
+                                 "dur": 1, "pid": 1, "tid": 1}]}],
+        path=str(tmp_path / "merged.json"))
+    names = [e["name"] for e in merged["traceEvents"]]
+    assert "a" in names and "b" in names
+    with open(tmp_path / "merged.json") as fh:
+        assert json.load(fh) == merged
+
+
+def test_track_name_comes_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_TRACK", "worker rank 3")
+    trace = obs.export_chrome_trace(include_native=False)
+    meta = trace["traceEvents"][0]
+    assert meta["name"] == "process_name"
+    assert meta["args"]["name"] == "worker rank 3"
+
+
+# ---------------------------------------------------------------------------
+# spans_dropped_total (satellite)
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_eviction_counts_spans_dropped(monkeypatch):
+    monkeypatch.setattr(tracing, "_buffer", collections.deque(maxlen=2))
+    obs.enable_tracing()
+    for i in range(5):
+        with tracing.span("s%d" % i):
+            pass
+    assert omet.REGISTRY.get("spans_dropped_total").value == 3
+    assert [sp.name for sp in tracing.spans()] == ["s3", "s4"]
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+_SHARD0_TEXT = (
+    "# HELP kv_failover_total Successful client-driven failovers\n"
+    "# TYPE kv_failover_total counter\n"
+    "kv_failover_total 1\n"
+    "# HELP kv_replication_lag Primary log entries not yet acked\n"
+    "# TYPE kv_replication_lag gauge\n"
+    'kv_replication_lag{follower="127.0.0.1:9001"} 2\n'
+)
+_SHARD1_TEXT = (
+    "# HELP kv_fenced_total Primaries fenced by a higher epoch\n"
+    "# TYPE kv_fenced_total counter\n"
+    "kv_fenced_total 1\n"
+    "# HELP kv_heartbeat_age_seconds Seconds since the last heartbeat\n"
+    "# TYPE kv_heartbeat_age_seconds gauge\n"
+    'kv_heartbeat_age_seconds{server="s1"} 0.25\n'
+)
+
+
+def _golden_targets():
+    # the standby shares its primary's source text (the in-process
+    # layout): the series must federate exactly once, under the labels
+    # of the first member naming the source
+    return [
+        {"shard": 0, "role": "primary", "epoch": 1, "text": _SHARD0_TEXT},
+        {"shard": 0, "role": "standby", "epoch": 1, "text": _SHARD0_TEXT},
+        {"shard": 1, "role": "primary", "epoch": 0, "text": _SHARD1_TEXT},
+    ]
+
+
+def test_federated_exposition_matches_golden(monkeypatch):
+    """tests/golden/metrics_federated.txt pins the federated rendering:
+    member identity series, relabeled shard series (exactly-once for the
+    shared source), and the derived cluster_* health metrics."""
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+    out = obs.federate(_golden_targets())
+    with open(_GOLDEN, encoding="utf-8") as fh:
+        assert out == fh.read()
+
+
+def test_federation_dedups_shared_registry_exactly_once(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+    omet.REGISTRY.get("kv_failover_total").inc()
+    targets = [
+        {"shard": 0, "role": "primary", "epoch": 2,
+         "registry": omet.REGISTRY},
+        {"shard": 0, "role": "standby", "epoch": 2,
+         "registry": omet.REGISTRY},
+    ]
+    out = obs.federate(targets)
+    relabeled = [l for l in out.splitlines()
+                 if l.startswith("kv_failover_total{")]
+    assert len(relabeled) == 1
+    assert 'role="primary"' in relabeled[0] and relabeled[0].endswith(" 1")
+    assert 'cluster_server_info{shard="0",role="standby",epoch="2"} 1' \
+        in out
+    assert "cluster_failover_total 1" in out
+
+
+def test_federation_scrapes_http_targets(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+    omet.REGISTRY.get("kv_fenced_total").inc()
+    with obs.start_metrics_server(port=0) as srv:
+        out = obs.federate([{"shard": 3, "role": "primary", "epoch": 0,
+                             "url": srv.url}])
+    assert 'kv_fenced_total{shard="3",role="primary",epoch="0"} 1' in out
+    assert "cluster_fenced_total 1" in out
+
+
+def test_federation_counts_unreachable_members(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+
+    def _boom(target, timeout):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(federation, "_scrape_one", _boom)
+    out = obs.federate([{"shard": 0, "role": "primary", "epoch": 0,
+                         "text": "x 1\n"}])
+    assert "cluster_scrape_errors_total 1" in out
+    assert ('cluster_scrape_errors_total{shard="0",role="primary",'
+            'epoch="0"} 1') in out
+    # membership identity still rendered for the dead member
+    assert 'cluster_server_info{shard="0",role="primary",epoch="0"} 1' \
+        in out
+
+
+def test_federation_target_needs_a_source():
+    with pytest.raises(ValueError):
+        obs.federate([{"shard": 0, "role": "primary", "epoch": 0}])
+
+
+def test_federation_tolerates_malformed_exposition(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+    out = obs.federate([{"shard": 0, "role": "primary", "epoch": 0,
+                         "text": "# HELP broken\nnot a series\nok 3\n"}])
+    assert 'ok{shard="0",role="primary",epoch="0"} 3' in out
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def _exc_with_cause():
+    try:
+        try:
+            raise ValueError("root cause")
+        except ValueError as root:
+            raise RuntimeError("wrapper") from root
+    except RuntimeError as exc:
+        return exc
+
+
+def test_flight_bundle_contents(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable_tracing()
+    with tracing.span("kv.rpc", cat="kvstore", op="push"):
+        pass
+    inj = chaos.inject("kvstore.server_kill", "raise", seed=7,
+                       match="never-visited", limit=1)
+    try:
+        path = obs.record_failure("unit_test", _exc_with_cause(),
+                                  rank=3, note=object())
+    finally:
+        inj.remove()
+    assert path is not None and os.path.isdir(path)
+    assert os.path.basename(path).startswith("flight_unit_test_")
+    assert not [d for d in os.listdir(tmp_path) if d.endswith(".tmp")]
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["kind"] == "unit_test"
+    chain = manifest["exception_chain"]
+    assert [c["type"] for c in chain] == ["RuntimeError", "ValueError"]
+    assert "wrapper" in chain[0]["message"]
+    assert manifest["extra"]["rank"] == 3
+    assert isinstance(manifest["extra"]["note"], str)  # repr-coerced
+    assert any(r["site"] == "kvstore.server_kill"
+               for r in manifest["chaos_rules"])
+    with open(os.path.join(path, "spans.json")) as fh:
+        spans = json.load(fh)["spans"]
+    assert any(s["name"] == "kv.rpc" and s["attrs"]["op"] == "push"
+               for s in spans)
+    with open(os.path.join(path, "metrics.prom")) as fh:
+        prom = fh.read()
+    assert "kv_failover_total" in prom
+    assert omet.REGISTRY.get(
+        "flight_bundles_total").labels("unit_test").value == 1
+
+
+def test_flight_dedups_across_the_cause_chain(monkeypatch, tmp_path):
+    """One bundle per ROOT cause: re-recording the same exception — or a
+    wrapper chaining it — is a no-op, so a failure climbing the stack
+    (ReplicatedClient -> ServerGroup -> trainer.fit) dumps once."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    root = ServerDeadError("group lost")
+    assert obs.record_failure("replica_group_lost", root) is not None
+    assert obs.record_failure("replica_group_lost", root) is None
+    wrapper = ShardFailedError("fan-out failed")
+    wrapper.__cause__ = root
+    assert obs.record_failure("shard_failed", wrapper) is None
+    outer = RuntimeError("fit failed")
+    outer.__context__ = wrapper
+    assert obs.record_failure("trainer.fit", outer) is None
+    assert len(os.listdir(tmp_path)) == 1
+    # exception-free records (fencing) have no object to mark: each dumps
+    assert obs.record_failure("fenced", server_id=0) is not None
+    assert obs.record_failure("fenced", server_id=0) is not None
+    assert len(os.listdir(tmp_path)) == 3
+
+
+def test_flight_disabled_is_a_constant_time_guard(monkeypatch, tmp_path):
+    calls = []
+    monkeypatch.setattr(flight_recorder, "_write_bundle",
+                        lambda *a: calls.append(a))
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    assert obs.record_failure("x", RuntimeError("e")) is None
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    assert obs.flight_enabled() is False
+    assert obs.record_failure("x", RuntimeError("e")) is None
+    assert calls == []
+
+
+def test_flight_write_failure_never_masks_the_real_error(monkeypatch,
+                                                         tmp_path):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+
+    def _die(*a):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(flight_recorder, "_write_bundle", _die)
+    assert obs.record_failure("x", RuntimeError("e")) is None
+
+
+def test_engine_poison_writes_one_bundle(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    from mxnet_tpu import engine
+
+    def _boom():
+        raise RuntimeError("op failed")
+
+    v = engine.new_variable()
+    engine.push(_boom, mutable_vars=(v,), name="obs_test_op")
+    with pytest.raises(Exception):
+        engine.wait_for_var(v)
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("flight_engine_poison_")]
+    assert len(bundles) == 1
+    with open(os.path.join(tmp_path, bundles[0], "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["extra"]["op"] == "obs_test_op"
+
+
+def test_trainer_fit_records_a_bundle(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    with pytest.raises(Exception):
+        _trainer().fit(None, num_epoch=1, log_every=0)
+    assert [d for d in os.listdir(tmp_path)
+            if d.startswith("flight_trainer.fit_")]
+
+
+def test_fencing_records_a_bundle(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    p = AsyncServer(secret="r").start()
+    f = AsyncServer(secret="r").start()
+    try:
+        f.rejoin(p.address)
+        promoter = AsyncClient(f.address, rank=9, heartbeat=False,
+                               secret="r")
+        promoter._call({"op": "promote", "epoch": p.epoch + 1})
+        promoter.close()
+        stale = AsyncClient(p.address, rank=0, heartbeat=False,
+                            secret="r")
+        stale.set_optimizer(_sgd_pickle())
+        _wait_until(lambda: p.role == "fenced", what="zombie fencing")
+        stale.close()
+    finally:
+        p.stop()
+        f.stop()
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("flight_fenced_")]
+    assert len(bundles) == 1
+    with open(os.path.join(tmp_path, bundles[0], "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["extra"]["address"] == p.address
+
+
+# ---------------------------------------------------------------------------
+# launcher metrics ports (satellite)
+# ---------------------------------------------------------------------------
+
+class _FakePopen:
+    """Stands in for subprocess.Popen: records the env, self-reports a
+    server address through the launcher's addr-file channel, and exits
+    0 immediately."""
+
+    spawned = []
+
+    def __init__(self, cmd, env=None, stdout=None, stderr=None):
+        import io
+
+        type(self).spawned.append((list(cmd), dict(env or {})))
+        self.returncode = 0
+        self.stdout = io.BytesIO(b"")
+        self.stderr = io.BytesIO(b"")
+        addr_file = (env or {}).get("MXNET_TPU_SERVER_ADDR_FILE")
+        if addr_file:
+            with open(addr_file, "w") as fh:
+                fh.write("127.0.0.1:%d" % (9000 + len(type(self).spawned)))
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        pass
+
+    def send_signal(self, sig):
+        pass
+
+
+def _launch_mod():
+    import importlib.util
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "launch_under_test", os.path.join(repo, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_launcher_assigns_deterministic_metrics_ports(monkeypatch):
+    """--metrics-port-base: server process k (replicas count as slots)
+    serves on base+k; worker rank i on base + <server procs> + i."""
+    import argparse
+
+    launch = _launch_mod()
+    monkeypatch.setattr(launch.subprocess, "Popen", _FakePopen)
+    _FakePopen.spawned = []
+    args = argparse.Namespace(num_workers=2, num_servers=2, num_replicas=2,
+                              metrics_port_base=9300, platform="cpu",
+                              tag_output=False)
+    assert launch.launch_local(args, ["true"]) == 0
+    servers = [(c, e) for c, e in _FakePopen.spawned
+               if "mxnet_tpu._async_ps_main" in c]
+    workers = [(c, e) for c, e in _FakePopen.spawned
+               if "mxnet_tpu._async_ps_main" not in c]
+    assert len(servers) == 4 and len(workers) == 2
+    assert sorted(int(e["MXNET_TPU_METRICS_PORT"]) for _, e in servers) \
+        == [9300, 9301, 9302, 9303]
+    # shard i replica j sits at slot i*R+j
+    by_slot = {int(e["MXNET_TPU_METRICS_PORT"]) - 9300:
+               int(e["MXNET_TPU_SERVER_ID"]) for _, e in servers}
+    assert by_slot == {0: 0, 1: 0, 2: 1, 3: 1}
+    worker_ports = sorted(int(e["MXNET_TPU_METRICS_PORT"])
+                          for _, e in workers)
+    assert worker_ports == [9304, 9305]
+
+
+def test_launcher_metrics_ports_off_by_default(monkeypatch):
+    import argparse
+
+    launch = _launch_mod()
+    monkeypatch.setattr(launch.subprocess, "Popen", _FakePopen)
+    _FakePopen.spawned = []
+    args = argparse.Namespace(num_workers=1, num_servers=0, num_replicas=1,
+                              metrics_port_base=0, platform="cpu",
+                              tag_output=False)
+    assert launch.launch_local(args, ["true"]) == 0
+    for _, env in _FakePopen.spawned:
+        assert ("MXNET_TPU_METRICS_PORT" in env) == \
+            ("MXNET_TPU_METRICS_PORT" in os.environ)
+
+
+def test_publish_address_carries_the_metrics_port(monkeypatch):
+    """The published server record gains an OPTIONAL metrics_port field;
+    lookup_address only picks the fields it knows, so old readers keep
+    working."""
+    from jax._src import distributed
+
+    store = {}
+
+    class _FakeClient:
+        def key_value_set(self, key, value):
+            store[key] = value
+
+        def blocking_key_value_get(self, key, timeout_ms):
+            return store[key]
+
+    monkeypatch.setattr(distributed.global_state, "client", _FakeClient())
+    ka.publish_address("127.0.0.1:9999", secret="s", epoch=2,
+                       metrics_port=9301)
+    rec = json.loads(next(iter(store.values())))
+    assert rec == {"addr": "127.0.0.1:9999", "secret": "s", "epoch": 2,
+                   "metrics_port": 9301}
+    addr, secret = ka.lookup_address(timeout_s=1)
+    assert addr == "127.0.0.1:9999" and secret == "s"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2-shard fit under a seeded primary kill
+# ---------------------------------------------------------------------------
+
+import jax
+from jax.sharding import Mesh
+
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+B, D = 8, 6
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=32, seed=3):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, D).astype(np.float32),
+            rs.randint(0, 8, (n,)).astype(np.float32))
+
+
+def _trainer():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    return ShardedTrainer(_mlp(), mesh, data_shapes={"data": (B, D)},
+                          label_shapes={"softmax_label": (B,)},
+                          rescale_grad=1.0 / B)
+
+
+@pytest.mark.chaos
+def test_distributed_observability_acceptance(monkeypatch, tmp_path):
+    """The PR's acceptance gate: a 2-shard replicated fit with a seeded
+    primary kill produces (a) a merged chrome trace where a worker-side
+    KV RPC span has a server-side child stitched via the propagated
+    context, (b) a federated exposition carrying every shard's
+    role/epoch labels with failover counters exactly-once, and (c) one
+    flight bundle whose span tail includes the killed RPC and whose
+    metrics snapshot shows the fence/failover counters."""
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    monkeypatch.setenv("MXNET_TPU_KV_REPLICAS", "2")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(flight_dir))
+    secret = "obs-acceptance"
+    monkeypatch.setenv("MXNET_TPU_PS_SECRET", secret)
+
+    servers = []        # [(shard, server), ...]
+    groups = []
+    for sid in range(2):
+        p = AsyncServer(secret=secret, server_id=sid).start()
+        f = AsyncServer(secret=secret, server_id=sid).start()
+        f.rejoin(p.address)
+        servers += [(sid, p), (sid, f)]
+        groups.append("%s|%s" % (p.address, f.address))
+    monkeypatch.setenv("MXNET_TPU_ASYNC_PS_ADDRS", ",".join(groups))
+    killed_primary = servers[0][1]
+
+    obs.enable_tracing()
+    X, Y = _data()
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                      rescale_grad=1.0 / B, wd=0.0))
+    it = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=B)
+    inj = chaos.inject("kvstore.server_kill", "raise", seed=0,
+                       match="s0:primary:push", limit=1)
+    try:
+        _trainer().fit(it, num_epoch=2, seed=5, log_every=0, kvstore=kv)
+    finally:
+        inj.remove()
+    assert inj.fires == 1, "the seeded kill never fired"
+    assert killed_primary._killed
+    # a clean failover is an OBSERVED event, not a flight emergency
+    assert os.listdir(flight_dir) == []
+
+    # (a) merged chrome trace: worker-side kv.rpc -> server-side child
+    merged = obs.merge_chrome_traces(
+        [obs.export_chrome_trace(include_native=False, track="worker 0")])
+    xevents = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    uid_of = {e["args"]["span_uid"]: e for e in xevents}
+    stitched = [
+        (e, uid_of[e["args"]["parent_uid"]]) for e in xevents
+        if e["name"].startswith("kv.serve.")
+        and e.get("args", {}).get("parent_uid") in uid_of
+        and uid_of[e["args"]["parent_uid"]]["name"] == "kv.rpc"]
+    assert stitched, "no server-side span stitched under a kv.rpc span"
+    assert any(parent["args"].get("op") == "push"
+               for _, parent in stitched)
+
+    # (b) federated exposition: every live member's identity labels,
+    # process-global counters exactly-once (all threads share one
+    # registry — the dedup-by-source contract)
+    alive = [(sid, s) for sid, s in servers if not s._killed]
+    targets = [{"shard": sid, "role": s.role, "epoch": s.epoch,
+                "registry": omet.REGISTRY} for sid, s in alive]
+    fed = obs.federate(targets)
+    for sid, s in alive:
+        assert ('cluster_server_info{shard="%d",role="%s",epoch="%d"} 1'
+                % (sid, s.role, s.epoch)) in fed
+    roles = {sid: set() for sid, _ in alive}
+    for sid, s in alive:
+        roles[sid].add(s.role)
+    assert "primary" in roles[0]        # the promoted standby
+    assert roles[1] == {"primary", "follower"}
+    assert len([l for l in fed.splitlines()
+                if l.startswith("kv_failover_total{")]) == 1
+    assert "cluster_failover_total 1" in fed
+    assert "cluster_fenced_total 0" in fed
+
+    # (c) flight recorder: lose the whole group -> exactly ONE bundle
+    # (the wrapper ShardFailedError chains the recorded root cause)
+    for _, s in alive:
+        s.stop()
+    with pytest.raises(ShardFailedError):
+        kv._async.push([("fc1_weight", np.zeros((16, D), np.float32))])
+    for c in kv._async._clients:
+        c.close()
+    bundles = os.listdir(flight_dir)
+    assert len(bundles) == 1, bundles
+    assert bundles[0].startswith("flight_replica_group_lost_")
+    bundle = flight_dir / bundles[0]
+    with open(bundle / "manifest.json") as fh:
+        manifest = json.load(fh)
+    assert manifest["exception_chain"][0]["type"] == "ServerDeadError"
+    assert any(m["epoch"] >= 1 for m in manifest["membership"])
+    with open(bundle / "spans.json") as fh:
+        tail = json.load(fh)["spans"]
+    killed_rpc = [s for s in tail if s["name"] == "kv.rpc"
+                  and s["attrs"].get("op") == "push"
+                  and s["attrs"].get("server") == killed_primary.address]
+    assert killed_rpc, "span tail lost the killed RPC"
+    with open(bundle / "metrics.prom") as fh:
+        prom = fh.read()
+    assert "kv_failover_total 1" in prom
+    assert "kv_fenced_total 0" in prom
+
+
+def test_everything_is_a_guard_when_metrics_disabled(monkeypatch):
+    """MXNET_TPU_METRICS=0: propagation, federation, and the recorder
+    all reduce to constant-time guards — call-counts asserted."""
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    calls = {"capture": 0, "scrape": 0, "bundle": 0}
+    real_capture = tracing.capture_wire_context
+
+    def _count_capture():
+        calls["capture"] += 1
+        return real_capture()
+
+    monkeypatch.setattr(tracing, "capture_wire_context", _count_capture)
+    monkeypatch.setattr(
+        federation, "_scrape_one",
+        lambda *a, **k: calls.__setitem__("scrape",
+                                          calls["scrape"] + 1))
+    monkeypatch.setattr(
+        flight_recorder, "_write_bundle",
+        lambda *a: calls.__setitem__("bundle", calls["bundle"] + 1))
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", "/tmp/never-used")
+
+    # propagation: tracing off -> the client RPC path never captures
+    # and records nothing for THIS rpc (straggler spans from earlier
+    # tests' heartbeat threads may still drain into the shared buffer)
+    s = AsyncServer(secret="t").start()
+    try:
+        cli = AsyncClient(s.address, rank=0, heartbeat=False, secret="t")
+        cli._call({"op": "stats"})
+        cli.close()
+        assert not [sp for sp in tracing.spans()
+                    if sp.attrs.get("server") in (s.address, s.server_id)]
+    finally:
+        s.stop()
+
+    # federation: render is empty and never scrapes
+    assert obs.federate(_golden_targets()) == ""
+
+    # flight recorder: nothing written
+    assert obs.record_failure("x", RuntimeError("e")) is None
+
+    assert calls == {"capture": 0, "scrape": 0, "bundle": 0}

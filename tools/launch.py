@@ -79,7 +79,9 @@ def launch_servers(args, coordinator=None):
     replicas = max(1, getattr(args, "num_replicas", 1))
     procs = []
 
-    def spawn(shard, tag, primary_addr=None):
+    metrics_base = getattr(args, "metrics_port_base", 0) or 0
+
+    def spawn(shard, tag, slot, primary_addr=None):
         addr_file = os.path.join(addr_dir, "server_%s.addr" % tag)
         env = dict(os.environ)
         # servers are host-side: never let one grab (or hang on) a chip
@@ -90,6 +92,11 @@ def launch_servers(args, coordinator=None):
         env["MXNET_TPU_SERVER_ID"] = str(shard)
         env["MXNET_TPU_NUM_SERVERS"] = str(args.num_servers)
         env["MXNET_TPU_PS_SECRET"] = secret
+        if metrics_base:
+            # deterministic federation scrape targets: server process at
+            # slot k (replicas count as their own slots) serves /metrics
+            # on base+k; workers continue after the server block
+            env["MXNET_TPU_METRICS_PORT"] = str(metrics_base + slot)
         if primary_addr:
             env["MXNET_TPU_SERVER_PRIMARY"] = primary_addr
         if coordinator:
@@ -121,12 +128,13 @@ def launch_servers(args, coordinator=None):
     deadline = time.time() + 90
     try:
         # primaries first: followers need the primary address to rejoin
-        primaries = [spawn(i, "%d" % i) for i in range(args.num_servers)]
+        primaries = [spawn(i, "%d" % i, i * replicas)
+                     for i in range(args.num_servers)]
         shard_addrs = [[collect(p, f, "server %d" % i, deadline)]
                        for i, (p, f) in enumerate(primaries)]
         for i in range(args.num_servers):
             for j in range(1, replicas):
-                p, f = spawn(i, "%d_%d" % (i, j),
+                p, f = spawn(i, "%d_%d" % (i, j), i * replicas + j,
                              primary_addr=shard_addrs[i][0])
                 shard_addrs[i].append(
                     collect(p, f, "server %d replica %d" % (i, j), deadline))
@@ -162,6 +170,15 @@ def launch_local(args, cmd):
         env["JAX_PLATFORMS"] = args.platform
         env["MXNET_TPU_PLATFORM"] = args.platform  # wins over site-hook presets
         env.update(server_env)
+        metrics_base = getattr(args, "metrics_port_base", 0) or 0
+        if metrics_base:
+            # workers take the ports after the server block: base +
+            # (num server procs incl. replicas) + worker rank
+            server_slots = (args.num_servers
+                            * max(1, getattr(args, "num_replicas", 1))
+                            if args.num_servers > 0 else 0)
+            env["MXNET_TPU_METRICS_PORT"] = str(
+                metrics_base + server_slots + i)
         procs.append(subprocess.Popen(cmd, env=env,
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE))
@@ -240,6 +257,9 @@ def launch_ssh(args, cmd):
                        "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
                        "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s"
                        % (port, i, args.num_servers, host))
+                if args.metrics_port_base:
+                    env += (" MXNET_TPU_METRICS_PORT=%d"
+                            % (args.metrics_port_base + slot))
                 if j > 0:
                     env += " MXNET_TPU_SERVER_PRIMARY=%s" % group[0]
                 remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
@@ -251,11 +271,16 @@ def launch_ssh(args, cmd):
         server_env = ("MXNET_TPU_ASYNC_PS_ADDRS='%s' MXNET_TPU_NUM_SERVERS=%d "
                       % (",".join("|".join(g) for g in shard_addrs),
                          args.num_servers))
+    server_slots = (args.num_servers * max(1, args.num_replicas)
+                    if args.num_servers > 0 else 0)
     workers = []
     for i in range(args.num_workers):
         env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
                "MXNET_TPU_PROC_ID=%d %s"
                % (coordinator, args.num_workers, i, server_env))
+        if args.metrics_port_base:
+            env += ("MXNET_TPU_METRICS_PORT=%d "
+                    % (args.metrics_port_base + server_slots + i))
         remote = "cd %s && %s %s" % (os.getcwd(), env, " ".join(cmd))
         if secret:
             workers.append(_ssh_with_secret(hosts[i], remote, secret))
@@ -287,6 +312,14 @@ def main():
     parser.add_argument("--server-port-base", type=int, default=9700,
                         help="first PS port for --launcher ssh (server i "
                              "listens on base+i; local mode self-assigns)")
+    parser.add_argument("--metrics-port-base", type=int, default=0,
+                        help="export MXNET_TPU_METRICS_PORT=base+slot to "
+                             "every launched process so each serves its "
+                             "own /metrics endpoint on a deterministic "
+                             "port: server process k (replicas count as "
+                             "slots) gets base+k, worker rank i gets "
+                             "base+<server procs>+i — the scrape targets "
+                             "for observability.federation (0 = off)")
     parser.add_argument("--launcher", choices=["local", "ssh"],
                         default="local")
     parser.add_argument("-H", "--hostfile", type=str, default=None)
