@@ -28,7 +28,11 @@ chaos:
 trace:
 	python tools/trace_fit.py
 
+watchdog:
+	python tools/watchdog_fit.py
+
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-fast bench dryrun dist-test chaos trace clean
+.PHONY: all native test test-fast bench dryrun dist-test chaos trace \
+	watchdog clean

@@ -65,6 +65,31 @@ def _obs_counters():
     }
 
 
+# bump when the emitted keys change shape (keys are only ever ADDED —
+# consumers keying on schema_version never break on older rows)
+_SCHEMA_VERSION = 3
+
+
+def _provenance():
+    """Additive provenance keys: the JSON schema revision and the git
+    commit the number was measured at — the fields a regression tracker
+    needs to pin 'which code produced this row'.  ``BENCH_GIT_SHA``
+    overrides (CI passes the exact sha); outside a work tree the sha is
+    ``"unknown"``, never an error."""
+    sha = os.environ.get("BENCH_GIT_SHA")
+    if not sha:
+        import subprocess
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except (OSError, subprocess.SubprocessError):
+            sha = "unknown"
+    return {"schema_version": _SCHEMA_VERSION, "git_sha": sha}
+
+
 def transformer_main():
     """Transformer-LM training throughput (the Pallas flash-attention
     path) + MFU.  Select with BENCH_MODEL=transformer; prints the same
@@ -173,6 +198,7 @@ def transformer_main():
         "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
         "tokens_per_sec": round(tokens_s, 1),
         **_obs_counters(),
+        **_provenance(),
         "mfu": round(mfu, 4), "n_params": n_params,
         **({"n_params_active": n_active} if ffn == "moe" else {}),
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
@@ -290,6 +316,7 @@ def main():
         "step_ms_p50": p50_ms, "step_ms_p99": p99_ms,
         "tokens_per_sec": round(img_s, 2),
         **_obs_counters(),
+        **_provenance(),
         **({"pipeline_steps": pipeline} if pipeline > 1 else {}),
     }))
 
@@ -388,6 +415,7 @@ def _emit_tunnel_down(reason):
         "tunnel_down": True,
         "error": "accelerator unreachable (%s); not a perf regression"
                  % reason,
+        **_provenance(),
     }
     if unit == "img/s":  # the driver-verified record is a ResNet capture
         verified = _last_driver_verified()
@@ -453,6 +481,7 @@ def _guarded_main():
         "metric": cpu_metric if on_cpu else tpu_metric, "value": 0.0,
         "unit": unit, "vs_baseline": 0.0,
         "error": (detail or "unknown")[:300],
+        **_provenance(),
     }))
 
 

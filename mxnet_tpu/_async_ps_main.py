@@ -31,11 +31,20 @@ def main():
     # /metrics when the launcher (--metrics-port-base) or the job hands
     # it a port; failure to bind must not take down the shard
     metrics = None
+    watchdog = None
+    if os.environ.get("MXNET_TPU_WATCHDOG", "").lower() not in (
+            "", "0", "false", "no"):
+        # default SLO rules over this process's own registry; terminal
+        # alerts route through the flight recorder (when enabled)
+        from .observability import Watchdog, default_rules
+
+        watchdog = Watchdog(default_rules())
+        watchdog.start()
     if os.environ.get("MXNET_TPU_METRICS_PORT"):
         try:
             from .observability import start_metrics_server
 
-            metrics = start_metrics_server()
+            metrics = start_metrics_server(watchdog=watchdog)
             logging.info("async PS shard %d metrics at %s", server_id,
                          metrics.url)
         except OSError:
@@ -69,6 +78,8 @@ def main():
                  server.address, server.role)
     server.wait_shutdown()
     server.stop()
+    if watchdog is not None:
+        watchdog.stop()
     if metrics is not None:
         metrics.close()
 

@@ -150,6 +150,12 @@ _M_REPL_LAG = _metrics.gauge(
     "kv_replication_lag",
     "Primary log entries not yet acked by the follower (seqno delta)",
     ["follower"])
+_M_SERVE = _metrics.histogram(
+    "kv_serve_seconds",
+    "Server-side request handling latency by op and serving shard "
+    "(REPL_SYNC follower-ack waits included; heartbeat/stats probes "
+    "excluded) — the federation derives per-shard straggler skew from "
+    "these series", ["op", "server"])
 
 
 # -- tunables, read LAZILY so jobs and tests can reconfigure timeouts
@@ -905,6 +911,10 @@ class AsyncServer:
     # -- message dispatch (runs on handler threads) --------------------
     def dispatch(self, msg):
         op = msg.get("op")
+        # serve latency starts HERE: a chaos delay below (the slow-shard
+        # injection) and the tail replication latch wait both belong to
+        # what the worker experienced from this shard
+        t_serve = time.monotonic()
         # the pusher's span context travels as an OPTIONAL header field;
         # a frame without one (old peer) or with a corrupt one attaches
         # nothing — tracing must never fail the RPC (attach_wire_context
@@ -940,6 +950,10 @@ class AsyncServer:
                 "AsyncServer s%d: follower ack for entry rseq=%d timed out "
                 "after %.1fs (replication lagging)", self.server_id,
                 latch.rseq, _repl_timeout_s())
+        if op not in ("heartbeat", "stats"):
+            # probes would drown the data ops' signal in the skew series
+            _M_SERVE.labels(str(op), str(self.server_id)).observe(
+                time.monotonic() - t_serve)
         return resp
 
     def _dispatch(self, msg):

@@ -21,6 +21,15 @@ The runtime's telemetry layer (the subsystem the paper's
 - :mod:`~mxnet_tpu.observability.flight_recorder` — atomically dump a
   postmortem bundle (span tail, metrics snapshot, chaos rules,
   membership epochs, exception chain) when a terminal fault surfaces.
+- :mod:`~mxnet_tpu.observability.attribution` — per-step wall-time
+  breakdown (data wait / placement / compute / kv / flush + a derived
+  ``unattributed`` residual that keeps the books honest), jit-cache
+  compile accounting, and live-buffer/HBM watermark sampling.
+- :mod:`~mxnet_tpu.observability.watchdog` — declarative SLO rules
+  (threshold / burn-rate window / rolling-baseline regression)
+  evaluated against the local registry or a federated view; firing
+  alerts surface as ``cluster_alert`` metrics, an ``/alerts`` JSON
+  endpoint, and — at terminal severity — flight-recorder bundles.
 
 Instrumented out of the box: engine push/run/poison per lane, prefetch
 occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
@@ -43,6 +52,9 @@ from .exporters import (render_prometheus, start_metrics_server,
                         MetricsServer)
 from .federation import FederatedCollector, federate
 from .flight_recorder import record_failure, flight_enabled
+from .attribution import (attributor, StepAttribution, sample_memory,
+                          attribution_table, format_attribution, PHASES)
+from .watchdog import Rule, Alert, Watchdog, default_rules
 
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
@@ -54,4 +66,7 @@ __all__ = [
     "merge_chrome_traces", "MetricsServer",
     "FederatedCollector", "federate",
     "record_failure", "flight_enabled",
+    "attributor", "StepAttribution", "sample_memory",
+    "attribution_table", "format_attribution", "PHASES",
+    "Rule", "Alert", "Watchdog", "default_rules",
 ]

@@ -1,0 +1,229 @@
+"""Step-time attribution + compile/memory accounting helpers.
+
+The metrics plane (PRs 4-5) answers *what happened*; this module is the
+*where did the time go* layer.  ``ShardedTrainer``'s fit loops time each
+phase of a step — data wait (prefetch stall / iterator pull), host→device
+placement, compute dispatch, kv push/pull, metric-flush readback — into
+one labeled histogram, ``trainer_step_phase_seconds{phase=}``, and close
+the books against the measured wall time: whatever the phases did NOT
+cover is observed under ``phase="unattributed"``.  That residual makes
+the breakdown falsifiable — by construction the per-phase sums plus the
+residual equal the ``trainer_step_seconds`` sum, and a tier-1 test
+asserts it within 5%, so a phase timer that silently stops covering its
+segment shows up as a growing residual instead of a quietly wrong chart.
+
+Usage in a loop body (the trainer's fit paths)::
+
+    att = attribution.attributor()          # _NULL when metrics are off
+    t0 = time.monotonic()
+    with att.phase("data_wait"):
+        batch = next(it)
+    with att.phase("compute"):
+        outs = step(...)
+    att.close(time.monotonic() - t0)        # observes phases + residual
+
+With ``MXNET_TPU_METRICS=0`` :func:`attributor` returns a shared no-op
+singleton: no clock reads, no allocation — the same constant-time-guard
+contract every handle method honors.
+
+:func:`sample_memory` is the companion accounting for *where did the
+memory go*: live-buffer bytes (``jax.live_arrays()`` — works on every
+backend) plus the backend allocator's in-use/peak bytes per device when
+``device.memory_stats()`` exposes them (TPU/GPU HBM; CPU returns
+nothing).  The trainer samples it at checkpoint saves and pipelined
+flush boundaries — the points where the live set is a meaningful
+watermark, not mid-dispatch churn.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from . import metrics as _metrics
+
+__all__ = ["PHASES", "attributor", "StepAttribution", "sample_memory",
+           "attribution_table", "format_attribution"]
+
+#: The phases the fit loops attribute; ``unattributed`` is derived.
+PHASES = ("data_wait", "placement", "compute", "kv", "flush")
+
+_M_PHASE = _metrics.histogram(
+    "trainer_step_phase_seconds",
+    "Wall time one fit-loop phase took per step (per flush when "
+    "pipelined); phases plus the derived 'unattributed' residual sum "
+    "to trainer_step_seconds", ["phase"])
+
+# pre-resolved handles: the loop records through these, never labels()
+_H_PHASE = {p: _M_PHASE.labels(p) for p in PHASES}
+_H_RESIDUAL = _M_PHASE.labels("unattributed")
+
+_M_LIVE = _metrics.gauge(
+    "memory_live_buffer_bytes",
+    "Bytes held by live device buffers at the last sample point "
+    "(device='all' sums jax.live_arrays(); per-device series come from "
+    "the backend allocator's bytes_in_use when it reports one)",
+    ["device"])
+_M_PEAK = _metrics.gauge(
+    "memory_peak_bytes",
+    "Backend allocator peak bytes in use, per device (HBM watermark; "
+    "absent on backends whose memory_stats() reports nothing)",
+    ["device"])
+_M_LIVE_WM = _metrics.gauge(
+    "memory_live_buffer_watermark_bytes",
+    "High-water mark of memory_live_buffer_bytes{device='all'} across "
+    "sample points since the last registry reset")
+
+
+class _PhaseTimer(object):
+    """Times one ``with`` block into its attribution accumulator."""
+
+    __slots__ = ("_att", "_name", "_t0")
+
+    def __init__(self, att, name):
+        self._att = att
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = _time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._att.add(self._name, _time.monotonic() - self._t0)
+        return False
+
+
+class StepAttribution(object):
+    """Accumulates per-phase wall time for ONE step/flush; ``close``
+    observes every recorded phase and the residual against the caller's
+    wall-clock measurement.  An instance that is never closed (skipped
+    replay batch, loop exit) records nothing."""
+
+    __slots__ = ("_acc",)
+
+    def __init__(self):
+        self._acc = {}
+
+    def phase(self, name):
+        """Context manager timing ``name`` (accumulates on re-entry)."""
+        return _PhaseTimer(self, name)
+
+    def add(self, name, seconds):
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    def close(self, wall_s):
+        """Observe the accumulated phases; whatever ``wall_s`` they do
+        not cover lands in ``phase="unattributed"``."""
+        covered = 0.0
+        for name, v in self._acc.items():
+            _H_PHASE[name].observe(v)
+            covered += v
+        _H_RESIDUAL.observe(max(wall_s - covered, 0.0))
+        self._acc.clear()
+
+
+class _NullAttribution(object):
+    """Shared no-op attributor for the metrics-disabled path: no clock
+    reads, no per-step allocation."""
+
+    __slots__ = ()
+
+    def phase(self, name):
+        return _NULL_TIMER
+
+    def add(self, name, seconds):
+        pass
+
+    def close(self, wall_s):
+        pass
+
+
+class _NullTimer(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TIMER = _NullTimer()
+_NULL = _NullAttribution()
+
+
+def attributor():
+    """A fresh :class:`StepAttribution` — or the shared no-op singleton
+    when ``MXNET_TPU_METRICS=0`` (constant-time guard)."""
+    if not _metrics.metrics_enabled():
+        return _NULL
+    return StepAttribution()
+
+
+def sample_memory():
+    """Sample live-buffer and allocator memory gauges (see module doc).
+    Constant-time guard when metrics are disabled; any backend that
+    can't report simply contributes nothing."""
+    if not _metrics.metrics_enabled():
+        return
+    import jax
+
+    total = 0
+    try:
+        for a in jax.live_arrays():
+            try:
+                total += int(a.nbytes)
+            except (AttributeError, TypeError):
+                pass
+    except Exception:
+        return
+    _M_LIVE.labels("all").set(float(total))
+    if total > (_M_LIVE_WM.value or 0.0):
+        _M_LIVE_WM.set(float(total))
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if "bytes_in_use" in stats:
+            _M_LIVE.labels("dev%d" % d.id).set(float(stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            _M_PEAK.labels("dev%d" % d.id).set(
+                float(stats["peak_bytes_in_use"]))
+
+
+def attribution_table(registry=None):
+    """The attribution snapshot as rows ``(phase, count, total_s,
+    share)`` sorted by total time, plus a trailing ``("wall", ...)`` row
+    from ``trainer_step_seconds`` — ``share`` is each phase's fraction
+    of that wall sum (None when no steps ran)."""
+    reg = registry or _metrics.REGISTRY
+    fam = reg.get("trainer_step_phase_seconds")
+    wall = reg.get("trainer_step_seconds")
+    wall_sum = wall_count = 0
+    if wall is not None and wall._default is not None:
+        wall_sum, wall_count = wall._default.sum, wall._default.count
+    rows = []
+    if fam is not None:
+        with fam._lock:
+            children = dict(fam._children)
+        for key, child in children.items():
+            if not child.count:
+                continue
+            share = child.sum / wall_sum if wall_sum > 0 else None
+            rows.append((key[0], child.count, child.sum, share))
+    rows.sort(key=lambda r: -r[2])
+    rows.append(("wall", wall_count, wall_sum,
+                 1.0 if wall_sum > 0 else None))
+    return rows
+
+
+def format_attribution(registry=None):
+    """:func:`attribution_table` rendered as an aligned text table."""
+    lines = ["%-14s %8s %12s %7s" % ("phase", "count", "total_s", "share")]
+    for phase, count, total, share in attribution_table(registry):
+        lines.append("%-14s %8d %12.4f %7s"
+                     % (phase, count, total,
+                        "-" if share is None else "%5.1f%%" % (100 * share)))
+    return "\n".join(lines)

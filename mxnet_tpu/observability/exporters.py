@@ -68,13 +68,19 @@ class MetricsServer(object):
         return False
 
 
-def start_metrics_server(port=None, addr="127.0.0.1", registry=None):
+def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
+                         watchdog=None):
     """Serve ``/metrics`` on a daemon thread; returns a
     :class:`MetricsServer`.
 
     ``port=None`` reads ``MXNET_TPU_METRICS_PORT`` (default 0 = a
     kernel-assigned free port, reported via ``.port``).  Binds loopback
     unless ``addr`` says otherwise — the exposition is unauthenticated.
+
+    With ``watchdog=`` (a :class:`~.watchdog.Watchdog`), the endpoint
+    also serves ``/alerts``: each GET runs an evaluation pass and
+    returns the firing alerts as JSON — the pull-based twin of the
+    watchdog's background loop.
     """
     import http.server
 
@@ -84,12 +90,18 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None):
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.split("?")[0] not in ("/metrics", "/"):
+            path = self.path.split("?")[0]
+            if path == "/alerts" and watchdog is not None:
+                body = watchdog.render_alerts().encode("utf-8")
+                ctype = "application/json; charset=utf-8"
+            elif path in ("/metrics", "/"):
+                body = reg.render().encode("utf-8")
+                ctype = CONTENT_TYPE
+            else:
                 self.send_error(404)
                 return
-            body = reg.render().encode("utf-8")
             self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -39,6 +39,7 @@ guard like every other handle.
 
 from __future__ import annotations
 
+import os
 import re
 import urllib.request
 
@@ -49,6 +50,29 @@ __all__ = ["FederatedCollector", "federate"]
 _IDENTITY = ("shard", "role", "epoch")
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: Server-side ops whose ``kv_serve_seconds`` latency counts toward a
+#: shard's straggler score (the data plane; heartbeats/replication and
+#: control ops would mask a slow shard behind cheap chatter).
+_DATA_OPS = frozenset({"push", "pull", "init"})
+
+
+def _label_dict(labelbody):
+    """``'a="x",b="y"'`` → ``{"a": "x", "b": "y"}`` (tolerant: unparsed
+    fragments are dropped)."""
+    return dict(_LABEL_RE.findall(labelbody))
+
+
+def _skew_threshold():
+    """Max/min latency ratio past which the slowest member is named in
+    ``cluster_straggler_info`` (``MXNET_TPU_WATCHDOG_STRAGGLER_SKEW``)."""
+    try:
+        return float(os.environ.get("MXNET_TPU_WATCHDOG_STRAGGLER_SKEW",
+                                    "2.0"))
+    except ValueError:
+        return 2.0
 
 
 def _scrape_one(target, timeout):
@@ -154,6 +178,8 @@ class FederatedCollector(object):
         values = {}          # bare series name -> [float] across members
         errors = []          # identity pair strings of failed scrapes
         seen = {}            # source key -> True
+        serve = {}           # server label -> [sum_s, count] (data ops)
+        wsteps = {}          # member name -> [sum_s, count] (worker steps)
         for t in self.targets:
             key = _source_key(t)
             if key in seen:
@@ -165,6 +191,8 @@ class FederatedCollector(object):
                 errors.append(_identity_pairs(t))
                 continue
             ident = _identity_pairs(t)
+            member = "%s:%s:%s" % (t.get("shard", ""), t.get("role", ""),
+                                   t.get("epoch", ""))
             for fam_name, fam in _parse(text).items():
                 slot = merged.setdefault(
                     fam_name, {"help": fam["help"], "type": fam["type"],
@@ -175,9 +203,25 @@ class FederatedCollector(object):
                     slot["lines"].append(
                         "%s %s\n" % (_relabel(name, labels, ident), value))
                     try:
-                        values.setdefault(name, []).append(float(value))
+                        fval = float(value)
                     except ValueError:
-                        pass
+                        continue
+                    values.setdefault(name, []).append(fval)
+                    # straggler inputs: per-shard serve latency (the
+                    # server label distinguishes shards even when an
+                    # in-process layout shares one registry) and
+                    # per-member worker step latency
+                    if name in ("kv_serve_seconds_sum",
+                                "kv_serve_seconds_count"):
+                        ld = _label_dict(labels or "")
+                        if ld.get("op") in _DATA_OPS:
+                            acc = serve.setdefault(ld.get("server", "?"),
+                                                   [0.0, 0.0])
+                            acc[0 if name.endswith("_sum") else 1] += fval
+                    elif name in ("trainer_step_seconds_sum",
+                                  "trainer_step_seconds_count"):
+                        acc = wsteps.setdefault(member, [0.0, 0.0])
+                        acc[0 if name.endswith("_sum") else 1] += fval
 
         # families sorted by name; series keep scrape order (histogram
         # buckets must stay in ascending-le order, which lexical
@@ -208,6 +252,53 @@ class FederatedCollector(object):
         derived("cluster_fenced_total",
                 "Fenced primaries summed across all members", "counter",
                 sum(values.get("kv_fenced_total", [])))
+
+        # -- straggler detection: per-shard / per-worker mean latency,
+        # the skew ratio, and a row NAMING the slowest member when the
+        # skew crosses the threshold -----------------------------------
+        shard_lat = {k: s / c for k, (s, c) in serve.items() if c}
+        worker_lat = {k: s / c for k, (s, c) in wsteps.items() if c}
+        if shard_lat:
+            w("# HELP cluster_shard_serve_seconds Mean data-plane serve "
+              "latency per shard (push/pull/init)\n")
+            w("# TYPE cluster_shard_serve_seconds gauge\n")
+            for k in sorted(shard_lat):
+                w('cluster_shard_serve_seconds{server="%s"} %s\n'
+                  % (_metrics._fmt_label(k),
+                     _metrics._fmt_value(shard_lat[k])))
+        if worker_lat:
+            w("# HELP cluster_step_latency_seconds Mean trainer step "
+              "latency per federation member\n")
+            w("# TYPE cluster_step_latency_seconds gauge\n")
+            for k in sorted(worker_lat):
+                w('cluster_step_latency_seconds{member="%s"} %s\n'
+                  % (_metrics._fmt_label(k),
+                     _metrics._fmt_value(worker_lat[k])))
+        skews = []           # (kind, skew, slowest member)
+        for kind, lat in (("shard", shard_lat), ("worker", worker_lat)):
+            if len(lat) < 2:
+                continue
+            slowest = max(lat, key=lat.get)
+            floor = max(min(lat.values()), 1e-12)
+            skews.append((kind, lat[slowest] / floor, slowest))
+        if skews:
+            w("# HELP cluster_straggler_skew Slowest/fastest mean-latency "
+              "ratio across members of one kind\n")
+            w("# TYPE cluster_straggler_skew gauge\n")
+            for kind, skew, _ in skews:
+                w('cluster_straggler_skew{kind="%s"} %s\n'
+                  % (kind, _metrics._fmt_value(skew)))
+        threshold = _skew_threshold()
+        stragglers = [(kind, skew, who) for kind, skew, who in skews
+                      if skew >= threshold]
+        if stragglers:
+            w("# HELP cluster_straggler_info The slowest member of each "
+              "kind whose skew exceeds the threshold\n")
+            w("# TYPE cluster_straggler_info gauge\n")
+            for kind, skew, who in stragglers:
+                w('cluster_straggler_info{kind="%s",member="%s"} 1\n'
+                  % (kind, _metrics._fmt_label(who)))
+
         w("# HELP cluster_scrape_errors_total Members whose source "
           "could not be scraped this pass\n")
         w("# TYPE cluster_scrape_errors_total counter\n")

@@ -1,0 +1,428 @@
+"""Watchdog: declarative SLO rules evaluated over the metrics plane.
+
+The observability plane (PRs 4-5) is passive — it records and renders,
+and a human decides whether the run is healthy.  The watchdog closes
+that loop: a set of declarative :class:`Rule`\\ s is evaluated against a
+metrics source — the local registry, a :class:`~.federation.
+FederatedCollector` (cluster-wide), or raw exposition text — and the
+firing set is exposed three ways:
+
+- as metrics: ``cluster_alert{alert,severity}`` is 1 while firing and
+  ``cluster_alerts_fired_total{alert}`` counts rising edges (so "fired
+  exactly once" is a testable statement);
+- as JSON: the ``/alerts`` endpoint (``start_metrics_server(...,
+  watchdog=)`` or :meth:`Watchdog.serve`) evaluates on GET and returns
+  the firing list;
+- as flight-recorder bundles: a rule with ``severity="terminal"``
+  routes its rising edge through :func:`~.flight_recorder.
+  record_failure` — one postmortem bundle per firing episode, with the
+  span tail and metrics snapshot that existed at the transition.
+
+Three rule kinds cover the SLO shapes the plane needs:
+
+``threshold``
+    the stat compared against ``threshold``, optionally sustained for
+    ``for_s`` seconds before firing (gauge-style conditions: heartbeat
+    age, replication lag, straggler skew).
+``increase``
+    the stat's increase over the trailing ``window_s`` compared against
+    ``threshold`` — the burn-rate window for counters that should stay
+    flat (``spans_dropped_total`` rising, scrape errors climbing).
+``regression``
+    the stat compared against ``factor ×`` its own rolling baseline
+    (mean of the samples in the trailing ``window_s``, needing
+    ``min_samples`` history) — step p99 regression against the run's
+    recent self.
+
+Stats are computed from parsed exposition text, so local and federated
+sources evaluate identically: ``value``/``sum``/``max``/``min`` over
+matching series, ``count``/``avg``/``p50``/``p90``/``p99`` over
+histograms (bucket-resolution quantiles, matching
+``metrics.Histogram.percentile``).  ``selector={"kind": "shard"}``
+restricts matching to series carrying those label values.
+
+With ``MXNET_TPU_METRICS=0``, :meth:`Watchdog.evaluate` returns without
+scraping anything — the same constant-time-guard contract as the rest
+of the plane.  ``MXNET_TPU_WATCHDOG=1`` makes ``_async_ps_main`` server
+processes run a default-rule watchdog next to their ``/metrics``
+endpoint; ``MXNET_TPU_WATCHDOG_INTERVAL`` paces the background loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+
+from . import federation as _federation
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+
+__all__ = ["Rule", "Alert", "Watchdog", "default_rules"]
+
+_SEVERITIES = ("info", "warning", "critical", "terminal")
+_KINDS = ("threshold", "increase", "regression")
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+_M_ALERT = _metrics.gauge(
+    "cluster_alert", "1 while the named watchdog alert is firing",
+    ["alert", "severity"])
+_M_FIRED = _metrics.counter(
+    "cluster_alerts_fired_total",
+    "Watchdog alert rising edges (resolved-to-firing transitions)",
+    ["alert"])
+_M_EVALS = _metrics.counter(
+    "watchdog_evaluations_total", "Watchdog rule-evaluation passes")
+
+
+def _interval_s():
+    try:
+        return float(os.environ.get("MXNET_TPU_WATCHDOG_INTERVAL", "10"))
+    except ValueError:
+        return 10.0
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# -- stat extraction from parsed exposition --------------------------------
+
+def _matching(fam, metric, selector, suffix=""):
+    """Values of series named ``metric + suffix`` whose labels contain
+    ``selector``; yields (label_dict, float_value)."""
+    want = metric + suffix
+    for name, labels, value in fam["series"]:
+        if name != want:
+            continue
+        ld = _federation._label_dict(labels or "")
+        if selector and any(ld.get(k) != str(v)
+                            for k, v in selector.items()):
+            continue
+        try:
+            yield ld, float(value)
+        except ValueError:
+            continue
+
+
+def _histogram_quantile(fam, metric, selector, q):
+    """Bucket-resolution quantile across every matching series (same
+    semantics as ``metrics.Histogram.percentile``: the upper bound of
+    the bucket holding the q-th observation)."""
+    cum = {}
+    for ld, v in _matching(fam, metric, selector, "_bucket"):
+        le = ld.get("le", "")
+        try:
+            ub = float("inf") if le == "+Inf" else float(le)
+        except ValueError:
+            continue
+        cum[ub] = cum.get(ub, 0.0) + v
+    if not cum:
+        return None
+    bounds = sorted(cum)
+    total = cum[bounds[-1]]           # +Inf (or widest) cumulative count
+    if total <= 0:
+        return None
+    rank = q * total
+    # cumulative counts were summed across series per bound, so they
+    # remain cumulative in bound order
+    for ub in bounds:
+        if cum[ub] >= rank:
+            return ub
+    return bounds[-1]
+
+
+def _stat_of(fams, metric, stat, selector):
+    """Evaluate ``stat`` for ``metric`` from parsed exposition ``fams``;
+    None when the metric (or the requested slice) is absent."""
+    fam = fams.get(metric)
+    if fam is None:
+        return None
+    if stat in ("p50", "p90", "p99"):
+        return _histogram_quantile(fam, metric, selector,
+                                   float(stat[1:]) / 100.0)
+    if fam.get("type") == "histogram" or stat in ("count", "avg"):
+        sums = [v for _, v in _matching(fam, metric, selector, "_sum")]
+        counts = [v for _, v in _matching(fam, metric, selector, "_count")]
+        if stat == "count":
+            return sum(counts) if counts else None
+        if stat == "avg":
+            return (sum(sums) / sum(counts)
+                    if counts and sum(counts) else None)
+        return sum(sums) if sums else None      # "sum"/"value" on a histogram
+    vals = [v for _, v in _matching(fam, metric, selector)]
+    if not vals:
+        return None
+    if stat == "max":
+        return max(vals)
+    if stat == "min":
+        return min(vals)
+    return sum(vals)                             # "value" / "sum"
+
+
+class Rule(object):
+    """One declarative alert rule (see module doc for the kinds).
+
+    Rules are stateful — burn-rate and regression windows live on the
+    instance — so a rule object belongs to exactly one
+    :class:`Watchdog`.
+    """
+
+    def __init__(self, name, metric, *, stat="value", selector=None,
+                 op=">", threshold=0.0, kind="threshold", window_s=300.0,
+                 for_s=0.0, factor=2.0, min_samples=3,
+                 severity="warning", description=""):
+        if kind not in _KINDS:
+            raise ValueError("rule kind must be one of %s, got %r"
+                             % (_KINDS, kind))
+        if severity not in _SEVERITIES:
+            raise ValueError("severity must be one of %s, got %r"
+                             % (_SEVERITIES, severity))
+        if op not in _OPS:
+            raise ValueError("op must be one of %s, got %r"
+                             % (sorted(_OPS), op))
+        self.name = name
+        self.metric = metric
+        self.stat = stat
+        self.selector = dict(selector) if selector else None
+        self.op = op
+        self.threshold = float(threshold)
+        self.kind = kind
+        self.window_s = float(window_s)
+        self.for_s = float(for_s)
+        self.factor = float(factor)
+        self.min_samples = int(min_samples)
+        self.severity = severity
+        self.description = description
+        # evaluation state
+        self.firing = False
+        self.value = None          # the quantity last compared
+        self.baseline = None       # regression rules: the rolling mean
+        self._samples = []         # [(t, raw_value)] within window_s
+        self._true_since = None
+
+    def _condition(self, raw, now):
+        """Update windows, return (quantity, condition_bool)."""
+        if self.kind == "threshold":
+            return raw, _OPS[self.op](raw, self.threshold)
+        self._samples = [(t, v) for t, v in self._samples
+                         if now - t <= self.window_s]
+        if self.kind == "increase":
+            base = self._samples[0][1] if self._samples else raw
+            self._samples.append((now, raw))
+            delta = raw - base
+            return delta, _OPS[self.op](delta, self.threshold)
+        # regression: compare against the rolling mean of PRIOR samples
+        prior = [v for _, v in self._samples]
+        self._samples.append((now, raw))
+        if len(prior) < self.min_samples:
+            return raw, False
+        self.baseline = sum(prior) / len(prior)
+        return raw, raw > self.factor * self.baseline
+
+    def update(self, raw, now):
+        """Feed one evaluation; returns whether the rule is firing."""
+        if raw is None:
+            # metric absent: resolve and forget sustained-state (a
+            # vanished series must not keep an alert pinned)
+            self.value = None
+            self._true_since = None
+            self.firing = False
+            return False
+        self.value, cond = self._condition(float(raw), now)
+        if not cond:
+            self._true_since = None
+            self.firing = False
+            return False
+        if self._true_since is None:
+            self._true_since = now
+        self.firing = (now - self._true_since) >= self.for_s
+        return self.firing
+
+
+class Alert(object):
+    """One firing alert: the rule's identity plus the evaluation that
+    tripped it."""
+
+    __slots__ = ("name", "severity", "value", "threshold", "since",
+                 "description")
+
+    def __init__(self, rule, now):
+        self.name = rule.name
+        self.severity = rule.severity
+        self.value = rule.value
+        self.threshold = (rule.factor * rule.baseline
+                          if rule.kind == "regression"
+                          and rule.baseline is not None
+                          else rule.threshold)
+        self.since = now
+        self.description = rule.description
+
+    def as_dict(self):
+        return {"name": self.name, "severity": self.severity,
+                "value": self.value, "threshold": self.threshold,
+                "since": self.since, "description": self.description}
+
+
+class Watchdog(object):
+    """Evaluate rules against a metrics source (see module doc).
+
+    ``source`` may be None (the process-global registry), any object
+    with a ``render()`` method (a :class:`Registry` or a
+    :class:`FederatedCollector`), exposition text, or a callable
+    returning exposition text.
+    """
+
+    def __init__(self, rules=None, source=None):
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.source = source
+        self._active = {}              # rule name -> Alert
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _scrape_text(self):
+        src = self.source
+        if src is None:
+            return _metrics.REGISTRY.render()
+        if callable(getattr(src, "render", None)):
+            return src.render()
+        if callable(src):
+            return src()
+        return str(src)
+
+    def evaluate(self, now=None):
+        """One evaluation pass; returns the list of active
+        :class:`Alert`\\ s.  ``now`` (monotonic seconds) is injectable
+        so tests can drive the burn-rate/sustain windows."""
+        if not _metrics.metrics_enabled():
+            return []
+        if now is None:
+            now = _time.monotonic()
+        fams = _federation._parse(self._scrape_text())
+        _M_EVALS.inc()
+        with self._lock:
+            for rule in self.rules:
+                raw = _stat_of(fams, rule.metric, rule.stat, rule.selector)
+                was = rule.firing
+                firing = rule.update(raw, now)
+                if firing and not was:
+                    alert = Alert(rule, now)
+                    self._active[rule.name] = alert
+                    _M_ALERT.labels(rule.name, rule.severity).set(1)
+                    _M_FIRED.labels(rule.name).inc()
+                    if rule.severity == "terminal":
+                        # one bundle per firing episode: the edge, not
+                        # every evaluation while it stays red
+                        _flight.record_failure(
+                            "watchdog.%s" % rule.name, None,
+                            alert=alert.as_dict())
+                elif firing:
+                    self._active[rule.name].value = rule.value
+                elif was:
+                    self._active.pop(rule.name, None)
+                    _M_ALERT.labels(rule.name, rule.severity).set(0)
+            return list(self._active.values())
+
+    def firing(self):
+        """The currently-active alerts (no evaluation pass)."""
+        with self._lock:
+            return list(self._active.values())
+
+    def alerts_json(self, evaluate=False):
+        """JSON-safe dict for the ``/alerts`` endpoint; ``evaluate=True``
+        runs a pass first so a bare GET drives the engine."""
+        if evaluate:
+            self.evaluate()
+        with self._lock:
+            active = list(self._active.values())
+        return {"alerts": [a.as_dict() for a in active],
+                "rules": len(self.rules),
+                "firing": len(active)}
+
+    def render_alerts(self):
+        """The ``/alerts`` body as a JSON string (evaluates first)."""
+        return json.dumps(self.alerts_json(evaluate=True), sort_keys=True)
+
+    # -- background loop ----------------------------------------------
+    def start(self, interval_s=None):
+        """Evaluate every ``interval_s`` (default
+        ``MXNET_TPU_WATCHDOG_INTERVAL``) on a daemon thread."""
+        if self._thread is not None:
+            return self
+        interval = _interval_s() if interval_s is None else float(interval_s)
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.evaluate()
+                except Exception:
+                    # the watchdog must never take down what it watches
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="mxtpu-watchdog",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def serve(self, port=None, addr="127.0.0.1", registry=None):
+        """Serve ``/metrics`` + ``/alerts`` on one endpoint (a
+        :class:`~.exporters.MetricsServer` with this watchdog wired)."""
+        from . import exporters as _exporters
+
+        return _exporters.start_metrics_server(
+            port=port, addr=addr, registry=registry, watchdog=self)
+
+
+def default_rules():
+    """The stock SLO rule set: trace-buffer pressure, heartbeat age,
+    replication lag, step-p99 self-regression, and (when evaluated over
+    a federated source) straggler skew.  Thresholds come from the
+    ``MXNET_TPU_WATCHDOG_*`` env rows (docs/env_vars.md)."""
+    dead_after = _env_float("MXNET_TPU_PS_DEAD_AFTER", 30.0)
+    return [
+        Rule("spans_dropped", "spans_dropped_total", kind="increase",
+             threshold=0.0, window_s=300.0, severity="warning",
+             description="trace ring buffer is evicting unexported "
+                         "spans (raise MXNET_TPU_METRICS_TRACE_BUFFER "
+                         "or export more often)"),
+        Rule("heartbeat_stale", "kv_heartbeat_age_seconds", stat="max",
+             threshold=dead_after, severity="critical",
+             description="a server has not answered heartbeats for "
+                         "longer than MXNET_TPU_PS_DEAD_AFTER"),
+        Rule("replication_lag", "kv_replication_lag", stat="max",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_REPL_LAG", 64.0),
+             for_s=_env_float("MXNET_TPU_WATCHDOG_REPL_LAG_FOR_S", 0.0),
+             severity="warning",
+             description="a follower is falling behind the primary's "
+                         "replication log"),
+        Rule("step_p99_regression", "trainer_step_seconds", stat="p99",
+             kind="regression",
+             factor=_env_float("MXNET_TPU_WATCHDOG_STEP_P99_FACTOR", 2.0),
+             window_s=600.0, severity="warning",
+             description="step p99 regressed against its own rolling "
+                         "baseline"),
+        Rule("straggler", "cluster_straggler_skew", stat="max",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_STRAGGLER_SKEW",
+                                  2.0),
+             severity="critical",
+             description="the slowest shard/worker's latency skew "
+                         "exceeds the straggler threshold "
+                         "(cluster_straggler_info names it)"),
+    ]

@@ -46,6 +46,12 @@ _M_STALL = _metrics.counter(
     "fetch that had not finished staging")
 _M_CHUNKS = _metrics.counter(
     "prefetch_chunks_total", "Chunks served to the consumer")
+_M_PLACE = _metrics.counter(
+    "prefetch_place_seconds_total",
+    "Seconds the IO workers spent in the place callback (host->device "
+    "superbatch staging) — placement the feeder hides off the step's "
+    "critical path, the complement of trainer_step_phase_seconds"
+    "{phase='placement'}")
 
 
 #: One prefetched pipeline flush: ``placed`` is the device superbatch (the
@@ -127,7 +133,9 @@ class PrefetchFeeder(object):
             if not host:
                 self._slots[i] = _END
                 return
+            t_place = _time.monotonic()
             self._slots[i] = Chunk(self._place(host), host, len(host))
+            _M_PLACE.inc(_time.monotonic() - t_place)
             self._ready += 1
             _M_OCCUPANCY.set(self._ready)
 

@@ -16,6 +16,8 @@ per-layer ``priority=-index`` push/pull scheduling plays by hand
 
 from __future__ import annotations
 
+import time as _time
+
 from typing import Dict, Optional
 
 import jax
@@ -24,8 +26,23 @@ import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from ..observability import attribution as _attr
+from ..observability import metrics as _metrics
 
 __all__ = ["ShardedTrainer", "auto_tp_specs", "zero_extend_spec"]
+
+# -- compile accounting: every jit cache miss (step / grad / fwd / each
+# (n, unroll) pipeline trace) is one entry here.  Steady state records
+# NOTHING — a counter that moves outside warmup IS the recompile bug the
+# cache keys exist to prevent (changed pipeline depth, epoch-tail flush,
+# resharded input), and the histogram says what each miss cost.
+_M_COMPILES = _metrics.counter(
+    "trainer_compiles_total",
+    "Jit-cache misses (traces compiled), by cache key; steady-state "
+    "training must not move this", ["cache"])
+_M_COMPILE_T = _metrics.histogram(
+    "trainer_compile_seconds",
+    "Wall time of each first-call trace+compile, by cache key", ["cache"])
 
 
 def auto_tp_specs(symbol, arg_shapes, mesh, data_axis="data", model_axis="model"):
@@ -599,6 +616,27 @@ class ShardedTrainer:
                   for n in self._input_names}
         return pshard, mshard, ashard, dshard
 
+    @staticmethod
+    def _compile_counted(cache, jitted):
+        """Wrap a jitted callable so its FIRST call (the trace+compile)
+        lands in the compile-accounting families under ``cache``; every
+        later call passes straight through.  Pairs with the jit caches:
+        one wrapper per cache entry, so steady-state fit records zero
+        compiles and a moving counter means the cache keys missed."""
+        done = []
+
+        def call(*args, **kwargs):
+            if done:
+                return jitted(*args, **kwargs)
+            t0 = _time.monotonic()
+            out = jitted(*args, **kwargs)
+            done.append(True)
+            _M_COMPILES.labels(cache).inc()
+            _M_COMPILE_T.labels(cache).observe(_time.monotonic() - t0)
+            return out
+
+        return call
+
     def step_fn(self):
         """The fused train step: (params, moms, aux, batch, rng) ->
         (outputs, new_params, new_moms, new_aux)."""
@@ -612,7 +650,8 @@ class ShardedTrainer:
             out_shardings=(None, pshard, mshard, ashard),
             donate_argnums=(0, 1),
         )
-        self._jit_step = self._with_mesh(self._jit_step_raw)
+        self._jit_step = self._compile_counted(
+            "step", self._with_mesh(self._jit_step_raw))
         return self._jit_step
 
     # ------------------------------------------------------------------
@@ -705,7 +744,8 @@ class ShardedTrainer:
             out_shardings=(None, pshard, mshard, ashard),
             donate_argnums=(0, 1),
         )
-        wrapped = self._with_mesh(fn)
+        wrapped = self._compile_counted(
+            "pipe:%d:%d" % (n, unroll), self._with_mesh(fn))
         self._jit_pipe[(n, unroll)] = wrapped
         return wrapped
 
@@ -759,8 +799,8 @@ class ShardedTrainer:
             return outs, grads, new_aux
 
         pshard, _, ashard, dshard = self._step_shardings()
-        self._jit_grad = self._with_mesh(jax.jit(
-            gstep, in_shardings=(pshard, ashard, dshard, None)))
+        self._jit_grad = self._compile_counted("grad", self._with_mesh(
+            jax.jit(gstep, in_shardings=(pshard, ashard, dshard, None))))
         return self._jit_grad
 
     def forward_fn(self):
@@ -781,8 +821,8 @@ class ShardedTrainer:
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n])
                   for n in self._input_names}
-        self._jit_fwd = self._with_mesh(jax.jit(
-            fwd, in_shardings=(pshard, ashard, dshard, None)))
+        self._jit_fwd = self._compile_counted("fwd", self._with_mesh(
+            jax.jit(fwd, in_shardings=(pshard, ashard, dshard, None))))
         return self._jit_fwd
 
     # ------------------------------------------------------------------
@@ -1079,40 +1119,57 @@ class ShardedTrainer:
                 _ckpt.save_fit_meta(checkpoint_dir, global_step,
                                     fit_meta(epoch, nbatch))
                 last_saved = global_step
+                _attr.sample_memory()
 
         for epoch in range(start_epoch, end_epoch):
             metric.reset()
             train_data.reset()
             nbatch = 0
             if K == 1:
-                for batch in train_data:
+                it = iter(train_data)
+                while True:
+                    # attribution brackets the WHOLE step — including the
+                    # iterator pull — so the phase sums plus the residual
+                    # reconcile against trainer_step_seconds exactly
+                    att = _attr.attributor()
+                    t_step = _time.monotonic()
+                    try:
+                        with att.phase("data_wait"):
+                            batch = next(it)
+                    except StopIteration:
+                        break
                     if skip_batches:
                         # resumed mid-epoch: replay the iterator up to the
-                        # checkpointed batch offset without stepping
+                        # checkpointed batch offset without stepping (the
+                        # attributor is dropped unclosed: records nothing)
                         skip_batches -= 1
                         nbatch += 1
                         continue
-                    t_step = _time.monotonic()
                     arrays, data_names = batch_arrays(batch, train_data)
                     with _obs.span("trainer.step", step=global_step):
-                        placed = self.place_batch(arrays)
-                        outs, params, moms, aux = step(
-                            params, moms, aux, placed,
-                            _jax.random.fold_in(base_key, global_step))
-                        ok = True
-                        if guard:
-                            # trailing scalar = the step's in-graph
-                            # verdict; the asnumpy read syncs, which the
-                            # skip policy needs anyway
-                            ok = bool(_np.asarray(outs[-1]))
-                            outs = outs[:-1]
+                        with att.phase("placement"):
+                            placed = self.place_batch(arrays)
+                        with att.phase("compute"):
+                            outs, params, moms, aux = step(
+                                params, moms, aux, placed,
+                                _jax.random.fold_in(base_key, global_step))
+                            ok = True
+                            if guard:
+                                # trailing scalar = the step's in-graph
+                                # verdict; the asnumpy read syncs, which
+                                # the skip policy needs anyway
+                                ok = bool(_np.asarray(outs[-1]))
+                                outs = outs[:-1]
                     global_step += 1
                     nbatch += 1
                     flushes += 1
-                    outs_host = ([_np.asarray(o) for o in outs]
-                                 if flushes % metric_every == 0 else None)
+                    with att.phase("flush"):
+                        outs_host = ([_np.asarray(o) for o in outs]
+                                     if flushes % metric_every == 0
+                                     else None)
                     after_step(epoch, arrays, data_names, ok, outs_host)
                     dt = _time.monotonic() - t_step
+                    att.close(dt)
                     _m_step.observe(dt)
                     _m_steps.inc()
                     if dt > 0:
@@ -1154,27 +1211,34 @@ class ShardedTrainer:
                         sizes=plan_size, depth=2, name="fit.prefetch")
                 try:
                     while True:
+                        # per-FLUSH attribution (feeder-side placement is
+                        # accounted by prefetch_place_seconds_total — here
+                        # data_wait is the stall waiting on the feeder)
+                        att = _attr.attributor()
                         t_flush = _time.monotonic()
                         with _obs.span("trainer.flush", flush=flushes):
-                            chunk = feeder.next_chunk()
+                            with att.phase("data_wait"):
+                                chunk = feeder.next_chunk()
                             if chunk is None:
                                 break
                             n = chunk.count
-                            outs_stack, params, moms, aux = \
-                                self.pipeline_fn(n)(
-                                    params, moms, aux, chunk.placed,
-                                    base_key, _np.int32(global_step))
+                            with att.phase("compute"):
+                                outs_stack, params, moms, aux = \
+                                    self.pipeline_fn(n)(
+                                        params, moms, aux, chunk.placed,
+                                        base_key, _np.int32(global_step))
                         flushes += 1
                         verdicts = None
-                        if guard:
-                            # one [n] readback per flush drives the skip
-                            # policy for all n steps
-                            verdicts = _np.asarray(outs_stack[-1])
-                            outs_stack = outs_stack[:-1]
-                        outs_host = None
-                        if flushes % metric_every == 0:
-                            outs_host = [_np.asarray(o)
-                                         for o in outs_stack]
+                        with att.phase("flush"):
+                            if guard:
+                                # one [n] readback per flush drives the
+                                # skip policy for all n steps
+                                verdicts = _np.asarray(outs_stack[-1])
+                                outs_stack = outs_stack[:-1]
+                            outs_host = None
+                            if flushes % metric_every == 0:
+                                outs_host = [_np.asarray(o)
+                                             for o in outs_stack]
                         for j in range(n):
                             arrays, data_names = chunk.host[j]
                             ok = (True if verdicts is None
@@ -1187,6 +1251,7 @@ class ShardedTrainer:
                                 else [o[j] for o in outs_host],
                                 can_ckpt=(j == n - 1))
                         dt = _time.monotonic() - t_flush
+                        att.close(dt)
                         _m_steps.inc(n)
                         for _ in range(n):  # amortized per-step latency
                             _m_step.observe(dt / n)
@@ -1194,6 +1259,9 @@ class ShardedTrainer:
                             rows = next(iter(
                                 chunk.host[0][0].values())).shape[0]
                             _m_tokens.set(rows * n / dt)
+                        # flush end = a stable live set (no mid-dispatch
+                        # churn): the meaningful HBM watermark point
+                        _attr.sample_memory()
                 finally:
                     feeder.close()
             history.setdefault(epoch, {})["train"] = metric.get()
@@ -1232,6 +1300,7 @@ class ShardedTrainer:
                                        moms, aux)
                     _ckpt.save_fit_meta(checkpoint_dir, epoch + 1,
                                         fit_meta(epoch + 1, 0))
+                _attr.sample_memory()
         return (params, moms, aux), history
 
     def _fit_kvstore(self, kv, train_data, eval_data=None, num_epoch=1,
@@ -1305,31 +1374,62 @@ class ShardedTrainer:
         base_key = _jax.random.fold_in(_jax.random.PRNGKey(seed),
                                        begin_epoch)
         end_epoch = begin_epoch + num_epoch
+        # same step-latency families the local paths feed — one dashboard
+        # regardless of where the optimizer runs; the kv phase (absent
+        # from the local paths) is where this loop earns its breakdown
+        _m_step = _metrics.histogram(
+            "trainer_step_seconds",
+            "Optimizer-step wall time seen by the fit loop; pipelined "
+            "flushes are amortized over their K fused steps")
+        _m_steps = _metrics.counter("trainer_steps_total",
+                                    "Optimizer steps applied by fit")
+        _m_tokens = _metrics.gauge(
+            "trainer_tokens_per_sec",
+            "Training throughput (batch rows per second) of the most "
+            "recent step or flush")
         for epoch in range(begin_epoch, end_epoch):
             metric.reset()
             train_data.reset()
             nbatch = 0
             for batch in train_data:
+                att = _attr.attributor()
+                t_step = _time.monotonic()
                 arrays, data_names = batch_arrays(batch, train_data)
-                placed = self.place_batch(arrays)
-                outs, grads, aux = gradf(
-                    params, aux, placed,
-                    _jax.random.fold_in(base_key, global_step))
-                # the push may ride out a shard failover internally
-                # (promote + same-seq retry); only whole-group loss
-                # escapes, as ShardFailedError
-                kv.push(diff, [NDArray(grads[n]) for n in diff])
-                kv.pull(diff, out=bufs)
-                for n, b in zip(diff, bufs):
-                    params[n] = jax.device_put(
-                        jnp.asarray(b._data).astype(self._param_dtype(n)),
-                        pshard[n])
+                with att.phase("placement"):
+                    placed = self.place_batch(arrays)
+                with att.phase("compute"):
+                    outs, grads, aux = gradf(
+                        params, aux, placed,
+                        _jax.random.fold_in(base_key, global_step))
+                with att.phase("kv"):
+                    # the push may ride out a shard failover internally
+                    # (promote + same-seq retry); only whole-group loss
+                    # escapes, as ShardFailedError
+                    kv.push(diff, [NDArray(grads[n]) for n in diff])
+                    kv.pull(diff, out=bufs)
+                with att.phase("placement"):
+                    # accumulates onto the batch placement above: both
+                    # are host->device transfers on the step's critical
+                    # path
+                    for n, b in zip(diff, bufs):
+                        params[n] = jax.device_put(
+                            jnp.asarray(b._data).astype(
+                                self._param_dtype(n)),
+                            pshard[n])
                 global_step += 1
                 nbatch += 1
-                labels = [v for n, v in arrays.items()
-                          if n not in data_names]
-                metric.update([_np.asarray(v) for v in labels],
-                              [_np.asarray(o) for o in outs])
+                with att.phase("flush"):
+                    labels = [v for n, v in arrays.items()
+                              if n not in data_names]
+                    metric.update([_np.asarray(v) for v in labels],
+                                  [_np.asarray(o) for o in outs])
+                dt = _time.monotonic() - t_step
+                att.close(dt)
+                _m_step.observe(dt)
+                _m_steps.inc()
+                if dt > 0:
+                    _m_tokens.set(
+                        next(iter(arrays.values())).shape[0] / dt)
                 if speedo is None and log_every:
                     speedo = Speedometer(
                         next(iter(arrays.values())).shape[0],

@@ -39,8 +39,34 @@ def test_bench_json_contract(pipeline):
     # drops no spans, but the keys must always be present
     assert rec["chaos_fired_total"] == 0
     assert rec["spans_dropped_total"] == 0
+    # additive provenance keys: schema revision + the commit measured
+    assert rec["schema_version"] >= 3
+    assert isinstance(rec["git_sha"], str) and rec["git_sha"]
     # pipeline_steps only appears when the pipelined path actually ran
     if pipeline > 1:
         assert rec["pipeline_steps"] == pipeline
     else:
         assert "pipeline_steps" not in rec
+
+
+def test_bench_git_sha_override():
+    rec = _run_bench({"BENCH_GIT_SHA": "cafef00d"})
+    assert rec["git_sha"] == "cafef00d"
+
+
+def test_bench_vs_baseline_published():
+    """Fresh bench number vs the BASELINE.json published reference for
+    the SAME metric.  The tolerance is deliberately generous (8x): this
+    guards against the bench silently measuring nothing (zeros, wrong
+    units, dead path), not against hardware variance between
+    containers."""
+    with open(os.path.join(_REPO, "BASELINE.json")) as f:
+        published = json.load(f).get("published", {})
+    rec = _run_bench({})
+    ref = published.get(rec["metric"])
+    if not ref:
+        pytest.skip("no published baseline for metric %r" % rec["metric"])
+    assert rec["value"] >= float(ref["value"]) / 8.0, (
+        "bench %s=%.2f collapsed vs published %.2f"
+        % (rec["metric"], rec["value"], ref["value"]))
+    assert rec["unit"] == ref["unit"]

@@ -99,6 +99,10 @@ def launch_servers(args, coordinator=None):
             env["MXNET_TPU_METRICS_PORT"] = str(metrics_base + slot)
         if primary_addr:
             env["MXNET_TPU_SERVER_PRIMARY"] = primary_addr
+        # merged chrome-trace views need each process on its own named
+        # track; an explicit operator choice still wins
+        env.setdefault("MXNET_TPU_TRACE_TRACK", "server%d:%s" % (
+            shard, "standby" if primary_addr else "primary"))
         if coordinator:
             # inert cluster-identity marker (NOT MXNET_TPU_COORDINATOR —
             # that one makes jax.distributed join the worker cluster, and
@@ -169,6 +173,7 @@ def launch_local(args, cmd):
         # one-process-per-host TPU launch
         env["JAX_PLATFORMS"] = args.platform
         env["MXNET_TPU_PLATFORM"] = args.platform  # wins over site-hook presets
+        env.setdefault("MXNET_TPU_TRACE_TRACK", "worker%d" % i)
         env.update(server_env)
         metrics_base = getattr(args, "metrics_port_base", 0) or 0
         if metrics_base:
@@ -255,8 +260,10 @@ def launch_ssh(args, cmd):
                 port = args.server_port_base + slot
                 env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
                        "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
-                       "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s"
-                       % (port, i, args.num_servers, host))
+                       "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s "
+                       "MXNET_TPU_TRACE_TRACK=server%d:%s"
+                       % (port, i, args.num_servers, host, i,
+                          "standby" if j > 0 else "primary"))
                 if args.metrics_port_base:
                     env += (" MXNET_TPU_METRICS_PORT=%d"
                             % (args.metrics_port_base + slot))
@@ -276,8 +283,8 @@ def launch_ssh(args, cmd):
     workers = []
     for i in range(args.num_workers):
         env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
-               "MXNET_TPU_PROC_ID=%d %s"
-               % (coordinator, args.num_workers, i, server_env))
+               "MXNET_TPU_PROC_ID=%d MXNET_TPU_TRACE_TRACK=worker%d %s"
+               % (coordinator, args.num_workers, i, i, server_env))
         if args.metrics_port_base:
             env += ("MXNET_TPU_METRICS_PORT=%d "
                     % (args.metrics_port_base + server_slots + i))
