@@ -16,6 +16,12 @@ test-fast:
 bench:
 	python bench.py
 
+bench-trend:
+	python tools/bench_table.py --trend
+
+efficiency:
+	python tools/efficiency_report.py
+
 dryrun:
 	python __graft_entry__.py
 
@@ -34,5 +40,5 @@ watchdog:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-fast bench dryrun dist-test chaos trace \
-	watchdog clean
+.PHONY: all native test test-fast bench bench-trend efficiency dryrun \
+	dist-test chaos trace watchdog clean

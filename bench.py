@@ -66,8 +66,47 @@ def _obs_counters():
 
 
 # bump when the emitted keys change shape (keys are only ever ADDED —
-# consumers keying on schema_version never break on older rows)
-_SCHEMA_VERSION = 3
+# consumers keying on schema_version never break on older rows).
+# v4: mfu / goodput_ratio / model_flops_per_step from the efficiency
+# accounting plane (cost-analysis FLOPs + goodput ledger)
+_SCHEMA_VERSION = 4
+
+
+def _bench_peak():
+    """MFU denominator: ``BENCH_PEAK_TFLOPS`` (the historical bench
+    knob) wins when set, else the efficiency module's per-device-kind
+    table (which itself honors ``MXNET_TPU_DEVICE_PEAK_FLOPS``)."""
+    from mxnet_tpu.observability import efficiency as eff
+
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    return eff.peak_flops()
+
+
+def _efficiency_keys(led, wall_s, n_steps, seconds):
+    """Additive schema-4 keys from the efficiency accounting plane.
+
+    ``mfu`` is the MEASURED ``model_flops_utilization`` gauge —
+    cost-analysis FLOPs of the compiled step times the headline-loop
+    step rate over the device peak — null when the backend supports no
+    cost analysis or metrics are disabled (the documented fallback);
+    ``goodput_ratio`` comes from closing the bench's ledger over the
+    whole warmup+measure wall; ``model_flops_per_step`` is the raw
+    numerator so consumers can re-derive MFU under a different peak."""
+    from mxnet_tpu.observability import efficiency as eff
+
+    eff.record_step_rate(n_steps, seconds, peak=_bench_peak())
+    summary = led.close(wall_s) or {}
+    mfps = eff.model_flops_per_step()
+    _, rows = eff.efficiency_table()
+    mfu = dict(rows).get("mfu")
+    ratio = summary.get("goodput_ratio")
+    return {
+        "mfu": None if mfu is None else round(float(mfu), 6),
+        "goodput_ratio": None if ratio is None else round(float(ratio), 4),
+        "model_flops_per_step": None if mfps is None else float(mfps),
+    }
 
 
 def _provenance():
@@ -151,14 +190,20 @@ def transformer_main():
     })
     step = tr.step_fn()
     key = jax.random.PRNGKey(0)
+    from mxnet_tpu.observability import efficiency as _eff
+
+    led = _eff.ledger()
+    t_bench = time.perf_counter()
 
     outs, params, moms, aux = step(params, moms, aux, arrays, key)
     _sync_leaf(outs)
+    led.step(time.perf_counter() - t_bench)
     t0 = time.perf_counter()
     for _ in range(steps):
         outs, params, moms, aux = step(params, moms, aux, arrays, key)
     _sync_leaf(outs)
     dt = time.perf_counter() - t0
+    led.step(dt)
 
     tokens_s = batch * seq * steps / dt
 
@@ -167,8 +212,10 @@ def transformer_main():
         outs, params, moms, aux = step(params, moms, aux, arrays, key)
         return outs
 
+    t_pct = time.perf_counter()
     p50_ms, p99_ms = _step_percentiles(_one_step, _sync_leaf,
                                        min(steps, 10))
+    led.step(time.perf_counter() - t_pct)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     # PaLM-appendix accounting: train FLOPs/token = 6N + 12*L*T*d_model
@@ -189,7 +236,14 @@ def transformer_main():
     flops_per_token = 6.0 * n_active + 12.0 * layers * seq * d_model
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                 PEAK_TFLOPS_V5E)) * 1e12
-    mfu = tokens_s * flops_per_token / peak
+    mfu_formula = tokens_s * flops_per_token / peak
+    # measured MFU (compiled-program FLOPs) wins when the backend gives
+    # cost analysis; the PaLM-appendix formula stays as mfu_formula and
+    # is the documented fallback for "mfu" when it does not
+    eff_keys = _efficiency_keys(led, time.perf_counter() - t_bench,
+                                steps, dt)
+    if eff_keys["mfu"] is None:
+        eff_keys["mfu"] = round(mfu_formula, 4)
     print(json.dumps({
         "metric": "transformer_lm_train_throughput" if on_tpu
                   else "transformer_lm_cpu_smoke_throughput",
@@ -199,7 +253,8 @@ def transformer_main():
         "tokens_per_sec": round(tokens_s, 1),
         **_obs_counters(),
         **_provenance(),
-        "mfu": round(mfu, 4), "n_params": n_params,
+        **eff_keys,
+        "mfu_formula": round(mfu_formula, 4), "n_params": n_params,
         **({"n_params_active": n_active} if ffn == "moe" else {}),
         "config": {"batch": batch, "seq": seq, "d_model": d_model,
                    "layers": layers, "head": head, "ffn": ffn,
@@ -254,6 +309,13 @@ def main():
         "softmax_label": np.random.randint(0, 1000, (batch,)).astype(np.float32),
     }
     key = jax.random.PRNGKey(0)
+    from mxnet_tpu.observability import efficiency as _eff
+
+    # goodput ledger over the whole warmup+measure window: the warmup
+    # dispatch books as a step whose compile seconds settle out as
+    # cause="recompile", the timed loops book as productive wall
+    led = _eff.ledger()
+    t_bench = time.perf_counter()
 
     # warmup / compile.  NOTE: on remote-tunneled devices block_until_ready
     # does not actually block; a tiny host fetch is the only true sync, so
@@ -268,12 +330,14 @@ def main():
         outs, params, moms, aux = pipe(params, moms, aux, sb, key,
                                        np.int32(0))
         sync(outs)
+        led.step(time.perf_counter() - t_bench)
         t0 = time.perf_counter()
         for i in range(steps):
             outs, params, moms, aux = pipe(
                 params, moms, aux, sb, key, np.int32((i + 1) * pipeline))
         sync(outs)
         dt = time.perf_counter() - t0
+        led.step(dt)
         img_s = batch * steps * pipeline / dt
 
         def _one_flush():
@@ -282,19 +346,23 @@ def main():
                 params, moms, aux, sb, key, np.int32(0))
             return outs
 
+        t_pct = time.perf_counter()
         p50_ms, p99_ms = _step_percentiles(_one_flush, sync,
                                            min(steps, 10),
                                            per_call_steps=pipeline)
+        led.step(time.perf_counter() - t_pct)
     else:
         data = tr.place_batch(host)
         step = tr.step_fn()
         outs, params, moms, aux = step(params, moms, aux, data, key)
         sync(outs)
+        led.step(time.perf_counter() - t_bench)
         t0 = time.perf_counter()
         for i in range(steps):
             outs, params, moms, aux = step(params, moms, aux, data, key)
         sync(outs)
         dt = time.perf_counter() - t0
+        led.step(dt)
         img_s = batch * steps / dt
 
         def _one_step():
@@ -302,9 +370,13 @@ def main():
             outs, params, moms, aux = step(params, moms, aux, data, key)
             return outs
 
+        t_pct = time.perf_counter()
         p50_ms, p99_ms = _step_percentiles(_one_step, sync,
                                            min(steps, 10))
+        led.step(time.perf_counter() - t_pct)
 
+    eff_keys = _efficiency_keys(led, time.perf_counter() - t_bench,
+                                steps * pipeline, dt)
     print(json.dumps({
         "metric": "resnet50_train_throughput" if platform == "tpu"
                   else "resnet8_cpu_smoke_throughput",
@@ -317,6 +389,7 @@ def main():
         "tokens_per_sec": round(img_s, 2),
         **_obs_counters(),
         **_provenance(),
+        **eff_keys,
         **({"pipeline_steps": pipeline} if pipeline > 1 else {}),
     }))
 

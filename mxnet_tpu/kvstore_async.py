@@ -140,6 +140,21 @@ _M_HB_AGE = _metrics.gauge(
 _M_FAILOVER = _metrics.counter(
     "kv_failover_total",
     "Successful client-driven failovers (standby promoted to primary)")
+# badput sources: the goodput ledger (observability/efficiency.py) turns
+# in-fit deltas of these into badput_seconds_total{cause=kv_retry|failover}.
+# Background-thread ops (heartbeat) and failover-internal ops (promote —
+# its wall is already inside kv_failover_seconds_total) are excluded so
+# the causes stay disjoint subsets of the fit loop's step wall.
+_RETRY_UNACCOUNTED_OPS = frozenset(("heartbeat", "promote"))
+_M_RETRY_S = _metrics.counter(
+    "kv_retry_seconds_total",
+    "Wall seconds worker RPCs spent in the retry/backoff window after a "
+    "transport failure, from first failure to final outcome (heartbeat "
+    "and promote ops excluded)")
+_M_FAILOVER_S = _metrics.counter(
+    "kv_failover_seconds_total",
+    "Wall seconds spent inside client-driven failover attempts, "
+    "successful or not")
 _M_FENCED = _metrics.counter(
     "kv_fenced_total",
     "Primaries demoted to role 'fenced' after meeting a higher epoch")
@@ -1284,6 +1299,7 @@ class AsyncClient:
     def _call_impl(self, msg, seq=None, deadline=None):
         msg["rank"] = self._rank
         t_rpc = time.monotonic()
+        t_fail = None  # first transport failure — opens the retry window
         with self._lock:
             if seq is None:
                 self._seq += 1
@@ -1317,8 +1333,12 @@ class AsyncClient:
                 except (EOFError, ConnectionError, socket.timeout,
                         OSError) as exc:
                     attempt += 1
+                    if t_fail is None:
+                        t_fail = time.monotonic()
                     pause = self._backoff_sleep(attempt - 1)
                     if time.monotonic() + pause >= hard_deadline:
+                        if msg.get("op") not in _RETRY_UNACCOUNTED_OPS:
+                            _M_RETRY_S.inc(time.monotonic() - t_fail)
                         raise ServerDeadError(
                             "async PS %s:%d unreachable after %d "
                             "attempt(s) within the %.1fs deadline "
@@ -1328,6 +1348,8 @@ class AsyncClient:
                                overall, msg.get("op"), exc)) from exc
                     time.sleep(pause)
                     # retry (same seq: the server dedups completed requests)
+        if t_fail is not None and msg.get("op") not in _RETRY_UNACCOUNTED_OPS:
+            _M_RETRY_S.inc(time.monotonic() - t_fail)
         _M_RPC.labels(msg.get("op", "?")).observe(time.monotonic() - t_rpc)
         if not resp.get("ok"):
             if resp.get("stale_epoch") or resp.get("not_primary"):
@@ -1514,6 +1536,13 @@ class ReplicatedClient:
         """Route around a dead primary: adopt a newer published view if
         one exists, else promote the first reachable standby at
         ``epoch+1`` and publish the new view."""
+        t0 = time.monotonic()
+        try:
+            return self._failover_impl(last_exc)
+        finally:
+            _M_FAILOVER_S.inc(time.monotonic() - t0)
+
+    def _failover_impl(self, last_exc=None):
         if self._refresh_membership():
             return
         target_epoch = self.epoch + 1

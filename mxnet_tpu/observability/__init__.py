@@ -30,6 +30,14 @@ The runtime's telemetry layer (the subsystem the paper's
   evaluated against the local registry or a federated view; firing
   alerts surface as ``cluster_alert`` metrics, an ``/alerts`` JSON
   endpoint, and — at terminal severity — flight-recorder bundles.
+- :mod:`~mxnet_tpu.observability.efficiency` — compute-efficiency
+  accounting: per-jit-cache HLO cost analysis (FLOPs / bytes /
+  arithmetic intensity / memory footprint), measured MFU
+  (``model_flops_utilization``), the goodput ledger
+  (``goodput_productive_seconds_total`` vs
+  ``badput_seconds_total{cause}``, 5%-reconciled against the fit
+  wall), and :func:`capture_profile` behind the ``/profile?ms=N``
+  endpoint.
 
 Instrumented out of the box: engine push/run/poison per lane, prefetch
 occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
@@ -55,6 +63,11 @@ from .flight_recorder import record_failure, flight_enabled
 from .attribution import (attributor, StepAttribution, sample_memory,
                           attribution_table, format_attribution, PHASES)
 from .watchdog import Rule, Alert, Watchdog, default_rules
+from .efficiency import (peak_flops, record_compile, record_step_rate,
+                         model_flops_per_step, GoodputLedger, ledger,
+                         BADPUT_CAUSES, efficiency_table,
+                         format_efficiency, goodput_table, format_goodput,
+                         goodput_reconciles, capture_profile)
 
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
@@ -69,4 +82,8 @@ __all__ = [
     "attributor", "StepAttribution", "sample_memory",
     "attribution_table", "format_attribution", "PHASES",
     "Rule", "Alert", "Watchdog", "default_rules",
+    "peak_flops", "record_compile", "record_step_rate",
+    "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",
+    "efficiency_table", "format_efficiency", "goodput_table",
+    "format_goodput", "goodput_reconciles", "capture_profile",
 ]

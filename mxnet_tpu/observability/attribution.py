@@ -45,7 +45,11 @@ __all__ = ["PHASES", "attributor", "StepAttribution", "sample_memory",
            "attribution_table", "format_attribution"]
 
 #: The phases the fit loops attribute; ``unattributed`` is derived.
-PHASES = ("data_wait", "placement", "compute", "kv", "flush")
+#: ``checkpoint`` times the periodic in-step ``save_sharded`` — badput
+#: in the goodput ledger's books (efficiency.py), productive-adjacent
+#: here.
+PHASES = ("data_wait", "placement", "compute", "kv", "flush",
+          "checkpoint")
 
 _M_PHASE = _metrics.histogram(
     "trainer_step_phase_seconds",
@@ -112,13 +116,17 @@ class StepAttribution(object):
 
     def close(self, wall_s):
         """Observe the accumulated phases; whatever ``wall_s`` they do
-        not cover lands in ``phase="unattributed"``."""
+        not cover lands in ``phase="unattributed"``.  Returns the phase
+        dict it observed — the goodput ledger's per-step feed
+        (``efficiency.GoodputLedger.step``)."""
         covered = 0.0
         for name, v in self._acc.items():
             _H_PHASE[name].observe(v)
             covered += v
         _H_RESIDUAL.observe(max(wall_s - covered, 0.0))
+        phases = dict(self._acc)
         self._acc.clear()
+        return phases
 
 
 class _NullAttribution(object):

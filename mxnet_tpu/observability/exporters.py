@@ -81,8 +81,17 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
     also serves ``/alerts``: each GET runs an evaluation pass and
     returns the firing alerts as JSON — the pull-based twin of the
     watchdog's background loop.
+
+    ``/profile?ms=N`` captures an on-demand device trace
+    (:func:`~.efficiency.capture_profile`: ``jax.profiler`` for N
+    milliseconds, span-ring tail as the fallback) and returns it as
+    Perfetto-loadable chrome-trace JSON — save responses from several
+    processes and feed them to :func:`merge_chrome_traces` for one
+    cluster timeline.  The ``X-Profile-Source`` response header says
+    which capture path served it.
     """
     import http.server
+    import urllib.parse
 
     if port is None:
         port = int(os.environ.get("MXNET_TPU_METRICS_PORT", "0"))
@@ -90,9 +99,21 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            path = self.path.split("?")[0]
+            path, _, query = self.path.partition("?")
+            source = None
             if path == "/alerts" and watchdog is not None:
                 body = watchdog.render_alerts().encode("utf-8")
+                ctype = "application/json; charset=utf-8"
+            elif path == "/profile":
+                from . import efficiency as _efficiency
+
+                try:
+                    ms = int(urllib.parse.parse_qs(query).get(
+                        "ms", ["500"])[0])
+                except (ValueError, IndexError):
+                    ms = 500
+                trace, source = _efficiency.capture_profile(ms)
+                body = json.dumps(trace).encode("utf-8")
                 ctype = "application/json; charset=utf-8"
             elif path in ("/metrics", "/"):
                 body = reg.render().encode("utf-8")
@@ -103,6 +124,8 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if source is not None:
+                self.send_header("X-Profile-Source", source)
             self.end_headers()
             self.wfile.write(body)
 

@@ -180,6 +180,7 @@ class FederatedCollector(object):
         seen = {}            # source key -> True
         serve = {}           # server label -> [sum_s, count] (data ops)
         wsteps = {}          # member name -> [sum_s, count] (worker steps)
+        mfu = {}             # member name -> model_flops_utilization
         for t in self.targets:
             key = _source_key(t)
             if key in seen:
@@ -222,6 +223,10 @@ class FederatedCollector(object):
                                   "trainer_step_seconds_count"):
                         acc = wsteps.setdefault(member, [0.0, 0.0])
                         acc[0 if name.endswith("_sum") else 1] += fval
+                    elif name == "model_flops_utilization" and fval > 0:
+                        # zero = a lazily-registered gauge that never
+                        # measured; it must not drag cluster_mfu_min
+                        mfu[member] = fval
 
         # families sorted by name; series keep scrape order (histogram
         # buckets must stay in ascending-le order, which lexical
@@ -298,6 +303,21 @@ class FederatedCollector(object):
             for kind, skew, who in stragglers:
                 w('cluster_straggler_info{kind="%s",member="%s"} 1\n'
                   % (kind, _metrics._fmt_label(who)))
+
+        # -- hardware efficiency: per-member MFU plus the fleet floor
+        # (the member every efficiency regression hunt starts from) ----
+        if mfu:
+            w("# HELP cluster_mfu Model FLOPs utilization per federation "
+              "member (model_flops_utilization)\n")
+            w("# TYPE cluster_mfu gauge\n")
+            for k in sorted(mfu):
+                w('cluster_mfu{member="%s"} %s\n'
+                  % (_metrics._fmt_label(k), _metrics._fmt_value(mfu[k])))
+            w("# HELP cluster_mfu_min The least-utilized member's MFU — "
+              "the fleet's efficiency floor\n")
+            w("# TYPE cluster_mfu_min gauge\n")
+            w("cluster_mfu_min %s\n"
+              % _metrics._fmt_value(min(mfu.values())))
 
         w("# HELP cluster_scrape_errors_total Members whose source "
           "could not be scraped this pass\n")

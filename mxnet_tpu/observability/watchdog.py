@@ -180,7 +180,8 @@ class Rule(object):
     def __init__(self, name, metric, *, stat="value", selector=None,
                  op=">", threshold=0.0, kind="threshold", window_s=300.0,
                  for_s=0.0, factor=2.0, min_samples=3,
-                 severity="warning", description=""):
+                 severity="warning", description="", direction="up",
+                 skip_zero=False):
         if kind not in _KINDS:
             raise ValueError("rule kind must be one of %s, got %r"
                              % (_KINDS, kind))
@@ -190,6 +191,9 @@ class Rule(object):
         if op not in _OPS:
             raise ValueError("op must be one of %s, got %r"
                              % (sorted(_OPS), op))
+        if direction not in ("up", "down"):
+            raise ValueError("direction must be 'up' or 'down', got %r"
+                             % (direction,))
         self.name = name
         self.metric = metric
         self.stat = stat
@@ -203,6 +207,15 @@ class Rule(object):
         self.min_samples = int(min_samples)
         self.severity = severity
         self.description = description
+        # direction="down": a regression fires when the value FALLS below
+        # baseline/factor (throughput-style metrics — MFU, goodput —
+        # where lower is worse); "up" keeps the latency-style raw >
+        # factor*baseline.  skip_zero treats an exact-zero sample like an
+        # absent metric: gauges that exist but have not measured yet
+        # (a lazily-registered family zeroed by a registry reset) must
+        # neither fire nor poison the baseline.
+        self.direction = direction
+        self.skip_zero = bool(skip_zero)
         # evaluation state
         self.firing = False
         self.value = None          # the quantity last compared
@@ -227,10 +240,14 @@ class Rule(object):
         if len(prior) < self.min_samples:
             return raw, False
         self.baseline = sum(prior) / len(prior)
+        if self.direction == "down":
+            return raw, raw * self.factor < self.baseline
         return raw, raw > self.factor * self.baseline
 
     def update(self, raw, now):
         """Feed one evaluation; returns whether the rule is firing."""
+        if raw is not None and self.skip_zero and float(raw) == 0.0:
+            raw = None
         if raw is None:
             # metric absent: resolve and forget sustained-state (a
             # vanished series must not keep an alert pinned)
@@ -260,10 +277,12 @@ class Alert(object):
         self.name = rule.name
         self.severity = rule.severity
         self.value = rule.value
-        self.threshold = (rule.factor * rule.baseline
-                          if rule.kind == "regression"
-                          and rule.baseline is not None
-                          else rule.threshold)
+        if rule.kind == "regression" and rule.baseline is not None:
+            self.threshold = (rule.baseline / rule.factor
+                              if getattr(rule, "direction", "up") == "down"
+                              else rule.factor * rule.baseline)
+        else:
+            self.threshold = rule.threshold
         self.since = now
         self.description = rule.description
 
@@ -392,9 +411,10 @@ class Watchdog(object):
 
 def default_rules():
     """The stock SLO rule set: trace-buffer pressure, heartbeat age,
-    replication lag, step-p99 self-regression, and (when evaluated over
-    a federated source) straggler skew.  Thresholds come from the
-    ``MXNET_TPU_WATCHDOG_*`` env rows (docs/env_vars.md)."""
+    replication lag, step-p99 self-regression, (when evaluated over a
+    federated source) straggler skew, MFU self-regression, and the
+    goodput floor.  Thresholds come from the ``MXNET_TPU_WATCHDOG_*``
+    env rows (docs/env_vars.md)."""
     dead_after = _env_float("MXNET_TPU_PS_DEAD_AFTER", 30.0)
     return [
         Rule("spans_dropped", "spans_dropped_total", kind="increase",
@@ -425,4 +445,21 @@ def default_rules():
              description="the slowest shard/worker's latency skew "
                          "exceeds the straggler threshold "
                          "(cluster_straggler_info names it)"),
+        # efficiency rules (observability/efficiency.py): both gauges are
+        # lazily measured, so skip_zero keeps a not-yet-measuring (or
+        # registry-reset) process from firing on the zero placeholder
+        Rule("mfu_regression", "model_flops_utilization",
+             kind="regression", direction="down", skip_zero=True,
+             factor=_env_float("MXNET_TPU_WATCHDOG_MFU_FACTOR", 1.5),
+             window_s=600.0, severity="warning",
+             description="model FLOPs utilization fell below its own "
+                         "rolling baseline / MXNET_TPU_WATCHDOG_MFU_"
+                         "FACTOR (hardware efficiency regressed)"),
+        Rule("goodput_floor", "goodput_ratio", op="<", skip_zero=True,
+             threshold=_env_float("MXNET_TPU_WATCHDOG_GOODPUT_FLOOR",
+                                  0.5),
+             severity="warning",
+             description="the last fit's goodput ratio fell below the "
+                         "floor — badput_seconds_total{cause} says "
+                         "where the wall time went"),
     ]

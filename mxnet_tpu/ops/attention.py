@@ -399,8 +399,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale,
                       block_q=1024, block_k=1024, interpret=False):
     """Two-pass Pallas flash backward on [B, H, T, D]: a dk/dv kernel and
     a dq kernel, each O(block) VMEM — the backward twin of
-    ``_flash_fwd_pallas`` (ends the plain-jax recompute that capped the
-    transformer bench at 27.8% MFU, docs/PERF.md)."""
+    ``_flash_fwd_pallas`` (ends the plain-jax recompute that MFU-capped
+    the transformer bench; the measured figure lives in the
+    ``model_flops_utilization`` gauge / bench.py's ``mfu`` key, not
+    here — see docs/PERF.md "MFU is measured, not quoted")."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

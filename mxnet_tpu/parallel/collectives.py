@@ -14,22 +14,67 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-__all__ = ["init_process_group", "allreduce_hosts", "barrier", "rank", "size"]
+__all__ = ["init_process_group", "serve_worker_metrics",
+           "allreduce_hosts", "barrier", "rank", "size"]
 
 _INITIALIZED = {"v": False}
+_WORKER_METRICS = {"server": None, "watchdog": None}
+
+
+def serve_worker_metrics():
+    """Serve this worker rank's ``/metrics`` (+ ``/alerts`` under
+    ``MXNET_TPU_WATCHDOG``, ``/profile`` always) endpoint — the same
+    contract ``mxnet_tpu._async_ps_main`` gives server processes, so
+    federation can scrape workers too.  No-op unless
+    ``MXNET_TPU_METRICS_PORT`` is set (``tools/launch.py
+    --metrics-port-base`` hands worker rank *i* port
+    ``base + <server procs> + i``); idempotent; a failed bind logs and
+    continues — observability must not take down training.  Returns
+    the :class:`~..observability.MetricsServer` or None."""
+    import logging
+    import os
+
+    if _WORKER_METRICS["server"] is not None:
+        return _WORKER_METRICS["server"]
+    if not os.environ.get("MXNET_TPU_METRICS_PORT"):
+        return None
+    watchdog = None
+    if os.environ.get("MXNET_TPU_WATCHDOG", "").lower() not in (
+            "", "0", "false", "no"):
+        from ..observability import Watchdog, default_rules
+
+        watchdog = Watchdog(default_rules())
+        watchdog.start()
+    try:
+        from ..observability import start_metrics_server
+
+        server = start_metrics_server(watchdog=watchdog)
+    except OSError:
+        logging.getLogger(__name__).exception(
+            "worker /metrics endpoint failed to bind (continuing "
+            "without)")
+        if watchdog is not None:
+            watchdog.stop()
+        return None
+    logging.getLogger(__name__).info("worker metrics at %s", server.url)
+    _WORKER_METRICS.update(server=server, watchdog=watchdog)
+    return server
 
 
 def init_process_group(coordinator_address=None, num_processes=None, process_id=None):
     """Bootstrap multi-process JAX (parity: the dmlc tracker env handshake,
     ``tools/launch.py`` + ``MXInitPSEnv``).  Reads ``MXNET_TPU_COORDINATOR``
-    style env vars when args are omitted (the DMLC_PS_ROOT_URI analog)."""
+    style env vars when args are omitted (the DMLC_PS_ROOT_URI analog).
+    Also brings up this rank's metrics endpoint when the launcher handed
+    it a port (:func:`serve_worker_metrics`)."""
     import os
 
+    serve_worker_metrics()
     if _INITIALIZED["v"]:
         return
     coordinator_address = coordinator_address or os.environ.get("MXNET_TPU_COORDINATOR")
     if coordinator_address is None:
-        return  # single-process mode
+        return  # single-process mode (the metrics endpoint still serves)
     if os.environ.get("_MXNET_TPU_DIST_READY"):
         # the package-import bootstrap (mxnet_tpu/__init__.py) already ran
         _INITIALIZED["v"] = True

@@ -16,6 +16,7 @@ per-layer ``priority=-index`` push/pull scheduling plays by hand
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import time as _time
 
 from typing import Dict, Optional
@@ -27,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..observability import attribution as _attr
+from ..observability import efficiency as _eff
 from ..observability import metrics as _metrics
 
 __all__ = ["ShardedTrainer", "auto_tp_specs", "zero_extend_spec"]
@@ -616,19 +618,41 @@ class ShardedTrainer:
                   for n in self._input_names}
         return pshard, mshard, ashard, dshard
 
-    @staticmethod
-    def _compile_counted(cache, jitted):
+    def _compile_counted(self, cache, jitted, raw=None, steps=1):
         """Wrap a jitted callable so its FIRST call (the trace+compile)
         lands in the compile-accounting families under ``cache``; every
         later call passes straight through.  Pairs with the jit caches:
         one wrapper per cache entry, so steady-state fit records zero
-        compiles and a moving counter means the cache keys missed."""
+        compiles and a moving counter means the cache keys missed.
+
+        With ``raw`` (the underlying ``jax.jit`` object), the first
+        call also records the compiled program's HLO cost analysis —
+        FLOPs / bytes / memory footprint under
+        ``trainer_compile_flops{cache}`` etc. and, via ``steps`` (how
+        many optimizer steps one dispatch advances — ``pipeline_fn(n)``
+        scans ``n``; 0 = not a train step), the per-step model-FLOPs
+        figure MFU is derived from (``observability.efficiency``).  The
+        lowering happens BEFORE the dispatch runs, while donated
+        argument buffers are still live; its cost (one extra AOT
+        compile per cache under the default
+        ``MXNET_TPU_COST_ANALYSIS=compiled`` tier) is deliberately
+        inside the ``trainer_compile_seconds`` window so the goodput
+        ledger books it as recompile badput."""
         done = []
+        mesh = self.mesh
 
         def call(*args, **kwargs):
             if done:
                 return jitted(*args, **kwargs)
             t0 = _time.monotonic()
+            if raw is not None:
+                from . import default_mesh
+
+                def _lower():
+                    with default_mesh(mesh):
+                        return raw.lower(*args, **kwargs)
+
+                _eff.record_compile(cache, _lower, steps=steps)
             out = jitted(*args, **kwargs)
             done.append(True)
             _M_COMPILES.labels(cache).inc()
@@ -651,7 +675,8 @@ class ShardedTrainer:
             donate_argnums=(0, 1),
         )
         self._jit_step = self._compile_counted(
-            "step", self._with_mesh(self._jit_step_raw))
+            "step", self._with_mesh(self._jit_step_raw),
+            raw=self._jit_step_raw)
         return self._jit_step
 
     # ------------------------------------------------------------------
@@ -745,7 +770,8 @@ class ShardedTrainer:
             donate_argnums=(0, 1),
         )
         wrapped = self._compile_counted(
-            "pipe:%d:%d" % (n, unroll), self._with_mesh(fn))
+            "pipe:%d:%d" % (n, unroll), self._with_mesh(fn), raw=fn,
+            steps=n)
         self._jit_pipe[(n, unroll)] = wrapped
         return wrapped
 
@@ -799,8 +825,9 @@ class ShardedTrainer:
             return outs, grads, new_aux
 
         pshard, _, ashard, dshard = self._step_shardings()
-        self._jit_grad = self._compile_counted("grad", self._with_mesh(
-            jax.jit(gstep, in_shardings=(pshard, ashard, dshard, None))))
+        gjit = jax.jit(gstep, in_shardings=(pshard, ashard, dshard, None))
+        self._jit_grad = self._compile_counted(
+            "grad", self._with_mesh(gjit), raw=gjit)
         return self._jit_grad
 
     def forward_fn(self):
@@ -821,8 +848,11 @@ class ShardedTrainer:
         ashard = {n: self._sharding(P()) for n in self.aux_shapes}
         dshard = {n: self._sharding(self.data_specs[n])
                   for n in self._input_names}
-        self._jit_fwd = self._compile_counted("fwd", self._with_mesh(
-            jax.jit(fwd, in_shardings=(pshard, ashard, dshard, None))))
+        fjit = jax.jit(fwd, in_shardings=(pshard, ashard, dshard, None))
+        # steps=0: the eval forward is not a training step — its cost
+        # rows are recorded, the model-FLOPs/step gauge is left alone
+        self._jit_fwd = self._compile_counted(
+            "fwd", self._with_mesh(fjit), raw=fjit, steps=0)
         return self._jit_fwd
 
     # ------------------------------------------------------------------
@@ -1059,6 +1089,13 @@ class ShardedTrainer:
             "Training throughput (batch rows per second) of the most "
             "recent step or flush")
 
+        # goodput ledger: every wall second from here to the return is
+        # accounted productive vs badput{cause} (observability.efficiency);
+        # the snapshot must precede the first compile so warmup books as
+        # recompile badput
+        led = _eff.ledger()
+        t_fit = _time.monotonic()
+
         guard = self._skip_nonfinite
         bad_streak = 0
         skipped_total = 0
@@ -1069,7 +1106,7 @@ class ShardedTrainer:
             raise MXNetError("metric_every must be >= 1")
 
         def after_step(epoch, arrays, data_names, ok, outs_host,
-                       can_ckpt=True):
+                       can_ckpt=True, att=None):
             """Per-step host bookkeeping shared by the per-step and
             pipelined paths: skip policy, metric, speedometer, callbacks,
             periodic checkpoint.  ``outs_host=None`` = this step's flush
@@ -1114,10 +1151,14 @@ class ShardedTrainer:
                 cb(bep)
             if (can_ckpt and checkpoint_every
                     and global_step % checkpoint_every == 0):
-                _ckpt.save_sharded(checkpoint_dir, global_step, params,
-                                   moms, aux)
-                _ckpt.save_fit_meta(checkpoint_dir, global_step,
-                                    fit_meta(epoch, nbatch))
+                # timed as its own phase: the in-step save is badput in
+                # the goodput ledger's books, not productive step time
+                with (att.phase("checkpoint") if att is not None
+                      else _contextlib.nullcontext()):
+                    _ckpt.save_sharded(checkpoint_dir, global_step,
+                                       params, moms, aux)
+                    _ckpt.save_fit_meta(checkpoint_dir, global_step,
+                                        fit_meta(epoch, nbatch))
                 last_saved = global_step
                 _attr.sample_memory()
 
@@ -1167,11 +1208,13 @@ class ShardedTrainer:
                         outs_host = ([_np.asarray(o) for o in outs]
                                      if flushes % metric_every == 0
                                      else None)
-                    after_step(epoch, arrays, data_names, ok, outs_host)
+                    after_step(epoch, arrays, data_names, ok, outs_host,
+                               att=att)
                     dt = _time.monotonic() - t_step
-                    att.close(dt)
+                    led.step(dt, att.close(dt))
                     _m_step.observe(dt)
                     _m_steps.inc()
+                    _eff.record_step_rate(1, dt)
                     if dt > 0:
                         _m_tokens.set(
                             next(iter(arrays.values())).shape[0] / dt)
@@ -1249,12 +1292,13 @@ class ShardedTrainer:
                                 epoch, arrays, data_names, ok,
                                 None if outs_host is None
                                 else [o[j] for o in outs_host],
-                                can_ckpt=(j == n - 1))
+                                can_ckpt=(j == n - 1), att=att)
                         dt = _time.monotonic() - t_flush
-                        att.close(dt)
+                        led.step(dt, att.close(dt))
                         _m_steps.inc(n)
                         for _ in range(n):  # amortized per-step latency
                             _m_step.observe(dt / n)
+                        _eff.record_step_rate(n, dt)
                         if dt > 0:
                             rows = next(iter(
                                 chunk.host[0][0].values())).shape[0]
@@ -1283,6 +1327,7 @@ class ShardedTrainer:
                 log.info("epoch %d eval: %s", epoch, history[epoch]["eval"])
 
             if checkpoint_dir is not None:
+                t_ck = _time.monotonic()
                 if checkpoint_every:
                     # global-step numbering throughout (the historical
                     # epoch+1 numbering would collide with step numbers)
@@ -1301,6 +1346,10 @@ class ShardedTrainer:
                     _ckpt.save_fit_meta(checkpoint_dir, epoch + 1,
                                         fit_meta(epoch + 1, 0))
                 _attr.sample_memory()
+                # out-of-step badput: the epoch-end save happens outside
+                # any step window
+                led.bad("checkpoint", _time.monotonic() - t_ck)
+        led.close(_time.monotonic() - t_fit)
         return (params, moms, aux), history
 
     def _fit_kvstore(self, kv, train_data, eval_data=None, num_epoch=1,
@@ -1387,6 +1436,10 @@ class ShardedTrainer:
             "trainer_tokens_per_sec",
             "Training throughput (batch rows per second) of the most "
             "recent step or flush")
+        # goodput ledger: RPC retry/backoff and failover seconds booked by
+        # the kvstore client surface here as badput counter deltas
+        led = _eff.ledger()
+        t_fit = _time.monotonic()
         for epoch in range(begin_epoch, end_epoch):
             metric.reset()
             train_data.reset()
@@ -1424,9 +1477,10 @@ class ShardedTrainer:
                     metric.update([_np.asarray(v) for v in labels],
                                   [_np.asarray(o) for o in outs])
                 dt = _time.monotonic() - t_step
-                att.close(dt)
+                led.step(dt, att.close(dt))
                 _m_step.observe(dt)
                 _m_steps.inc()
+                _eff.record_step_rate(1, dt)
                 if dt > 0:
                     _m_tokens.set(
                         next(iter(arrays.values())).shape[0] / dt)
@@ -1456,6 +1510,7 @@ class ShardedTrainer:
                 history[epoch]["eval"] = metric.get()
                 log.info("epoch %d eval: %s", epoch,
                          history[epoch]["eval"])
+        led.close(_time.monotonic() - t_fit)
         return (params, moms, aux), history
 
     def _with_mesh(self, jitted):

@@ -243,6 +243,9 @@ _SHARD0_TEXT = (
     "# HELP kv_replication_lag Primary log entries not yet acked\n"
     "# TYPE kv_replication_lag gauge\n"
     'kv_replication_lag{follower="127.0.0.1:9001"} 2\n'
+    "# HELP model_flops_utilization Model FLOPs utilization\n"
+    "# TYPE model_flops_utilization gauge\n"
+    "model_flops_utilization 0.41\n"
 )
 _SHARD1_TEXT = (
     "# HELP kv_fenced_total Primaries fenced by a higher epoch\n"

@@ -302,7 +302,7 @@ def test_default_rules_clean_registry_fires_nothing():
     names = [r.name for r in wd.rules]
     assert names == ["spans_dropped", "heartbeat_stale",
                      "replication_lag", "step_p99_regression",
-                     "straggler"]
+                     "straggler", "mfu_regression", "goodput_floor"]
 
 
 # ---------------------------------------------------------------------------

@@ -6,6 +6,12 @@ training throughput rows (perf.md:181-188 +
 example/image-classification/README.md:145-156).
 
 Run on the TPU chip:  python tools/bench_table.py [--out BENCH_TABLE.md]
+
+Also the perf TREND GATE over the driver-verified history
+(``python tools/bench_table.py --trend`` / ``make bench-trend``): pure
+JSON over ``BENCH_r*.json`` — no accelerator, no fit — comparing the
+newest round's tracked keys against the best prior round and exiting
+nonzero on a >10% regression.
 """
 
 import argparse
@@ -28,6 +34,98 @@ P100_INFER = {"alexnet": 4883.77, "vgg": 854.4, "inception-bn": 1197.74,
 P100_TRAIN = {"resnet-50": 181.53, "inception-v3": 129.98}
 K80_TRAIN = {"resnet-18": 185.0, "resnet-50": 109.0, "resnet-152": 57.0,
              "inception-bn": 152.0}
+
+# trend-gate tracked keys: True = higher is better.  A key is only
+# gated when BOTH the newest round and some prior round carry it — the
+# bench schema is additive (older rows simply lack mfu/goodput_ratio)
+TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
+              "goodput_ratio": True,
+              "step_ms_p50": False, "step_ms_p99": False}
+TREND_TOLERANCE = 0.10
+
+
+def load_bench_rounds(root=ROOT):
+    """The ``BENCH_r*.json`` parsed rows as a round-sorted
+    ``[(round, row)]`` list.  Zero-value captures (tunnel-down rounds —
+    an outage is not a perf baseline) are dropped; rounds sharing a
+    ``git_sha`` are re-measurements of one commit, so only the
+    best-value one stands (schema<3 rows carry no sha and each stand
+    alone)."""
+    import glob
+    import re
+
+    rounds = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                row = json.load(f).get("parsed", {})
+        except Exception:
+            continue
+        try:
+            if float(row.get("value", 0) or 0) <= 0.0:
+                continue
+        except (TypeError, ValueError):
+            continue
+        rounds.append((int(m.group(1)), row))
+    rounds.sort()
+    best_by_sha = {}
+    for n, row in rounds:
+        sha = row.get("git_sha")
+        key = sha if sha and sha != "unknown" else "round-%d" % n
+        prev = best_by_sha.get(key)
+        if prev is None or float(row.get("value", 0)) > float(
+                prev[1].get("value", 0)):
+            best_by_sha[key] = (n, row)
+    return sorted(best_by_sha.values())
+
+
+def trend_gate(rounds=None, tolerance=TREND_TOLERANCE):
+    """Gate the newest round against the best prior value of every
+    tracked key.  Returns ``(ok, report_lines)``; ``ok`` is False when
+    any key shared by both sides regresses beyond ``tolerance`` in its
+    bad direction (throughput/mfu/goodput down, latency up)."""
+    if rounds is None:
+        rounds = load_bench_rounds()
+    lines = []
+    if len(rounds) < 2:
+        lines.append("trend: %d usable round(s) — nothing to compare"
+                     % len(rounds))
+        return True, lines
+    latest_n, latest = rounds[-1]
+    prior = rounds[:-1]
+    ok = True
+    for key in sorted(TREND_KEYS):
+        higher_better = TREND_KEYS[key]
+        try:
+            cur = float(latest[key])
+        except (KeyError, TypeError, ValueError):
+            continue
+        vals = []
+        for n, row in prior:
+            try:
+                vals.append((float(row[key]), n))
+            except (KeyError, TypeError, ValueError):
+                continue
+        if not vals:
+            lines.append("trend %-16s r%02d %.6g (new key; no prior "
+                         "round carries it)" % (key, latest_n, cur))
+            continue
+        best, best_n = max(vals) if higher_better else min(vals)
+        if higher_better:
+            regressed = best > 0 and cur < best * (1.0 - tolerance)
+        else:
+            regressed = cur > best * (1.0 + tolerance)
+        delta = (cur / best - 1.0) if best else 0.0
+        lines.append("trend %-16s r%02d %.6g vs best r%02d %.6g "
+                     "(%+.1f%%)%s" % (key, latest_n, cur, best_n, best,
+                                      100.0 * delta,
+                                      "  REGRESSED" if regressed else ""))
+        if regressed:
+            ok = False
+    return ok, lines
 
 
 def bench_train(network, batch, dtype, steps=20, num_layers=None,
@@ -373,7 +471,18 @@ def main():
                     "rows) and keep the max — sub-2ms steps over the "
                     "tunneled device see transient dispatch stalls that "
                     "can halve a single capture")
+    ap.add_argument("--trend", action="store_true",
+                    help="no measurement: gate the BENCH_r*.json history "
+                    "— exit 1 if the newest round regresses any tracked "
+                    "key beyond --trend-tolerance vs the best prior round")
+    ap.add_argument("--trend-tolerance", type=float,
+                    default=TREND_TOLERANCE)
     args = ap.parse_args()
+
+    if args.trend:
+        ok, lines = trend_gate(tolerance=args.trend_tolerance)
+        print("\n".join(lines))
+        sys.exit(0 if ok else 1)
 
     import jax
     import mxnet_tpu as mx
