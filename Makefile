@@ -37,8 +37,11 @@ trace:
 watchdog:
 	python tools/watchdog_fit.py
 
+serve:
+	python tools/serve.py --smoke
+
 clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast bench bench-trend efficiency dryrun \
-	dist-test chaos trace watchdog clean
+	dist-test chaos trace watchdog serve clean

@@ -69,7 +69,9 @@ def _obs_counters():
 # consumers keying on schema_version never break on older rows).
 # v4: mfu / goodput_ratio / model_flops_per_step from the efficiency
 # accounting plane (cost-analysis FLOPs + goodput ledger)
-_SCHEMA_VERSION = 4
+# v5: requests_per_sec / request_ms_p50 / request_ms_p99 /
+# batch_occupancy from the BENCH_SERVING=1 continuous-batching loop
+_SCHEMA_VERSION = 5
 
 
 def _bench_peak():
@@ -263,6 +265,99 @@ def transformer_main():
     }))
 
 
+def serving_main():
+    """Serving-tier throughput: the continuous-batching scheduler vs a
+    batch-1 sequential ``forward()`` loop over the SAME model and
+    shapes.  Select with BENCH_SERVING=1; prints the same one-line JSON
+    contract with the schema-5 additive keys (``requests_per_sec``,
+    ``request_ms_p50``/``p99``, ``batch_occupancy``) plus
+    ``requests_per_sec_sequential`` (the per-request-dispatch baseline
+    the ≥2× acceptance ratio is taken against) and
+    ``recompiles_after_warmup`` (0 is the steady-state contract)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu import predict, serving
+
+    platform = jax.devices()[0].platform
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "256"))
+    feat = int(os.environ.get("BENCH_FEATURES", "32"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "64"))
+    # a geometric ladder (not a dense one): deep windows amortize the
+    # per-dispatch tax hardest, and each bucket is one compiled
+    # executor — 4 shapes cover 1..64 within 4x padding waste
+    buckets = [1, 4, 16, 64]
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(1, feat))
+    rs = np.random.RandomState(0)
+    params = {"arg:%s" % n: nd.array(rs.randn(*s).astype(np.float32)
+                                     * 0.1)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data" and not n.endswith("label")}
+
+    def _pred():
+        return predict.Predictor(net.tojson(), dict(params),
+                                 input_shapes={"data": (1, feat)})
+
+    rows = rs.randn(n_requests, feat).astype(np.float32)
+
+    # baseline: one device dispatch per request (batch 1, warm executor)
+    seq_pred = _pred()
+    seq_pred.forward(data=rows[:1])
+    seq_pred.get_output(0)
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        seq_pred.forward(data=rows[i:i + 1])
+        seq_pred.get_output(0)
+    rps_sequential = n_requests / (time.perf_counter() - t0)
+
+    # continuous batching over the same shapes: pre-bound buckets, all
+    # requests in flight, the dispatch loop packs them into windows
+    sched = serving.Scheduler(name="bench")
+    sched.register("bench_mlp", _pred(), buckets=buckets,
+                   max_queue=n_requests + len(buckets))
+    sched.warmup("bench_mlp")
+    compiles = obs.REGISTRY.get("serving_compiles_total")
+    warm_compiles = int(compiles.total()) if compiles else 0
+    t0 = time.perf_counter()
+    reqs = [sched.submit("bench_mlp", {"data": rows[i]})
+            for i in range(n_requests)]
+    for r in reqs:
+        r.result(timeout=120)
+    dt = time.perf_counter() - t0
+    rps = n_requests / dt
+    lat_ms = np.asarray([r.latency_s for r in reqs]) * 1e3
+    p50, p99 = np.percentile(lat_ms, [50, 99])
+    stats = sched.stats("bench_mlp")
+    recompiles = (int(compiles.total()) if compiles else 0) \
+        - warm_compiles
+    sched.close()
+
+    print(json.dumps({
+        "metric": "serving_throughput" if platform == "tpu"
+                  else "serving_cpu_smoke_throughput",
+        "value": round(rps, 2), "unit": "req/s",
+        "vs_baseline": 0.0,  # the 2017 reference has no serving tier
+        "requests_per_sec": round(rps, 2),
+        "request_ms_p50": round(float(p50), 3),
+        "request_ms_p99": round(float(p99), 3),
+        "batch_occupancy": round(stats["occupancy"], 4),
+        "requests_per_sec_sequential": round(rps_sequential, 2),
+        "recompiles_after_warmup": recompiles,
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"requests": n_requests, "features": feat,
+                   "hidden": hidden, "buckets": buckets},
+    }))
+
+
 def main():
     import jax
     import mxnet_tpu  # noqa: F401
@@ -270,6 +365,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_SERVING") == "1":
+        serving_main()
+        return
     if os.environ.get("BENCH_MODEL") == "transformer":
         transformer_main()
         return
@@ -473,6 +571,9 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_SERVING") == "1":
+        return ("serving_throughput", "serving_cpu_smoke_throughput",
+                "req/s")
     if os.environ.get("BENCH_MODEL") == "transformer":
         return ("transformer_lm_train_throughput",
                 "transformer_lm_cpu_smoke_throughput", "tokens/s")

@@ -101,6 +101,7 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import predict
 from . import deploy
+from . import serving
 from . import kvstore_server
 from . import engine
 from . import chaos

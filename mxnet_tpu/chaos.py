@@ -21,6 +21,13 @@ site                      planted at
 ``kvstore.repl_delay``    primary→follower replication send (stretches
                           the replication-lag window)
 ``checkpoint.write``      sharded + two-file checkpoint writes
+``serving.admit``         serving request admission
+                          (``serving.Scheduler.submit``; ``name`` is the
+                          model, so ``match`` can shed one tenant)
+``serving.dispatch``      serving batch dispatch, just before the device
+                          call (``name`` is ``<model>:<bucket>``; retried
+                          ``MXNET_TPU_SERVING_RETRIES`` times, then failed
+                          requests fail over to a peer replica)
 ========================  ==================================================
 
 Four failure modes:
@@ -70,7 +77,7 @@ _M_FIRED = _metrics.counter(
 SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
-    "checkpoint.write",
+    "checkpoint.write", "serving.admit", "serving.dispatch",
 })
 
 

@@ -412,9 +412,10 @@ class Watchdog(object):
 def default_rules():
     """The stock SLO rule set: trace-buffer pressure, heartbeat age,
     replication lag, step-p99 self-regression, (when evaluated over a
-    federated source) straggler skew, MFU self-regression, and the
-    goodput floor.  Thresholds come from the ``MXNET_TPU_WATCHDOG_*``
-    env rows (docs/env_vars.md)."""
+    federated source) straggler skew, MFU self-regression, the goodput
+    floor, and the serving tier's request-p99 SLO + queue-saturation
+    rules.  Thresholds come from the ``MXNET_TPU_WATCHDOG_*`` env rows
+    (docs/env_vars.md)."""
     dead_after = _env_float("MXNET_TPU_PS_DEAD_AFTER", 30.0)
     return [
         Rule("spans_dropped", "spans_dropped_total", kind="increase",
@@ -462,4 +463,17 @@ def default_rules():
              description="the last fit's goodput ratio fell below the "
                          "floor — badput_seconds_total{cause} says "
                          "where the wall time went"),
+        # serving-tier SLOs (serving/scheduler.py)
+        Rule("request_p99_slo", "serving_request_seconds", stat="p99",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_REQUEST_P99", 1.0),
+             severity="critical",
+             description="serving request p99 (admission to response) "
+                         "broke the MXNET_TPU_WATCHDOG_REQUEST_P99 SLO"),
+        Rule("queue_saturation", "serving_queue_saturation", stat="max",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_QUEUE_SAT", 0.9),
+             for_s=_env_float("MXNET_TPU_WATCHDOG_QUEUE_SAT_FOR_S", 0.0),
+             severity="warning",
+             description="a model lane's queue is nearly full "
+                         "(depth/max_queue) — overload shedding is "
+                         "imminent; add replicas or widen buckets"),
     ]

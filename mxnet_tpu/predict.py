@@ -132,6 +132,8 @@ class Predictor(object):
         import jax
 
         if isinstance(batches, dict):
+            if not batches:
+                raise MXNetError("forward_pipeline needs >= 1 batch")
             stacked = {n: _np.asarray(v) for n, v in batches.items()}
         else:
             if not batches:
@@ -155,6 +157,10 @@ class Predictor(object):
             raise MXNetError(
                 "inputs disagree on pipeline depth: %r" % sorted(depths))
         depth = depths.pop()
+        if depth == 0:
+            # a pre-stacked {n: empty [0, ...]} dict would compile a
+            # degenerate scan and silently return empty outputs
+            raise MXNetError("forward_pipeline needs >= 1 batch")
         ex = self._exec
         stacked = {n: v.astype(ex.arg_dict[n].dtype, copy=False)
                    for n, v in stacked.items()}

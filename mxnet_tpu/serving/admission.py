@@ -1,0 +1,185 @@
+"""Admission control and SLO-aware deadlines for the serving tier.
+
+A production front-end must say **no** cheaply: every request admitted
+past the system's capacity makes every other request slower, and a
+request whose deadline has already passed wastes device time producing
+an answer nobody is waiting for.  This module is the serving tier's
+bouncer — typed, HTTP-mappable rejections at the door:
+
+- **Bounded queues.**  Each model lane has a ``max_queue``
+  (``MXNET_TPU_SERVING_MAX_QUEUE``); an admit past the bound raises
+  :class:`ServerOverloadedError` (HTTP 429).  Backpressure is explicit
+  and immediate, never a silently growing queue.
+- **Deadlines, checked twice.**  A request may carry ``deadline_ms``
+  (default ``MXNET_TPU_SERVING_DEADLINE_MS``; 0 = none).  An
+  already-expired deadline is rejected at admission
+  (:class:`DeadlineExceededError`, HTTP 504), and the scheduler checks
+  AGAIN when the request is pulled for dispatch — a request that
+  expired while queued never reaches the device (docs/how_to/
+  serving.md "SLO knobs").
+- **Drain mode.**  :meth:`AdmissionController.start_drain` stops
+  admitting (:class:`ServerDrainingError`, HTTP 503) while everything
+  already accepted keeps flowing to completion — the graceful-restart
+  half of a rolling deploy.
+
+Every rejection increments ``serving_rejected_total{model,reason}``
+with ``reason`` ∈ ``overload | deadline | draining`` so shed load is
+accounted, never inferred.  The scheduler consults the chaos site
+``serving.admit`` on every admit (outside the queue lock, so injected
+delays stall one caller, not the dispatch loop), letting fault drills
+shed or delay at the door deterministically (seeded — see
+``mxnet_tpu/chaos.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..base import MXNetError
+from ..observability import metrics as _metrics
+
+__all__ = ["ServingError", "ServerOverloadedError", "ServerDrainingError",
+           "DeadlineExceededError", "UnknownModelError", "ReplicaDeadError",
+           "AdmissionController", "deadline_from_ms", "default_deadline_ms",
+           "max_queue_default"]
+
+
+class ServingError(MXNetError):
+    """Base class for typed serving rejections; ``http_status`` maps the
+    error onto the wire (``frontend.py`` uses it verbatim)."""
+
+    http_status = 500
+
+
+class ServerOverloadedError(ServingError):
+    """The model's queue is at ``max_queue`` — shed, don't buffer."""
+
+    http_status = 429
+
+
+class ServerDrainingError(ServingError):
+    """The replica is draining: accepted work finishes, new work is
+    refused (the rolling-restart window)."""
+
+    http_status = 503
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed — at admission, while queued, or
+    before its batch dispatched.  Expired requests never cost device
+    time."""
+
+    http_status = 504
+
+
+class UnknownModelError(ServingError):
+    """No model registered under that name."""
+
+    http_status = 404
+
+
+class ReplicaDeadError(ServingError):
+    """The replica was killed (or fenced) with this request unanswered;
+    a router retries it on a peer — the caller only sees this when no
+    peer is left."""
+
+    http_status = 503
+
+
+_M_REJECTED = _metrics.counter(
+    "serving_rejected_total",
+    "Serving requests shed, by model and reason "
+    "(overload | deadline | draining)", ["model", "reason"])
+
+
+def default_deadline_ms():
+    """``MXNET_TPU_SERVING_DEADLINE_MS`` (0 = no default deadline)."""
+    try:
+        return float(os.environ.get("MXNET_TPU_SERVING_DEADLINE_MS", "0"))
+    except ValueError:
+        return 0.0
+
+
+def max_queue_default():
+    """``MXNET_TPU_SERVING_MAX_QUEUE`` (per-model lane bound)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_SERVING_MAX_QUEUE", "256"))
+    except ValueError:
+        return 256
+
+
+def deadline_from_ms(deadline_ms=None, now=None):
+    """Relative ``deadline_ms`` → absolute monotonic deadline (seconds),
+    or None for no deadline.  ``deadline_ms=None`` falls back to the
+    ``MXNET_TPU_SERVING_DEADLINE_MS`` default."""
+    if deadline_ms is None:
+        deadline_ms = default_deadline_ms()
+    deadline_ms = float(deadline_ms)
+    if deadline_ms <= 0:
+        return None
+    return (time.monotonic() if now is None else now) + deadline_ms / 1e3
+
+
+class AdmissionController(object):
+    """Admission policy for one replica: queue bounds, deadline checks,
+    drain mode.  The scheduler consults :meth:`admit` with the lane's
+    current depth BEFORE enqueueing and :meth:`expired` again when the
+    request is pulled for dispatch."""
+
+    def __init__(self, reject_counter=None):
+        # per-replica metric registries (in-process replica groups)
+        # resolve their own family; the process-global one is the default
+        self._rejected = reject_counter or _M_REJECTED
+        self._draining = False
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def start_drain(self):
+        """Stop admitting; everything already queued still completes."""
+        self._draining = True
+
+    def stop_drain(self):
+        """Re-open admission (a drain that turned out unnecessary)."""
+        self._draining = False
+
+    def account(self, model, reason):
+        """Book one shed request without raising (dispatch-side expiry,
+        where the error lands on the request future instead)."""
+        self._rejected.labels(model, reason).inc()
+
+    def reject(self, model, reason, detail=""):
+        """Account a shed request and raise its typed error."""
+        self.account(model, reason)
+        if reason == "draining":
+            raise ServerDrainingError(
+                "model %r: replica is draining%s" % (model, detail))
+        if reason == "deadline":
+            raise DeadlineExceededError(
+                "model %r: deadline exceeded%s" % (model, detail))
+        raise ServerOverloadedError(
+            "model %r: queue full%s" % (model, detail))
+
+    def admit(self, model, depth, max_queue, deadline, now=None):
+        """Gate one request at the door.  Raises the typed rejection
+        (accounted in ``serving_rejected_total``) or returns silently.
+        Pure policy — the scheduler fires the ``serving.admit`` chaos
+        site before calling, outside its queue lock."""
+        if self._draining:
+            self.reject(model, "draining")
+        now = time.monotonic() if now is None else now
+        if deadline is not None and now >= deadline:
+            self.reject(model, "deadline", " (expired at admission)")
+        if depth >= max_queue:
+            self.reject(model, "overload",
+                        " (depth %d >= max_queue %d)" % (depth, max_queue))
+
+    @staticmethod
+    def expired(deadline, now=None):
+        """Second check, at dispatch time: True when the deadline passed
+        while the request sat in the queue."""
+        if deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= deadline

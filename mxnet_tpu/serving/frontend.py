@@ -1,0 +1,194 @@
+"""HTTP front-end: the serving tier's wire surface.
+
+A tiny stdlib ``http.server`` endpoint (same loopback posture as
+``observability.exporters.start_metrics_server``) in front of a
+:class:`~.scheduler.Scheduler` or a
+:class:`~.replication.ServingRouter`:
+
+``POST /v1/predict``
+    JSON body ``{"model": ..., "inputs": {name: nested lists},
+    "deadline_ms": ...}`` → ``{"model": ..., "outputs": [...]}``.
+    Raw-tensor bodies are supported with
+    ``Content-Type: application/octet-stream`` and query parameters
+    ``?model=m&input=data``: the body is one ``.npy``-serialized
+    per-sample array (``numpy.save`` bytes), the response the first
+    output as ``.npy`` bytes (``X-MXTPU-Outputs`` carries the count) —
+    no JSON float round-trip on the hot path.
+``GET /v1/models``
+    The registry listing (name, input signature, buckets, max_queue).
+``GET /healthz`` / ``GET /readyz``
+    Liveness vs readiness: ``healthz`` answers 200 while the process
+    serves HTTP at all; ``readyz`` answers 503 while draining/fenced,
+    which is how a load balancer is told to stop sending — the other
+    half of drain mode.
+
+Typed serving errors map to the wire via their ``http_status``
+(429 overload, 503 draining/dead, 504 deadline, 404 unknown model);
+the body is ``{"error": ..., "type": ...}``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["ServingFrontend", "start_frontend"]
+
+
+class ServingFrontend(object):
+    """Handle for a running front-end: ``.port``, ``.url``,
+    ``.close()``.  Also a context manager."""
+
+    def __init__(self, httpd, thread, target):
+        self._httpd = httpd
+        self._thread = thread
+        self.target = target
+        self.port = httpd.server_address[1]
+        self.url = "http://%s:%d" % (httpd.server_address[0], self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _target_request(target, model, inputs, deadline_ms, timeout):
+    # Scheduler and ServingRouter share the request() signature
+    return target.request(model, inputs, deadline_ms=deadline_ms,
+                          timeout=timeout)
+
+
+def _target_models(target):
+    if hasattr(target, "registry"):               # Scheduler
+        return target.registry.describe()
+    group = getattr(target, "_group", None)       # ServingRouter
+    if group is not None:
+        live = group.live()
+        if live:
+            return live[0][1].registry.describe()
+    return []
+
+
+def _target_ready(target):
+    if hasattr(target, "ready"):                  # Scheduler
+        return bool(target.ready())
+    group = getattr(target, "_group", None)       # ServingRouter
+    if group is not None:
+        return any(s.ready() for _, s in group.live())
+    return False
+
+
+def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
+    """Serve the v1 API for ``target`` (a Scheduler or ServingRouter)
+    on a daemon thread; returns a :class:`ServingFrontend`.
+
+    ``port=None`` reads ``MXNET_TPU_SERVING_PORT`` (default 0 = a
+    kernel-assigned free port, reported via ``.port``).  Loopback-bound
+    unless ``addr`` says otherwise — the endpoint is unauthenticated.
+    """
+    import http.server
+    import os
+    import urllib.parse
+
+    if port is None:
+        port = int(os.environ.get("MXNET_TPU_SERVING_PORT", "0"))
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, status, body, ctype, extra=()):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, status, payload):
+            self._reply(status, json.dumps(payload).encode("utf-8"),
+                        "application/json; charset=utf-8")
+
+        def _reply_error(self, exc):
+            status = getattr(exc, "http_status", None)
+            if status is None:
+                status = 400 if isinstance(exc, MXNetError) else 500
+            self._reply_json(status, {"error": str(exc),
+                                      "type": type(exc).__name__})
+
+        def do_GET(self):
+            path, _, _query = self.path.partition("?")
+            if path == "/v1/models":
+                self._reply_json(200, {"models": _target_models(target)})
+            elif path == "/healthz":
+                self._reply_json(200, {"status": "ok"})
+            elif path == "/readyz":
+                if _target_ready(target):
+                    self._reply_json(200, {"status": "ready"})
+                else:
+                    self._reply_json(503, {"status": "not ready"})
+            else:
+                self.send_error(404)
+
+        def do_POST(self):
+            path, _, query = self.path.partition("?")
+            if path != "/v1/predict":
+                self.send_error(404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                ctype = (self.headers.get("Content-Type") or "").lower()
+                if ctype.startswith("application/octet-stream"):
+                    self._predict_raw(body, query)
+                else:
+                    self._predict_json(body)
+            except MXNetError as exc:
+                self._reply_error(exc)
+            except (ValueError, KeyError, TypeError) as exc:
+                self._reply_json(400, {"error": str(exc),
+                                       "type": type(exc).__name__})
+
+        def _predict_json(self, body):
+            payload = json.loads(body.decode("utf-8"))
+            model = payload["model"]
+            inputs = {n: _np.asarray(v, dtype=_np.float32)
+                      for n, v in payload["inputs"].items()}
+            outs = _target_request(target, model, inputs,
+                                   payload.get("deadline_ms"), timeout)
+            self._reply_json(200, {
+                "model": model,
+                "outputs": [_np.asarray(o).tolist() for o in outs]})
+
+        def _predict_raw(self, body, query):
+            q = urllib.parse.parse_qs(query)
+            model = q["model"][0]
+            name = q.get("input", ["data"])[0]
+            deadline = q.get("deadline_ms", [None])[0]
+            row = _np.load(io.BytesIO(body), allow_pickle=False)
+            outs = _target_request(
+                target, model, {name: row},
+                float(deadline) if deadline is not None else None, timeout)
+            buf = io.BytesIO()
+            _np.save(buf, _np.asarray(outs[0]))
+            self._reply(200, buf.getvalue(), "application/octet-stream",
+                        extra=(("X-MXTPU-Outputs", str(len(outs))),))
+
+        def log_message(self, *args):  # requests don't belong on stderr
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, int(port)), _Handler)
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mxtpu-serving-http", daemon=True)
+    thread.start()
+    return ServingFrontend(httpd, thread, target)

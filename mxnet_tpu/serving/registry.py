@@ -1,0 +1,272 @@
+"""Multi-tenant model registry + pluggable serving backends.
+
+One serving replica hosts many models: the registry maps a model
+**name** to a backend — a :class:`~mxnet_tpu.predict.Predictor`
+(checkpoint artifacts, per-bucket executor cache) or a
+:class:`~mxnet_tpu.deploy.ExportedModel` (a ``.mxtpu`` StableHLO
+artifact) — plus its per-model batching policy (bucket sizes, queue
+bound).  Both backends serve through the same scheduler and front-end;
+:func:`as_backend` coerces either raw object.
+
+**Bucketing model.**  A backend declares per-sample input shapes; the
+scheduler packs waiting requests along the batch axis and pads to the
+smallest configured bucket ≥ the pack size.  For a Predictor every
+bucket is one entry in its shape-keyed executor cache, so steady-state
+serving re-uses compiled executables and never recompiles — the
+bucketing-executor trick applied to live traffic (``serving_compiles_
+total{model}`` counts cold buckets; flat after warmup is the tested
+contract).  An ExportedModel's signature is frozen at export, so its
+only bucket is the exported batch size.
+
+**Hot reload.**  :meth:`ModelRegistry.swap` replaces a model's backend
+atomically *between* dispatch windows: the scheduler holds the entry's
+``dispatch_lock`` for the duration of a device dispatch, and the swap
+takes the same lock — a batch is computed entirely by the old params
+or entirely by the new, never a mix (``tests/test_serving.py``
+hot-reload atomicity).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import admission as _admission
+
+__all__ = ["Backend", "PredictorBackend", "ExportedBackend", "as_backend",
+           "ModelRegistry", "default_buckets"]
+
+
+def default_buckets():
+    """``MXNET_TPU_SERVING_BUCKETS`` (comma-separated batch sizes)."""
+    raw = os.environ.get("MXNET_TPU_SERVING_BUCKETS", "1,2,4,8")
+    try:
+        buckets = sorted({int(b) for b in raw.split(",") if b.strip()})
+    except ValueError:
+        buckets = [1, 2, 4, 8]
+    return [b for b in buckets if b > 0] or [1]
+
+
+class Backend(object):
+    """Serving-backend protocol.
+
+    ``input_shapes``  dict name -> **per-sample** shape (no batch dim).
+    ``buckets``       fixed bucket list, or None to accept the
+                      registry's configured buckets.
+    ``infer(batch)``  run one padded ``{name: [B, ...]}`` batch; returns
+                      ``(outputs, cold)`` where ``outputs`` is a list of
+                      ``[B, ...]`` numpy arrays and ``cold`` is True when
+                      this batch shape had to compile (first visit).
+    """
+
+    input_shapes = None
+    buckets = None
+
+    def infer(self, batch):
+        raise NotImplementedError
+
+    def describe(self):
+        return {"kind": type(self).__name__,
+                "inputs": {n: list(s) for n, s in self.input_shapes.items()}}
+
+
+class PredictorBackend(Backend):
+    """Serve a :class:`~mxnet_tpu.predict.Predictor`.
+
+    Rebinding per bucket goes through the Predictor's shape-keyed
+    executor cache, so each bucket compiles once and is thereafter a
+    cache hit; ``cold`` reports the cache miss so the scheduler can
+    account ``serving_compiles_total``.
+    """
+
+    def __init__(self, predictor):
+        self._pred = predictor
+        self.input_shapes = {n: tuple(s)[1:]
+                             for n, s in predictor._input_shapes.items()}
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None):
+        """Build straight from ``save_checkpoint`` artifacts (the hot-
+        reload path: load the new epoch, then ``registry.swap``)."""
+        from .. import predict
+
+        return cls(predict.load(prefix, epoch, ctx=ctx,
+                                input_shapes=input_shapes))
+
+    def _shape_key(self, bucket):
+        return tuple(sorted((n, (bucket,) + tuple(s))
+                            for n, s in self.input_shapes.items()))
+
+    def infer(self, batch):
+        pred = self._pred
+        bucket = next(iter(batch.values())).shape[0]
+        cold = self._shape_key(bucket) not in pred._exec_cache
+        shapes = {n: (bucket,) + tuple(self.input_shapes[n]) for n in batch}
+        if shapes != {n: tuple(s)
+                      for n, s in pred._input_shapes.items()}:
+            # ONE rebind for the whole batch shape — per-input set_input
+            # reshapes would bind throwaway mixed-batch executors
+            pred.reshape(shapes)
+        for n, v in batch.items():
+            pred.set_input(n, v)
+        pred._exec.forward(is_train=False)
+        outs = [pred.get_output(i) for i in range(pred.num_outputs)]
+        return outs, cold
+
+
+class ExportedBackend(Backend):
+    """Serve a ``.mxtpu`` deployment artifact
+    (:class:`~mxnet_tpu.deploy.ExportedModel`).
+
+    The StableHLO signature is frozen at export, so the ONLY bucket is
+    the exported batch size — the scheduler pads every window up to it.
+    """
+
+    def __init__(self, model):
+        from .. import deploy
+
+        if isinstance(model, str):
+            model = deploy.load_exported(model)
+        self._model = model
+        batches = {tuple(s)[0] for s in model.input_shapes.values()}
+        if len(batches) != 1:
+            raise MXNetError(
+                "exported model inputs disagree on batch dim: %r"
+                % sorted(batches))
+        self.buckets = [batches.pop()]
+        self.input_shapes = {n: tuple(s)[1:]
+                             for n, s in model.input_shapes.items()}
+        self._warm = False
+
+    def infer(self, batch):
+        cold = not self._warm
+        self._warm = True
+        outs = self._model(**batch)
+        return outs, cold
+
+
+def as_backend(obj):
+    """Coerce a Predictor / ExportedModel / ``.mxtpu`` path / Backend
+    into a :class:`Backend`."""
+    from .. import deploy, predict
+
+    if isinstance(obj, Backend):
+        return obj
+    if isinstance(obj, predict.Predictor):
+        return PredictorBackend(obj)
+    if isinstance(obj, deploy.ExportedModel) or (
+            isinstance(obj, str) and obj.endswith(".mxtpu")):
+        return ExportedBackend(obj)
+    raise MXNetError("cannot serve %r (want Predictor, ExportedModel, "
+                     ".mxtpu path, or Backend)" % (type(obj).__name__,))
+
+
+class _Entry(object):
+    """One registered model: the (swappable) backend + batching policy.
+    ``dispatch_lock`` serializes device dispatch with backend swaps —
+    the hot-reload atomicity boundary."""
+
+    __slots__ = ("name", "backend", "buckets", "max_queue",
+                 "dispatch_lock")
+
+    def __init__(self, name, backend, buckets, max_queue):
+        self.name = name
+        self.backend = backend
+        self.buckets = buckets
+        self.max_queue = max_queue
+        self.dispatch_lock = threading.Lock()
+
+    def pick_bucket(self, n):
+        """Smallest bucket ≥ n (the pad target); the largest bucket caps
+        a window, so n never exceeds it."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def pad(self, rows):
+        """Stack per-request rows into a padded ``{name: [bucket, ...]}``
+        batch.  Pad rows are zeros; their outputs are sliced off before
+        any caller sees them."""
+        n = len(rows)
+        bucket = self.pick_bucket(n)
+        batch = {}
+        for name, shape in self.backend.input_shapes.items():
+            arr = _np.zeros((bucket,) + tuple(shape), dtype=_np.float32)
+            for i, row in enumerate(rows):
+                arr[i] = row[name]
+            batch[name] = arr
+        return batch, bucket
+
+
+class ModelRegistry(object):
+    """Name → :class:`_Entry` map shared by scheduler and front-end."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def register(self, name, backend, buckets=None, max_queue=None):
+        """Register ``backend`` (coerced via :func:`as_backend`) under
+        ``name``.  ``buckets`` defaults to the backend's own bucket list
+        or ``MXNET_TPU_SERVING_BUCKETS``; ``max_queue`` to
+        ``MXNET_TPU_SERVING_MAX_QUEUE``."""
+        backend = as_backend(backend)
+        if buckets is None:
+            buckets = backend.buckets or default_buckets()
+        buckets = sorted({int(b) for b in buckets})
+        if backend.buckets is not None and buckets != backend.buckets:
+            raise MXNetError(
+                "model %r: backend serves fixed buckets %r, got %r"
+                % (name, backend.buckets, buckets))
+        if max_queue is None:
+            max_queue = _admission.max_queue_default()
+        with self._lock:
+            if name in self._entries:
+                raise MXNetError("model %r already registered (use swap "
+                                 "for hot reload)" % name)
+            entry = _Entry(name, backend, buckets, int(max_queue))
+            self._entries[name] = entry
+        return entry
+
+    def swap(self, name, backend):
+        """Atomically replace ``name``'s backend (checkpoint hot
+        reload).  Taken under the entry's ``dispatch_lock``, so the swap
+        lands BETWEEN dispatch windows: no batch ever mixes old and new
+        params.  The new backend must serve the same input signature."""
+        backend = as_backend(backend)
+        entry = self.get(name)
+        if backend.input_shapes != entry.backend.input_shapes:
+            raise MXNetError(
+                "model %r: hot reload changed input shapes %r -> %r"
+                % (name, entry.backend.input_shapes, backend.input_shapes))
+        if backend.buckets is not None and backend.buckets != entry.buckets:
+            raise MXNetError(
+                "model %r: hot reload changed buckets %r -> %r"
+                % (name, entry.buckets, backend.buckets))
+        with entry.dispatch_lock:
+            old, entry.backend = entry.backend, backend
+        return old
+
+    def get(self, name):
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise _admission.UnknownModelError(
+                "no model registered as %r" % (name,))
+        return entry
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def describe(self):
+        """``/v1/models`` payload: per-model signature + policy."""
+        with self._lock:
+            entries = sorted(self._entries.items())
+        return [{"name": name, "buckets": list(e.buckets),
+                 "max_queue": e.max_queue, **e.backend.describe()}
+                for name, e in entries]

@@ -1,0 +1,259 @@
+"""Serving replication and brownout: replica groups + a failover router.
+
+One replica is a single point of failure; the serving tier runs a
+**replica group** — N schedulers hosting the same models — and a
+router that spreads load round-robin and, when a replica dies, retries
+its accepted-but-unanswered requests on a peer.  The contract is
+brownout, not blackout:
+
+- **Accepted requests are never dropped.**  A request a dead replica
+  had admitted fails over to a live peer with ``force=True`` — the
+  peer re-admits it past its own overload/drain shedding, because the
+  request already cost the caller an accept.
+- **New load sheds gracefully.**  With a replica gone the survivors'
+  queues fill sooner; the overflow is shed with typed 429/503, every
+  shed accounted in ``serving_rejected_total``.
+
+Membership reuses the PR-3 machinery in ``kvstore_async``: the group
+publishes ``serving:<group>`` records through ``_membership_publish``
+(epoch-monotonic, replica lists merge), a fenced replica's epoch is
+left behind so a zombie refuses new work, and liveness is the same
+heartbeat idea — every scheduler's dispatch loop beats ``last_beat``,
+and :meth:`ReplicaGroup.detect` fences any replica whose beat went
+stale.  ``serving_failover_total`` counts fences;
+``serving_replica_up{replica}`` tracks liveness for the exposition.
+
+With ``isolated_metrics=True`` each replica gets its own metrics
+registry, and :meth:`ReplicaGroup.federation_targets` hands them to
+``observability.federation`` under the standard ``{shard, role,
+epoch}`` identity — one exposition, per-replica serving rows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..observability import metrics as _metrics
+from . import admission as _admission
+from .scheduler import Scheduler
+
+__all__ = ["ReplicaGroup", "ServingRouter"]
+
+_M_FAILOVER = _metrics.counter(
+    "serving_failover_total",
+    "Replica fences: a dead/stale replica removed from its group",
+    ["group"])
+_M_UP = _metrics.gauge(
+    "serving_replica_up",
+    "1 while the serving replica is live, 0 once fenced", ["replica"])
+
+
+def _group_key(group):
+    return "serving:%s" % group
+
+
+class ReplicaGroup(object):
+    """N serving replicas (schedulers) behind one membership record.
+
+    ``isolated_metrics=True`` gives each replica a private
+    ``observability.metrics.Registry`` so federation can render them as
+    distinct members; the default shares the process-global registry
+    (the single-process common case).
+    """
+
+    def __init__(self, replicas=2, group="serving",
+                 isolated_metrics=False):
+        from .. import kvstore_async as _kv
+
+        self.group = group
+        self.epoch = 0
+        self._lock = threading.Lock()
+        self._fenced = set()
+        self.registries = []
+        self.schedulers = []
+        for i in range(int(replicas)):
+            reg = _metrics.Registry() if isolated_metrics else None
+            self.registries.append(reg)
+            sched = Scheduler(metrics_registry=reg,
+                              name="%s/%d" % (group, i))
+            self.schedulers.append(sched)
+            _M_UP.labels(sched.name).set(1)
+        _kv._membership_publish(
+            _group_key(group), self.epoch,
+            [s.name for s in self.schedulers],
+            primary=self.schedulers[0].name)
+
+    # -- models -------------------------------------------------------
+
+    def register(self, name, backends, buckets=None, max_queue=None):
+        """Register ``name`` on every replica.  ``backends`` is either
+        a list (one backend per replica — each replica needs its OWN
+        Predictor/ExportedModel, executors are not shared) or a
+        zero-arg factory called once per replica."""
+        if callable(backends):
+            backends = [backends() for _ in self.schedulers]
+        if len(backends) != len(self.schedulers):
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "group %r has %d replicas, got %d backends"
+                % (self.group, len(self.schedulers), len(backends)))
+        for sched, backend in zip(self.schedulers, backends):
+            sched.register(name, backend, buckets=buckets,
+                           max_queue=max_queue)
+
+    def warmup(self, name):
+        """Pre-bind every bucket on every live replica."""
+        for _, sched in self.live():
+            sched.warmup(name)
+
+    # -- membership ---------------------------------------------------
+
+    def live(self):
+        """``[(index, scheduler)]`` for replicas not yet fenced."""
+        with self._lock:
+            fenced = set(self._fenced)
+        return [(i, s) for i, s in enumerate(self.schedulers)
+                if i not in fenced and s.alive]
+
+    def membership(self):
+        from .. import kvstore_async as _kv
+
+        return _kv._membership_lookup(_group_key(self.group))
+
+    def kill(self, index):
+        """Crash replica ``index`` (chaos drills): queued requests fail
+        with ``ReplicaDeadError`` for the router to retry, then the
+        group fences it out of membership."""
+        self.schedulers[index].kill()
+        self.fence(index)
+
+    def fence(self, index):
+        """Remove replica ``index`` from the group: bump the membership
+        epoch past it (PR-3 monotonic publish — the zombie's old epoch
+        can never win again), fail anything it still holds, and account
+        the failover.  Idempotent."""
+        from .. import kvstore_async as _kv
+
+        with self._lock:
+            if index in self._fenced:
+                return
+            self._fenced.add(index)
+            self.epoch += 1
+            epoch = self.epoch
+            fenced = set(self._fenced)
+        zombie = self.schedulers[index]
+        zombie.fence(epoch)
+        _M_UP.labels(zombie.name).set(0)
+        _M_FAILOVER.labels(self.group).inc()
+        survivors = [s.name for i, s in enumerate(self.schedulers)
+                     if i not in fenced]
+        for i, s in enumerate(self.schedulers):
+            if i not in fenced:
+                s.epoch = epoch
+        _kv._membership_publish(
+            _group_key(self.group), epoch, survivors or [zombie.name],
+            primary=survivors[0] if survivors else zombie.name)
+
+    def detect(self, heartbeat_timeout_s=1.0):
+        """Heartbeat sweep: fence every replica whose dispatch loops
+        stopped beating.  Returns the indices fenced this sweep."""
+        now = time.monotonic()
+        with self._lock:
+            fenced = set(self._fenced)
+        # NOT live(): a replica that died without being fenced is exactly
+        # what this sweep exists to find
+        stale = [i for i, s in enumerate(self.schedulers)
+                 if i not in fenced
+                 and (not s.alive
+                      or now - s.last_beat > heartbeat_timeout_s)]
+        for i in stale:
+            self.fence(i)
+        return stale
+
+    # -- observability ------------------------------------------------
+
+    def federation_targets(self):
+        """Per-replica federation targets (``isolated_metrics=True``):
+        each replica's registry under ``{shard, role, epoch}``."""
+        targets = []
+        for i, s in enumerate(self.schedulers):
+            if self.registries[i] is None:
+                continue
+            targets.append({"shard": i, "role": "serving",
+                            "epoch": s.epoch,
+                            "registry": self.registries[i]})
+        return targets
+
+    def close(self):
+        for _, sched in self.live():
+            sched.close()
+
+
+class ServingRouter(object):
+    """Round-robin request router with peer failover.
+
+    Sheds (:class:`~.admission.ServerOverloadedError` /
+    :class:`~.admission.ServerDrainingError`) try the next replica and
+    only surface when every replica shed.  A replica that dies holding
+    an accepted request is fenced and the request re-admitted on a peer
+    with ``force=True`` — the brownout guarantee."""
+
+    def __init__(self, group):
+        self._group = group
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _rotation(self):
+        live = self._group.live()
+        if not live:
+            return []
+        with self._lock:
+            start = self._rr
+            self._rr += 1
+        return live[start % len(live):] + live[:start % len(live)]
+
+    @staticmethod
+    def _remaining_ms(req):
+        """Carry the original absolute deadline onto the retry."""
+        if req.deadline is None:
+            return 0  # deadline_from_ms(0) -> no deadline
+        return max((req.deadline - time.monotonic()) * 1e3, 0.001)
+
+    def request(self, model, inputs, deadline_ms=None, timeout=30.0):
+        shed = None
+        for index, sched in self._rotation():
+            try:
+                req = sched.submit(model, inputs, deadline_ms=deadline_ms)
+            except _admission.ReplicaDeadError:
+                self._group.fence(index)
+                continue
+            except (_admission.ServerOverloadedError,
+                    _admission.ServerDrainingError) as exc:
+                shed = exc
+                continue
+            try:
+                return req.result(timeout=timeout)
+            except _admission.ReplicaDeadError:
+                # accepted but unanswered: fence the replica, finish
+                # the request on a peer — never drop accepted work
+                self._group.fence(index)
+                return self._retry_on_peer(model, req, timeout)
+        if shed is not None:
+            raise shed
+        raise _admission.ReplicaDeadError(
+            "group %r has no live serving replica" % self._group.group)
+
+    def _retry_on_peer(self, model, req, timeout):
+        for index, sched in self._group.live():
+            try:
+                peer = sched.submit(model, req.inputs,
+                                    deadline_ms=self._remaining_ms(req),
+                                    force=True)
+                return peer.result(timeout=timeout)
+            except _admission.ReplicaDeadError:
+                self._group.fence(index)
+        raise _admission.ReplicaDeadError(
+            "request to %r accepted by a dead replica and no peer is "
+            "left in group %r" % (model, self._group.group))

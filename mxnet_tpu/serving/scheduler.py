@@ -1,0 +1,476 @@
+"""Continuous-batching scheduler: the serving tier's dispatch engine.
+
+Classic batched serving gates on a *full* batch — latency is hostage
+to the slowest co-arrival.  Continuous batching (Orca, OSDI '22;
+vLLM's scheduler, SOSP '23) inverts that: a dispatch loop per model
+pulls **whatever is waiting** the moment the device frees up, pads the
+pack to the smallest configured bucket, and runs it.  Requests admitted
+while a batch is on the device ride the *next* window — slots free
+continuously, nothing waits for stragglers.
+
+Why buckets: each bucket is one shape key in the Predictor's executor
+cache, so after one warm pass per bucket steady-state serving performs
+**zero recompiles** — the same pad-to-bucket trick the training stack
+uses, applied to live traffic.  ``serving_compiles_total{model}``
+counts cold buckets; a flat counter after :meth:`Scheduler.warmup` is
+the tested contract (``tests/test_serving.py``).
+
+Lifecycle verbs map to production events:
+
+- :meth:`Scheduler.drain` — rolling restart: stop admitting, finish
+  everything accepted.
+- :meth:`Scheduler.kill` — crash simulation: queued and in-flight
+  requests fail with :class:`~.admission.ReplicaDeadError` so a
+  router (``replication.py``) can retry them on a peer.  Accepted
+  requests are never silently dropped.
+- :meth:`Scheduler.fence` — the PR-3 epoch fence: a zombie replica
+  that lost its membership epoch refuses new work.
+
+Chaos sites ``serving.admit`` (in :meth:`submit`, before the queue
+lock) and ``serving.dispatch`` (inside the dispatch window, before the
+device call) let seeded drills inject shed/delay/crash at both doors.
+Dispatch faults are retried ``MXNET_TPU_SERVING_RETRIES`` times on the
+same replica before the failure lands on the request futures.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+import numpy as _np
+
+from .. import chaos
+from ..base import MXNetError
+from ..observability import metrics as _metrics
+from . import admission as _admission
+from .registry import ModelRegistry
+
+__all__ = ["InferenceRequest", "Scheduler", "default_retries"]
+
+
+def default_retries():
+    """``MXNET_TPU_SERVING_RETRIES``: same-replica dispatch retries
+    before a fault is surfaced to the request futures."""
+    try:
+        return int(os.environ.get("MXNET_TPU_SERVING_RETRIES", "2"))
+    except ValueError:
+        return 2
+
+
+class InferenceRequest(object):
+    """One admitted request: a future the dispatch loop resolves.
+
+    ``result()`` blocks the submitting thread; the scheduler's dispatch
+    thread calls ``_resolve``/``_fail`` exactly once.  ``latency_s``
+    (admission -> resolution) feeds ``serving_request_seconds``.
+    """
+
+    __slots__ = ("model", "inputs", "deadline", "t_admit", "_event",
+                 "outputs", "error", "latency_s")
+
+    def __init__(self, model, inputs, deadline):
+        self.model = model
+        self.inputs = inputs
+        self.deadline = deadline
+        self.t_admit = time.monotonic()
+        self._event = threading.Event()
+        self.outputs = None
+        self.error = None
+        self.latency_s = None
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    def _resolve(self, outputs):
+        self.latency_s = time.monotonic() - self.t_admit
+        self.outputs = outputs
+        self._event.set()
+
+    def _fail(self, error):
+        self.latency_s = time.monotonic() - self.t_admit
+        self.error = error
+        self._event.set()
+
+    def result(self, timeout=30.0):
+        """Block for the response; re-raises the typed serving error on
+        failure (deadline, overload-requeue exhaustion, dead replica)."""
+        if not self._event.wait(timeout):
+            raise MXNetError("request to model %r timed out after %.1fs "
+                             "(still queued or in flight)"
+                             % (self.model, timeout))
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+
+class _Lane(object):
+    """Per-model queue + its dispatch thread + pre-resolved metric
+    handles (label resolution off the hot path)."""
+
+    __slots__ = ("entry", "queue", "thread", "batches", "rows", "slots",
+                 "m_req", "m_wait", "m_depth", "m_sat", "m_occ",
+                 "m_requests", "m_batches", "m_compiles", "m_errors")
+
+    def __init__(self, entry):
+        self.entry = entry
+        self.queue = collections.deque()
+        self.thread = None
+        # running totals for bench occupancy (rows served / slots run)
+        self.batches = 0
+        self.rows = 0
+        self.slots = 0
+
+
+class Scheduler(object):
+    """Continuous-batching scheduler for one serving replica.
+
+    Parameters
+    ----------
+    registry : ModelRegistry, optional
+        Shared model registry; a private one is created by default.
+    metrics_registry : observability.metrics.Registry, optional
+        Where serving metrics live.  Defaults to the process-global
+        registry; replica groups pass per-replica registries so the
+        federated exposition shows each replica under its own
+        ``{shard, role, epoch}`` identity.
+    name : str
+        Replica name (membership + error messages).
+    """
+
+    def __init__(self, registry=None, metrics_registry=None,
+                 name="serving0"):
+        self.name = name
+        self.registry = registry if registry is not None else ModelRegistry()
+        self._reg = (metrics_registry if metrics_registry is not None
+                     else _metrics.REGISTRY)
+        self.admission = _admission.AdmissionController(
+            reject_counter=self._reg.counter(
+                "serving_rejected_total",
+                "Serving requests shed, by model and reason "
+                "(overload | deadline | draining)", ["model", "reason"]))
+        self._fam = self._families(self._reg)
+        self._cond = threading.Condition()
+        self._lanes = {}
+        self._stopping = False
+        self._killed = False
+        self._fenced_epoch = None
+        self.epoch = 0
+        # dispatch loops beat this; a stale beat is how the replica
+        # group detects a dead replica (replication.py)
+        self.last_beat = time.monotonic()
+
+    @staticmethod
+    def _families(reg):
+        return {
+            "req": reg.histogram(
+                "serving_request_seconds",
+                "End-to-end request latency, admission to response",
+                ["model"]),
+            "wait": reg.histogram(
+                "serving_queue_wait_seconds",
+                "Time a request waited in its model lane before dispatch",
+                ["model"]),
+            "depth": reg.gauge(
+                "serving_queue_depth",
+                "Requests currently queued per model lane", ["model"]),
+            "sat": reg.gauge(
+                "serving_queue_saturation",
+                "Queue depth / max_queue per model lane (1.0 = shedding)",
+                ["model"]),
+            "occ": reg.gauge(
+                "serving_batch_occupancy",
+                "Live rows / bucket slots of the last dispatched batch",
+                ["model"]),
+            "requests": reg.counter(
+                "serving_requests_total",
+                "Requests answered successfully per model", ["model"]),
+            "batches": reg.counter(
+                "serving_batches_total",
+                "Device dispatch windows run per model", ["model"]),
+            "compiles": reg.counter(
+                "serving_compiles_total",
+                "Cold (compiling) buckets per model; flat after warmup",
+                ["model"]),
+            "errors": reg.counter(
+                "serving_dispatch_errors_total",
+                "Dispatch attempts that raised (chaos or backend fault)",
+                ["model"]),
+        }
+
+    # -- registration -------------------------------------------------
+
+    def register(self, name, backend, buckets=None, max_queue=None):
+        """Register a model and start its dispatch thread.  Accepts
+        anything :func:`~.registry.as_backend` does."""
+        entry = self.registry.register(name, backend, buckets=buckets,
+                                       max_queue=max_queue)
+        lane = _Lane(entry)
+        for key, attr in (("req", "m_req"), ("wait", "m_wait"),
+                          ("depth", "m_depth"), ("sat", "m_sat"),
+                          ("occ", "m_occ"), ("requests", "m_requests"),
+                          ("batches", "m_batches"),
+                          ("compiles", "m_compiles"),
+                          ("errors", "m_errors")):
+            setattr(lane, attr, self._fam[key].labels(name))
+        with self._cond:
+            self._lanes[name] = lane
+        lane.thread = threading.Thread(
+            target=self._loop, args=(name, lane),
+            name="%s-dispatch-%s" % (self.name, name), daemon=True)
+        lane.thread.start()
+        return entry
+
+    def swap(self, name, backend):
+        """Hot reload: atomically swap ``name``'s backend between
+        dispatch windows (see :meth:`~.registry.ModelRegistry.swap`)."""
+        return self.registry.swap(name, backend)
+
+    def warmup(self, name):
+        """Pre-bind every bucket of ``name`` so live traffic never sees
+        a compile.  Returns the number of cold buckets visited."""
+        lane = self._lane(name)
+        entry = lane.entry
+        cold_n = 0
+        with entry.dispatch_lock:
+            for bucket in entry.buckets:
+                batch = {n: _np.zeros((bucket,) + tuple(s),
+                                      dtype=_np.float32)
+                         for n, s in entry.backend.input_shapes.items()}
+                _, cold = entry.backend.infer(batch)
+                if cold:
+                    cold_n += 1
+                    if _metrics.metrics_enabled():
+                        lane.m_compiles.inc()
+        return cold_n
+
+    # -- admission ----------------------------------------------------
+
+    def _lane(self, name):
+        with self._cond:
+            lane = self._lanes.get(name)
+        if lane is None:
+            # registry.get raises the typed UnknownModelError (404)
+            self.registry.get(name)
+            raise _admission.UnknownModelError(
+                "model %r has no dispatch lane" % (name,))
+        return lane
+
+    def _check_inputs(self, entry, inputs):
+        rows = {}
+        want = entry.backend.input_shapes
+        for n, shape in want.items():
+            if n not in inputs:
+                raise MXNetError("request missing input %r (model wants "
+                                 "%s)" % (n, sorted(want)))
+            row = _np.asarray(inputs[n], dtype=_np.float32)
+            if tuple(row.shape) != tuple(shape):
+                raise MXNetError(
+                    "input %r: got shape %r, model serves per-sample "
+                    "shape %r" % (n, tuple(row.shape), tuple(shape)))
+            rows[n] = row
+        extra = set(inputs) - set(want)
+        if extra:
+            raise MXNetError("unknown inputs %r (model wants %s)"
+                             % (sorted(extra), sorted(want)))
+        return rows
+
+    def submit(self, name, inputs, deadline_ms=None, force=False):
+        """Admit one request; returns its :class:`InferenceRequest`
+        future.  ``force=True`` bypasses overload/drain shedding — used
+        by the router to re-admit a request that a DEAD peer had
+        already accepted (accepted work is never shed twice); kill and
+        fencing still refuse."""
+        if self._killed or self._fenced_epoch is not None:
+            raise _admission.ReplicaDeadError(
+                "replica %r is %s" % (self.name,
+                                      "fenced at epoch %r" % self._fenced_epoch
+                                      if self._fenced_epoch is not None
+                                      else "dead"))
+        lane = self._lane(name)
+        rows = self._check_inputs(lane.entry, inputs)
+        deadline = _admission.deadline_from_ms(deadline_ms)
+        # chaos fires OUTSIDE the queue lock: an injected delay stalls
+        # this caller, not every lane's dispatch loop
+        chaos.visit("serving.admit", name=name)
+        req = InferenceRequest(name, rows, deadline)
+        with self._cond:
+            if self._stopping and not force:
+                self.admission.reject(name, "draining")
+            if not force:
+                self.admission.admit(name, len(lane.queue),
+                                     lane.entry.max_queue, deadline)
+            lane.queue.append(req)
+            if _metrics.metrics_enabled():
+                depth = len(lane.queue)
+                lane.m_depth.set(depth)
+                lane.m_sat.set(depth / float(lane.entry.max_queue))
+            self._cond.notify_all()
+        return req
+
+    def request(self, name, inputs, deadline_ms=None, timeout=30.0):
+        """Synchronous convenience: :meth:`submit` + ``result()``."""
+        return self.submit(name, inputs, deadline_ms=deadline_ms).result(
+            timeout=timeout)
+
+    # -- dispatch loop ------------------------------------------------
+
+    def _loop(self, name, lane):
+        while True:
+            self.last_beat = time.monotonic()
+            with self._cond:
+                while (not lane.queue and not self._killed
+                       and not self._stopping):
+                    self._cond.wait(0.05)
+                    self.last_beat = time.monotonic()
+                if self._killed:
+                    return
+                if not lane.queue:
+                    # stopping with an empty queue: done
+                    return
+                take = min(len(lane.queue), lane.entry.buckets[-1])
+                window = [lane.queue.popleft() for _ in range(take)]
+                if _metrics.metrics_enabled():
+                    depth = len(lane.queue)
+                    lane.m_depth.set(depth)
+                    lane.m_sat.set(depth / float(lane.entry.max_queue))
+            self._dispatch(name, lane, window)
+
+    def _dispatch(self, name, lane, window):
+        now = time.monotonic()
+        live = []
+        for req in window:
+            # second deadline check: expired while queued -> shed
+            # BEFORE costing device time
+            if _admission.AdmissionController.expired(req.deadline, now):
+                self.admission.account(name, "deadline")
+                req._fail(_admission.DeadlineExceededError(
+                    "model %r: deadline expired while queued "
+                    "(waited %.3fs)" % (name, now - req.t_admit)))
+            else:
+                live.append(req)
+        if not live:
+            return
+        entry = lane.entry
+        outs = None
+        # dispatch_lock is the hot-reload atomicity boundary: a swap
+        # can never land mid-window
+        with entry.dispatch_lock:
+            backend = entry.backend
+            batch, bucket = entry.pad([r.inputs for r in live])
+            for attempt in range(default_retries() + 1):
+                if self._killed:
+                    break
+                try:
+                    chaos.visit("serving.dispatch",
+                                name="%s:%d" % (name, bucket))
+                    outs, cold = backend.infer(batch)
+                    break
+                except Exception as exc:   # noqa: BLE001 - fault path
+                    if _metrics.metrics_enabled():
+                        lane.m_errors.inc()
+                    last_exc = exc
+        if self._killed:
+            for req in live:
+                req._fail(_admission.ReplicaDeadError(
+                    "replica %r died with request in flight" % self.name))
+            return
+        if outs is None:
+            err = MXNetError("model %r: dispatch failed after %d attempts: "
+                             "%s" % (name, default_retries() + 1, last_exc))
+            for req in live:
+                req._fail(err)
+            return
+        t_done = time.monotonic()
+        if _metrics.metrics_enabled():
+            lane.m_batches.inc()
+            lane.m_occ.set(len(live) / float(bucket))
+            if cold:
+                lane.m_compiles.inc()
+        lane.batches += 1
+        lane.rows += len(live)
+        lane.slots += bucket
+        for i, req in enumerate(live):
+            req._resolve([o[i] for o in outs])
+            if _metrics.metrics_enabled():
+                lane.m_requests.inc()
+                lane.m_wait.observe(now - req.t_admit)
+                lane.m_req.observe(t_done - req.t_admit)
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def alive(self):
+        return not self._killed and self._fenced_epoch is None
+
+    def ready(self):
+        """Readiness: alive and admitting (the ``/readyz`` answer)."""
+        return self.alive and not self.admission.draining \
+            and not self._stopping
+
+    def queue_depth(self, name):
+        with self._cond:
+            lane = self._lanes.get(name)
+            return len(lane.queue) if lane else 0
+
+    def stats(self, name):
+        """Running totals for bench: batches, rows served, bucket slots
+        run, and their ratio (mean batch occupancy)."""
+        lane = self._lane(name)
+        occ = lane.rows / float(lane.slots) if lane.slots else 0.0
+        return {"batches": lane.batches, "rows": lane.rows,
+                "slots": lane.slots, "occupancy": occ}
+
+    def drain(self):
+        """Stop admitting; accepted work keeps flowing (rolling
+        restart).  Pair with :meth:`close` to also stop the loops."""
+        self.admission.start_drain()
+
+    def close(self, timeout=10.0):
+        """Drain, let queues empty, stop dispatch threads."""
+        self.drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not any(l.queue for l in self._lanes.values()):
+                    break
+            time.sleep(0.005)
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        for lane in list(self._lanes.values()):
+            if lane.thread is not None:
+                lane.thread.join(timeout=timeout)
+
+    def kill(self):
+        """Crash simulation: fail every queued request with
+        :class:`~.admission.ReplicaDeadError` (a router retries them on
+        a peer) and refuse everything new.  Idempotent."""
+        with self._cond:
+            if self._killed:
+                return
+            self._killed = True
+            orphans = []
+            for lane in self._lanes.values():
+                while lane.queue:
+                    orphans.append(lane.queue.popleft())
+                if _metrics.metrics_enabled():
+                    lane.m_depth.set(0)
+                    lane.m_sat.set(0.0)
+            self._cond.notify_all()
+        err = _admission.ReplicaDeadError(
+            "replica %r was killed with the request queued" % self.name)
+        for req in orphans:
+            req._fail(err)
+
+    def fence(self, epoch):
+        """Epoch fence (PR-3 semantics): this replica lost membership
+        epoch ``epoch`` and must refuse new work — the zombie half of a
+        failover.  Queued work is failed like :meth:`kill` so the new
+        epoch's replicas take it over."""
+        self._fenced_epoch = epoch
+        self.kill()
+        self._killed = True

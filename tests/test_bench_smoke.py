@@ -49,6 +49,23 @@ def test_bench_json_contract(pipeline):
         assert "pipeline_steps" not in rec
 
 
+def test_bench_serving_keys():
+    """BENCH_SERVING=1: the schema-5 serving keys, the >= 2x continuous-
+    batching acceptance floor over the batch-1 sequential baseline, and
+    the zero-recompiles-after-warmup steady-state contract."""
+    rec = _run_bench({"BENCH_SERVING": "1", "BENCH_REQUESTS": "128"})
+    assert rec["schema_version"] >= 5
+    assert rec["metric"] == "serving_cpu_smoke_throughput"
+    assert rec["unit"] == "req/s"
+    assert rec["requests_per_sec"] > 0
+    assert rec["request_ms_p99"] >= rec["request_ms_p50"] > 0
+    assert 0.0 < rec["batch_occupancy"] <= 1.0
+    assert rec["recompiles_after_warmup"] == 0
+    assert rec["requests_per_sec"] >= 2.0 * rec["requests_per_sec_sequential"], (
+        "continuous batching lost its edge: %.1f vs sequential %.1f req/s"
+        % (rec["requests_per_sec"], rec["requests_per_sec_sequential"]))
+
+
 def test_bench_git_sha_override():
     rec = _run_bench({"BENCH_GIT_SHA": "cafef00d"})
     assert rec["git_sha"] == "cafef00d"

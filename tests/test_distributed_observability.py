@@ -255,16 +255,38 @@ _SHARD1_TEXT = (
     "# TYPE kv_heartbeat_age_seconds gauge\n"
     'kv_heartbeat_age_seconds{server="s1"} 0.25\n'
 )
+_SERVING_TEXT = (
+    "# HELP serving_request_seconds End-to-end request latency, "
+    "admission to response\n"
+    "# TYPE serving_request_seconds histogram\n"
+    'serving_request_seconds_count{model="mlp"} 5\n'
+    'serving_request_seconds_sum{model="mlp"} 0.25\n'
+    "# HELP serving_queue_depth Requests currently queued per model "
+    "lane\n"
+    "# TYPE serving_queue_depth gauge\n"
+    'serving_queue_depth{model="mlp"} 3\n'
+    "# HELP serving_batch_occupancy Live rows / bucket slots of the "
+    "last dispatched batch\n"
+    "# TYPE serving_batch_occupancy gauge\n"
+    'serving_batch_occupancy{model="mlp"} 0.75\n'
+    "# HELP serving_rejected_total Serving requests shed, by model and "
+    "reason (overload | deadline | draining)\n"
+    "# TYPE serving_rejected_total counter\n"
+    'serving_rejected_total{model="mlp",reason="overload"} 2\n'
+)
 
 
 def _golden_targets():
     # the standby shares its primary's source text (the in-process
     # layout): the series must federate exactly once, under the labels
-    # of the first member naming the source
+    # of the first member naming the source; the serving replica is a
+    # peer member under the same {shard, role, epoch} identity
     return [
         {"shard": 0, "role": "primary", "epoch": 1, "text": _SHARD0_TEXT},
         {"shard": 0, "role": "standby", "epoch": 1, "text": _SHARD0_TEXT},
         {"shard": 1, "role": "primary", "epoch": 0, "text": _SHARD1_TEXT},
+        {"shard": 2, "role": "serving", "epoch": 1,
+         "text": _SERVING_TEXT},
     ]
 
 

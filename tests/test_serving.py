@@ -1,0 +1,523 @@
+"""Serving tier (mxnet_tpu/serving/): continuous batching, admission,
+hot reload, HTTP front-end, and the brownout replica-group contract.
+
+The acceptance tests from the round-8 issue live here: zero
+steady-state recompiles after warmup, typed 429/503/504 shedding,
+hot-reload atomicity (no mixed-params batch), and the 2-replica
+kill-one drill — every accepted request answered by a peer."""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, deploy, predict, serving
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import MXNetError
+
+FEAT = 6
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    """One tiny trained checkpoint shared by the whole module."""
+    rng = np.random.RandomState(0)
+    data = rng.randn(64, FEAT).astype(np.float32)
+    labels = (data.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=16)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=2, name="fc2"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path_factory.mktemp("serving") / "tiny")
+    mod.save_checkpoint(prefix, 2)
+    return prefix, data
+
+
+def _predictor(ckpt, batch=4):
+    prefix, _ = ckpt
+    return predict.load(prefix, 2, ctx=mx.cpu(),
+                        input_shapes={"data": (batch, FEAT)})
+
+
+def _reference(ckpt, rows):
+    """Ground-truth outputs for per-sample rows via a plain Predictor."""
+    pred = _predictor(ckpt, batch=len(rows))
+    pred.forward(data=np.stack(rows))
+    return pred.get_output(0)
+
+
+# ---------------------------------------------------------------------
+# continuous batching: packing, bucketing, zero recompiles
+# ---------------------------------------------------------------------
+
+
+def test_packing_and_zero_recompiles(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1, 2, 4])
+    # the Predictor pre-binds its load-time batch (4); warmup compiles
+    # the remaining buckets — every compile happens before live traffic
+    cold = sched.warmup("mlp")
+    assert cold == 2
+    compiles = sched._fam["compiles"].labels("mlp")
+    assert compiles.value == 2
+
+    rng = np.random.RandomState(1)
+    rows = [rng.randn(FEAT).astype(np.float32) for _ in range(7)]
+    want = _reference(ckpt, rows)
+
+    # hold the dispatch lock so all three requests pack into ONE window
+    entry = sched.registry.get("mlp")
+    with entry.dispatch_lock:
+        reqs = [sched.submit("mlp", {"data": r}) for r in rows[:3]]
+        time.sleep(0.05)
+    outs = [r.result(timeout=10) for r in reqs]
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out[0], want[i], rtol=1e-5, atol=1e-6)
+
+    # singles and pairs reuse the warm buckets — counter stays flat
+    for i in range(3, 7):
+        out = sched.request("mlp", {"data": rows[i]})
+        np.testing.assert_allclose(out[0], want[i], rtol=1e-5, atol=1e-6)
+    assert compiles.value == 2, "steady-state serving recompiled"
+
+    stats = sched.stats("mlp")
+    assert stats["rows"] == 7 and stats["batches"] >= 1
+    assert 0.0 < stats["occupancy"] <= 1.0
+    # the 3-pack padded to bucket 4: occupancy below 1 proves padding ran
+    assert stats["slots"] >= stats["rows"]
+    sched.close()
+
+
+def test_input_validation(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    with pytest.raises(MXNetError, match="missing input"):
+        sched.submit("mlp", {})
+    with pytest.raises(MXNetError, match="per-sample shape"):
+        sched.submit("mlp", {"data": np.zeros((2, FEAT), np.float32)})
+    with pytest.raises(MXNetError, match="unknown inputs"):
+        sched.submit("mlp", {"data": np.zeros(FEAT, np.float32),
+                             "bogus": np.zeros(1, np.float32)})
+    with pytest.raises(serving.UnknownModelError):
+        sched.submit("nope", {"data": np.zeros(FEAT, np.float32)})
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# admission: deadlines, overload, drain
+# ---------------------------------------------------------------------
+
+
+def test_deadline_rejected_at_admission(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    with pytest.raises(serving.DeadlineExceededError) as ei:
+        sched.submit("mlp", {"data": np.zeros(FEAT, np.float32)},
+                     deadline_ms=1e-6)
+    assert ei.value.http_status == 504
+    assert sched.admission._rejected.labels("mlp", "deadline").value == 1
+    sched.close()
+
+
+def test_deadline_expires_while_queued(ckpt):
+    """The second check: a request that expired in the queue is shed at
+    dispatch, before costing device time."""
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    sched.warmup("mlp")
+    entry = sched.registry.get("mlp")
+    row = {"data": np.zeros(FEAT, np.float32)}
+    with entry.dispatch_lock:
+        blocker = sched.submit("mlp", row)      # no deadline
+        # wait for the loop to pull it and block on the dispatch lock
+        deadline = time.monotonic() + 5
+        while sched.queue_depth("mlp") and time.monotonic() < deadline:
+            time.sleep(0.005)
+        victim = sched.submit("mlp", row, deadline_ms=30)
+        time.sleep(0.15)                        # 30ms deadline passes
+    assert blocker.result(timeout=10)
+    with pytest.raises(serving.DeadlineExceededError):
+        victim.result(timeout=10)
+    assert sched.admission._rejected.labels("mlp", "deadline").value == 1
+    sched.close()
+
+
+def test_overload_sheds_429(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1], max_queue=2)
+    sched.warmup("mlp")
+    entry = sched.registry.get("mlp")
+    row = {"data": np.zeros(FEAT, np.float32)}
+    with entry.dispatch_lock:
+        first = sched.submit("mlp", row)
+        deadline = time.monotonic() + 5
+        while sched.queue_depth("mlp") and time.monotonic() < deadline:
+            time.sleep(0.005)
+        accepted = [sched.submit("mlp", row) for _ in range(2)]
+        with pytest.raises(serving.ServerOverloadedError) as ei:
+            sched.submit("mlp", row)
+        assert ei.value.http_status == 429
+    # shedding never drops accepted work: everything admitted completes
+    for req in [first] + accepted:
+        assert req.result(timeout=10)
+    assert sched.admission._rejected.labels("mlp", "overload").value == 1
+    sched.close()
+
+
+def test_drain_mode(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    sched.warmup("mlp")
+    row = {"data": np.zeros(FEAT, np.float32)}
+    assert sched.ready()
+    sched.drain()
+    assert not sched.ready()
+    with pytest.raises(serving.ServerDrainingError) as ei:
+        sched.submit("mlp", row)
+    assert ei.value.http_status == 503
+    sched.admission.stop_drain()            # drain turned out unnecessary
+    assert sched.ready()
+    assert sched.request("mlp", row)
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# hot reload
+# ---------------------------------------------------------------------
+
+
+def _zero_predictor(ckpt):
+    """Same architecture, all-zero weights: softmax outputs are exactly
+    uniform — trivially distinguishable from the trained model."""
+    prefix, _ = ckpt
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 2)
+    zeros = {"arg:%s" % n: mx.nd.zeros(v.shape) for n, v in args.items()}
+    zeros.update({"aux:%s" % n: mx.nd.zeros(v.shape)
+                  for n, v in auxs.items()})
+    return predict.Predictor(sym.tojson(), zeros,
+                             input_shapes={"data": (4, FEAT)})
+
+
+def test_hot_reload_atomicity(ckpt):
+    """Swapping the backend under live load: every response comes
+    entirely from the old or entirely from the new params, never a mix,
+    and no request is dropped."""
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1, 2, 4])
+    sched.warmup("mlp")
+
+    rng = np.random.RandomState(2)
+    rows = [rng.randn(FEAT).astype(np.float32) for _ in range(24)]
+    want_a = _reference(ckpt, rows)
+    want_b = np.full((len(rows), 2), 0.5, np.float32)  # uniform softmax
+
+    results = [None] * len(rows)
+
+    def client(lo, hi):
+        for i in range(lo, hi):
+            results[i] = sched.request("mlp", {"data": rows[i]},
+                                       timeout=30)[0]
+
+    threads = [threading.Thread(target=client, args=(i * 8, (i + 1) * 8))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(4):                       # reload under load, twice
+        time.sleep(0.01)
+        sched.swap("mlp", serving.PredictorBackend(_zero_predictor(ckpt)))
+        time.sleep(0.01)
+        sched.swap("mlp", _predictor(ckpt))
+    for t in threads:
+        t.join(timeout=30)
+    for i, out in enumerate(results):
+        assert out is not None, "request %d dropped across a swap" % i
+        from_a = np.allclose(out, want_a[i], rtol=1e-4, atol=1e-5)
+        from_b = np.allclose(out, want_b[i], rtol=1e-4, atol=1e-5)
+        assert from_a or from_b, (
+            "request %d saw mixed-params output %r" % (i, out))
+    sched.close()
+
+
+def test_hot_reload_rejects_signature_change(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fcx"), name="softmax")
+    other = predict.Predictor(
+        net.tojson(),
+        {"arg:fcx_weight": mx.nd.zeros((2, FEAT + 1)),
+         "arg:fcx_bias": mx.nd.zeros((2,))},
+        input_shapes={"data": (4, FEAT + 1)})
+    with pytest.raises(MXNetError, match="changed input shapes"):
+        sched.swap("mlp", other)
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# backends: ExportedModel parity
+# ---------------------------------------------------------------------
+
+
+def test_exported_backend_parity(ckpt):
+    """The .mxtpu deployment artifact serves bit-compatible answers with
+    the Predictor path through the same scheduler."""
+    prefix, _ = ckpt
+    path = deploy.export_model(prefix, 2, {"data": (4, FEAT)})
+    sched = serving.Scheduler()
+    sched.register("pred", _predictor(ckpt), buckets=[1, 2, 4])
+    sched.register("exp", path)              # as_backend on the path
+    assert sched.registry.get("exp").buckets == [4]  # frozen at export
+    assert sched.warmup("exp") == 1
+    rng = np.random.RandomState(3)
+    row = rng.randn(FEAT).astype(np.float32)
+    out_pred = sched.request("pred", {"data": row})
+    out_exp = sched.request("exp", {"data": row})
+    np.testing.assert_allclose(out_exp[0], out_pred[0],
+                               rtol=1e-4, atol=1e-5)
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# dispatch chaos: same-replica retries
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_dispatch_chaos_retried_same_replica(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1])
+    sched.warmup("mlp")
+    row = {"data": np.zeros(FEAT, np.float32)}
+    errors = sched._fam["errors"].labels("mlp")
+    # 2 faults < 3 attempts (MXNET_TPU_SERVING_RETRIES=2): request lands
+    with chaos.inject("serving.dispatch", "raise", prob=1.0, seed=5,
+                      limit=2) as inj:
+        assert sched.request("mlp", row, timeout=30)
+    assert inj.fires == 2
+    assert errors.value == 2
+    # unbounded faults exhaust the retry budget: typed failure, counted
+    with chaos.inject("serving.dispatch", "raise", prob=1.0, seed=5):
+        with pytest.raises(MXNetError, match="dispatch failed after"):
+            sched.request("mlp", row, timeout=30)
+    assert errors.value == 5
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# brownout: replica group, kill one, nothing accepted is dropped
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_brownout_kill_replica_under_load(ckpt):
+    """THE round-8 acceptance drill: two replicas, seeded dispatch
+    chaos, one replica killed mid-load — every accepted request is
+    answered (by a peer when its replica died), membership re-publishes
+    at a bumped epoch, and the fenced zombie refuses new work."""
+    group = serving.ReplicaGroup(replicas=2, group="brownout-t",
+                                 isolated_metrics=True)
+    group.register("mlp", lambda: _predictor(ckpt), buckets=[1, 2, 4],
+                   max_queue=128)
+    group.warmup("mlp")
+    router = serving.ServingRouter(group)
+
+    rng = np.random.RandomState(4)
+    rows = [rng.randn(FEAT).astype(np.float32) for _ in range(32)]
+    want = _reference(ckpt, rows)
+    results = [None] * len(rows)
+    failures = []
+
+    def client(lo, hi):
+        for i in range(lo, hi):
+            try:
+                results[i] = router.request("mlp", {"data": rows[i]},
+                                            timeout=30)[0]
+            except Exception as exc:  # noqa: BLE001 - recorded, asserted
+                failures.append((i, exc))
+
+    with chaos.inject("serving.dispatch", "raise", prob=1.0, seed=11,
+                      limit=2):
+        threads = [threading.Thread(target=client,
+                                    args=(i * 8, (i + 1) * 8))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.01)
+        group.kill(0)                        # crash mid-load
+        for t in threads:
+            t.join(timeout=60)
+
+    assert not failures, "accepted requests dropped: %r" % failures[:3]
+    for i, out in enumerate(results):
+        np.testing.assert_allclose(out, want[i], rtol=1e-4, atol=1e-5)
+
+    # membership: epoch bumped past the zombie, survivor promoted
+    member = group.membership()
+    assert member["epoch"] == 1
+    assert member["primary"] == "brownout-t/1"
+    assert group.schedulers[0].alive is False
+    with pytest.raises(serving.ReplicaDeadError):
+        group.schedulers[0].submit("mlp", {"data": rows[0]})
+
+    # the survivor actually answered work, and the federated exposition
+    # renders both replicas under {shard, role, epoch}
+    text = obs.federate(group.federation_targets())
+    assert 'role="serving"' in text
+    assert 'serving_requests_total' in text
+    assert 'shard="1"' in text and 'epoch="1"' in text
+    group.close()
+
+
+def test_replica_group_detect_fences_dead(ckpt):
+    group = serving.ReplicaGroup(replicas=2, group="detect-t")
+    group.register("mlp", lambda: _predictor(ckpt), buckets=[1])
+    group.schedulers[1].kill()               # died without telling anyone
+    assert group.detect(heartbeat_timeout_s=1.0) == [1]
+    assert [i for i, _ in group.live()] == [0]
+    assert group.membership()["epoch"] == 1
+    assert group.detect() == []              # idempotent sweep
+    group.close()
+
+
+def test_router_sheds_when_all_replicas_drain(ckpt):
+    group = serving.ReplicaGroup(replicas=2, group="drain-t")
+    group.register("mlp", lambda: _predictor(ckpt), buckets=[1])
+    group.warmup("mlp")
+    router = serving.ServingRouter(group)
+    row = {"data": np.zeros(FEAT, np.float32)}
+    assert router.request("mlp", row)
+    for _, s in group.live():
+        s.drain()
+    with pytest.raises(serving.ServerDrainingError):
+        router.request("mlp", row)
+    group.close()
+
+
+# ---------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as err:
+        return err.code, json.load(err)
+
+
+def test_frontend_http_roundtrip(ckpt):
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1, 2])
+    sched.warmup("mlp")
+    rng = np.random.RandomState(5)
+    row = rng.randn(FEAT).astype(np.float32)
+    want = _reference(ckpt, [row])[0]
+    with serving.start_frontend(sched) as fe:
+        with urllib.request.urlopen(fe.url + "/healthz", timeout=10) as r:
+            assert json.load(r)["status"] == "ok"
+        with urllib.request.urlopen(fe.url + "/readyz", timeout=10) as r:
+            assert json.load(r)["status"] == "ready"
+        with urllib.request.urlopen(fe.url + "/v1/models",
+                                    timeout=10) as r:
+            models = json.load(r)["models"]
+        assert models[0]["name"] == "mlp"
+        assert models[0]["inputs"] == {"data": [FEAT]}
+        assert models[0]["buckets"] == [1, 2]
+
+        # JSON body
+        status, out = _post(fe.url + "/v1/predict", {
+            "model": "mlp", "inputs": {"data": row.tolist()}})
+        assert status == 200
+        np.testing.assert_allclose(out["outputs"][0], want,
+                                   rtol=1e-4, atol=1e-5)
+
+        # raw .npy body — no JSON float round-trip
+        buf = io.BytesIO()
+        np.save(buf, row)
+        req = urllib.request.Request(
+            fe.url + "/v1/predict?model=mlp&input=data",
+            data=buf.getvalue(),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["X-MXTPU-Outputs"] == "1"
+            raw = np.load(io.BytesIO(resp.read()), allow_pickle=False)
+        np.testing.assert_allclose(raw, want, rtol=1e-4, atol=1e-5)
+
+        # typed errors ride http_status onto the wire
+        status, err = _post(fe.url + "/v1/predict", {
+            "model": "nope", "inputs": {"data": row.tolist()}})
+        assert status == 404 and err["type"] == "UnknownModelError"
+        status, err = _post(fe.url + "/v1/predict", {
+            "model": "mlp", "inputs": {"data": row.tolist()},
+            "deadline_ms": 1e-6})
+        assert status == 504 and err["type"] == "DeadlineExceededError"
+
+        # drain flips readiness to 503 — the load balancer signal
+        sched.drain()
+        try:
+            with urllib.request.urlopen(fe.url + "/readyz",
+                                        timeout=10) as r:
+                raise AssertionError("draining replica claimed ready")
+        except urllib.error.HTTPError as errh:
+            assert errh.code == 503
+        status, err = _post(fe.url + "/v1/predict", {
+            "model": "mlp", "inputs": {"data": row.tolist()}})
+        assert status == 503 and err["type"] == "ServerDrainingError"
+    sched.close()
+
+
+# ---------------------------------------------------------------------
+# metrics gate
+# ---------------------------------------------------------------------
+
+
+def test_metrics_disabled_serving_still_works(ckpt, monkeypatch):
+    """MXNET_TPU_METRICS=0: the serving hot path reduces to constant-
+    time guards — requests flow, nothing is recorded."""
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    sched = serving.Scheduler()
+    sched.register("mlp", _predictor(ckpt), buckets=[1, 2])
+    sched.warmup("mlp")
+    row = {"data": np.zeros(FEAT, np.float32)}
+    assert sched.request("mlp", row)
+    assert sched._fam["compiles"].labels("mlp").value == 0
+    assert sched._fam["requests"].labels("mlp").value == 0
+    assert sched._fam["req"].labels("mlp").count == 0
+    # shedding still raises typed errors, just unrecorded
+    with pytest.raises(serving.DeadlineExceededError):
+        sched.submit("mlp", row, deadline_ms=1e-6)
+    assert sched.admission._rejected.labels("mlp", "deadline").value == 0
+    sched.close()
+
+
+def test_serving_watchdog_rules_fire():
+    """The two new default rules see serving metrics end to end."""
+    hist = obs.histogram("serving_request_seconds", "", ["model"])
+    sat = obs.gauge("serving_queue_saturation", "", ["model"])
+    for _ in range(5):
+        hist.labels("mlp").observe(5.0)      # way past the 1s SLO
+    sat.labels("mlp").set(0.97)
+    rules = {r.name: r for r in obs.default_rules()}
+    assert "request_p99_slo" in rules and "queue_saturation" in rules
+    wd = obs.Watchdog(rules=[rules["request_p99_slo"],
+                             rules["queue_saturation"]])
+    alerts = {a.name for a in wd.evaluate(now=0.0)}
+    assert alerts == {"request_p99_slo", "queue_saturation"}

@@ -302,7 +302,8 @@ def test_default_rules_clean_registry_fires_nothing():
     names = [r.name for r in wd.rules]
     assert names == ["spans_dropped", "heartbeat_stale",
                      "replication_lag", "step_p99_regression",
-                     "straggler", "mfu_regression", "goodput_floor"]
+                     "straggler", "mfu_regression", "goodput_floor",
+                     "request_p99_slo", "queue_saturation"]
 
 
 # ---------------------------------------------------------------------------

@@ -40,7 +40,10 @@ K80_TRAIN = {"resnet-18": 185.0, "resnet-50": 109.0, "resnet-152": 57.0,
 # bench schema is additive (older rows simply lack mfu/goodput_ratio)
 TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               "goodput_ratio": True,
-              "step_ms_p50": False, "step_ms_p99": False}
+              "step_ms_p50": False, "step_ms_p99": False,
+              # schema-5 serving keys (BENCH_SERVING=1 rounds)
+              "requests_per_sec": True, "batch_occupancy": True,
+              "request_ms_p50": False, "request_ms_p99": False}
 TREND_TOLERANCE = 0.10
 
 
