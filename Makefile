@@ -6,12 +6,15 @@ all: native
 native:
 	$(MAKE) -C native
 
-test: native
+test: native check
 	$(MAKE) -C native test
 	python -m pytest tests/ -q
 
-test-fast:
+test-fast: check
 	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
+
+check:
+	python -m tools.graftcheck
 
 bench:
 	python bench.py
@@ -43,5 +46,5 @@ serve:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-fast bench bench-trend efficiency dryrun \
-	dist-test chaos trace watchdog serve clean
+.PHONY: all native test test-fast check bench bench-trend efficiency \
+	dryrun dist-test chaos trace watchdog serve clean

@@ -16,6 +16,7 @@ __all__ = [
     "ShardFailedError",
     "StaleEpochError",
     "TruncatedMessageError",
+    "CorruptMessageError",
     "string_types",
     "numeric_types",
     "DTYPE_TO_STR",
@@ -60,6 +61,15 @@ class TruncatedMessageError(MXNetError, EOFError):
     ``EOFError`` so the client retry path treats it like any other
     connection loss, but the type distinguishes a half-read frame from a
     clean close."""
+
+
+class CorruptMessageError(MXNetError, ValueError):
+    """A fully received PS wire frame failed validation — an internal
+    length inconsistent with the payload, or a declared size past the
+    ``MXNET_TPU_PS_MAX_MSG_MB`` cap.  The socket may be desynchronized
+    mid-stream, so the client tears the connection down before
+    surfacing it.  Subclasses ``ValueError`` so pre-existing corrupt-
+    frame handlers keep classifying it."""
 
 
 string_types = (str,)

@@ -112,15 +112,16 @@ import zlib
 import numpy as _np
 
 from . import chaos as _chaos
-from .base import (MXNetError, ServerDeadError, ShardFailedError,
-                   StaleEpochError, TruncatedMessageError)
+from .base import (CorruptMessageError, MXNetError, ServerDeadError,
+                   ShardFailedError, StaleEpochError,
+                   TruncatedMessageError)
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 from .observability import flight_recorder as _flight
 
 __all__ = ["AsyncServer", "AsyncClient", "ReplicatedClient", "ServerGroup",
            "ServerDeadError", "ShardFailedError", "StaleEpochError",
-           "TruncatedMessageError",
+           "TruncatedMessageError", "CorruptMessageError",
            "publish_address", "lookup_address", "reset_membership"]
 
 _KV_KEY = "mxtpu_async_ps_addr"
@@ -285,7 +286,7 @@ def _decode_msg(payload):
     def take(n):
         start = cursor[0]
         if start + n > len(payload):
-            raise ValueError("truncated message")
+            raise CorruptMessageError("truncated message")
         cursor[0] = start + n
         return payload[start:start + n]
 
@@ -373,8 +374,8 @@ def _recv_msg(sock):
     hdr = _recv_exact(sock, 8, "frame header")
     (n,) = struct.unpack("<Q", hdr)
     if n > _max_msg_bytes():
-        raise ValueError("message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB"
-                         % n)
+        raise CorruptMessageError(
+            "message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB" % n)
     buf = _recv_exact(sock, n, "frame body")
     # chaos site AFTER the frame is fully consumed: a drop models the
     # response lost in flight (the socket is torn down either way), a
@@ -559,9 +560,9 @@ class _FollowerLink:
                 with self._cv:
                     if self._q and self._q[0][0] is entry:
                         self._q.popleft()
-                self.acked_rseq = max(
-                    self.acked_rseq,
-                    int(resp.get("rseq", entry.get("rseq", 0))))
+                    self.acked_rseq = max(
+                        self.acked_rseq,
+                        int(resp.get("rseq", entry.get("rseq", 0))))
                 _M_REPL_LAG.labels(self.addr).set(
                     max(self._owner._applied_seq - self.acked_rseq, 0))
                 if latch is not None:
@@ -666,7 +667,8 @@ class AsyncServer:
         return "%s:%d" % (_advertise_host(self._bind_host), port)
 
     def start(self):
-        self._started = True
+        with self._stop_lock:
+            self._started = True
         self._thread.start()
         return self
 
@@ -716,7 +718,8 @@ class AsyncServer:
         """Abrupt crash (chaos / failover tests): no drain — in-flight
         handlers are cut mid-RPC, exactly what a process death looks
         like to the workers."""
-        self._killed = True
+        with self._stop_lock:
+            self._killed = True
         self.stop(drain_timeout=0.0)
 
     def _track_conn(self, conn):
@@ -1185,7 +1188,9 @@ class AsyncClient:
         """Release the socket and stop the heartbeat thread.  Any call
         in flight (or made after) fails fast instead of retrying into a
         connection the owner has abandoned."""
-        self._closed = True
+        # single-transition fail-fast flag: taking self._lock here would
+        # block close() behind an in-flight RPC, defeating its purpose
+        self._closed = True  # graftcheck: disable=lock-discipline
         self._hb_stop.set()
         try:
             self._sock.close()
@@ -1223,8 +1228,10 @@ class AsyncClient:
                 if down_since is None:
                     down_since = now
                 if now - down_since >= _dead_after_s():
-                    # declared dead: surface it and STOP probing
-                    self.dead = True
+                    # declared dead: surface it and STOP probing.
+                    # Monotone False->True flag with a single writer
+                    # (this heartbeat thread); readers only poll it.
+                    self.dead = True  # graftcheck: disable=lock-discipline
                     cb = self._on_dead
                     if cb is not None:
                         try:
@@ -1259,7 +1266,8 @@ class AsyncClient:
         return (self._deadline if self._deadline is not None
                 else _deadline_s())
 
-    def _reconnect(self):
+    def _reconnect_locked(self):
+        # caller holds self._lock (the _call_impl retry loop)
         try:
             self._sock.close()
         except OSError:
@@ -1317,7 +1325,7 @@ class AsyncClient:
                         % self._addr)
                 try:
                     if attempt:  # re-dial failures count as attempts too
-                        self._reconnect()
+                        self._reconnect_locked()
                     _chaos.visit("kvstore.call", name=msg.get("op"))
                     self._sock.settimeout(call_timeout)
                     _send_msg(self._sock, msg)
@@ -1328,7 +1336,7 @@ class AsyncClient:
                 except ValueError:
                     # corrupt/oversize frame from the peer: the socket may
                     # be desynchronized mid-payload — never reuse it
-                    self._reconnect()
+                    self._reconnect_locked()
                     raise
                 except (EOFError, ConnectionError, socket.timeout,
                         OSError) as exc:

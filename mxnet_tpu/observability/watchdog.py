@@ -376,10 +376,7 @@ class Watchdog(object):
     def start(self, interval_s=None):
         """Evaluate every ``interval_s`` (default
         ``MXNET_TPU_WATCHDOG_INTERVAL``) on a daemon thread."""
-        if self._thread is not None:
-            return self
         interval = _interval_s() if interval_s is None else float(interval_s)
-        self._stop.clear()
 
         def loop():
             while not self._stop.wait(interval):
@@ -389,16 +386,21 @@ class Watchdog(object):
                     # the watchdog must never take down what it watches
                     pass
 
-        self._thread = threading.Thread(target=loop, name="mxtpu-watchdog",
-                                        daemon=True)
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=loop, name="mxtpu-watchdog", daemon=True)
+            self._thread.start()
         return self
 
     def stop(self):
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
 
     def serve(self, port=None, addr="127.0.0.1", registry=None):
         """Serve ``/metrics`` + ``/alerts`` on one endpoint (a

@@ -320,7 +320,9 @@ class Scheduler(object):
 
     def _loop(self, name, lane):
         while True:
-            self.last_beat = time.monotonic()
+            # racy-by-design liveness timestamp: any lane thread bumping
+            # it is fresh enough for the straggler-detect sweep
+            self.last_beat = time.monotonic()  # graftcheck: disable=lock-discipline
             with self._cond:
                 while (not lane.queue and not self._killed
                        and not self._stopping):
@@ -471,6 +473,6 @@ class Scheduler(object):
         epoch ``epoch`` and must refuse new work — the zombie half of a
         failover.  Queued work is failed like :meth:`kill` so the new
         epoch's replicas take it over."""
-        self._fenced_epoch = epoch
+        with self._cond:
+            self._fenced_epoch = epoch
         self.kill()
-        self._killed = True

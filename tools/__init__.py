@@ -1,0 +1,4 @@
+"""Developer tooling (launchers, benchmarks, docs generation, and the
+``tools.graftcheck`` static-analysis suite).  Scripts here are run
+directly (``python tools/launch.py``) or as modules
+(``python -m tools.graftcheck``)."""

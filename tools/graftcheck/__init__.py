@@ -1,0 +1,56 @@
+"""graftcheck — project-native static analysis for the mxnet-tpu runtime.
+
+The reference stack kept its async, multi-threaded runtime honest with
+dmlc-core ``CHECK`` macros and C++ compile-time discipline.  The Python
+rebuild replaced that with *conventions* — and after nine PRs the repo
+holds ~10 daemon-thread classes, ~60 env tunables, ~20 chaos sites and
+~80 metric families whose contracts nothing machine-checked.  graftcheck
+is that machine check: a fast (no jax import, pure ``ast``) per-file
+analysis pass with project-specific rules:
+
+====================  ====================================================
+rule                  invariant enforced
+====================  ====================================================
+``env-var-registry``  every ``MXNET_TPU_*`` env var read in code has a
+                      row in ``docs/env_vars.md``, and no doc row is dead
+``chaos-site``        every site string passed to ``chaos.visit`` /
+                      ``inject`` / ``corrupt_file`` — or spelled in an
+                      ``MXNET_TPU_CHAOS`` spec string, including inside
+                      docs code blocks — exists in ``chaos.SITES``
+``metrics-hot-path``  no registry/label lookup inside designated hot-path
+                      functions (engine push/run, scheduler dispatch
+                      loop, trainer step loops); family names are
+                      Prometheus-valid; no conflicting re-registrations
+``typed-errors``      wire/dispatch paths (``kvstore*``, ``serving/``,
+                      ``engine.py``) raise the typed ``MXNetError``
+                      hierarchy, never bare ``Exception``/``RuntimeError``
+``lock-discipline``   in a class that spawns threads, an attribute
+                      assigned in two or more methods has every
+                      post-``__init__`` write inside a ``with self._lock``
+                      style block (pragma-suppressible for intentionally
+                      lock-free fields)
+``jit-purity``        functions handed to ``jax.jit``/``lax.scan`` do not
+                      call ``time.*``, stdlib ``random.*``, ``print``,
+                      read ``os.environ``, or mutate globals
+``golden-metrics``    every metric family named in ``tests/golden/*.txt``
+                      is a registered family (or a federation-derived
+                      exposition name), so golden files cannot drift from
+                      the registry
+====================  ====================================================
+
+Findings print as ``file:line rule message``; ``--json`` emits a machine
+schema.  Suppression is explicit and reviewable: an inline
+``# graftcheck: disable=<rule>`` pragma on (or above) the offending
+line, or a checked-in baseline (``tools/graftcheck/baseline.txt``) for
+grandfathered findings — ``--update-baseline`` regenerates it.
+
+Run:  ``python -m tools.graftcheck``  (or ``make check``).
+"""
+
+from .core import (Finding, Project, load_baseline, run_rules,
+                   report_text, report_json, DEFAULT_SCAN_PATHS)
+from .rules import ALL_RULES
+
+__all__ = ["Finding", "Project", "ALL_RULES", "load_baseline",
+           "run_rules", "report_text", "report_json",
+           "DEFAULT_SCAN_PATHS"]

@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.graftcheck [options] [paths...]``.
+
+Exit status 0 = no unbaselined findings; 1 = findings; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from .core import (Project, apply_baseline, load_baseline, run_rules,
+                   report_json, report_text, save_baseline)
+from .rules import ALL_RULES
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.txt")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="Project-native static analysis for the mxnet-tpu "
+                    "runtime's conventions (see tools/graftcheck/"
+                    "__init__.py for the rule catalog).")
+    ap.add_argument("paths", nargs="*",
+                    help="paths (relative to --root) to analyze; default "
+                         "is mxnet_tpu, tools, tests, docs, README.md")
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(_HERE)),
+        help="project root (default: the repo this tool lives in)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this rule (repeatable); see "
+                         "--list-rules")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON instead of text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rule names and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(ALL_RULES):
+            print(name)
+        return 0
+
+    rules = dict(ALL_RULES)
+    if args.rule:
+        unknown = [r for r in args.rule if r not in ALL_RULES]
+        if unknown:
+            print("unknown rule(s): %s (have: %s)"
+                  % (", ".join(unknown), ", ".join(sorted(ALL_RULES))),
+                  file=sys.stderr)
+            return 2
+        rules = {r: ALL_RULES[r] for r in args.rule}
+
+    t0 = time.monotonic()
+    project = Project(args.root, paths=args.paths or None)
+    findings = run_rules(project, rules)
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print("graftcheck: baseline updated with %d finding(s) -> %s"
+              % (len(findings), os.path.relpath(args.baseline, args.root)))
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    fresh, grandfathered, stale = apply_baseline(findings, baseline)
+
+    if args.json:
+        report_json(fresh, grandfathered, stale, rules, sys.stdout)
+    else:
+        report_text(fresh, grandfathered, stale, sys.stdout)
+        sys.stdout.write("graftcheck: %d file(s) in %.2fs\n" % (
+            len(project.py_files) + len(project.md_files)
+            + len(project.golden_files), time.monotonic() - t0))
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

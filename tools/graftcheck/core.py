@@ -1,0 +1,446 @@
+"""graftcheck framework: file model, pragmas, project facts, baseline,
+reporters.
+
+Everything here is import-light on purpose (``ast`` + stdlib only, no
+``mxnet_tpu`` import): the whole suite must stay interactive-fast so it
+can sit on the default ``make`` verify path.  Shared *project facts* —
+the documented env-var registry, ``chaos.SITES``, the statically
+registered metric families — are parsed from source once per run and
+cached on the :class:`Project`, so each rule is a cheap walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+__all__ = ["Finding", "SourceFile", "Project", "DEFAULT_SCAN_PATHS",
+           "load_baseline", "save_baseline", "apply_baseline",
+           "run_rules", "report_text", "report_json", "dotted_name",
+           "iter_code_blocks"]
+
+#: Default analysis surface, relative to the project root.  ``native/``
+#: (C) and ``examples/`` (user-facing sample code, not runtime) are out.
+DEFAULT_SCAN_PATHS = ("mxnet_tpu", "tools", "tests", "docs", "README.md")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftcheck:\s*(disable|disable-next|disable-file)"
+    r"\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+class Finding(object):
+    """One rule violation at ``path:line``.
+
+    The baseline identity is ``(rule, path, message)`` — deliberately
+    line-insensitive so unrelated edits above a grandfathered finding do
+    not resurrect it.
+    """
+
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path, line, rule, message):
+        self.path = path
+        self.line = int(line)
+        self.rule = rule
+        self.message = message
+
+    def key(self):
+        return (self.rule, self.path, self.message)
+
+    def as_dict(self):
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+    def __repr__(self):
+        return "Finding(%s:%d %s %s)" % (self.path, self.line, self.rule,
+                                         self.message)
+
+
+def dotted_name(node):
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class SourceFile(object):
+    """One analyzed file: text, lines, lazy AST, and parsed pragmas."""
+
+    def __init__(self, root, relpath):
+        self.root = root
+        self.path = relpath
+        with open(os.path.join(root, relpath), "r", encoding="utf-8",
+                  errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree = "unparsed"
+        self._line_disable = None    # line -> set(rules)
+        self._file_disable = None    # set(rules)
+
+    @property
+    def tree(self):
+        """Module AST, or None on a syntax error (the runner reports a
+        parse finding separately)."""
+        if self._tree == "unparsed":
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError:
+                self._tree = None
+        return self._tree
+
+    def _parse_pragmas(self):
+        line_dis, file_dis = {}, set()
+        for i, line in enumerate(self.lines, 1):
+            if "graftcheck" not in line:
+                continue
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            kind = m.group(1)
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if kind == "disable-file":
+                file_dis |= rules
+            elif kind == "disable-next":
+                line_dis.setdefault(i + 1, set()).update(rules)
+            else:
+                line_dis.setdefault(i, set()).update(rules)
+                # a pragma on a pure comment line also covers the next
+                # code line, so long findings can keep the pragma above
+                if line.lstrip().startswith("#"):
+                    line_dis.setdefault(i + 1, set()).update(rules)
+        self._line_disable, self._file_disable = line_dis, file_dis
+
+    def suppressed(self, rule, line):
+        """True when an inline pragma disables ``rule`` at ``line``."""
+        if self._line_disable is None:
+            self._parse_pragmas()
+        if rule in self._file_disable or "all" in self._file_disable:
+            return True
+        rules = self._line_disable.get(line, ())
+        return rule in rules or "all" in rules
+
+
+def iter_code_blocks(md_text):
+    """Yield ``(start_line, block_text)`` for each fenced code block of a
+    markdown document (start_line = first line *inside* the fence)."""
+    lines = md_text.splitlines()
+    in_block, start, buf = False, 0, []
+    for i, line in enumerate(lines, 1):
+        if line.lstrip().startswith("```"):
+            if in_block:
+                yield start, "\n".join(buf)
+                in_block, buf = False, []
+            else:
+                in_block, start = True, i + 1
+            continue
+        if in_block:
+            buf.append(line)
+    if in_block and buf:
+        yield start, "\n".join(buf)
+
+
+# --- project facts ---------------------------------------------------------
+
+_ENV_VAR_RE = re.compile(r"^MXNET_TPU_[A-Z0-9_]+$")
+_DOC_VAR_RE = re.compile(r"`(MXNET_TPU_[A-Z0-9_]+)`")
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_EXPO_TYPE_RE = re.compile(r"#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)")
+_EXPO_SERIES_RE = re.compile(r"^([a-z][a-zA-Z0-9_:]*)\{")
+
+
+class MetricReg(object):
+    """One static metric-family registration site."""
+
+    __slots__ = ("name", "kind", "labels", "path", "line")
+
+    def __init__(self, name, kind, labels, path, line):
+        self.name = name
+        self.kind = kind
+        self.labels = labels      # tuple of label names, or None = dynamic
+        self.path = path
+        self.line = line
+
+
+class Project(object):
+    """The analysis universe: walked files plus cached cross-file facts.
+
+    ``root`` is the repository root; ``paths`` restricts the walk (used
+    by fixture tests to point the suite at a synthetic mini-repo).
+    """
+
+    def __init__(self, root, paths=None):
+        self.root = os.path.abspath(root)
+        self.paths = tuple(paths) if paths else DEFAULT_SCAN_PATHS
+        self.py_files = []       # [SourceFile]
+        self.md_files = []       # [SourceFile]
+        self.golden_files = []   # [SourceFile] tests/golden/*.txt
+        self.parse_errors = []   # [Finding]
+        self._walk()
+        self._documented_env = None
+        self._chaos_sites = None
+        self._metric_regs = None
+        self._expo_names = None
+
+    # -- file walk ----------------------------------------------------
+
+    def _walk(self):
+        seen = set()
+        for top in self.paths:
+            full = os.path.join(self.root, top)
+            if os.path.isfile(full):
+                self._add(os.path.relpath(full, self.root), seen)
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__")
+                for fn in sorted(filenames):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root)
+                    self._add(rel, seen)
+
+    def _add(self, rel, seen):
+        if rel in seen:
+            return
+        seen.add(rel)
+        if rel.endswith(".py"):
+            sf = SourceFile(self.root, rel)
+            self.py_files.append(sf)
+            if sf.tree is None:
+                self.parse_errors.append(Finding(
+                    rel, 1, "parse", "file does not parse as Python"))
+        elif rel.endswith(".md"):
+            self.md_files.append(SourceFile(self.root, rel))
+        elif rel.endswith(".txt") and os.sep.join(
+                rel.split(os.sep)[-3:-1]) == os.path.join("tests", "golden"):
+            self.golden_files.append(SourceFile(self.root, rel))
+
+    def runtime_files(self):
+        """Python files that are runtime/tooling code (not tests): the
+        surface whose env-var reads must be documented."""
+        return [f for f in self.py_files
+                if not f.path.startswith("tests" + os.sep)]
+
+    # -- documented env vars -------------------------------------------
+
+    def documented_env_vars(self):
+        """{name: (docpath, line)} parsed from docs/env_vars.md table
+        rows (a row documents every backticked MXNET_TPU_* token it
+        carries)."""
+        if self._documented_env is None:
+            out = {}
+            doc = os.path.join("docs", "env_vars.md")
+            for sf in self.md_files:
+                if sf.path != doc:
+                    continue
+                for i, line in enumerate(sf.lines, 1):
+                    if not line.lstrip().startswith("|"):
+                        continue
+                    for name in _DOC_VAR_RE.findall(line):
+                        out.setdefault(name, (sf.path, i))
+            self._documented_env = out
+        return self._documented_env
+
+    # -- chaos sites ---------------------------------------------------
+
+    def chaos_sites(self):
+        """The ``SITES`` frozenset parsed (not imported) out of
+        ``mxnet_tpu/chaos.py``; None when the module is absent, so the
+        chaos rule degrades to a no-op instead of flagging everything."""
+        if self._chaos_sites is None:
+            sites = None
+            rel = os.path.join("mxnet_tpu", "chaos.py")
+            for sf in self.py_files:
+                if sf.path != rel or sf.tree is None:
+                    continue
+                for node in ast.walk(sf.tree):
+                    if not (isinstance(node, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "SITES"
+                                    for t in node.targets)):
+                        continue
+                    consts = [c.value for c in ast.walk(node.value)
+                              if isinstance(c, ast.Constant)
+                              and isinstance(c.value, str)]
+                    sites = frozenset(consts)
+            self._chaos_sites = sites if sites is not None else False
+        return None if self._chaos_sites is False else self._chaos_sites
+
+    # -- metric registrations ------------------------------------------
+
+    def metric_registrations(self):
+        """Every static ``counter(``/``gauge(``/``histogram(`` call with
+        a literal family name, across runtime files."""
+        if self._metric_regs is None:
+            regs = []
+            for sf in self.runtime_files():
+                if sf.tree is None or sf.path.startswith(
+                        os.path.join("tools", "graftcheck")):
+                    continue
+                for node in ast.walk(sf.tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kind = None
+                    if isinstance(node.func, ast.Attribute):
+                        kind = node.func.attr
+                    elif isinstance(node.func, ast.Name):
+                        kind = node.func.id
+                    if kind not in ("counter", "gauge", "histogram"):
+                        continue
+                    if not (node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        continue
+                    labels = ()
+                    lab_node = None
+                    if len(node.args) >= 3:
+                        lab_node = node.args[2]
+                    for kw in node.keywords:
+                        if kw.arg == "labels":
+                            lab_node = kw.value
+                    if lab_node is not None:
+                        if isinstance(lab_node, (ast.List, ast.Tuple)) \
+                                and all(isinstance(e, ast.Constant)
+                                        and isinstance(e.value, str)
+                                        for e in lab_node.elts):
+                            labels = tuple(e.value for e in lab_node.elts)
+                        else:
+                            labels = None   # dynamic — skip comparisons
+                    regs.append(MetricReg(node.args[0].value, kind,
+                                          labels, sf.path, node.lineno))
+            self._metric_regs = regs
+        return self._metric_regs
+
+    def exposition_names(self):
+        """Family names written straight into exposition text by the
+        federation/watchdog renderers (``# TYPE name`` lines, ``derived``
+        calls, ``name{...}`` series templates in string literals)."""
+        if self._expo_names is None:
+            names = set()
+            obs = os.path.join("mxnet_tpu", "observability")
+            for sf in self.py_files:
+                if not sf.path.startswith(obs) or sf.tree is None:
+                    continue
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.Call):
+                        fn = (node.func.id if isinstance(node.func, ast.Name)
+                              else getattr(node.func, "attr", None))
+                        if fn == "derived" and node.args and isinstance(
+                                node.args[0], ast.Constant) and isinstance(
+                                node.args[0].value, str):
+                            names.add(node.args[0].value)
+                    if isinstance(node, ast.Constant) \
+                            and isinstance(node.value, str):
+                        for m in _EXPO_TYPE_RE.finditer(node.value):
+                            names.add(m.group(1))
+                        m = _EXPO_SERIES_RE.match(node.value)
+                        if m:
+                            names.add(m.group(1))
+            self._expo_names = names
+        return self._expo_names
+
+
+# --- baseline --------------------------------------------------------------
+
+def load_baseline(path):
+    """Baseline file → multiset {(rule, path, message): count}.  Lines
+    are ``rule<TAB>path<TAB>message``; ``#`` comments and blanks skipped."""
+    counts = {}
+    if not os.path.exists(path):
+        return counts
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            parts = line.split("\t", 2)
+            if len(parts) != 3:
+                continue
+            key = tuple(parts)
+            counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def save_baseline(path, findings):
+    """Write the current findings as the new baseline (sorted, one line
+    per finding; duplicates preserved as repeated lines)."""
+    keys = sorted(f.key() for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# graftcheck baseline — grandfathered findings.\n"
+                "# Lines are rule<TAB>path<TAB>message; matching is\n"
+                "# line-number-insensitive.  Regenerate with\n"
+                "#   python -m tools.graftcheck --update-baseline\n"
+                "# Prefer an inline '# graftcheck: disable=<rule>' pragma\n"
+                "# with a justification over a baseline entry.\n")
+        for key in keys:
+            f.write("\t".join(key) + "\n")
+
+
+def apply_baseline(findings, baseline):
+    """Split findings into (unbaselined, baselined, stale_keys)."""
+    remaining = dict(baseline)
+    fresh, grandfathered = [], []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            grandfathered.append(f)
+        else:
+            fresh.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0)
+    return fresh, grandfathered, stale
+
+
+# --- runner ----------------------------------------------------------------
+
+def run_rules(project, rules):
+    """Run ``rules`` ({name: check_fn}) over ``project``; pragma-filtered
+    findings, sorted.  Parse errors surface as ``parse`` findings so a
+    broken file can never silently hide violations."""
+    by_path = {sf.path: sf for sf in
+               project.py_files + project.md_files + project.golden_files}
+    findings = list(project.parse_errors)
+    for name in sorted(rules):
+        for f in rules[name](project):
+            sf = by_path.get(f.path)
+            if sf is not None and sf.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def report_text(fresh, grandfathered, stale, out):
+    for f in fresh:
+        out.write("%s:%d %s %s\n" % (f.path, f.line, f.rule, f.message))
+    if grandfathered:
+        out.write("# %d baselined finding(s) suppressed\n"
+                  % len(grandfathered))
+    for key in stale:
+        out.write("# stale baseline entry (no longer found): %s\n"
+                  % " ".join(key))
+    out.write("graftcheck: %d finding(s), %d unbaselined\n"
+              % (len(fresh) + len(grandfathered), len(fresh)))
+
+
+def report_json(fresh, grandfathered, stale, rules_run, out):
+    doc = {
+        "version": 1,
+        "rules": sorted(rules_run),
+        "findings": [dict(f.as_dict(), baselined=False) for f in fresh]
+        + [dict(f.as_dict(), baselined=True) for f in grandfathered],
+        "stale_baseline": [list(k) for k in stale],
+        "counts": {"total": len(fresh) + len(grandfathered),
+                   "unbaselined": len(fresh),
+                   "baselined": len(grandfathered)},
+    }
+    json.dump(doc, out, indent=2, sort_keys=True)
+    out.write("\n")

@@ -1,0 +1,104 @@
+"""chaos-site: every site string handed to the chaos plant/fire APIs
+exists in ``chaos.SITES`` — including sites spelled inside
+``MXNET_TPU_CHAOS`` spec strings and in docs code blocks.
+
+The chaos registry already rejects unknown sites at runtime
+(``_Rule.__init__``), but only when that code path *runs*: a typo'd site
+in a rarely-exercised test, a doc example, or an env-spec string fails
+silently (the rule simply never fires) — the worst failure mode for
+fault-injection coverage.  This rule closes that statically.
+
+Checked call forms: ``chaos.visit("<site>", ...)``,
+``chaos.inject("<site>", ...)``, ``chaos.corrupt_file("<site>", ...)``
+(any module alias whose last segment is ``chaos``/``_chaos``).  Checked
+string forms: any literal shaped like an ``MXNET_TPU_CHAOS`` spec —
+comma-separated ``site:mode[:...]`` entries whose mode is one of
+``drop|delay|raise|corrupt``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, dotted_name, iter_code_blocks
+
+RULE = "chaos-site"
+
+_CHAOS_FUNCS = {"visit", "inject", "corrupt_file"}
+_SPEC_ENTRY_RE = re.compile(
+    r"^([A-Za-z_][\w.]*):(drop|delay|raise|corrupt)([:@]|$)")
+_MD_CALL_RE = re.compile(
+    r"\bchaos\.(?:visit|inject|corrupt_file)\(\s*[\"']([^\"']+)[\"']")
+
+
+def _spec_sites(value):
+    """Site names from an ``MXNET_TPU_CHAOS``-shaped spec string; empty
+    when the string is not spec-shaped (every entry must match)."""
+    entries = [e.strip() for e in value.split(",") if e.strip()]
+    if not entries:
+        return []
+    sites = []
+    for e in entries:
+        m = _SPEC_ENTRY_RE.match(e)
+        if not m:
+            return []
+        sites.append(m.group(1))
+    return sites
+
+
+def check_chaos_sites(project):
+    sites = project.chaos_sites()
+    if sites is None:
+        return   # no chaos module in this tree — nothing to check
+
+    chaos_rel = os.path.join("mxnet_tpu", "chaos.py")
+    for sf in project.py_files:
+        if sf.tree is None or sf.path.startswith(
+                os.path.join("tools", "graftcheck")):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn and dn.rsplit(".", 1)[-1] in _CHAOS_FUNCS \
+                        and dn.split(".")[-2:-1] in (["chaos"],
+                                                     ["_chaos"]) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    site = node.args[0].value
+                    if site not in sites:
+                        yield Finding(
+                            sf.path, node.lineno, RULE,
+                            "unknown chaos site %r (not in chaos.SITES)"
+                            % site)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and sf.path != chaos_rel:
+                for site in _spec_sites(node.value):
+                    if site not in sites:
+                        yield Finding(
+                            sf.path, node.lineno, RULE,
+                            "MXNET_TPU_CHAOS spec names unknown chaos "
+                            "site %r (not in chaos.SITES)" % site)
+
+    # docs code blocks (and the chaos module's own docstring example is
+    # covered above via the literal scan)
+    for sf in project.md_files:
+        for start, block in iter_code_blocks(sf.text):
+            for off, line in enumerate(block.splitlines()):
+                for m in _MD_CALL_RE.finditer(line):
+                    if m.group(1) not in sites:
+                        yield Finding(
+                            sf.path, start + off, RULE,
+                            "docs code block uses unknown chaos site %r "
+                            "(not in chaos.SITES)" % m.group(1))
+                for part in re.findall(
+                        r"MXNET_TPU_CHAOS=[\"']?([^\"'\s]+)", line):
+                    for site in _spec_sites(part):
+                        if site not in sites:
+                            yield Finding(
+                                sf.path, start + off, RULE,
+                                "docs code block MXNET_TPU_CHAOS spec "
+                                "names unknown chaos site %r" % site)

@@ -1,0 +1,85 @@
+"""env-var-registry: every ``MXNET_TPU_*`` env var the runtime reads has
+a row in ``docs/env_vars.md``, and no documented row is dead.
+
+The reference cataloged its ``MXNET_*`` knobs (read via ``dmlc::GetEnv``)
+in ``docs/how_to/env_var.md``; this rule keeps the rebuild's catalog
+load-bearing.  A *read* is a literal name reaching ``os.environ.get`` /
+``os.environ[...]`` / ``os.getenv`` / ``environ.setdefault|pop``, or the
+first argument of a local ``_env*`` helper (the lazy-tunable idiom in
+``kvstore_async.py`` / ``watchdog.py``).  Internal sentinels carrying a
+leading underscore (``_MXNET_TPU_DIST_READY``) are exempt by the prefix
+match itself.
+
+A doc row is *dead* when its variable's name appears nowhere in the
+scanned runtime/tooling/test sources (not even as a write or a message
+string) — a renamed or removed tunable whose row would otherwise rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, dotted_name, _ENV_VAR_RE
+
+RULE = "env-var-registry"
+
+_HELPER_RE = re.compile(r"^_?env[_a-z]*$|^_env_[a-z]+$|getenv$")
+
+
+def _env_read_calls(tree):
+    """Yield ``(name, lineno)`` for literal MXNET_TPU_* env reads."""
+    for node in ast.walk(tree):
+        lit = None
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            last = dn.rsplit(".", 1)[-1]
+            is_environ_method = (
+                last in ("get", "setdefault", "pop")
+                and dn.split(".")[-2:-1] == ["environ"])
+            is_helper = (last == "getenv"
+                         or _HELPER_RE.match(last) is not None)
+            if (is_environ_method or is_helper) and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                lit = node.args[0].value
+        elif isinstance(node, ast.Subscript):
+            dn = dotted_name(node.value) or ""
+            if dn.split(".")[-1] == "environ" \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                lit = node.slice.value
+        if lit is not None and _ENV_VAR_RE.match(lit):
+            yield lit, node.lineno
+
+
+def check_env_var_registry(project):
+    documented = project.documented_env_vars()
+
+    # undocumented reads, flagged at the read site
+    used_anywhere = set()
+    for sf in project.py_files:
+        if sf.path.startswith(os.path.join("tools", "graftcheck")):
+            continue
+        # dead-row evidence: ANY appearance of the literal name counts
+        # (reads, launcher env writes, process-marker strings)
+        for name in documented:
+            if name in sf.text:
+                used_anywhere.add(name)
+        if sf.tree is None or sf.path.startswith("tests" + os.sep):
+            continue
+        for name, line in _env_read_calls(sf.tree):
+            if name not in documented:
+                yield Finding(
+                    sf.path, line, RULE,
+                    "env var %s is read here but has no row in "
+                    "docs/env_vars.md" % name)
+
+    # dead doc rows, flagged at the doc row
+    for name, (docpath, line) in sorted(documented.items()):
+        if name not in used_anywhere:
+            yield Finding(
+                docpath, line, RULE,
+                "documented env var %s appears nowhere in mxnet_tpu/, "
+                "tools/ or tests/ — dead row" % name)

@@ -1,0 +1,72 @@
+"""golden-metrics: golden exposition files cannot drift from the
+registry.
+
+``tests/golden/*.txt`` pin the Prometheus exposition format; a metric
+renamed in code with a stale golden row would keep the golden test green
+against the wrong contract.  Every family name declared in a golden
+file's ``# TYPE`` lines must be either a statically registered family
+(a literal ``counter(``/``gauge(``/``histogram(`` name anywhere in
+``mxnet_tpu/``/``tools/``) or a federation-derived exposition name
+(``# TYPE``/``derived``/series templates in ``observability/``).  Series
+lines must also belong to a family the same file declares (catching a
+hand-edited stray series).
+
+The synthetic renderer fixtures in ``metrics_exposition.txt`` use the
+reserved ``demo_`` prefix — those exercise the *exposition writer*, not
+the runtime registry, and are exempt by that prefix.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..core import Finding
+
+RULE = "golden-metrics"
+
+_TYPE_RE = re.compile(r"^#\s*TYPE\s+(\S+)\s+(counter|gauge|histogram)")
+_SERIES_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{| )")
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: fixture families exercising the renderer, not the registry
+_EXEMPT_PREFIX = "demo_"
+
+
+def check_golden_metrics(project):
+    known = {reg.name for reg in project.metric_registrations()}
+    known |= project.exposition_names()
+
+    for sf in project.golden_files:
+        declared = set()
+        for i, line in enumerate(sf.lines, 1):
+            m = _TYPE_RE.match(line)
+            if not m:
+                continue
+            name = m.group(1)
+            declared.add(name)
+            if name.startswith(_EXEMPT_PREFIX):
+                continue
+            if name not in known:
+                yield Finding(
+                    sf.path, i, RULE,
+                    "golden file declares metric family %r which is "
+                    "neither registered in code nor a derived "
+                    "exposition name" % name)
+        for i, line in enumerate(sf.lines, 1):
+            if line.startswith("#") or not line.strip():
+                continue
+            m = _SERIES_RE.match(line)
+            if not m:
+                continue
+            series = m.group(1)
+            fam = series
+            for suffix in _HISTO_SUFFIXES:
+                if series.endswith(suffix) \
+                        and series[:-len(suffix)] in declared:
+                    fam = series[:-len(suffix)]
+                    break
+            if fam not in declared:
+                yield Finding(
+                    sf.path, i, RULE,
+                    "golden series %r has no matching # TYPE "
+                    "declaration in this file" % series)

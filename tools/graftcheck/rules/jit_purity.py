@@ -1,0 +1,120 @@
+"""jit-purity: functions handed to ``jax.jit``/``lax.scan`` stay pure.
+
+A traced function runs *once* per compile cache entry, not once per
+step: a ``time.time()`` / stdlib ``random.*`` call, a ``print``, an
+``os.environ`` read or a global mutation inside it is baked into the
+compiled program as a constant (or fires only on recompiles) — the
+classic source of unreproducible traces and "why is my RNG frozen"
+bugs.  ``jax.random`` is of course fine; the forbidden roots are the
+*host-side* impure modules.
+
+Checked binding forms: ``jax.jit(f)`` / ``jit(f)`` (any alias ending in
+``jit``), ``lax.scan(f, ...)`` / ``jax.lax.scan(f, ...)``.  ``f`` is
+resolved when it is an inline ``lambda``/``def`` in the same module;
+attribute references (``self._step``) are beyond a per-file pass and
+skipped.  The walk covers the function body including nested defs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Finding, dotted_name
+
+RULE = "jit-purity"
+
+#: attribute-chain roots that are impure on a traced path
+_IMPURE_ROOTS = {"time", "random"}
+# time/random are commonly imported as _time/_np/etc; cover the
+# underscore-alias idiom too
+_IMPURE_ALIASES = {"time", "_time", "random", "_random"}
+
+
+def _collect_defs(tree):
+    """name -> [FunctionDef] for every def anywhere in the module."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _jitted_arg(call):
+    """The AST node passed as the traced function, or None."""
+    dn = dotted_name(call.func)
+    if dn is None or not call.args:
+        return None
+    last = dn.rsplit(".", 1)[-1]
+    if last == "jit" or last == "scan" and \
+            dn.split(".")[-2:-1] in (["lax"], []):
+        return call.args[0]
+    return None
+
+
+def _impurities(fn_node):
+    """Yield (lineno, what) for impure constructs in a traced body."""
+    global_names = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Global):
+            global_names.update(node.names)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn:
+                root = dn.split(".")[0]
+                if root in _IMPURE_ALIASES and "." in dn:
+                    yield node.lineno, "call to %s" % dn
+                elif dn == "print":
+                    yield node.lineno, "print() call"
+        elif isinstance(node, ast.Attribute) and node.attr == "environ":
+            dn = dotted_name(node)
+            if dn in ("os.environ", "_os.environ"):
+                yield node.lineno, "os.environ access"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in global_names:
+                    yield node.lineno, \
+                        "mutation of global %r" % t.id
+
+
+def check_jit_purity(project):
+    for sf in project.py_files:
+        if sf.tree is None or sf.path.startswith(
+                os.path.join("tools", "graftcheck")):
+            continue
+        defs = None
+        seen = set()   # (fn lineno) — a def jitted twice reports once
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            arg = _jitted_arg(node)
+            if arg is None:
+                continue
+            fn_node = None
+            if isinstance(arg, ast.Lambda):
+                fn_node = arg
+            elif isinstance(arg, ast.Name):
+                if defs is None:
+                    defs = _collect_defs(sf.tree)
+                cands = defs.get(arg.id, ())
+                # nearest def above the call site — the closure that is
+                # actually in scope in straight-line builder code
+                best = None
+                for c in cands:
+                    if c.lineno <= node.lineno and (
+                            best is None or c.lineno > best.lineno):
+                        best = c
+                fn_node = best or (cands[0] if cands else None)
+            if fn_node is None or id(fn_node) in seen:
+                continue
+            seen.add(id(fn_node))
+            for line, what in _impurities(fn_node):
+                yield Finding(
+                    sf.path, line, RULE,
+                    "%s inside %r which is traced by jax.jit/lax.scan — "
+                    "traced bodies must be pure (host effects bake into "
+                    "the compiled program)" % (
+                        what, getattr(fn_node, "name", "<lambda>")))

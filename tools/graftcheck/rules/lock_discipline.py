@@ -1,0 +1,144 @@
+"""lock-discipline: thread-spawning classes guard multi-method state
+with their lock.
+
+Any class that starts a ``threading.Thread`` has, by construction, at
+least two control flows touching ``self``.  An attribute assigned in two
+or more methods is shared mutable state; every write site outside
+``__init__`` (construction happens-before the thread start) must then be
+lexically inside a ``with self._lock:``-style block — where "lock-style"
+means the ``with`` expression names something matching
+``lock|mutex|cond|cv`` (``self._lock``, ``self._cv``, ``lane.cv``,
+``self._send_cond`` ...).
+
+Two project conventions are honored:
+
+- a method named ``*_locked`` declares "caller holds the lock" (the
+  ``AsyncServer._replicate_apply_locked`` idiom) — its writes count as
+  guarded; the rule polices the *name*, the callers police the call;
+- intentionally lock-free fields (e.g. the PR-1 single-writer push
+  counter in the engine) carry an inline
+  ``# graftcheck: disable=lock-discipline`` pragma with a one-line
+  justification — the exemption is then visible in review, not implicit
+  in the analyzer.
+
+Static limits, by design: a write inside a helper that every caller
+invokes under the lock is still flagged (move the ``with`` into the
+helper or pragma it); ``__init__`` writes are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding
+
+RULE = "lock-discipline"
+
+_LOCKISH_RE = re.compile(r"(?i)(^|_)(lock|mutex|cond|cv)($|_)|lock$|cv$")
+_INIT_METHODS = {"__init__", "__new__"}
+
+
+def _is_lockish_expr(node):
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name and _LOCKISH_RE.search(name):
+            return True
+    return False
+
+
+def _spawns_thread(method):
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call):
+            fn = (node.func.attr if isinstance(node.func, ast.Attribute)
+                  else node.func.id if isinstance(node.func, ast.Name)
+                  else None)
+            if fn in ("Thread", "start_new_thread"):
+                return True
+    return False
+
+
+def _self_attr_targets(node):
+    """self.X attribute names written by an assignment node."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    out = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name) and t.value.id == "self":
+            out.append(t.attr)
+    return out
+
+
+def _collect_writes(method):
+    """Yield (attr, lineno, guarded) for every self.X write in the
+    method, tracking the lexical with-lock stack (nested defs included —
+    a closure still runs on some thread against the same self)."""
+    def walk(node, depth):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            held = depth + (1 if any(
+                _is_lockish_expr(item.context_expr)
+                for item in node.items) else 0)
+            # with-items themselves are evaluated before the lock is held
+            for item in node.items:
+                for child in ast.iter_child_nodes(item):
+                    yield from walk(child, depth)
+            for stmt in node.body:
+                yield from walk(stmt, held)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            for attr in _self_attr_targets(node):
+                yield attr, node.lineno, depth > 0
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, depth)
+
+    for top in method.body:
+        yield from walk(top, 0)
+
+
+def check_lock_discipline(project):
+    for sf in project.py_files:
+        if sf.tree is None or sf.path.startswith("tests" + os.sep) \
+                or sf.path.startswith(os.path.join("tools", "graftcheck")):
+            continue
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            if not any(_spawns_thread(m) for m in methods):
+                continue
+            # attr -> {method name}, and unguarded non-init write sites
+            written_in = {}
+            unguarded = {}
+            for m in methods:
+                holds_lock = m.name.endswith("_locked")
+                for attr, line, guarded in _collect_writes(m):
+                    written_in.setdefault(attr, set()).add(m.name)
+                    if m.name not in _INIT_METHODS and not guarded \
+                            and not holds_lock:
+                        unguarded.setdefault(attr, []).append(
+                            (line, m.name))
+            for attr in sorted(written_in):
+                if len(written_in[attr]) < 2:
+                    continue
+                for line, mname in sorted(unguarded.get(attr, ())):
+                    yield Finding(
+                        sf.path, line, RULE,
+                        "self.%s of thread-spawning class %s is assigned "
+                        "in %d methods but this write in %s() is not "
+                        "inside a with-lock block" % (
+                            attr, cls.name, len(written_in[attr]), mname))

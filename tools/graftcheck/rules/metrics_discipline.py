@@ -1,0 +1,129 @@
+"""metrics-hot-path: hot paths record through pre-resolved handles, and
+the static registry surface stays coherent.
+
+Three sub-checks, one rule name:
+
+1. **No lookup on a hot path.**  Inside the designated hot-path
+   functions, no ``counter(``/``gauge(``/``histogram(`` registration, no
+   ``.labels(...)`` resolution, no ``REGISTRY.get``: the per-event cost
+   budget there is one method call on an already-resolved handle
+   (``metrics.py`` "Pre-resolved handles").  Designated hot paths:
+
+   - ``mxnet_tpu/engine.py`` — ``push``, ``_run_cb``, ``guarded``
+     (whole body: every op traverses them);
+   - ``mxnet_tpu/serving/scheduler.py`` — ``_loop``, ``_dispatch``
+     (whole body: the continuous-batching dispatch loop);
+   - ``mxnet_tpu/parallel/trainer.py`` — ``fit`` (loop bodies only:
+     registration before the epoch loop is exactly the pre-resolve
+     idiom this rule exists to enforce).
+
+2. **Prometheus-valid names.**  Literal family names must match
+   ``[a-zA-Z_:][a-zA-Z0-9_:]*`` and label names
+   ``[a-zA-Z_][a-zA-Z0-9_]*`` — an invalid name renders an exposition
+   Prometheus rejects wholesale.
+
+3. **No conflicting re-registration.**  The same family name registered
+   twice with a different (kind, label schema) raises at import time in
+   whichever process happens to import both modules — this flags it
+   before any process does.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import (Finding, dotted_name, _METRIC_NAME_RE,
+                    _LABEL_NAME_RE)
+
+RULE = "metrics-hot-path"
+
+#: (file relpath, function name, scope) — scope "body" treats the whole
+#: function as hot; "loops" only For/While bodies within it.
+HOT_PATHS = (
+    (os.path.join("mxnet_tpu", "engine.py"), "push", "body"),
+    (os.path.join("mxnet_tpu", "engine.py"), "_run_cb", "body"),
+    (os.path.join("mxnet_tpu", "engine.py"), "guarded", "body"),
+    (os.path.join("mxnet_tpu", "serving", "scheduler.py"), "_loop",
+     "body"),
+    (os.path.join("mxnet_tpu", "serving", "scheduler.py"), "_dispatch",
+     "body"),
+    (os.path.join("mxnet_tpu", "parallel", "trainer.py"), "fit", "loops"),
+)
+
+_REG_FUNCS = {"counter", "gauge", "histogram"}
+
+
+def _lookup_calls(body_nodes):
+    """Yield (lineno, what) for registry/label lookups in the nodes."""
+    for top in body_nodes:
+        for node in ast.walk(top):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = (node.func.attr if isinstance(node.func, ast.Attribute)
+                  else node.func.id if isinstance(node.func, ast.Name)
+                  else None)
+            if fn in _REG_FUNCS:
+                yield node.lineno, "%s(...) registration" % fn
+            elif fn == "labels":
+                yield node.lineno, ".labels(...) resolution"
+            elif fn == "get":
+                dn = dotted_name(node.func) or ""
+                if dn.split(".")[-2:-1] == ["REGISTRY"]:
+                    yield node.lineno, "REGISTRY.get(...) lookup"
+
+
+def _hot_regions(tree, name, scope):
+    """Yield lists of body nodes that count as hot for (name, scope)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            if scope == "body":
+                yield node.body
+            else:
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.For, ast.While)):
+                        yield sub.body
+
+def check_metrics_hot_path(project):
+    # 1. hot-path lookups
+    by_path = {sf.path: sf for sf in project.py_files}
+    for relpath, name, scope in HOT_PATHS:
+        sf = by_path.get(relpath)
+        if sf is None or sf.tree is None:
+            continue
+        for body in _hot_regions(sf.tree, name, scope):
+            for line, what in _lookup_calls(body):
+                yield Finding(
+                    sf.path, line, RULE,
+                    "%s inside hot-path function %r — pre-resolve the "
+                    "handle outside the hot path" % (what, name))
+
+    # 2 + 3. registration-surface checks
+    first = {}
+    for reg in project.metric_registrations():
+        if not _METRIC_NAME_RE.match(reg.name):
+            yield Finding(
+                reg.path, reg.line, RULE,
+                "metric family name %r is not Prometheus-valid "
+                "([a-zA-Z_:][a-zA-Z0-9_:]*)" % reg.name)
+            continue
+        if reg.labels:
+            for lab in reg.labels:
+                if not _LABEL_NAME_RE.match(lab):
+                    yield Finding(
+                        reg.path, reg.line, RULE,
+                        "label %r of metric %r is not Prometheus-valid "
+                        "([a-zA-Z_][a-zA-Z0-9_]*)" % (lab, reg.name))
+        prev = first.get(reg.name)
+        if prev is None:
+            first[reg.name] = reg
+        elif reg.labels is not None and prev.labels is not None \
+                and (reg.kind != prev.kind
+                     or tuple(reg.labels) != tuple(prev.labels)):
+            yield Finding(
+                reg.path, reg.line, RULE,
+                "metric %r re-registered as %s%s but first registered "
+                "as %s%s at %s:%d" % (
+                    reg.name, reg.kind, tuple(reg.labels),
+                    prev.kind, tuple(prev.labels), prev.path, prev.line))

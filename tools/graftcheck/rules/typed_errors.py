@@ -1,0 +1,101 @@
+"""typed-errors: wire/dispatch paths raise the typed ``MXNetError``
+hierarchy, never generic exceptions.
+
+The kvstore client retry/failover ladder, the serving admission layer
+and every test that asserts on failure semantics dispatch on *exception
+type* (``ServerDeadError`` → failover, ``ServingError.http_status`` →
+HTTP code, ``TruncatedMessageError`` → reconnect).  A generic ``raise
+RuntimeError`` on those paths is invisible to all of them — it rides the
+generic retry path at best and aborts the caller at worst.
+
+Two tiers:
+
+- ``raise Exception(...)`` / ``raise RuntimeError(...)`` anywhere in the
+  wire/serving/dispatch modules (``mxnet_tpu/kvstore*.py``,
+  ``mxnet_tpu/serving/``, ``mxnet_tpu/engine.py``,
+  ``mxnet_tpu/_async_ps_main.py``) is flagged.
+- inside *wire functions* (frame encode/decode/send/receive, server
+  ``dispatch``, client ``_call``) even ``ValueError``/``OSError``/
+  ``IOError`` is flagged: wire corruption must surface as a typed error
+  the recovery ladder can classify (``TruncatedMessageError`` is the
+  model citizen).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding
+
+RULE = "typed-errors"
+
+_GENERIC = {"Exception", "RuntimeError"}
+_WIRE_GENERIC = {"ValueError", "OSError", "IOError"}
+_WIRE_FN_RE = re.compile(
+    r"^_?(send|recv|encode|decode|sendall|recv_exact)\w*$"
+    r"|^dispatch$|^_call$|^serve\w*$")
+
+
+def _scoped_files(project):
+    serving = os.path.join("mxnet_tpu", "serving") + os.sep
+    for sf in project.py_files:
+        base = os.path.basename(sf.path)
+        if (sf.path.startswith(serving)
+                or (sf.path.startswith("mxnet_tpu" + os.sep)
+                    and base.startswith("kvstore"))
+                or sf.path == os.path.join("mxnet_tpu", "engine.py")
+                or sf.path == os.path.join("mxnet_tpu",
+                                           "_async_ps_main.py")):
+            yield sf
+
+
+def _exc_name(raise_node):
+    exc = raise_node.exc
+    if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+        return exc.func.id
+    if isinstance(exc, ast.Name):
+        return exc.id
+    return None
+
+
+def _walk_functions(tree):
+    """Yield (function_node, enclosing_names) depth-first."""
+    def rec(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, stack + [child.name]
+                yield from rec(child, stack + [child.name])
+            else:
+                yield from rec(child, stack)
+    yield from rec(tree, [])
+
+
+def check_typed_errors(project):
+    for sf in _scoped_files(project):
+        if sf.tree is None:
+            continue
+        # raises at module level or in any function
+        wire_lines = set()
+        for fn, stack in _walk_functions(sf.tree):
+            if any(_WIRE_FN_RE.match(n) for n in stack):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Raise):
+                        wire_lines.add(node.lineno)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Raise):
+                continue
+            name = _exc_name(node)
+            if name in _GENERIC:
+                yield Finding(
+                    sf.path, node.lineno, RULE,
+                    "bare `raise %s` on a wire/serving path — raise a "
+                    "typed MXNetError subclass instead" % name)
+            elif name in _WIRE_GENERIC and node.lineno in wire_lines:
+                yield Finding(
+                    sf.path, node.lineno, RULE,
+                    "`raise %s` inside a wire function — wire faults "
+                    "must be typed (MXNetError hierarchy, e.g. "
+                    "TruncatedMessageError) so the recovery ladder can "
+                    "classify them" % name)
