@@ -40,6 +40,9 @@ trace:
 watchdog:
 	python tools/watchdog_fit.py
 
+elastic:
+	python tools/elastic_fit.py
+
 serve:
 	python tools/serve.py --smoke
 
@@ -47,4 +50,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	dryrun dist-test chaos trace watchdog serve clean
+	dryrun dist-test chaos trace watchdog elastic serve clean

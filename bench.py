@@ -71,7 +71,11 @@ def _obs_counters():
 # accounting plane (cost-analysis FLOPs + goodput ledger)
 # v5: requests_per_sec / request_ms_p50 / request_ms_p99 /
 # batch_occupancy from the BENCH_SERVING=1 continuous-batching loop
-_SCHEMA_VERSION = 5
+# v6: reserved (ROADMAP: LM serving lane — tokens/sec/user, inter-token
+# p99)
+# v7: resize_cutover_ms / autoscale_actions_total from the
+# BENCH_ELASTIC=1 live-resize loop
+_SCHEMA_VERSION = 7
 
 
 def _bench_peak():
@@ -358,6 +362,127 @@ def serving_main():
     }))
 
 
+def elastic_main():
+    """Elastic-scale lane (BENCH_ELASTIC=1): a live 2→4→2 PS-shard
+    resize under a concurrent push load, driven end-to-end by the
+    autoscaler (a firing watchdog rule scales up; sustained quiet
+    scales back down).  Emits the schema-7 additive keys:
+    ``resize_cutover_ms`` (max routing-frozen window across the two
+    cutovers) and ``autoscale_actions_total`` (actions the policy
+    engine took — 2 on a clean run)."""
+    import threading
+
+    import mxnet_tpu  # noqa: F401 — env bootstrap
+    from mxnet_tpu import elastic
+    from mxnet_tpu import kvstore_async as ka
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import Autoscaler, Rule, Watchdog
+
+    n_keys = int(os.environ.get("BENCH_ELASTIC_KEYS", "24"))
+    n_push = int(os.environ.get("BENCH_ELASTIC_PUSHES", "400"))
+    servers = [ka.AsyncServer(secret="bench", server_id=i).start()
+               for i in range(4)]
+    group = ka.ServerGroup([servers[0].address, servers[1].address],
+                           rank=0, heartbeat=False, secret="bench")
+    group._bound = 1 << 10  # stripe the big keys across the fleet
+    rs = np.random.RandomState(0)
+    keys = [("k%02d" % i,
+             (4096,) if i % 4 == 0 else (64,)) for i in range(n_keys)]
+    group.init([(k, rs.randn(*s).astype(np.float32)) for k, s in keys])
+    import pickle
+
+    from mxnet_tpu import optimizer as mx_opt
+
+    # pushes go through the server-side optimizer, like a real fit
+    group.set_optimizer(pickle.dumps(mx_opt.SGD(learning_rate=0.01)))
+
+    pushed = [0]
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            k, s = keys[pushed[0] % n_keys]
+            group.push([(k, np.ones(s, np.float32))])
+            pushed[0] += 1
+            if pushed[0] >= n_push:
+                break
+
+    # the alert loop, closed: a saturation gauge trips the watchdog
+    # rule, the autoscaler's sustained-alert policy resizes the fleet
+    sat = obs.gauge("serving_queue_saturation",
+                    "Queue depth / max_queue per model lane "
+                    "(1.0 = shedding)", ["model"]).labels("bench")
+    dog = Watchdog([Rule("queue_saturation", "serving_queue_saturation",
+                         stat="max", op=">=", threshold=0.9,
+                         description="bench: synthetic saturation")])
+    cutovers = []
+
+    def up(action):
+        res = elastic.ResizePlan(
+            group, [s.address for s in servers], keys,
+            secret="bench")
+        res.run()
+        cutovers.append(res.cutover_ms)
+        return {"epoch": group.topology_epoch}
+
+    def down(action):
+        res = elastic.ResizePlan(
+            group, [servers[0].address, servers[1].address], keys,
+            secret="bench")
+        res.run()
+        cutovers.append(res.cutover_ms)
+        return {"epoch": group.topology_epoch}
+
+    asc = Autoscaler(dog, scale_up=up, scale_down=down,
+                     size=lambda: len(group._specs),
+                     sustain_s=0.0, cooldown_s=0.0, idle_s=0.05,
+                     min_size=2, max_size=4)
+    pusher = threading.Thread(target=pound)
+    t0 = time.perf_counter()
+    pusher.start()
+    while pushed[0] < 8 and time.perf_counter() - t0 < 5:
+        time.sleep(0.002)               # resize under real push load
+    sat.set(1.0)                        # load spike → scale-up
+    act_up = asc.evaluate()
+    sat.set(0.0)                        # quiet → drain-and-shrink
+    deadline = time.perf_counter() + 30
+    act_down = None
+    while act_down is None and time.perf_counter() < deadline:
+        act_down = asc.evaluate()
+        time.sleep(0.01)
+    stop.set()
+    pusher.join()
+    dt = time.perf_counter() - t0
+    ok = (act_up is not None and act_up.ok
+          and act_down is not None and act_down.ok
+          and len(group._specs) == 2)
+    # every key must survive both restripes at full value (the pusher's
+    # in-flight increments make exact totals racy; presence + shape is
+    # the bench contract, tests assert exactness)
+    out = group.pull([k for k, _ in keys])
+    survived = all(v.shape == tuple(s) for v, (_, s) in zip(out, keys))
+    group.shutdown()
+    for s in servers:
+        s.stop()
+    actions = obs.REGISTRY.get("cluster_autoscale_actions_total")
+    print(json.dumps({
+        "metric": "elastic_resize_cutover",
+        "value": round(max(cutovers), 3) if cutovers else None,
+        "unit": "ms",
+        "vs_baseline": 0.0,  # the 2017 reference cannot resize at all
+        "resize_cutover_ms": round(max(cutovers), 3) if cutovers
+                             else None,
+        "autoscale_actions_total": int(actions.total()) if actions
+                                   else 0,
+        "scale_cycle_ok": bool(ok and survived),
+        "pushes_during_resize": pushed[0],
+        "elapsed_s": round(dt, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"keys": n_keys, "pushes": n_push},
+    }))
+
+
 def main():
     import jax
     import mxnet_tpu  # noqa: F401
@@ -365,6 +490,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_ELASTIC") == "1":
+        elastic_main()
+        return
     if os.environ.get("BENCH_SERVING") == "1":
         serving_main()
         return

@@ -15,6 +15,7 @@ __all__ = [
     "ServerDeadError",
     "ShardFailedError",
     "StaleEpochError",
+    "ResizeAbortedError",
     "TruncatedMessageError",
     "CorruptMessageError",
     "string_types",
@@ -47,12 +48,32 @@ class StaleEpochError(MXNetError):
     epoch older than the server's (a fenced zombie primary, or a worker
     that missed a failover), or it was a mutation sent to a follower
     (``not_primary``).  Carries the server's ``epoch`` so the caller can
-    refresh its membership view and retry."""
+    refresh its membership view and retry.
 
-    def __init__(self, msg, epoch=None, not_primary=False):
+    ``moved=True`` marks the elastic-resize variant: the KEY — not the
+    server — has a new home (it was re-striped to another shard at
+    ``epoch``).  The fix is a topology refresh (``elastic`` directory),
+    not a replica failover, so routing layers must not treat it as a
+    dead primary.  When the cutover has fully committed, the rejection
+    is a self-describing forwarding pointer: ``addresses`` carries the
+    new shard list to adopt; ``addresses is None`` means the cutover
+    (or its abort) is still in flight and the caller should poll."""
+
+    def __init__(self, msg, epoch=None, not_primary=False, moved=False,
+                 addresses=None):
         super().__init__(msg)
         self.epoch = epoch
         self.not_primary = not_primary
+        self.moved = moved
+        self.addresses = addresses
+
+
+class ResizeAbortedError(MXNetError):
+    """A live PS re-striping plan (``elastic.ResizePlan``) aborted: a
+    transfer or cutover step failed and the plan rolled back to the old
+    key→shard assignment at the old epoch.  No key is orphaned — staged
+    copies are discarded and any retired key is restored — so the caller
+    may simply retry the resize."""
 
 
 class TruncatedMessageError(MXNetError, EOFError):

@@ -28,6 +28,16 @@ site                      planted at
                           call (``name`` is ``<model>:<bucket>``; retried
                           ``MXNET_TPU_SERVING_RETRIES`` times, then failed
                           requests fail over to a peer replica)
+``kvstore.resize_drop``   elastic re-striping transfer/cutover steps
+                          (``elastic.ResizePlan``; ``name`` is
+                          ``prepare:<key>`` / ``commit:<shard>`` — a fired
+                          rule aborts the plan cleanly at the old epoch,
+                          no key orphaned)
+``serving.scale``         serving-group scale action entry
+                          (``ReplicaGroup.grow``/``shrink``; ``name`` is
+                          ``grow:<group>`` / ``shrink:<group>`` — a fired
+                          rule aborts the action before any membership
+                          change)
 ========================  ==================================================
 
 Four failure modes:
@@ -77,7 +87,8 @@ _M_FIRED = _metrics.counter(
 SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
-    "checkpoint.write", "serving.admit", "serving.dispatch",
+    "kvstore.resize_drop", "checkpoint.write", "serving.admit",
+    "serving.dispatch", "serving.scale",
 })
 
 
@@ -104,6 +115,8 @@ def _drop_exc(site):
         return socket.timeout("chaos: call timed out")
     if site == "kvstore.repl_drop":
         return ConnectionResetError("chaos: replication frame dropped")
+    if site == "kvstore.resize_drop":
+        return ConnectionResetError("chaos: resize transfer dropped")
     return ChaosDrop("chaos: dropped at %s" % site)
 
 

@@ -1,0 +1,576 @@
+"""Elastic scale: live PS re-striping and elastic worker rosters.
+
+ROADMAP item 4 — generalize the epoch-fenced membership machinery from
+"replica replaces dead primary" to "capacity follows load".  This module
+owns the two training-side halves (``observability.autoscaler`` closes
+the alert loop; ``serving.replication`` grows/shrinks serving groups):
+
+**Live PS re-striping** (:class:`ResizePlan`) — add or remove parameter-
+server shards mid-fit with a two-phase cutover:
+
+1. *prepare* — the plan computes the epoch-bumped key→shard assignment,
+   then **warm-copies** every moving key to its new owner
+   (``resize_export`` → ``resize_install``) while the trainer keeps
+   stepping against the old assignment.  Each copy carries the source
+   per-key seqno as a *staged mark*.
+2. *commit* — a short critical section (the group's routing lock, so
+   same-process ops never observe the middle): ``resize_retire``
+   atomically freezes each moving key on its old owner, deletes it,
+   leaves a tombstone, and returns — in the same response — the
+   (value, seqno) of every key whose seqno advanced past its staged
+   mark, i.e. exactly the pushes that landed after the warm copy.  The
+   plan installs those dirty deltas, **seals** the tombstones with the
+   new shard list (``resize_seal`` — a straggler's rejection becomes a
+   self-describing forwarding pointer), publishes the topology at the
+   new epoch, and atomically cuts ``ServerGroup`` routing over.
+
+Any failure rolls back (*abort*): staged copies are discarded and
+retired keys are restored at their old seqnos — no key is orphaned, the
+old epoch stays authoritative, and the caller sees a typed
+:class:`~mxnet_tpu.base.ResizeAbortedError`.
+
+Straggler writes to a key's old home are fenced by the tombstones with
+``StaleEpochError(moved=True)`` — a *topology* staleness, handled by
+``ServerGroup._routed`` (adopt the forwarded shard list / the published
+topology and retry), never by replica failover.
+
+**Worker elasticity** (:class:`WorkerRoster`) — data-parallel ranks join
+or drain mid-fit; batch ownership is a pure function of the live member
+list (``index % len(members) == my position``) so assignment re-balances
+the moment the roster version bumps, and joiners fast-forward to the
+roster's recorded (epoch, batch) progress so ``resume="auto"`` semantics
+hold mid-epoch.
+
+Chunk geometry note: a resize that changes the shard count re-chunks
+big striped tensors, so per-chunk optimizer slots (momentum etc.) cannot
+be remapped exactly and are reset for those keys; plain-key moves and
+same-count re-shardings carry their slots bit-exactly.  Run stateless
+optimizers (plain SGD) or budget a parity tolerance when resizing across
+stripe counts.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import zlib
+
+import numpy as _np
+
+from . import chaos as _chaos
+from . import kvstore_async as _ka
+from .base import MXNetError, ResizeAbortedError
+from .observability import flight_recorder as _flight
+from .observability import metrics as _metrics
+
+__all__ = ["ResizePlan", "WorkerRoster", "publish_topology",
+           "lookup_topology", "reset_topology"]
+
+_M_RESIZE = _metrics.counter(
+    "kv_resize_total", "Elastic PS re-striping plans, by outcome",
+    ["outcome"])
+_M_CUTOVER = _metrics.histogram(
+    "kv_resize_cutover_seconds",
+    "Commit critical section of a PS resize (routing frozen)")
+_M_ROSTER = _metrics.gauge(
+    "elastic_worker_ranks", "Live data-parallel ranks in the roster")
+
+
+# -- topology directory --------------------------------------------------
+#
+# Maps a ServerGroup's IDENTITY (its original spec tuple — stable across
+# resizes) to the current shard list + epoch.  Process-local like the
+# replica-membership directory; cross-process stragglers don't need it —
+# sealed tombstones forward the new shard list from the old owner.
+
+_TOPO_LOCK = threading.Lock()
+_TOPOLOGY = {}  # group_id tuple -> {"epoch": int, "addresses": [spec...]}
+
+
+def reset_topology():
+    """Forget every published topology (test isolation)."""
+    with _TOPO_LOCK:
+        _TOPOLOGY.clear()
+
+
+def publish_topology(group_id, addresses, epoch):
+    """Record an epoch-bumped shard list for a group.  Monotonic: an
+    older epoch never overwrites a newer one."""
+    group_id = tuple(group_id)
+    with _TOPO_LOCK:
+        rec = _TOPOLOGY.get(group_id)
+        if rec is not None and int(epoch) <= rec["epoch"]:
+            return
+        _TOPOLOGY[group_id] = {"epoch": int(epoch),
+                               "addresses": [str(a) for a in addresses]}
+
+
+def lookup_topology(group_id):
+    with _TOPO_LOCK:
+        rec = _TOPOLOGY.get(tuple(group_id))
+        if rec is None:
+            return None
+        return {"epoch": rec["epoch"],
+                "addresses": list(rec["addresses"])}
+
+
+# -- placement math ------------------------------------------------------
+
+def _prod(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _chunk_slices(size, n):
+    """Flat (start, end) per chunk, matching ``np.array_split``."""
+    base, extra = divmod(size, n)
+    out, off = [], 0
+    for i in range(n):
+        ln = base + (1 if i < extra else 0)
+        out.append((off, off + ln))
+        off += ln
+    return out
+
+
+def _placement(specs, key, shape, bound):
+    """[(shard_idx, wire_key, flat_slice | None)] under one topology —
+    the same pure function of (element count, bound, shard count) that
+    ``ServerGroup._split`` / ``server_of`` route by."""
+    n = len(specs)
+    size = _prod(shape)
+    if n > 1 and size >= bound:
+        return [(i, ("stripe", key, i), sl)
+                for i, sl in enumerate(_chunk_slices(size, n))]
+    return [(zlib.crc32(repr(key).encode("utf-8")) % n, key, None)]
+
+
+def _state_key(wire_key):
+    return repr(wire_key) if isinstance(wire_key, tuple) else wire_key
+
+
+def _batch_keys():
+    return max(1, int(os.environ.get("MXNET_TPU_RESIZE_BATCH_KEYS", "64")))
+
+
+def _batched(items, n):
+    for i in range(0, len(items), n):
+        yield items[i:i + n]
+
+
+class _KeyPlan:
+    """Transfer plan for ONE base key across the resize."""
+
+    __slots__ = ("key", "shape", "size", "old_parts", "new_parts",
+                 "persist", "warm", "colliding", "src_seq", "s0", "dirty")
+
+    def __init__(self, key, shape, old_specs, new_specs, bound):
+        self.key = key
+        self.shape = tuple(int(d) for d in shape)
+        self.size = _prod(self.shape)
+        self.old_parts = [(old_specs[i], wk, sl) for i, wk, sl
+                          in _placement(old_specs, key, self.shape, bound)]
+        self.new_parts = [(new_specs[i], wk, sl) for i, wk, sl
+                          in _placement(new_specs, key, self.shape, bound)]
+        old_ident = set(self.old_parts)
+        # parts identical under both topologies stay put: not exported,
+        # not retired, not re-installed
+        self.persist = {p for p in self.new_parts if p in old_ident}
+        occupied = {(spec, wk) for spec, wk, _ in self.old_parts}
+        # a new part whose (shard, wire key) is live under the OLD
+        # placement with different geometry cannot be warm-staged — the
+        # old key is still serving reads — so it transfers inside the
+        # commit critical section instead
+        self.colliding = [p for p in self.new_parts
+                          if p not in self.persist
+                          and (p[0], p[1]) in occupied]
+        self.warm = [p for p in self.new_parts
+                     if p not in self.persist and p not in self.colliding]
+        self.src_seq = {}   # old wire key -> seqno at export
+        self.s0 = 0
+        self.dirty = False
+
+    @property
+    def moving(self):
+        return len(self.persist) != len(self.new_parts) \
+            or len(self.old_parts) != len(self.new_parts)
+
+    def retired_parts(self):
+        return [p for p in self.old_parts if p not in self.persist]
+
+
+class ResizePlan:
+    """Two-phase live re-striping of a :class:`~mxnet_tpu.kvstore_async.
+    ServerGroup` onto a new shard list.
+
+    ``keys`` is the full ``[(key, shape), ...]`` inventory of the store
+    (``KVStore.resize`` derives it from its local mirror).  Typical use::
+
+        plan = ResizePlan(group, new_addresses, keys)
+        plan.run()        # prepare + commit, abort-on-failure
+        plan.cutover_ms   # routing-frozen window, for the bench
+
+    ``prepare``/``commit``/``abort`` are also public for tests and for
+    callers that want to overlap the warm copy with training exactly.
+    """
+
+    def __init__(self, group, new_addresses, keys, secret=None):
+        self._group = group
+        self._old_specs = list(group._specs)
+        self._new_specs = [group._normalize_spec(a) for a in new_addresses]
+        if not self._new_specs:
+            raise ValueError("ResizePlan: empty new shard list")
+        self.new_epoch = group.topology_epoch + 1
+        self._secret = secret or group._secret \
+            or os.environ.get("MXNET_TPU_PS_SECRET")
+        self._plans = [_KeyPlan(k, s, self._old_specs, self._new_specs,
+                                group._bound) for k, s in keys]
+        self._moving = [p for p in self._plans if p.moving]
+        self._base = {}       # key -> flat np array (moving segments)
+        self._states = {}     # state_key -> optimizer slot (by NEW home)
+        self._opt_raw = None  # set_optimizer pickle forwarded by exports
+        self._installed = []  # (spec, [wire keys]) — staged/commit installs
+        self._retired = []    # (spec, [wire keys]) — for abort restore
+        self._clients = {}
+        self.state = "new"
+        self.cutover_ms = None
+
+    # -- shard RPC plumbing ---------------------------------------------
+
+    def _client(self, spec):
+        cli = self._clients.get(spec)
+        if cli is None:
+            reps = spec.split("|")
+            rank = -next(_ka._rejoin_ranks)
+            if len(reps) > 1:
+                cli = _ka.ReplicatedClient(reps, rank, heartbeat=False,
+                                           secret=self._secret)
+            else:
+                cli = _ka.AsyncClient(reps[0], rank, heartbeat=False,
+                                      secret=self._secret)
+            self._clients[spec] = cli
+        return cli
+
+    def close(self):
+        for cli in self._clients.values():
+            cli.close()
+        self._clients = {}
+
+    def _states_payload(self, wire_keys):
+        """(raw, mac) optimizer payload for these wire keys, or None."""
+        states = {sk: self._states[sk]
+                  for sk in (_state_key(wk) for wk in wire_keys)
+                  if sk in self._states}
+        if not states:
+            return None
+        raw = pickle.dumps({"states": states})
+        return raw, _ka._optimizer_mac(self._secret or "", raw)
+
+    def _take_states(self, resp):
+        """Verify + absorb an export/retire response's optimizer slots."""
+        raw = resp.get("optimizer")
+        if raw is None:
+            return
+        mac = _ka._optimizer_mac(self._secret or "", raw)
+        import hmac as _hmaclib
+
+        if not _hmaclib.compare_digest(resp.get("mac", ""), mac):
+            raise MXNetError(
+                "resize transfer rejected: bad or missing HMAC on the "
+                "optimizer-state payload (shards must share the per-job "
+                "secret)")
+        payload = pickle.loads(raw)
+        self._states.update(payload.get("states", {}))
+        if payload.get("opt_raw") is not None:
+            self._opt_raw = payload["opt_raw"]
+
+    def _install(self, spec, triples, extra_states=True):
+        """``resize_install`` a batch of (wire_key, flat value, seqno)."""
+        for batch in _batched(triples, _batch_keys()):
+            msg = {"op": "resize_install",
+                   "pairs": [(wk, v) for wk, v, _ in batch],
+                   "seqlist": [[_ka._wire_key(wk), int(sq)]
+                               for wk, _, sq in batch]}
+            if extra_states:
+                payload = self._states_payload([wk for wk, _, _ in batch])
+                if payload is not None:
+                    msg["optimizer"], msg["mac"] = payload
+            self._client(spec)._call(dict(msg))
+            self._installed.append((spec, [wk for wk, _, _ in batch]))
+
+    def _fill(self, plan, sl, val):
+        """Absorb one exported/dirty part into the key's base array."""
+        flat = _np.asarray(val).ravel()
+        if self._base.get(plan.key) is None:
+            self._base[plan.key] = _np.zeros(plan.size, dtype=flat.dtype)
+        if sl is None:
+            self._base[plan.key][:] = flat
+        else:
+            self._base[plan.key][sl[0]:sl[1]] = flat
+
+    def _part_value(self, plan, sl):
+        """One part's install payload: a flat chunk (striped) or the
+        full tensor in its original shape (plain key)."""
+        flat = self._base[plan.key]
+        if sl is None:
+            return flat.reshape(plan.shape)
+        return flat[sl[0]:sl[1]]
+
+    # -- phase 1: warm copy ----------------------------------------------
+
+    def prepare(self):
+        """Export every moving key from its old owner and stage it on
+        its new owner, recording staged seqno marks.  The trainer keeps
+        pushing through the old assignment the whole time."""
+        if self.state != "new":
+            raise MXNetError("ResizePlan.prepare: plan is %s" % self.state)
+        try:
+            per_old = {}  # old spec -> [(plan, wire_key, slice)]
+            for plan in self._moving:
+                _chaos.visit("kvstore.resize_drop",
+                             name="prepare:%r" % (plan.key,))
+                self._base[plan.key] = None
+                for spec, wk, sl in plan.retired_parts():
+                    per_old.setdefault(spec, []).append((plan, wk, sl))
+            for spec, parts in sorted(per_old.items()):
+                for batch in _batched(parts, _batch_keys()):
+                    resp = self._client(spec)._call(
+                        {"op": "resize_export",
+                         "keys": [wk for _, wk, _ in batch]})
+                    seqs = {_ka._unwire_key(k): int(n)
+                            for k, n in resp.get("seqlist", [])}
+                    for (plan, wk, sl), val in zip(batch, resp["vals"]):
+                        self._fill(plan, sl, val)
+                        plan.src_seq[wk] = seqs.get(wk, 0)
+                    self._take_states(resp)
+            for plan in self._moving:
+                plan.s0 = max(plan.src_seq.values(), default=0)
+            # a shard that joined AFTER set_optimizer has no updater and
+            # would reject every post-cutover push: configure it from
+            # the optimizer pickle the exports forwarded
+            if self._opt_raw is not None:
+                for spec in self._new_specs:
+                    if spec not in self._old_specs:
+                        self._client(spec).set_optimizer(self._opt_raw)
+            per_new = {}  # new spec -> [(wk, value, seq)]
+            for plan in self._moving:
+                for spec, wk, sl in plan.warm:
+                    per_new.setdefault(spec, []).append(
+                        (wk, self._part_value(plan, sl), plan.s0 + 1))
+            for spec, triples in sorted(per_new.items()):
+                self._install(spec, triples)
+        except Exception:
+            self.state = "failed"
+            raise
+        self.state = "prepared"
+        return self
+
+    # -- phase 2: cutover ------------------------------------------------
+
+    def commit(self):
+        """Freeze, delta-copy, seal, publish, adopt — all inside the
+        group's routing lock, so same-process ops go straight from the
+        old assignment to the new one with no observable middle."""
+        if self.state != "prepared":
+            raise MXNetError("ResizePlan.commit: plan is %s" % self.state)
+        per_old = {}  # old spec -> [(plan, wire_key, slice)]
+        for plan in self._moving:
+            for spec, wk, sl in plan.retired_parts():
+                per_old.setdefault(spec, []).append((plan, wk, sl))
+        t0 = time.monotonic()
+        try:
+            with self._group.routing_frozen():
+                for spec, parts in sorted(per_old.items()):
+                    _chaos.visit("kvstore.resize_drop",
+                                 name="commit:%s" % spec)
+                    wks = [wk for _, wk, _ in parts]
+                    staged = [[_ka._wire_key(wk), int(plan.src_seq[wk])]
+                              for plan, wk, _ in parts]
+                    resp = self._client(spec)._call(
+                        {"op": "resize_retire", "keys": wks,
+                         "new_epoch": self.new_epoch, "staged": staged})
+                    self._retired.append((spec, wks))
+                    dseqs = {_ka._unwire_key(k): int(n)
+                             for k, n in resp.get("seqlist", [])}
+                    by_wk = {wk: (plan, sl) for plan, wk, sl in parts}
+                    for wk, val in resp.get("pairs", []):
+                        plan, sl = by_wk[wk]
+                        self._fill(plan, sl, val)
+                        plan.src_seq[wk] = dseqs.get(
+                            wk, plan.src_seq.get(wk, 0))
+                        plan.dirty = True
+                    self._take_states(resp)
+                # install the commit-phase content: every colliding part,
+                # plus ALL non-persisting parts of any dirty key
+                per_new = {}
+                for plan in self._moving:
+                    parts = list(plan.colliding)
+                    if plan.dirty:
+                        parts = plan.colliding + plan.warm
+                    for spec, wk, sl in parts:
+                        per_new.setdefault(spec, []).append(
+                            (wk, self._part_value(plan, sl), plan.s0 + 2))
+                for spec, triples in sorted(per_new.items()):
+                    self._install(spec, triples)
+                # seal: moved rejections now forward the new shard list
+                for spec, wks in self._retired:
+                    self._client(spec)._call(
+                        {"op": "resize_seal", "keys": wks,
+                         "addresses": list(self._new_specs),
+                         "new_epoch": self.new_epoch})
+                publish_topology(self._group.group_id, self._new_specs,
+                                 self.new_epoch)
+                self._group.adopt_topology(self._new_specs, self.new_epoch)
+        except Exception:
+            self.state = "failed"
+            raise
+        dt = time.monotonic() - t0
+        self.cutover_ms = dt * 1000.0
+        _M_CUTOVER.observe(dt)
+        _M_RESIZE.labels("committed").inc()
+        self.state = "committed"
+        return self
+
+    # -- rollback ---------------------------------------------------------
+
+    def abort(self):
+        """Roll back to the old assignment at the old epoch: discard
+        every staged/committed install, restore every retired key at its
+        last seqno, clear tombstones.  Idempotent and safe after a
+        partial prepare or a partial commit."""
+        if self.state in ("committed", "aborted"):
+            raise MXNetError("ResizePlan.abort: plan is %s" % self.state)
+        failures = []
+        for spec, wks in self._installed:
+            try:
+                self._client(spec)._call(
+                    {"op": "resize_discard", "keys": list(wks)})
+            except Exception as exc:  # noqa: BLE001 — best-effort rollback
+                failures.append((spec, exc))
+        for spec, wks in self._retired:
+            triples = []
+            for plan in self._moving:
+                for pspec, wk, sl in plan.retired_parts():
+                    if pspec == spec and wk in wks:
+                        triples.append((wk, self._part_value(plan, sl),
+                                        plan.src_seq.get(wk, 1)))
+            try:
+                self._install(spec, triples)
+            except Exception as exc:  # noqa: BLE001 — best-effort rollback
+                failures.append((spec, exc))
+        self._installed = []
+        self._retired = []
+        self.state = "aborted"
+        _M_RESIZE.labels("aborted").inc()
+        _flight.record_failure(
+            "resize_aborted",
+            group=",".join(self._group.group_id),
+            old=",".join(self._old_specs), new=",".join(self._new_specs),
+            epoch=self._group.topology_epoch,
+            restore_failures=len(failures))
+        if failures:
+            raise MXNetError(
+                "ResizePlan.abort: rollback left %d shard(s) unrestored: "
+                "%s" % (len(failures),
+                        "; ".join("%s: %r" % f for f in failures)))
+        return self
+
+    def run(self):
+        """prepare + commit; any failure aborts (rollback to the old
+        epoch) and re-raises as :class:`ResizeAbortedError`."""
+        try:
+            self.prepare()
+            self.commit()
+        except Exception as exc:  # noqa: BLE001 — abort on ANY failure
+            try:
+                self.abort()
+            finally:
+                self.close()
+            raise ResizeAbortedError(
+                "resize %s -> %s aborted at the old epoch (%d): %r"
+                % (",".join(self._old_specs), ",".join(self._new_specs),
+                   self._group.topology_epoch, exc)) from exc
+        self.close()
+        return self
+
+
+# -- worker elasticity ---------------------------------------------------
+
+class WorkerRoster:
+    """Elastic membership for data-parallel workers.
+
+    Batch ownership is a pure function of the member list: worker at
+    sorted position ``p`` of ``n`` members owns global batch ``i`` iff
+    ``i % n == p`` — so a ``join``/``drain`` re-balances the assignment
+    for everyone at the next batch boundary with no coordinator.
+
+    Mid-epoch handoff: the fit loop records (epoch, next batch index)
+    through :meth:`mark_progress`; a joining worker reads
+    :meth:`resume_point` and fast-forwards its iterator so the epoch's
+    already-consumed batches are not re-trained (``resume="auto"``
+    semantics across a roster change)."""
+
+    def __init__(self, ranks=(0,)):
+        self._lock = threading.Lock()
+        self._members = sorted(set(ranks))
+        self.version = 0
+        self._progress = (0, 0)  # (epoch, next batch index)
+        _M_ROSTER.set(len(self._members))
+
+    def members(self):
+        with self._lock:
+            return list(self._members)
+
+    @property
+    def size(self):
+        with self._lock:
+            return len(self._members)
+
+    def join(self, rank):
+        """Add a rank; returns the roster version after the change."""
+        with self._lock:
+            if rank not in self._members:
+                self._members = sorted(self._members + [rank])
+                self.version += 1
+            _M_ROSTER.set(len(self._members))
+            return self.version
+
+    def drain(self, rank):
+        """Remove a rank (it finishes its in-flight batch and stops
+        claiming new ones).  The last member can not drain — training
+        must keep a worker."""
+        with self._lock:
+            if rank in self._members and len(self._members) == 1:
+                raise MXNetError(
+                    "WorkerRoster.drain: cannot drain the last worker "
+                    "(rank %d)" % rank)
+            if rank in self._members:
+                self._members = [m for m in self._members if m != rank]
+                self.version += 1
+            _M_ROSTER.set(len(self._members))
+            return self.version
+
+    def owns(self, rank, batch_index):
+        """Does ``rank`` own global batch ``batch_index`` under the
+        CURRENT membership?  A drained rank owns nothing."""
+        with self._lock:
+            if rank not in self._members:
+                return False
+            pos = self._members.index(rank)
+            return batch_index % len(self._members) == pos
+
+    def mark_progress(self, epoch, next_batch):
+        """Advance the group's high-water mark (monotonic: interleaved
+        ranks can never move the handoff point backward)."""
+        with self._lock:
+            point = (int(epoch), int(next_batch))
+            if point > self._progress:
+                self._progress = point
+
+    def resume_point(self):
+        """(epoch, next batch index) a joining worker fast-forwards to."""
+        with self._lock:
+            return self._progress

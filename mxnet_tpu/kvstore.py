@@ -510,6 +510,32 @@ class KVStore(object):
             "(sync modes reduce via collectives; only dist_async runs a "
             "parameter server)" % self._kind)
 
+    def resize(self, new_addresses):
+        """Live re-striping: move this store's keys onto a NEW shard
+        list (grow or shrink the PS fleet) without stopping training.
+
+        Drives an :class:`~mxnet_tpu.elastic.ResizePlan` over every key
+        this worker has initialized — warm-copies while pushes keep
+        flowing, then a short routing-frozen cutover at a bumped
+        topology epoch (see :mod:`mxnet_tpu.elastic` for the protocol
+        and its abort/rollback guarantees).  Only ``dist_async`` with a
+        live PS data plane has shards to re-stripe.  Returns
+        ``{"epoch", "cutover_ms"}`` — the actuator contract the
+        autoscaler's flight bundles expect."""
+        if self._async is None:
+            raise MXNetError(
+                "resize: kvstore type %r has no parameter-server shards "
+                "to re-stripe (dist_async with a PS data plane only)"
+                % self._kind)
+        from . import elastic
+
+        keys = [(_updater_key(k), tuple(self._store[k].shape))
+                for k in self._store]
+        plan = elastic.ResizePlan(self._async, new_addresses, keys)
+        plan.run()
+        return {"epoch": self._async.topology_epoch,
+                "cutover_ms": plan.cutover_ms}
+
     def num_dead_node(self, node_id):
         """Liveness probe (parity: ``kvstore.h:242`` /
         ``ps::Postoffice::get_num_dead_node``).

@@ -92,6 +92,7 @@ variable                       default    meaning
 
 from __future__ import annotations
 
+import base64 as _b64
 import collections
 import hashlib
 import hmac as _hmaclib
@@ -228,8 +229,15 @@ def _repl_timeout_s():
 # a retry is answered from cache, never re-applied.  pulls/stats re-execute.
 # promote/sync_follower are membership ops and idempotent by construction
 # (same-epoch promote acks; re-sync re-snapshots), so they stay out.
-_MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command"})
-# the same four ops are what a primary appends to its replication log
+# The three resize_* mutations are the elastic re-striping protocol
+# (``elastic.ResizePlan``): install stages a key on its new owner, retire
+# freezes+exports it on the old owner (leaving a ``StaleEpochError``
+# tombstone), discard rolls a staged copy back — all three replicate so a
+# follower promoted mid-resize holds the same tombstones and staged keys.
+_MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command",
+                           "resize_install", "resize_retire",
+                           "resize_discard", "resize_seal"})
+# the same ops are what a primary appends to its replication log
 _REPLICATED_OPS = _MUTATING_OPS
 
 
@@ -628,9 +636,17 @@ class AsyncServer:
         self.epoch = 0
         self._store = {}
         self._seqnos = {}  # key -> per-key update sequence number
+        # elastic re-striping tombstones: key -> {"epoch": N} for keys
+        # retired to a new owner; any straggler access is rejected with
+        # a typed StaleEpochError (moved=True) carrying that epoch
+        self._moved = {}
         self._applied_seq = 0  # replication log position
         self._followers = {}  # follower addr -> _FollowerLink
         self._updater = None
+        # the raw set_optimizer pickle, kept so a resize_export can
+        # forward it: a shard that joins the job AFTER set_optimizer
+        # (elastic scale-up) is configured by the plan from this copy
+        self._opt_raw = None
         self._commands = []
         self._lock = threading.Lock()
         self._heartbeat = {}  # worker rank -> last contact time
@@ -764,6 +780,9 @@ class AsyncServer:
         snap = {"pairs": [(k, _np.array(v)) for k, v in self._store.items()],
                 "seqlist": [[_wire_key(k), int(n)]
                             for k, n in self._seqnos.items()],
+                "moved": [[_wire_key(k), int(v["epoch"]),
+                           v.get("addresses")]
+                          for k, v in self._moved.items()],
                 "rseq": self._applied_seq,
                 "epoch": self.epoch,
                 "last_seq": [[r, s, resp]
@@ -774,6 +793,10 @@ class AsyncServer:
             raw = pickle.dumps(self._updater._updater)
             snap["optimizer"] = raw
             snap["mac"] = _optimizer_mac(self.secret, raw)
+        if self._opt_raw is not None:
+            # rides base64 in the JSON header ("optimizer" is the one
+            # binary codec field and it already carries the updater)
+            snap["opt_raw_b64"] = _b64.b64encode(self._opt_raw).decode()
         return snap
 
     def _install_snapshot_locked(self, msg):
@@ -786,9 +809,18 @@ class AsyncServer:
                     "the optimizer-state payload (replicas must share the "
                     "per-job secret)")
             self._updater = _NumpyUpdater(pickle.loads(raw))
+        if msg.get("opt_raw_b64"):
+            self._opt_raw = _b64.b64decode(msg["opt_raw_b64"])
         self._store = {k: _np.array(v, copy=True) for k, v in msg["pairs"]}
         self._seqnos = {_unwire_key(k): int(n)
                         for k, n in msg.get("seqlist", [])}
+        self._moved = {}
+        for entry in msg.get("moved", []):
+            k, e, addrs = (entry + [None])[:3]
+            t = {"epoch": int(e)}
+            if addrs:
+                t["addresses"] = list(addrs)
+            self._moved[_unwire_key(k)] = t
         self._applied_seq = int(msg.get("rseq", 0))
         self.epoch = max(self.epoch, int(msg.get("epoch", 0)))
         self._last_seq = {int(r): (s, resp)
@@ -814,6 +846,23 @@ class AsyncServer:
         elif op == "set_optimizer":
             entry["optimizer"] = msg["optimizer"]
             entry["mac"] = msg.get("mac", "")
+        elif op == "resize_install":
+            entry["pairs"] = msg["pairs"]
+            entry["seqlist"] = msg.get("seqlist", [])
+            if "optimizer" in msg:
+                entry["optimizer"] = msg["optimizer"]
+                entry["mac"] = msg.get("mac", "")
+        elif op == "resize_retire":
+            # "keys" is a codec field: _encode_msg wires it on send
+            entry["keys"] = msg["keys"]
+            entry["new_epoch"] = msg["new_epoch"]
+            entry["staged"] = msg.get("staged", [])
+        elif op == "resize_discard":
+            entry["keys"] = msg["keys"]
+        elif op == "resize_seal":
+            entry["keys"] = msg["keys"]
+            entry["new_epoch"] = msg["new_epoch"]
+            entry["addresses"] = msg.get("addresses", [])
         else:  # command
             entry["head"] = msg["head"]
             entry["body"] = msg["body"]
@@ -1006,7 +1055,21 @@ class AsyncServer:
                                "primary owns this shard"
                                % (self.server_id, self.epoch)}, None
             if op == "pull":
+                rej = self._moved_reject_locked(msg["keys"])
+                if rej is not None:
+                    return rej, None
                 return self._pull_locked(msg), None
+            if op == "resize_export":
+                # read-only side of the re-striping protocol: primary-only
+                # (followers may lag the seqnos a warm copy is staged
+                # against), but deliberately NOT dedup'd or replicated
+                if self.role != "primary":
+                    return {"ok": False, "not_primary": True,
+                            "epoch": self.epoch,
+                            "err": "resize_export: server s%d is %s — "
+                                   "exports come from the primary"
+                                   % (self.server_id, self.role)}, None
+                return self._resize_export_locked(msg), None
             if op not in _REPLICATED_OPS:
                 return {"ok": False, "err": "unknown op %r" % op}, None
             # mutating client ops: primary-only, epoch-fenced
@@ -1026,6 +1089,14 @@ class AsyncServer:
                 last = self._last_seq.get(rank)
                 if last is not None and last[0] == seq:
                     return last[1], None  # duplicate of a completed request
+            if op in ("init", "push"):
+                # AFTER dedup: a push applied before its key moved must
+                # still answer its retry from cache (the applied update
+                # travelled with the key), never re-route and re-apply
+                rej = self._moved_reject_locked(
+                    [k for k, _ in msg["pairs"]])
+                if rej is not None:
+                    return rej, None
             resp = self._dispatch_locked(op, rank, msg)
             if dedup:
                 self._last_seq[rank] = (seq, resp)
@@ -1047,6 +1118,66 @@ class AsyncServer:
                               for k in msg["keys"]]
         return resp
 
+    # -- elastic re-striping (``elastic.ResizePlan``) -------------------
+
+    def _moved_reject_locked(self, keys):
+        """Tombstone fence: None when no key has been re-striped away,
+        else the typed moved/stale_epoch rejection carrying the cutover
+        epoch so the caller refreshes topology rather than failing over."""
+        hit = [k for k in keys if k in self._moved]
+        if not hit:
+            return None
+        newest = max((self._moved[k] for k in hit),
+                     key=lambda t: t["epoch"])
+        resp = {"ok": False, "stale_epoch": True, "moved": True,
+                "epoch": newest["epoch"],
+                "err": "key(s) %s re-striped off server s%d at topology "
+                       "epoch %d — refresh the elastic topology and retry"
+                       % (", ".join(sorted(repr(k) for k in hit)),
+                          self.server_id, newest["epoch"])}
+        # a SEALED tombstone (cutover fully committed) forwards the new
+        # shard list, so even a worker with no topology directory entry
+        # can re-route; an unsealed one means the commit (or its abort)
+        # is still in flight — the caller polls
+        if newest.get("addresses"):
+            resp["addresses"] = list(newest["addresses"])
+        return resp
+
+    def _opt_states_locked(self, keys):
+        """Per-key optimizer slots (momentum etc.) for an export; {} when
+        no optimizer is installed or no key has accumulated state yet."""
+        if self._updater is None:
+            return {}
+        states = getattr(self._updater._updater, "states", {})
+        out = {}
+        for k in keys:
+            sk = repr(k) if isinstance(k, tuple) else k
+            if sk in states:
+                out[sk] = states[sk]
+        return out
+
+    def _resize_export_locked(self, msg):
+        """Warm-copy source: values + per-key seqnos (the staging marks
+        that ``resize_retire`` later diffs against) + optimizer slots,
+        HMAC-gated like every executable payload."""
+        keys = msg["keys"]
+        missing = [k for k in keys if k not in self._store]
+        if missing:
+            return {"ok": False,
+                    "err": "resize_export: keys %r not on server s%d"
+                           % (missing, self.server_id)}
+        resp = {"ok": True, "epoch": self.epoch,
+                "vals": [_np.array(self._store[k]) for k in keys],
+                "seqlist": [[_wire_key(k), int(self._seqnos.get(k, 0))]
+                            for k in keys]}
+        states = self._opt_states_locked(keys)
+        if states or self._opt_raw is not None:
+            raw = pickle.dumps({"states": states,
+                                "opt_raw": self._opt_raw})
+            resp["optimizer"] = raw
+            resp["mac"] = _optimizer_mac(self.secret, raw)
+        return resp
+
     def _stats_locked(self):
         now = time.time()
         dead = [r for r, t in self._heartbeat.items()
@@ -1059,7 +1190,8 @@ class AsyncServer:
                 "push_counts": [[r, c] for r, c
                                 in sorted(self._push_counts.items())],
                 "dead": dead, "workers": sorted(self._heartbeat),
-                "keys": sorted((repr(k) for k in self._store))}
+                "keys": sorted((repr(k) for k in self._store)),
+                "moved": sorted((repr(k) for k in self._moved))}
 
     def _dispatch_locked(self, op, rank, msg):
         if op == "init":
@@ -1101,12 +1233,116 @@ class AsyncServer:
 
             optimizer = pickle.loads(raw)
             self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+            self._opt_raw = bytes(raw)
             return {"ok": True}
         if op == "command":
             # reference kController escape hatch: kept for inspection
             self._commands.append((msg["head"], msg["body"]))
             return {"ok": True}
+        if op == "resize_install":
+            return self._resize_install_locked(msg)
+        if op == "resize_retire":
+            return self._resize_retire_locked(msg)
+        if op == "resize_discard":
+            return self._resize_discard_locked(msg)
+        if op == "resize_seal":
+            return self._resize_seal_locked(msg)
         return {"ok": False, "err": "unknown op %r" % op}
+
+    def _resize_seal_locked(self, msg):
+        """Final step of a committed cutover: stamp the new shard list
+        onto the tombstones so moved rejections become self-describing
+        forwarding pointers (stragglers re-route without a directory)."""
+        addresses = [str(a) for a in msg.get("addresses", [])]
+        new_epoch = int(msg["new_epoch"])
+        for k in msg["keys"]:
+            t = self._moved.get(k)
+            if t is not None and t["epoch"] <= new_epoch:
+                t["epoch"] = new_epoch
+                t["addresses"] = addresses
+        return {"ok": True}
+
+    def _resize_install_locked(self, msg):
+        """Stage keys arriving from their old owner.  Seqno-guarded and
+        idempotent: a retried install (or a stale warm copy racing the
+        commit's dirty delta) never rolls a key backwards.  Installing a
+        key clears any tombstone — the key is coming (back) home."""
+        raw = msg.get("optimizer")
+        states = {}
+        if raw is not None:
+            if not _hmaclib.compare_digest(
+                    msg.get("mac", ""), _optimizer_mac(self.secret, raw)):
+                return {"ok": False,
+                        "err": "resize_install rejected: bad or missing "
+                               "HMAC on the optimizer-state payload "
+                               "(shards must share the per-job secret)"}
+            states = pickle.loads(raw).get("states", {})
+        seqmap = {_unwire_key(k): int(n) for k, n in msg.get("seqlist", [])}
+        installed = []
+        for k, v in msg["pairs"]:
+            seq = seqmap.get(k, 1)
+            if k in self._store and self._seqnos.get(k, 0) >= seq:
+                self._moved.pop(k, None)
+                continue
+            self._store[k] = _np.array(v, copy=True)
+            self._seqnos[k] = seq
+            self._moved.pop(k, None)
+            installed.append(k)
+        if states and self._updater is not None:
+            self._updater._updater.states.update(states)
+        return {"ok": True, "installed": [_wire_key(k) for k in installed]}
+
+    def _resize_retire_locked(self, msg):
+        """Freeze + export + tombstone, atomically: delete the keys from
+        this shard, leave ``moved`` tombstones at ``new_epoch``, and
+        return — in the same response — the (value, seqno, optimizer
+        slot) of every key that advanced past its staged seqno since the
+        warm copy.  Idempotent: retiring an already-retired key only
+        refreshes its tombstone."""
+        new_epoch = int(msg["new_epoch"])
+        staged = {_unwire_key(k): int(n) for k, n in msg.get("staged", [])}
+        dirty_keys, dirty_pairs, dirty_seq = [], [], []
+        for k in msg["keys"]:
+            if k not in self._store:
+                self._moved[k] = {"epoch": new_epoch}
+                continue
+            seqno = int(self._seqnos.get(k, 0))
+            if seqno != staged.get(k):
+                # pushes landed after the warm copy: the staged copy on
+                # the new owner is stale for this key — ship the delta
+                dirty_keys.append(k)
+                dirty_pairs.append((k, _np.array(self._store[k])))
+                dirty_seq.append([_wire_key(k), seqno])
+            del self._store[k]
+            self._seqnos.pop(k, None)
+            self._moved[k] = {"epoch": new_epoch}
+        states = self._opt_states_locked(dirty_keys)
+        if self._updater is not None:
+            upd_states = getattr(self._updater._updater, "states", {})
+            for k in msg["keys"]:
+                upd_states.pop(repr(k) if isinstance(k, tuple) else k, None)
+        resp = {"ok": True, "epoch": self.epoch, "pairs": dirty_pairs,
+                "seqlist": dirty_seq}
+        if states:
+            raw = pickle.dumps({"states": states})
+            resp["optimizer"] = raw
+            resp["mac"] = _optimizer_mac(self.secret, raw)
+        return resp
+
+    def _resize_discard_locked(self, msg):
+        """Abort path: drop staged copies (and any tombstone — a rolled-
+        back retire must leave the key servable at its old home)."""
+        dropped = []
+        for k in msg["keys"]:
+            if k in self._store:
+                del self._store[k]
+                self._seqnos.pop(k, None)
+                dropped.append(k)
+            if self._updater is not None:
+                getattr(self._updater._updater, "states", {}).pop(
+                    repr(k) if isinstance(k, tuple) else k, None)
+            self._moved.pop(k, None)
+        return {"ok": True, "dropped": [_wire_key(k) for k in dropped]}
 
 
 class _NumpyUpdater:
@@ -1364,7 +1600,9 @@ class AsyncClient:
                 raise StaleEpochError(
                     "async kvstore: %s" % resp.get("err"),
                     epoch=resp.get("epoch"),
-                    not_primary=bool(resp.get("not_primary")))
+                    not_primary=bool(resp.get("not_primary")),
+                    moved=bool(resp.get("moved")),
+                    addresses=resp.get("addresses"))
             raise MXNetError("async kvstore: %s" % resp.get("err"))
         return resp
 
@@ -1632,6 +1870,12 @@ class ReplicatedClient:
                         raise
                     self._failover(exc)
                 except StaleEpochError as exc:
+                    if exc.moved:
+                        # the KEY moved (elastic re-striping), not the
+                        # primary: failing over inside the group cannot
+                        # help — surface it so ServerGroup refreshes the
+                        # key→shard topology instead
+                        raise
                     last = exc
                     failovers += 1
                     if failovers > cap:
@@ -1693,18 +1937,20 @@ class ServerGroup:
 
     def __init__(self, addresses, rank, heartbeat=True, secret=None,
                  bigarray_bound=None):
-        self._clients = []
-        for a in addresses:
-            reps = a.split("|") if isinstance(a, str) else list(a)
-            reps = [r.strip() for r in reps if r and r.strip()]
-            if len(reps) > 1:
-                self._clients.append(ReplicatedClient(
-                    reps, rank, heartbeat=heartbeat, secret=secret))
-            else:
-                self._clients.append(AsyncClient(
-                    reps[0], rank, heartbeat=heartbeat, secret=secret))
         self._rank = rank
+        self._hb = heartbeat
+        self._secret = secret
+        self._specs = [self._normalize_spec(a) for a in addresses]
+        self._clients = [self._build_client(sp) for sp in self._specs]
         self._n = len(self._clients)
+        # elastic identity + routing state: the ORIGINAL spec list names
+        # this group in the elastic topology directory forever (resizes
+        # change _specs/_clients, never group_id); all routing reads/
+        # writes happen under _route_lock so a cutover is atomic with
+        # respect to in-flight group ops
+        self.group_id = tuple(self._specs)
+        self.topology_epoch = 0
+        self._route_lock = threading.RLock()
         # NOTE: the bound decides routing, so it must agree across all
         # worker processes (the launcher exports one env for the job) —
         # exactly the reference's bigarray_bound_ contract
@@ -1713,6 +1959,135 @@ class ServerGroup:
                                               "1000000"))
         self._striped = {}  # base key -> (shape, n_chunks)
         self._pool = None  # lazy persistent fan-out pool (hot path)
+
+    @staticmethod
+    def _normalize_spec(a):
+        """Canonical ``"addr|addr"`` string for one logical shard."""
+        reps = a.split("|") if isinstance(a, str) else list(a)
+        return "|".join(r.strip() for r in reps if r and r.strip())
+
+    def _build_client(self, spec):
+        reps = spec.split("|")
+        if len(reps) > 1:
+            return ReplicatedClient(reps, self._rank, heartbeat=self._hb,
+                                    secret=self._secret)
+        return AsyncClient(reps[0], self._rank, heartbeat=self._hb,
+                           secret=self._secret)
+
+    # -- elastic topology (``elastic.ResizePlan`` cutover target) -------
+
+    def adopt_topology(self, addresses, epoch):
+        """Atomically cut key→shard routing over to an epoch-bumped
+        shard list.  Clients for surviving shard specs are reused (their
+        sockets, seq streams and dedup state stay valid); removed
+        shards' clients are closed; striped keys are re-chunked to the
+        new shard count.  Idempotent and monotonic: an older or equal
+        epoch with the same specs is a no-op."""
+        specs = [self._normalize_spec(a) for a in addresses]
+        if not specs:
+            raise ValueError("adopt_topology: empty shard list")
+        with self._route_lock:
+            if int(epoch) <= self.topology_epoch and specs == self._specs:
+                return
+            old = dict(zip(self._specs, self._clients))
+            clients, reused = [], set()
+            for sp in specs:
+                if sp in old and sp not in reused:
+                    clients.append(old[sp])
+                    reused.add(sp)
+                else:
+                    clients.append(self._build_client(sp))
+            for sp, cli in old.items():
+                if sp not in reused:
+                    cli.close()
+            self._clients = clients
+            self._specs = specs
+            self._n = len(clients)
+            if self._n > 1:
+                self._striped = {k: (shape, self._n)
+                                 for k, (shape, _) in self._striped.items()}
+            else:
+                # a single shard holds whole tensors under the plain key
+                self._striped = {}
+            self.topology_epoch = max(self.topology_epoch, int(epoch))
+            if self._pool is not None:
+                # pool width tracks shard count; no jobs are in flight
+                # (ops run under _route_lock)
+                self._pool.shutdown(wait=False)
+                self._pool = None
+
+    def routing_frozen(self):
+        """The routing lock, usable as a context manager: while held, no
+        group op runs.  ``elastic.ResizePlan`` holds it across its
+        commit critical section so same-process ops never observe the
+        mid-cutover state (retired-but-unsealed keys)."""
+        return self._route_lock
+
+    def _refresh_topology(self):
+        """Adopt a newer published topology for this group; True if
+        routing changed.  (Lazy import: elastic imports this module.)"""
+        from . import elastic as _elastic
+
+        rec = _elastic.lookup_topology(self.group_id)
+        if rec is None or rec["epoch"] <= self.topology_epoch:
+            return False
+        self.adopt_topology(rec["addresses"], rec["epoch"])
+        return True
+
+    @staticmethod
+    def _moved_cause(exc):
+        """The moved-key StaleEpochError behind this failure (possibly
+        wrapped in a ShardFailedError), or None."""
+        node, seen = exc, set()
+        while node is not None and id(node) not in seen:
+            if isinstance(node, StaleEpochError) \
+                    and getattr(node, "moved", False):
+                return node
+            seen.add(id(node))
+            node = node.__cause__ if node.__cause__ is not None \
+                else node.__context__
+        return None
+
+    def _routed(self, fn):
+        """Run one group op under the routing lock.  A moved-key reject
+        (a straggler op raced a re-striping cutover) means the key→shard
+        assignment changed under us:
+
+        * a SEALED rejection forwards the new shard list — adopt it and
+          retry against the new routing;
+        * otherwise consult the elastic topology directory;
+        * a rejection with neither (the cutover — or its abort — is
+          still committing) is polled: retrying against the OLD home
+          succeeds the moment an abort clears the tombstone, and picks
+          up the forwarding pointer the moment the commit seals.  The
+          poll is bounded by ``MXNET_TPU_RESIZE_STALL_S`` so a wedged
+          cutover surfaces the typed error instead of hanging forever.
+
+        Note moved rejections happen BEFORE any server-side apply, so
+        retrying the whole fan-out cannot double-apply on the rejecting
+        shard; in-process resizes additionally hold this routing lock
+        across the whole commit, so same-process ops never observe the
+        mid-cutover state at all."""
+        with self._route_lock:
+            deadline = None
+            while True:
+                try:
+                    return fn()
+                except (StaleEpochError, ShardFailedError) as exc:
+                    mv = self._moved_cause(exc)
+                    if mv is None:
+                        raise
+                    if mv.addresses:
+                        self.adopt_topology(mv.addresses, mv.epoch or 0)
+                        continue
+                    if self._refresh_topology():
+                        continue
+                    if deadline is None:
+                        deadline = time.monotonic() + float(os.environ.get(
+                            "MXNET_TPU_RESIZE_STALL_S", "30"))
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.025)
 
     def _shard_label(self, server):
         try:
@@ -1823,8 +2198,9 @@ class ServerGroup:
             self.wait_for_init([(k, _np.asarray(v).shape)
                                 for k, v in pairs])
             return
-        self._fanout([(s, lambda s=s, p=p: self._clients[s].init(p))
-                      for s, p in self._scatter(pairs).items()])
+        self._routed(lambda: self._fanout(
+            [(s, lambda s=s, p=p: self._clients[s].init(p))
+             for s, p in self._scatter(pairs).items()]))
 
     def wait_for_init(self, key_shapes, timeout=None):
         """Block until every key is initialized on its shard(s);
@@ -1855,10 +2231,14 @@ class ServerGroup:
             delay = min(delay * 2, 0.5)
 
     def push(self, pairs):
-        self._fanout([(s, lambda s=s, p=p: self._clients[s].push(p))
-                      for s, p in self._scatter(pairs).items()])
+        self._routed(lambda: self._fanout(
+            [(s, lambda s=s, p=p: self._clients[s].push(p))
+             for s, p in self._scatter(pairs).items()]))
 
     def pull(self, keys, shapes=None):
+        return self._routed(lambda: self._pull_impl(keys, shapes))
+
+    def _pull_impl(self, keys, shapes=None):
         """``shapes`` (per-key tuples, e.g. the out buffers' shapes) makes
         routing deterministic for keys this worker never initialized
         itself: striping is a pure function of element count and the
@@ -1910,22 +2290,26 @@ class ServerGroup:
         return out
 
     def set_optimizer(self, pickled):
-        self._fanout([(i, lambda c=c: c.set_optimizer(pickled))
-                      for i, c in enumerate(self._clients)])
+        self._routed(lambda: self._fanout(
+            [(i, lambda c=c: c.set_optimizer(pickled))
+             for i, c in enumerate(self._clients)]))
 
     def command(self, head, body):
-        self._fanout([(i, lambda c=c: c.command(head, body))
-                      for i, c in enumerate(self._clients)])
+        self._routed(lambda: self._fanout(
+            [(i, lambda c=c: c.command(head, body))
+             for i, c in enumerate(self._clients)]))
 
     def shutdown(self):
-        self._fanout([(i, lambda c=c: c.shutdown())
-                      for i, c in enumerate(self._clients)])
+        self._routed(lambda: self._fanout(
+            [(i, lambda c=c: c.shutdown())
+             for i, c in enumerate(self._clients)]))
 
     def stats(self):
         """Aggregate across shards; ``per_server`` keeps the raw shard
         stats (key placement, replica role/epoch etc.) observable."""
-        per_server = self._fanout([(i, lambda c=c: c.stats())
-                                   for i, c in enumerate(self._clients)])
+        per_server = self._routed(lambda: self._fanout(
+            [(i, lambda c=c: c.stats())
+             for i, c in enumerate(self._clients)]))
         push_counts = {}
         dead, workers = set(), set()
         for s in per_server:

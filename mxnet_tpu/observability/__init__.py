@@ -30,6 +30,13 @@ The runtime's telemetry layer (the subsystem the paper's
   evaluated against the local registry or a federated view; firing
   alerts surface as ``cluster_alert`` metrics, an ``/alerts`` JSON
   endpoint, and — at terminal severity — flight-recorder bundles.
+- :mod:`~mxnet_tpu.observability.autoscaler` — the policy engine that
+  closes the watchdog's alert loop: sustained ``queue_saturation`` /
+  ``request_p99_slo`` / ``straggler`` alerts drive a scale-up, a
+  sustained quiet period drives a drain-and-shrink, every action
+  cooldown-rate-limited, size-bounded, counted in
+  ``cluster_autoscale_actions_total{action}``, and flight-recorded
+  with the triggering rule.
 - :mod:`~mxnet_tpu.observability.efficiency` — compute-efficiency
   accounting: per-jit-cache HLO cost analysis (FLOPs / bytes /
   arithmetic intensity / memory footprint), measured MFU
@@ -63,6 +70,7 @@ from .flight_recorder import record_failure, flight_enabled
 from .attribution import (attributor, StepAttribution, sample_memory,
                           attribution_table, format_attribution, PHASES)
 from .watchdog import Rule, Alert, Watchdog, default_rules
+from .autoscaler import Autoscaler, ScaleAction, WATCHED_RULES
 from .efficiency import (peak_flops, record_compile, record_step_rate,
                          model_flops_per_step, GoodputLedger, ledger,
                          BADPUT_CAUSES, efficiency_table,
@@ -82,6 +90,7 @@ __all__ = [
     "attributor", "StepAttribution", "sample_memory",
     "attribution_table", "format_attribution", "PHASES",
     "Rule", "Alert", "Watchdog", "default_rules",
+    "Autoscaler", "ScaleAction", "WATCHED_RULES",
     "peak_flops", "record_compile", "record_step_rate",
     "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",
     "efficiency_table", "format_efficiency", "goodput_table",

@@ -1,0 +1,233 @@
+"""Watchdog-driven autoscaler: the policy engine that closes the alert
+loop (ROADMAP item 4, PR-6's missing half).
+
+The PR-6 :class:`~.watchdog.Watchdog` can only *alert* on
+``queue_saturation`` / ``request_p99_slo`` / ``straggler`` breaches.
+This module makes those alerts *act*:
+
+* a watched alert that stays active for ``MXNET_TPU_AUTOSCALE_SUSTAIN_S``
+  drives a **scale-up** (one transient blip never resizes a cluster);
+* no watched alert for ``MXNET_TPU_AUTOSCALE_IDLE_S`` drives a
+  **drain-and-shrink** (capacity follows load down as well as up);
+* every action is rate-limited by ``MXNET_TPU_AUTOSCALE_COOLDOWN_S``
+  (scale → re-observe → maybe scale again, never a thundering herd),
+  bounded by ``MXNET_TPU_AUTOSCALE_MIN``/``MXNET_TPU_AUTOSCALE_MAX``,
+  counted in ``cluster_autoscale_actions_total{action}``, and
+  flight-recorded with the TRIGGERING RULE in the bundle manifest, so a
+  3am resize is attributable to the exact SLO breach that caused it.
+
+The engine is deliberately mechanism-free: ``scale_up``/``scale_down``
+are caller-supplied actuators — ``serving.ReplicaGroup.grow``/
+``shrink`` for the serving tier, an ``elastic.ResizePlan`` driver for PS
+shards, a rank join/drain for workers.  Actuators return a dict
+(``{"epoch": N, ...}``) whose epoch lands in the flight bundle — every
+action is epoch-fenced by the mechanism it drives, and the fence is
+recorded.
+
+Clock injection (``clock=``) makes the sustain/cooldown/idle windows
+testable without sleeping, exactly like ``Watchdog.evaluate(now=)``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+
+__all__ = ["Autoscaler", "ScaleAction", "WATCHED_RULES"]
+
+# the alert names that mean "capacity is short" (PR-6 stock rule set)
+WATCHED_RULES = ("queue_saturation", "request_p99_slo", "straggler")
+
+_M_ACTIONS = _metrics.counter(
+    "cluster_autoscale_actions_total",
+    "Autoscaler actions taken, by direction", ["action"])
+_M_BLOCKED = _metrics.counter(
+    "cluster_autoscale_blocked_total",
+    "Autoscaler decisions suppressed, by reason (cooldown/bounds/failed)",
+    ["reason"])
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return float(default)
+
+
+class ScaleAction(object):
+    """One decision the autoscaler acted on (or tried to)."""
+
+    __slots__ = ("action", "rule", "at", "ok", "epoch", "detail")
+
+    def __init__(self, action, rule, at):
+        self.action = action      # "scale_up" | "scale_down"
+        self.rule = rule          # triggering rule name, or "idle"
+        self.at = at              # monotonic decision time
+        self.ok = False
+        self.epoch = None         # the fence epoch the actuator reported
+        self.detail = None
+
+    def as_dict(self):
+        return {"action": self.action, "rule": self.rule, "at": self.at,
+                "ok": self.ok, "epoch": self.epoch, "detail": self.detail}
+
+
+class Autoscaler(object):
+    """Poll a :class:`~.watchdog.Watchdog`, turn sustained alerts into
+    scale actions.
+
+    ``scale_up(action)`` / ``scale_down(action)`` are the actuators;
+    either may be None (that direction is then disabled).  ``size`` is a
+    zero-argument callable reporting current capacity (replica count,
+    shard count, rank count) for the min/max bounds; without it the
+    bounds are not enforced.  All windows are injectable for tests and
+    default to the ``MXNET_TPU_AUTOSCALE_*`` env rows."""
+
+    def __init__(self, watchdog, scale_up=None, scale_down=None, *,
+                 size=None, rules=WATCHED_RULES, sustain_s=None,
+                 cooldown_s=None, idle_s=None, min_size=None,
+                 max_size=None, clock=None):
+        self.watchdog = watchdog
+        self._up = scale_up
+        self._down = scale_down
+        self._size = size
+        self.rules = frozenset(rules)
+        self.sustain_s = (_env_float("MXNET_TPU_AUTOSCALE_SUSTAIN_S", 10.0)
+                          if sustain_s is None else float(sustain_s))
+        self.cooldown_s = (_env_float("MXNET_TPU_AUTOSCALE_COOLDOWN_S", 60.0)
+                           if cooldown_s is None else float(cooldown_s))
+        self.idle_s = (_env_float("MXNET_TPU_AUTOSCALE_IDLE_S", 300.0)
+                       if idle_s is None else float(idle_s))
+        self.min_size = (int(_env_float("MXNET_TPU_AUTOSCALE_MIN", 1))
+                         if min_size is None else int(min_size))
+        max_default = int(_env_float("MXNET_TPU_AUTOSCALE_MAX", 0))
+        self.max_size = (max_default if max_size is None
+                         else int(max_size)) or None  # 0/None = unbounded
+        self._clock = clock or _time.monotonic
+        self._lock = threading.Lock()
+        self._first_seen = {}     # watched rule name -> first active time
+        self._last_action_t = None
+        self._busy_until = None   # last time a watched alert was active
+        self.actions = []         # every acted ScaleAction, oldest first
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- decision core ---------------------------------------------------
+
+    def evaluate(self, now=None):
+        """One policy pass: evaluate the watchdog, maybe act.  Returns
+        the :class:`ScaleAction` taken, else None."""
+        if now is None:
+            now = self._clock()
+        alerts = self.watchdog.evaluate(now=now)
+        watched = [a for a in alerts if a.name in self.rules]
+        with self._lock:
+            active_names = {a.name for a in watched}
+            for name in list(self._first_seen):
+                if name not in active_names:
+                    del self._first_seen[name]
+            for name in active_names:
+                self._first_seen.setdefault(name, now)
+            if watched:
+                self._busy_until = now
+            elif self._busy_until is None:
+                # idle window starts at the first evaluation, not at
+                # process birth — a fresh autoscaler never insta-shrinks
+                self._busy_until = now
+            sustained = [n for n, t0 in self._first_seen.items()
+                         if now - t0 >= self.sustain_s]
+            if sustained and self._up is not None:
+                # longest-burning rule is THE trigger named in the bundle
+                rule = min(sustained, key=self._first_seen.get)
+                return self._act("scale_up", rule, now)
+            if (self._down is not None and not watched
+                    and now - self._busy_until >= self.idle_s):
+                return self._act("scale_down", "idle", now)
+        return None
+
+    def _act(self, direction, rule, now):
+        # caller holds self._lock
+        if self._last_action_t is not None \
+                and now - self._last_action_t < self.cooldown_s:
+            _M_BLOCKED.labels("cooldown").inc()
+            return None
+        size = self._size() if self._size is not None else None
+        if size is not None:
+            if direction == "scale_up" and self.max_size is not None \
+                    and size >= self.max_size:
+                _M_BLOCKED.labels("bounds").inc()
+                return None
+            if direction == "scale_down" and size <= self.min_size:
+                _M_BLOCKED.labels("bounds").inc()
+                return None
+        action = ScaleAction(direction, rule, now)
+        actuator = self._up if direction == "scale_up" else self._down
+        try:
+            result = actuator(action)
+        except Exception as exc:  # noqa: BLE001 — policy must survive
+            action.detail = repr(exc)
+            _M_BLOCKED.labels("failed").inc()
+            _flight.record_failure(
+                "autoscale_failed", exc, rule=rule, action=direction,
+                size=size)
+            # a failed actuator still burns the cooldown: retrying a
+            # broken resize every interval would thrash the cluster
+            # (caller holds self._lock)
+            self._last_action_t = now  # graftcheck: disable=lock-discipline
+            self.actions.append(action)
+            return action
+        action.ok = True
+        if isinstance(result, dict):
+            action.epoch = result.get("epoch")
+            action.detail = result
+        self._last_action_t = now  # graftcheck: disable=lock-discipline
+        # acting on a sustained alert resets its burn clock: the next
+        # scale-up needs the breach to persist PAST the new capacity
+        # (caller holds self._lock)
+        if rule in self._first_seen:
+            del self._first_seen[rule]
+        self._busy_until = now  # graftcheck: disable=lock-discipline
+        self.actions.append(action)
+        _M_ACTIONS.labels(direction).inc()
+        _flight.record_failure(
+            "autoscale_action", None, rule=rule, action=direction,
+            epoch=action.epoch, size=size,
+            alert=next((a.as_dict() for a in self.watchdog.firing()
+                        if a.name == rule), None))
+        return action
+
+    # -- background loop -------------------------------------------------
+
+    def start(self, interval_s=None):
+        """Run :meth:`evaluate` every ``interval_s`` (default
+        ``MXNET_TPU_AUTOSCALE_INTERVAL``) on a daemon thread."""
+        interval = (_env_float("MXNET_TPU_AUTOSCALE_INTERVAL", 5.0)
+                    if interval_s is None else float(interval_s))
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.evaluate()
+                except Exception:
+                    # the autoscaler must never take down what it scales
+                    pass
+
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=loop, name="mxtpu-autoscaler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)

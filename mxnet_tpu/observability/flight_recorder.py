@@ -19,7 +19,9 @@ The recorder is **off by default**: it activates only when
 bundles.  When off, :func:`record_failure` is a constant-time guard
 (call-count asserted in tests).  Bundles appear atomically: everything
 is written into a ``.tmp`` sibling first, then ``os.rename``\\ d into
-place, so a watcher never sees a half-written bundle.
+place, so a watcher never sees a half-written bundle.  When
+``MXNET_TPU_FLIGHT_MAX_BUNDLES`` is set (>0) the oldest bundles are
+evicted after each write so a chaos soak can't fill the disk.
 
 The same exception often crosses several instrumented seams on its way
 out (``ReplicatedClient`` → ``ShardedTrainer.fit``); the recorder
@@ -31,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 import traceback
 
@@ -139,7 +142,34 @@ def _write_bundle(kind, exc, extra):
               encoding="utf-8") as f:
         f.write(_metrics.dump_metrics())
     os.rename(tmp, final)
+    _prune_bundles(root)
     return final
+
+
+def _prune_bundles(root):
+    """Retention cap: keep at most ``MXNET_TPU_FLIGHT_MAX_BUNDLES``
+    bundles (0/unset = unlimited), evicting oldest-mtime first.  A
+    long soak under chaos must not fill the disk with postmortems —
+    the autoscaler alone writes one bundle per action."""
+    try:
+        cap = int(os.environ.get("MXNET_TPU_FLIGHT_MAX_BUNDLES", "0"))
+    except ValueError:
+        cap = 0
+    if cap <= 0:
+        return
+    try:
+        bundles = []
+        for name in os.listdir(root):
+            if not name.startswith("flight_") or name.endswith(".tmp"):
+                continue
+            path = os.path.join(root, name)
+            if os.path.isdir(path):
+                bundles.append((os.path.getmtime(path), path))
+        bundles.sort()
+        for _, path in bundles[:max(0, len(bundles) - cap)]:
+            shutil.rmtree(path, ignore_errors=True)
+    except OSError:
+        pass  # retention is best-effort; never mask the real failure
 
 
 def record_failure(kind, exc=None, **extra):

@@ -15,10 +15,46 @@ import jax.numpy as jnp
 import numpy as _np
 
 __all__ = ["init_process_group", "serve_worker_metrics",
-           "allreduce_hosts", "barrier", "rank", "size"]
+           "allreduce_hosts", "barrier", "rank", "size",
+           "elastic_roster", "elastic_join", "elastic_drain",
+           "reset_elastic_roster"]
 
 _INITIALIZED = {"v": False}
 _WORKER_METRICS = {"server": None, "watchdog": None}
+_ROSTER = {"v": None}
+
+
+def elastic_roster():
+    """This process's :class:`~mxnet_tpu.elastic.WorkerRoster` — the
+    elastic worker membership the kvstore fit loop consults
+    (``ShardedTrainer.fit(kvstore=..., roster=...)``).  Created lazily
+    with every currently known rank as a member, so a non-elastic job
+    that never joins/drains sees the static topology it launched with.
+    """
+    if _ROSTER["v"] is None:
+        from .. import elastic
+
+        _ROSTER["v"] = elastic.WorkerRoster(ranks=range(size()))
+    return _ROSTER["v"]
+
+
+def elastic_join(new_rank):
+    """Admit ``new_rank`` to the worker set; batch assignment
+    re-balances at the next batch boundary.  Returns the roster
+    version."""
+    return elastic_roster().join(new_rank)
+
+
+def elastic_drain(old_rank):
+    """Retire ``old_rank`` from the worker set (it finishes its
+    in-flight batch, then stops claiming).  Returns the roster
+    version."""
+    return elastic_roster().drain(old_rank)
+
+
+def reset_elastic_roster():
+    """Forget the process-global roster (tests)."""
+    _ROSTER["v"] = None
 
 
 def serve_worker_metrics():

@@ -860,7 +860,8 @@ class ShardedTrainer:
             eval_metric="accuracy", initializer=None, state=None,
             begin_epoch=0, checkpoint_dir=None, checkpoint_every=None,
             resume=None, max_bad_steps=5, log_every=50, logger=None,
-            batch_end_callback=None, metric_every=1, kvstore=None):
+            batch_end_callback=None, metric_every=1, kvstore=None,
+            roster=None):
         """Mesh-native training loop — ``Module.fit``'s role
         (reference ``module/base_module.py:368``) for a ``ShardedTrainer``:
         epochs over a ``DataIter``, metric updates, throughput logging
@@ -931,6 +932,21 @@ class ShardedTrainer:
         (heartbeat failover + same-seq retry), so a mid-epoch primary
         kill neither aborts the loop nor trips any resume machinery.
 
+        ``roster=`` (kvstore path only) makes the worker set elastic:
+        an :class:`~mxnet_tpu.elastic.WorkerRoster` assigns each global
+        batch index to exactly one member rank, re-consulted EVERY
+        batch — a ``roster.join``/``drain`` between two steps
+        re-balances the remaining batches across the new member set
+        with no epoch restart.  The loop records its position in the
+        roster (``mark_progress``) after every batch, so a rank that
+        joins mid-epoch fast-forwards its iterator to the group's
+        ``resume_point()`` instead of re-running covered batches — the
+        mid-epoch handoff that keeps ``resume="auto"``-style
+        exactly-once batch coverage across topology changes.  The
+        roster is this process's view of membership (in-process ranks
+        share one instance; cross-process deployments drive each
+        process's roster from the same control plane).
+
         A terminal failure escaping the loop (``ShardFailedError`` after
         a whole-group loss, poison surfacing at a sync point, divergence
         abort) triggers the flight recorder on its way out — with
@@ -947,7 +963,8 @@ class ShardedTrainer:
                 checkpoint_every=checkpoint_every, resume=resume,
                 max_bad_steps=max_bad_steps, log_every=log_every,
                 logger=logger, batch_end_callback=batch_end_callback,
-                metric_every=metric_every, kvstore=kvstore)
+                metric_every=metric_every, kvstore=kvstore,
+                roster=roster)
         except Exception as exc:
             from ..observability import flight_recorder as _flight
 
@@ -959,7 +976,7 @@ class ShardedTrainer:
                   begin_epoch=0, checkpoint_dir=None,
                   checkpoint_every=None, resume=None, max_bad_steps=5,
                   log_every=50, logger=None, batch_end_callback=None,
-                  metric_every=1, kvstore=None):
+                  metric_every=1, kvstore=None, roster=None):
         import logging
         import time as _time
 
@@ -978,7 +995,13 @@ class ShardedTrainer:
                 begin_epoch=begin_epoch, checkpoint_dir=checkpoint_dir,
                 checkpoint_every=checkpoint_every, resume=resume,
                 log_every=log_every, logger=logger,
-                batch_end_callback=batch_end_callback)
+                batch_end_callback=batch_end_callback, roster=roster)
+
+        if roster is not None:
+            raise MXNetError(
+                "roster= is the elastic-worker knob of the kvstore path; "
+                "pass kvstore= as well (the local fused-update path has "
+                "no cross-worker batch assignment to re-balance)")
 
         log = logger or logging.getLogger(__name__)
         metric = (eval_metric if isinstance(eval_metric, _metric_mod.EvalMetric)
@@ -1356,11 +1379,17 @@ class ShardedTrainer:
                      seed=0, eval_metric="accuracy", initializer=None,
                      state=None, begin_epoch=0, checkpoint_dir=None,
                      checkpoint_every=None, resume=None, log_every=50,
-                     logger=None, batch_end_callback=None):
+                     logger=None, batch_end_callback=None, roster=None):
         """Parameter-server-backed loop behind ``fit(kvstore=)``: local
         gradients (``grad_fn``) pushed to the kvstore, whose server-side
         optimizer owns weights and state; fresh weights pulled back each
-        step.  Requires the caller to have called ``kv.set_optimizer``."""
+        step.  Requires the caller to have called ``kv.set_optimizer``.
+
+        With ``roster=`` the batch loop becomes elastic: each global
+        batch index runs on the rank ``roster.owns`` says, membership
+        re-read per batch so a join/drain re-balances mid-epoch, and
+        ``mark_progress``/``resume_point`` give a joining rank the
+        iterator fast-forward (see :meth:`fit`)."""
         import logging
 
         import jax as _jax
@@ -1440,11 +1469,22 @@ class ShardedTrainer:
         # the kvstore client surface here as badput counter deltas
         led = _eff.ledger()
         t_fit = _time.monotonic()
+        my_rank = getattr(kv, "rank", 0)
         for epoch in range(begin_epoch, end_epoch):
             metric.reset()
             train_data.reset()
             nbatch = 0
+            bidx = -1
             for batch in train_data:
+                bidx += 1
+                if roster is not None:
+                    if (epoch, bidx) < roster.resume_point():
+                        # the group already covered this batch before we
+                        # joined — fast-forward, never re-apply it
+                        continue
+                    if not roster.owns(my_rank, bidx):
+                        roster.mark_progress(epoch, bidx + 1)
+                        continue
                 att = _attr.attributor()
                 t_step = _time.monotonic()
                 arrays, data_names = batch_arrays(batch, train_data)
@@ -1471,6 +1511,8 @@ class ShardedTrainer:
                             pshard[n])
                 global_step += 1
                 nbatch += 1
+                if roster is not None:
+                    roster.mark_progress(epoch, bidx + 1)
                 with att.phase("flush"):
                     labels = [v for n, v in arrays.items()
                               if n not in data_names]

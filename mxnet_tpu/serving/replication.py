@@ -27,6 +27,21 @@ With ``isolated_metrics=True`` each replica gets its own metrics
 registry, and :meth:`ReplicaGroup.federation_targets` hands them to
 ``observability.federation`` under the standard ``{shard, role,
 epoch}`` identity — one exposition, per-replica serving rows.
+
+**Elastic scale** (PR-11): the group is no longer frozen at its launch
+size.  :meth:`ReplicaGroup.grow` adds replicas live — each new
+scheduler is stamped with every registered model (which therefore must
+have been registered with a *factory*, not a backend list) and joins
+membership at a bumped epoch.  :meth:`ReplicaGroup.shrink` is
+drain-before-remove: the victims stop admitting, finish every accepted
+request, and only then retire quietly — no failover counted, nothing
+dropped.  Both actions pass the ``serving.scale`` chaos site *before*
+any membership change, so an injected fault aborts the action with the
+group intact.  :meth:`ReplicaGroup.capacity` is what an autoscaler's
+``size()`` should report: live replicas only — :meth:`detect` reaps
+fenced replicas that never re-registered (slot tombstoned to ``None``,
+indices stay stable) so a shrink after a failover never counts zombies
+toward capacity.
 """
 
 from __future__ import annotations
@@ -70,6 +85,8 @@ class ReplicaGroup(object):
         self.epoch = 0
         self._lock = threading.Lock()
         self._fenced = set()
+        self._isolated = bool(isolated_metrics)
+        self._models = {}    # name -> (factory|None, buckets, max_queue)
         self.registries = []
         self.schedulers = []
         for i in range(int(replicas)):
@@ -90,16 +107,22 @@ class ReplicaGroup(object):
         """Register ``name`` on every replica.  ``backends`` is either
         a list (one backend per replica — each replica needs its OWN
         Predictor/ExportedModel, executors are not shared) or a
-        zero-arg factory called once per replica."""
+        zero-arg factory called once per replica.  Factories are
+        remembered so :meth:`grow` can stamp the model onto replicas
+        added later; list registrations pin the group size."""
+        factory = backends if callable(backends) else None
+        targets = [s for s in self.schedulers if s is not None]
         if callable(backends):
-            backends = [backends() for _ in self.schedulers]
-        if len(backends) != len(self.schedulers):
+            backends = [backends() for _ in targets]
+        if len(backends) != len(targets):
             from ..base import MXNetError
 
             raise MXNetError(
                 "group %r has %d replicas, got %d backends"
-                % (self.group, len(self.schedulers), len(backends)))
-        for sched, backend in zip(self.schedulers, backends):
+                % (self.group, len(targets), len(backends)))
+        with self._lock:
+            self._models[name] = (factory, buckets, max_queue)
+        for sched, backend in zip(targets, backends):
             sched.register(name, backend, buckets=buckets,
                            max_queue=max_queue)
 
@@ -115,7 +138,12 @@ class ReplicaGroup(object):
         with self._lock:
             fenced = set(self._fenced)
         return [(i, s) for i, s in enumerate(self.schedulers)
-                if i not in fenced and s.alive]
+                if s is not None and i not in fenced and s.alive]
+
+    def capacity(self):
+        """Live replica count — the ``size()`` an autoscaler should
+        bound on.  Fenced and reaped zombies never count."""
+        return len(self.live())
 
     def membership(self):
         from .. import kvstore_async as _kv
@@ -126,6 +154,8 @@ class ReplicaGroup(object):
         """Crash replica ``index`` (chaos drills): queued requests fail
         with ``ReplicaDeadError`` for the router to retry, then the
         group fences it out of membership."""
+        if self.schedulers[index] is None:
+            return
         self.schedulers[index].kill()
         self.fence(index)
 
@@ -139,18 +169,20 @@ class ReplicaGroup(object):
         with self._lock:
             if index in self._fenced:
                 return
+            zombie = self.schedulers[index]
+            if zombie is None:
+                return
             self._fenced.add(index)
             self.epoch += 1
             epoch = self.epoch
             fenced = set(self._fenced)
-        zombie = self.schedulers[index]
         zombie.fence(epoch)
         _M_UP.labels(zombie.name).set(0)
         _M_FAILOVER.labels(self.group).inc()
         survivors = [s.name for i, s in enumerate(self.schedulers)
-                     if i not in fenced]
+                     if s is not None and i not in fenced]
         for i, s in enumerate(self.schedulers):
-            if i not in fenced:
+            if s is not None and i not in fenced:
                 s.epoch = epoch
         _kv._membership_publish(
             _group_key(self.group), epoch, survivors or [zombie.name],
@@ -158,19 +190,135 @@ class ReplicaGroup(object):
 
     def detect(self, heartbeat_timeout_s=1.0):
         """Heartbeat sweep: fence every replica whose dispatch loops
-        stopped beating.  Returns the indices fenced this sweep."""
+        stopped beating, then **reap** fenced replicas that never
+        re-registered.  Returns the indices fenced this sweep.
+
+        Only ``last_beat`` of a replica with dispatch lanes counts —
+        a freshly grown replica with no model registered yet has no
+        loop to beat and must not be fenced for it.
+
+        The reap half fixes the shrink-after-failover hazard: a fenced
+        zombie used to sit in ``schedulers`` forever, counting toward
+        any ``len()``-based capacity view.  A fenced replica that is
+        still dead when a sweep runs (no rejoin re-registered its
+        slot) is retired for good — its slot is tombstoned to ``None``
+        (indices stay stable for routers), its per-replica registry
+        dropped from federation."""
         now = time.monotonic()
         with self._lock:
             fenced = set(self._fenced)
         # NOT live(): a replica that died without being fenced is exactly
         # what this sweep exists to find
         stale = [i for i, s in enumerate(self.schedulers)
-                 if i not in fenced
+                 if s is not None and i not in fenced
                  and (not s.alive
-                      or now - s.last_beat > heartbeat_timeout_s)]
+                      or (s._lanes
+                          and now - s.last_beat > heartbeat_timeout_s))]
         for i in stale:
             self.fence(i)
+        with self._lock:
+            for i in self._fenced:
+                s = self.schedulers[i]
+                if s is not None and not s.alive:
+                    self.schedulers[i] = None
+                    self.registries[i] = None
         return stale
+
+    # -- elastic scale ------------------------------------------------
+
+    def _advance_epoch(self):
+        """Bump the membership epoch and publish the live roster —
+        every scale action is epoch-fenced exactly like a failover."""
+        from .. import kvstore_async as _kv
+
+        with self._lock:
+            self.epoch += 1
+            epoch = self.epoch
+            fenced = set(self._fenced)
+        names = [s.name for i, s in enumerate(self.schedulers)
+                 if s is not None and i not in fenced]
+        for i, s in enumerate(self.schedulers):
+            if s is not None and i not in fenced:
+                s.epoch = epoch
+        if names:
+            _kv._membership_publish(_group_key(self.group), epoch,
+                                    names, primary=names[0])
+        return epoch
+
+    def grow(self, n=1):
+        """Add ``n`` replicas to the group, live.
+
+        Every registered model is stamped onto each newcomer, which
+        requires the model to have been registered with a *factory*
+        (a backend list can't mint executors for replicas that didn't
+        exist yet).  New replicas take fresh indices at the end —
+        existing routing is untouched — and the whole grow lands under
+        one bumped membership epoch.  Returns ``{"epoch", "added"}``
+        (actuator contract: the epoch rides into the autoscaler's
+        flight bundle)."""
+        from .. import chaos as _chaos
+        from ..base import MXNetError
+
+        _chaos.visit("serving.scale", name="grow:%s" % self.group)
+        with self._lock:
+            models = dict(self._models)
+        pinned = sorted(name for name, (fac, _, _) in models.items()
+                        if fac is None)
+        if pinned:
+            raise MXNetError(
+                "cannot grow group %r: model(s) %s were registered "
+                "with a backend list, not a factory — the group size "
+                "is pinned" % (self.group, ", ".join(pinned)))
+        added = []
+        for _ in range(int(n)):
+            with self._lock:
+                idx = len(self.schedulers)
+                reg = _metrics.Registry() if self._isolated else None
+                sched = Scheduler(metrics_registry=reg,
+                                  name="%s/%d" % (self.group, idx))
+                self.registries.append(reg)
+                self.schedulers.append(sched)
+            for name, (factory, buckets, max_queue) in models.items():
+                sched.register(name, factory(), buckets=buckets,
+                               max_queue=max_queue)
+            _M_UP.labels(sched.name).set(1)
+            added.append(idx)
+        epoch = self._advance_epoch()
+        return {"epoch": epoch, "added": added}
+
+    def shrink(self, n=1, timeout=10.0):
+        """Remove ``n`` replicas, drain-before-remove: the victims
+        (highest live indices) stop admitting, finish every accepted
+        request (bounded by ``timeout`` seconds each), and only then
+        retire — quietly: no ``serving_failover_total`` tick, because
+        a voluntary scale-down is not a failover.  Refuses to remove
+        the last live replica.  Returns ``{"epoch", "removed"}``."""
+        from .. import chaos as _chaos
+        from ..base import MXNetError
+
+        _chaos.visit("serving.scale", name="shrink:%s" % self.group)
+        n = int(n)
+        live = self.live()
+        if n >= len(live):
+            raise MXNetError(
+                "shrink(%d) would empty group %r (%d live replica(s))"
+                % (n, self.group, len(live)))
+        victims = live[len(live) - n:]
+        for _, sched in victims:          # stop admitting everywhere
+            sched.drain()                 # first, then wait queues dry
+        removed = []
+        for idx, sched in victims:
+            sched.close(timeout=timeout)  # drains queues, joins loops
+            with self._lock:
+                self._fenced.add(idx)
+            _M_UP.labels(sched.name).set(0)
+            removed.append(idx)
+        epoch = self._advance_epoch()
+        for _, sched in victims:
+            # queues are empty, so the fence fails nothing — it only
+            # turns the retiree into a refusing zombie at the new epoch
+            sched.fence(epoch)
+        return {"epoch": epoch, "removed": removed}
 
     # -- observability ------------------------------------------------
 
@@ -179,7 +327,7 @@ class ReplicaGroup(object):
         each replica's registry under ``{shard, role, epoch}``."""
         targets = []
         for i, s in enumerate(self.schedulers):
-            if self.registries[i] is None:
+            if s is None or self.registries[i] is None:
                 continue
             targets.append({"shard": i, "role": "serving",
                             "epoch": s.epoch,

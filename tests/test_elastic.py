@@ -1,0 +1,492 @@
+"""Elastic scale (PR-11): live PS re-striping via two-phase cutover,
+worker-roster re-balancing in the kvstore fit loop, serving
+grow/shrink with drain-before-remove, and the watchdog-driven
+autoscaler that closes the alert loop.
+
+Everything runs IN-PROCESS — thread-backed servers over real sockets,
+thread schedulers for serving — and every chaos schedule is seeded, so
+each failure scenario is deterministic."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, elastic, serving
+from mxnet_tpu import kvstore_async as ka
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import MXNetError, ResizeAbortedError
+from mxnet_tpu.kvstore_async import AsyncServer, ServerGroup
+from mxnet_tpu.observability import Autoscaler, Watchdog
+from mxnet_tpu.observability.watchdog import Rule
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_isolated(monkeypatch):
+    """Sub-second RPC envelope + clean membership/topology directories
+    for every test."""
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "2")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "3")
+    monkeypatch.setenv("MXNET_TPU_RESIZE_STALL_S", "5")
+    ka.reset_membership()
+    elastic.reset_topology()
+    yield
+    ka.reset_membership()
+    elastic.reset_topology()
+
+
+def _servers(n):
+    return [AsyncServer(secret="el", server_id=i).start()
+            for i in range(n)]
+
+
+def _striped_group(servers, n_live=2):
+    """A 2-shard group with a tiny stripe bound and two keys: 'w'
+    (plain) and 'big' (striped across the shards)."""
+    group = ServerGroup([s.address for s in servers[:n_live]], rank=0,
+                        heartbeat=False, secret="el")
+    group._bound = 1 << 6
+    rs = np.random.RandomState(0)
+    w0 = np.arange(8).astype(np.float32)
+    big0 = rs.standard_normal((32, 8)).astype(np.float32)
+    group.init([("w", w0), ("big", big0)])
+    keys = [("w", (8,)), ("big", (32, 8))]
+    return group, keys, w0, big0
+
+
+def _pull_check(group, w0, big0):
+    out = group.pull(["w", "big"])
+    np.testing.assert_array_equal(np.asarray(out[0]).reshape(8), w0)
+    np.testing.assert_array_equal(
+        np.asarray(out[1]).reshape(32, 8), big0)
+
+
+# ---------------------------------------------------------------------
+# resize plan lifecycle
+# ---------------------------------------------------------------------
+
+
+def test_resize_plan_lifecycle():
+    """2→4→2: prepare/commit state machine, epoch monotonicity, value
+    preservation across both cutovers, topology publication."""
+    servers = _servers(4)
+    group, keys, w0, big0 = _striped_group(servers)
+    all4 = [s.address for s in servers]
+    try:
+        plan = elastic.ResizePlan(group, all4, keys)
+        with pytest.raises(MXNetError, match="plan is new"):
+            plan.commit()                      # phases are ordered
+        plan.prepare()
+        assert plan.state == "prepared"
+        plan.commit()
+        plan.close()
+        assert plan.state == "committed"
+        assert group.topology_epoch == 1 and len(group._specs) == 4
+        assert plan.cutover_ms is not None and plan.cutover_ms >= 0.0
+        _pull_check(group, w0, big0)
+        # late joiners find the new shard list at the new epoch
+        rec = elastic.lookup_topology(group.group_id)
+        assert rec["epoch"] == 1 and len(rec["addresses"]) == 4
+        # shrink back: values survive the round trip, epoch keeps rising
+        elastic.ResizePlan(group, all4[:2], keys).run()
+        assert group.topology_epoch == 2 and len(group._specs) == 2
+        _pull_check(group, w0, big0)
+        with pytest.raises(ValueError, match="empty"):
+            elastic.ResizePlan(group, [], keys)
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
+def test_cutover_atomicity_under_seeded_resize_drop():
+    """A fault at either phase of the cutover aborts the plan cleanly
+    at the OLD epoch: routing untouched, no key orphaned, and the same
+    resize succeeds once the fault clears."""
+    servers = _servers(4)
+    group, keys, w0, big0 = _striped_group(servers)
+    all4 = [s.address for s in servers]
+    try:
+        # phase-1 drop: the warm copy dies before any retire happened
+        with chaos.inject("kvstore.resize_drop", "raise", seed=7,
+                          match="prepare:", limit=1) as inj:
+            with pytest.raises(ResizeAbortedError):
+                elastic.ResizePlan(group, all4, keys).run()
+            assert inj.fires == 1
+        assert group.topology_epoch == 0 and len(group._specs) == 2
+        _pull_check(group, w0, big0)
+        # phase-2 drop: mid-commit, after retires began — rollback must
+        # restore every retired key on its old owner at the old epoch
+        with chaos.inject("kvstore.resize_drop", "raise", seed=7,
+                          match="commit:", limit=1) as inj:
+            with pytest.raises(ResizeAbortedError):
+                elastic.ResizePlan(group, all4, keys).run()
+            assert inj.fires == 1
+        assert group.topology_epoch == 0 and len(group._specs) == 2
+        _pull_check(group, w0, big0)
+        # the exact same plan shape succeeds clean afterwards
+        elastic.ResizePlan(group, all4, keys).run()
+        assert group.topology_epoch == 1 and len(group._specs) == 4
+        _pull_check(group, w0, big0)
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------
+# worker elasticity: roster math + fit-loop integration
+# ---------------------------------------------------------------------
+
+
+def test_worker_roster_rebalance_and_handoff():
+    r = elastic.WorkerRoster(ranks=[1, 0])
+    assert r.members() == [0, 1] and r.size == 2
+    # ownership is pure round-robin over the sorted member list
+    assert [b for b in range(6) if r.owns(0, b)] == [0, 2, 4]
+    assert r.join(3) == 1
+    assert [b for b in range(6) if r.owns(3, b)] == [2, 5]
+    assert r.join(3) == 1                      # idempotent
+    r.drain(1)
+    assert r.members() == [0, 3]
+    assert [b for b in range(6) if r.owns(1, b)] == []  # drained owns 0
+    r.drain(3)
+    with pytest.raises(MXNetError, match="last worker"):
+        r.drain(0)
+    # the handoff point is monotonic: a straggler marking an older
+    # batch can never move the group's high-water mark backward
+    r.mark_progress(0, 3)
+    r.mark_progress(0, 1)
+    assert r.resume_point() == (0, 3)
+    r.mark_progress(1, 0)
+    assert r.resume_point() == (1, 0)
+
+
+B, D = 8, 6
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit_elastic(kv, roster, callback=None):
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    rs = np.random.RandomState(3)
+    it = NDArrayIter({"data": rs.randn(32, D).astype(np.float32)},
+                     {"softmax_label": rs.randint(0, 8, (32,)).astype(
+                         np.float32)}, batch_size=B)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(_mlp(), mesh, data_shapes={"data": (B, D)},
+                        label_shapes={"softmax_label": (B,)},
+                        rescale_grad=1.0 / B)
+    return tr.fit(it, num_epoch=1, seed=5, log_every=0, kvstore=kv,
+                  roster=roster, batch_end_callback=callback)
+
+
+def test_fit_roster_drain_rebalances_mid_epoch(monkeypatch):
+    """4 global batches, members {0, 1}: rank 0 runs batch 0, rank 1
+    drains, rank 0 takes over EVERY remaining batch — no batch is lost
+    at the membership change."""
+    monkeypatch.setenv("MXNET_TPU_KV_REPLICAS", "2")
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                          rescale_grad=1.0 / B, wd=0.0))
+        roster = elastic.WorkerRoster(ranks=[0, 1])
+        ran = []
+
+        def cb(bep):
+            ran.append(bep.nbatch)
+            if len(ran) == 1:
+                roster.drain(1)
+
+        _fit_elastic(kv, roster, callback=cb)
+        # without the drain rank 0 owns batches {0, 2}; after it, all 4
+        assert ran == [1, 2, 3, 4]
+        assert roster.resume_point() == (0, 4)
+    finally:
+        kv._async.shutdown()
+        for s in kv._async_replicas:
+            s.stop()
+
+
+def test_fit_roster_joiner_fast_forwards(monkeypatch):
+    """A rank joining mid-epoch fast-forwards past the batches the
+    group already covered (``resume="auto"`` semantics across a roster
+    change) instead of re-training them."""
+    monkeypatch.setenv("MXNET_TPU_KV_REPLICAS", "2")
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                          rescale_grad=1.0 / B, wd=0.0))
+        roster = elastic.WorkerRoster(ranks=[0])
+        roster.mark_progress(0, 2)     # the group already ran batches 0-1
+        ran = []
+        _fit_elastic(kv, roster, callback=lambda bep: ran.append(bep.nbatch))
+        assert len(ran) == 2           # only batches 2 and 3
+    finally:
+        kv._async.shutdown()
+        for s in kv._async_replicas:
+            s.stop()
+
+
+def test_fit_roster_requires_kvstore():
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    rs = np.random.RandomState(3)
+    it = NDArrayIter({"data": rs.randn(16, D).astype(np.float32)},
+                     {"softmax_label": rs.randint(0, 8, (16,)).astype(
+                         np.float32)}, batch_size=B)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(_mlp(), mesh, data_shapes={"data": (B, D)},
+                        label_shapes={"softmax_label": (B,)},
+                        rescale_grad=1.0 / B)
+    with pytest.raises(MXNetError, match="kvstore"):
+        tr.fit(it, num_epoch=1, roster=elastic.WorkerRoster(ranks=[0]))
+
+
+# ---------------------------------------------------------------------
+# autoscaler: rule -> action -> cooldown
+# ---------------------------------------------------------------------
+
+
+def _probe_watchdog():
+    sat = obs.gauge("elastic_autoscale_probe",
+                    "Synthetic saturation probe for autoscaler tests",
+                    ["model"]).labels("t")
+    dog = Watchdog([Rule("queue_saturation", "elastic_autoscale_probe",
+                         stat="max", op=">=", threshold=0.9,
+                         severity="critical",
+                         description="synthetic breach")])
+    return sat, dog
+
+
+def test_autoscaler_rule_action_cooldown(tmp_path, monkeypatch):
+    """The policy core on an injected clock: a blip never scales, a
+    sustained breach scales up once, the cooldown and size bounds
+    suppress the follow-ups, sustained idleness drains back down —
+    and both actions land in flight bundles naming their trigger."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    sat, dog = _probe_watchdog()
+    sizes = {"n": 2}
+
+    def up(action):
+        sizes["n"] += 1
+        return {"epoch": 40 + sizes["n"]}
+
+    def down(action):
+        sizes["n"] -= 1
+        return {"epoch": 40 + sizes["n"]}
+
+    sc = Autoscaler(dog, scale_up=up, scale_down=down,
+                    size=lambda: sizes["n"], sustain_s=5.0,
+                    cooldown_s=60.0, idle_s=30.0, min_size=2, max_size=3)
+    blocked = obs.REGISTRY.get("cluster_autoscale_blocked_total")
+    b_cool = blocked.labels("cooldown").value
+    b_bounds = blocked.labels("bounds").value
+
+    sat.set(1.0)
+    assert sc.evaluate(now=0.0) is None        # a blip is not sustained
+    act = sc.evaluate(now=6.0)                 # burning past sustain_s
+    assert act is not None and act.ok
+    assert act.action == "scale_up" and act.rule == "queue_saturation"
+    assert act.epoch == 43 and sizes["n"] == 3
+    # acting reset the burn clock; the persisting breach re-arms...
+    assert sc.evaluate(now=12.0) is None
+    # ...but the cooldown suppresses the re-fire
+    assert sc.evaluate(now=20.0) is None
+    assert blocked.labels("cooldown").value == b_cool + 1
+    # cooldown over, breach sustained — the max bound holds the line
+    assert sc.evaluate(now=70.0) is None
+    assert blocked.labels("bounds").value == b_bounds + 1
+
+    # load clears: sustained idleness drains, bounded by min_size
+    sat.set(0.0)
+    assert sc.evaluate(now=80.0) is None       # idle 10s of 30
+    act2 = sc.evaluate(now=101.0)
+    assert act2 is not None and act2.ok
+    assert act2.action == "scale_down" and act2.rule == "idle"
+    assert sizes["n"] == 2
+    assert sc.evaluate(now=140.0) is None      # cooldown again
+    assert sc.evaluate(now=170.0) is None      # min bound
+    assert sizes["n"] == 2
+
+    # the action counter series the acceptance bar names
+    text = obs.metrics.dump_metrics()
+    assert 'cluster_autoscale_actions_total{action="scale_up"}' in text
+    assert 'cluster_autoscale_actions_total{action="scale_down"}' in text
+
+    # flight bundles name the triggering rule + the fence epoch
+    bundles = sorted(d for d in os.listdir(str(tmp_path))
+                     if d.startswith("flight_autoscale_action"))
+    assert len(bundles) == 2
+    extras = []
+    for d in bundles:
+        with open(os.path.join(str(tmp_path), d, "manifest.json")) as f:
+            extras.append(json.load(f)["extra"])
+    by_action = {e["action"]: e for e in extras}
+    assert by_action["scale_up"]["rule"] == "queue_saturation"
+    assert by_action["scale_up"]["epoch"] == 43
+    assert by_action["scale_down"]["rule"] == "idle"
+
+
+def test_autoscaler_failed_actuator_burns_cooldown(tmp_path, monkeypatch):
+    """A broken actuator must not be retried every evaluation — the
+    failure is flight-recorded and the cooldown still applies."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    sat, dog = _probe_watchdog()
+
+    def boom(action):
+        raise ValueError("no capacity anywhere")
+
+    sc = Autoscaler(dog, scale_up=boom, sustain_s=0.0, cooldown_s=50.0)
+    sat.set(1.0)
+    act = sc.evaluate(now=200.0)
+    assert act is not None and not act.ok
+    assert "no capacity" in str(act.detail)
+    assert sc.evaluate(now=210.0) is None      # cooldown despite failure
+    assert any(d.startswith("flight_autoscale_failed")
+               for d in os.listdir(str(tmp_path)))
+    sat.set(0.0)
+
+
+# ---------------------------------------------------------------------
+# serving elasticity: grow / drain-before-shrink
+# ---------------------------------------------------------------------
+
+
+FEAT = 4
+
+
+class _Echo(serving.registry.Backend):
+    input_shapes = {"data": (FEAT,)}
+
+    def infer(self, batch):
+        return [np.asarray(batch["data"], np.float32) + 1.0], False
+
+
+def test_serving_shrink_drains_before_remove_zero_drop():
+    """THE serving half of the acceptance bar: a live shrink under
+    concurrent load answers every accepted request — the victim stops
+    admitting, finishes its queue, and only then retires at a bumped
+    epoch."""
+    group = serving.ReplicaGroup(replicas=3, group="elastic-t",
+                                 isolated_metrics=True)
+    group.register("echo", _Echo, buckets=[1, 2, 4], max_queue=256)
+    router = serving.ServingRouter(group)
+    rng = np.random.RandomState(2)
+    rows = [rng.randn(FEAT).astype(np.float32) for _ in range(48)]
+    results = [None] * len(rows)
+    failures = []
+
+    def client(lo, hi):
+        for i in range(lo, hi):
+            try:
+                results[i] = router.request(
+                    "echo", {"data": rows[i]}, timeout=30)[0]
+            except Exception as exc:  # noqa: BLE001 - recorded, asserted
+                failures.append((i, exc))
+
+    threads = [threading.Thread(target=client, args=(i * 12, (i + 1) * 12))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    shrunk = group.shrink(1)                   # drain-before-remove, live
+    for t in threads:
+        t.join(timeout=60)
+
+    assert not failures, "accepted requests dropped: %r" % failures[:3]
+    for i, out in enumerate(results):
+        np.testing.assert_allclose(out, rows[i] + 1.0, rtol=1e-6)
+    assert shrunk["removed"] == [2] and group.capacity() == 2
+    assert group.membership()["epoch"] == shrunk["epoch"] == 1
+    # the retiree is an epoch-fenced zombie now: refuses new work
+    with pytest.raises(serving.ReplicaDeadError):
+        group.schedulers[2].submit("echo", {"data": rows[0]})
+    # a shrink may never empty the group
+    with pytest.raises(MXNetError, match="would empty"):
+        group.shrink(2)
+    group.close()
+
+
+def test_serving_grow_stamps_models_and_serves():
+    group = serving.ReplicaGroup(replicas=2, group="grow-t",
+                                 isolated_metrics=True)
+    group.register("echo", _Echo, buckets=[1], max_queue=16)
+    grown = group.grow(1)
+    assert grown["added"] == [2] and group.capacity() == 3
+    assert group.membership()["epoch"] == grown["epoch"] == 1
+    # the newcomer got every registered model stamped on and answers
+    row = np.ones(FEAT, np.float32)
+    out = group.schedulers[2].submit(
+        "echo", {"data": row}).result(timeout=10)
+    np.testing.assert_allclose(out[0], row + 1.0, rtol=1e-6)
+    group.close()
+
+
+def test_serving_grow_refuses_pinned_backend_list():
+    """A model registered with a backend LIST (one instance per launch
+    replica) pins the group size — grow must refuse, not mint a
+    replica with no executor."""
+    group = serving.ReplicaGroup(replicas=2, group="pinned-t")
+    group.register("echo", [_Echo(), _Echo()], buckets=[1])
+    with pytest.raises(MXNetError, match="pinned"):
+        group.grow(1)
+    assert group.capacity() == 2
+    group.close()
+
+
+@pytest.mark.chaos
+def test_serving_scale_chaos_aborts_before_membership():
+    """A seeded serving.scale fault aborts the action before any
+    membership change: capacity and epoch are untouched."""
+    group = serving.ReplicaGroup(replicas=2, group="scale-chaos-t")
+    group.register("echo", _Echo, buckets=[1])
+    with chaos.inject("serving.scale", "raise", seed=3, limit=1) as inj:
+        with pytest.raises(chaos.ChaosError):
+            group.grow(1)
+        assert inj.fires == 1
+    assert group.capacity() == 2 and group.epoch == 0
+    group.close()
+
+
+def test_detect_reaps_fenced_zombies_for_capacity():
+    """Satellite fix: a replica fenced by failover that never
+    re-registered must stop counting toward capacity, so a shrink
+    after failover sizes against reality."""
+    group = serving.ReplicaGroup(replicas=3, group="reap-t")
+    group.register("echo", _Echo, buckets=[1])
+    group.kill(0)                              # failover fences it
+    assert group.capacity() == 2
+    group.detect()                             # sweep reaps the zombie
+    assert group.schedulers[0] is None and group.registries[0] is None
+    assert group.capacity() == 2
+    # shrink after failover: the true capacity is 2, so shrink(1) works
+    shrunk = group.shrink(1)
+    assert group.capacity() == 1 and shrunk["removed"] == [2]
+    # a freshly grown replica with no dispatch lanes yet has no beat —
+    # the sweep must not fence it for that
+    bare = serving.ReplicaGroup(replicas=2, group="bare-t")
+    assert bare.detect(heartbeat_timeout_s=0.0001) == []
+    assert bare.capacity() == 2
+    bare.close()
+    group.close()

@@ -68,7 +68,10 @@ def launch_servers(args, coordinator=None):
     probe-then-bind race with other jobs on the host.  Replica addresses
     reach the workers ``|``-joined inside the shard's slot of
     ``MXNET_TPU_ASYNC_PS_ADDRS``, so the worker-side ``ServerGroup``
-    routes the shard through a failover-capable ``ReplicatedClient``."""
+    routes the shard through a failover-capable ``ReplicatedClient``.
+    ``--elastic-spares K`` additionally parks K blank servers outside
+    the live topology (addresses in ``MXNET_TPU_ELASTIC_SPARE_ADDRS``)
+    as pre-warmed ``kv.resize()`` targets."""
     import secrets
     import tempfile
     import time
@@ -142,6 +145,17 @@ def launch_servers(args, coordinator=None):
                              primary_addr=shard_addrs[i][0])
                 shard_addrs[i].append(
                     collect(p, f, "server %d replica %d" % (i, j), deadline))
+        # elastic spares: blank shards parked beyond the live topology,
+        # sharing the cluster secret so a later ``kv.resize()`` (or the
+        # autoscaler's scale_up actuator) can adopt them without a cold
+        # process launch — the expensive part of growing is already paid
+        spares = max(0, getattr(args, "elastic_spares", 0) or 0)
+        spare_addrs = []
+        for k in range(spares):
+            p, f = spawn(args.num_servers + k, "spare%d" % k,
+                         args.num_servers * replicas + k)
+            spare_addrs.append(
+                collect(p, f, "elastic spare %d" % k, deadline))
     except Exception:
         # don't orphan the shards that DID start
         for p in procs:
@@ -154,6 +168,8 @@ def launch_servers(args, coordinator=None):
         "MXNET_TPU_NUM_SERVERS": str(args.num_servers),
         "MXNET_TPU_PS_SECRET": secret,
     }
+    if spare_addrs:
+        worker_env["MXNET_TPU_ELASTIC_SPARE_ADDRS"] = ",".join(spare_addrs)
     return procs, worker_env
 
 
@@ -179,8 +195,9 @@ def launch_local(args, cmd):
         if metrics_base:
             # workers take the ports after the server block: base +
             # (num server procs incl. replicas) + worker rank
-            server_slots = (args.num_servers
-                            * max(1, getattr(args, "num_replicas", 1))
+            server_slots = ((args.num_servers
+                             * max(1, getattr(args, "num_replicas", 1))
+                             + max(0, getattr(args, "elastic_spares", 0)))
                             if args.num_servers > 0 else 0)
             env["MXNET_TPU_METRICS_PORT"] = str(
                 metrics_base + server_slots + i)
@@ -278,7 +295,31 @@ def launch_ssh(args, cmd):
         server_env = ("MXNET_TPU_ASYNC_PS_ADDRS='%s' MXNET_TPU_NUM_SERVERS=%d "
                       % (",".join("|".join(g) for g in shard_addrs),
                          args.num_servers))
-    server_slots = (args.num_servers * max(1, args.num_replicas)
+        spares = max(0, getattr(args, "elastic_spares", 0) or 0)
+        spare_addrs = []
+        for k in range(spares):
+            # blank shards beyond the live topology — resize targets
+            slot = args.num_servers * replicas + k
+            host = hosts[slot % len(hosts)]
+            port = args.server_port_base + slot
+            env = ("MXNET_TPU_PLATFORM=cpu JAX_PLATFORMS=cpu "
+                   "MXNET_TPU_SERVER_PORT=%d MXNET_TPU_SERVER_ID=%d "
+                   "MXNET_TPU_NUM_SERVERS=%d MXNET_TPU_PS_HOST=%s "
+                   "MXNET_TPU_TRACE_TRACK=server%d:spare"
+                   % (port, args.num_servers + k, args.num_servers, host,
+                      args.num_servers + k))
+            if args.metrics_port_base:
+                env += (" MXNET_TPU_METRICS_PORT=%d"
+                        % (args.metrics_port_base + slot))
+            remote = "cd %s && %s %s -m mxnet_tpu._async_ps_main" % (
+                os.getcwd(), env, sys.executable)
+            procs.append(_ssh_with_secret(host, remote, secret))
+            spare_addrs.append("%s:%d" % (host, port))
+        if spare_addrs:
+            server_env += ("MXNET_TPU_ELASTIC_SPARE_ADDRS=%s "
+                           % ",".join(spare_addrs))
+    server_slots = ((args.num_servers * max(1, args.num_replicas)
+                     + max(0, getattr(args, "elastic_spares", 0)))
                     if args.num_servers > 0 else 0)
     workers = []
     for i in range(args.num_workers):
@@ -316,6 +357,13 @@ def main():
                              "R > 1 adds R-1 hot standbys per shard — "
                              "workers fail over to a promoted standby if "
                              "the shard's primary dies)")
+    parser.add_argument("--elastic-spares", type=int, default=0,
+                        help="extra blank PS processes beyond -s N, parked "
+                             "with the cluster secret but outside the live "
+                             "topology; their addresses reach workers as "
+                             "MXNET_TPU_ELASTIC_SPARE_ADDRS so kv.resize() "
+                             "/ the autoscaler can grow onto pre-warmed "
+                             "shards (needs -s > 0)")
     parser.add_argument("--server-port-base", type=int, default=9700,
                         help="first PS port for --launcher ssh (server i "
                              "listens on base+i; local mode self-assigns)")
