@@ -46,8 +46,11 @@ elastic:
 serve:
 	python tools/serve.py --smoke
 
+slo:
+	python tools/slo_report.py
+
 clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	dryrun dist-test chaos trace watchdog elastic serve clean
+	dryrun dist-test chaos trace watchdog elastic serve slo clean

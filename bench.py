@@ -75,7 +75,10 @@ def _obs_counters():
 # p99)
 # v7: resize_cutover_ms / autoscale_actions_total from the
 # BENCH_ELASTIC=1 live-resize loop
-_SCHEMA_VERSION = 7
+# v8: request_trace_overhead_pct (serving throughput with the metrics
+# plane on vs MXNET_TPU_METRICS=0) / slo_availability from the
+# per-request observability plane
+_SCHEMA_VERSION = 8
 
 
 def _bench_peak():
@@ -277,7 +280,12 @@ def serving_main():
     ``request_ms_p50``/``p99``, ``batch_occupancy``) plus
     ``requests_per_sec_sequential`` (the per-request-dispatch baseline
     the ≥2× acceptance ratio is taken against) and
-    ``recompiles_after_warmup`` (0 is the steady-state contract)."""
+    ``recompiles_after_warmup`` (0 is the steady-state contract).
+    Schema-8 adds ``request_trace_overhead_pct`` (the same warm
+    scheduler re-measured under ``MXNET_TPU_METRICS=0`` — the
+    per-request observability tax as a percentage of throughput) and
+    ``slo_availability`` (good/(good+bad) from the availability error
+    budget the run just accrued)."""
     import jax
 
     import mxnet_tpu as mx
@@ -342,7 +350,37 @@ def serving_main():
     stats = sched.stats("bench_mlp")
     recompiles = (int(compiles.total()) if compiles else 0) \
         - warm_compiles
+
+    # schema-8: the per-request observability tax — the same warm
+    # scheduler re-measured with the metrics plane off.  The env var is
+    # re-read lazily on every hot-path call, so flipping it here turns
+    # every counter/histogram/event/exemplar into a constant-time no-op.
+    prior = os.environ.get("MXNET_TPU_METRICS")
+    os.environ["MXNET_TPU_METRICS"] = "0"
+    try:
+        t0 = time.perf_counter()
+        bare = [sched.submit("bench_mlp", {"data": rows[i]})
+                for i in range(n_requests)]
+        for r in bare:
+            r.result(timeout=120)
+        rps_off = n_requests / (time.perf_counter() - t0)
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_TPU_METRICS", None)
+        else:
+            os.environ["MXNET_TPU_METRICS"] = prior
+    overhead_pct = ((1.0 - rps / rps_off) * 100.0) if rps_off > 0 else 0.0
     sched.close()
+
+    # the availability budget the instrumented pass just accrued (the
+    # METRICS=0 pass recorded nothing, by construction)
+    from mxnet_tpu.observability import slo as _slo
+
+    arow = next((r for r in _slo.report().get("slos", ())
+                 if r["slo"] == "availability"), None)
+    slo_availability = (
+        None if arow is None or not (arow["good"] + arow["bad"])
+        else round(arow["good"] / float(arow["good"] + arow["bad"]), 6))
 
     print(json.dumps({
         "metric": "serving_throughput" if platform == "tpu"
@@ -355,6 +393,8 @@ def serving_main():
         "batch_occupancy": round(stats["occupancy"], 4),
         "requests_per_sec_sequential": round(rps_sequential, 2),
         "recompiles_after_warmup": recompiles,
+        "request_trace_overhead_pct": round(overhead_pct, 2),
+        "slo_availability": slo_availability,
         **_obs_counters(),
         **_provenance(),
         "config": {"requests": n_requests, "features": feat,

@@ -62,6 +62,7 @@ import numpy as _np
 from . import chaos as _chaos
 from . import kvstore_async as _ka
 from .base import MXNetError, ResizeAbortedError
+from .observability.events import emit as _emit_event
 from .observability import flight_recorder as _flight
 from .observability import metrics as _metrics
 
@@ -366,6 +367,9 @@ class ResizePlan:
             self.state = "failed"
             raise
         self.state = "prepared"
+        _emit_event("resize", phase="prepared",
+                     group=",".join(self._group.group_id),
+                     moving=len(self._moving), epoch=self.new_epoch)
         return self
 
     # -- phase 2: cutover ------------------------------------------------
@@ -432,6 +436,10 @@ class ResizePlan:
         _M_CUTOVER.observe(dt)
         _M_RESIZE.labels("committed").inc()
         self.state = "committed"
+        _emit_event("resize", phase="committed",
+                     group=",".join(self._group.group_id),
+                     cutover_ms=round(self.cutover_ms, 3),
+                     epoch=self.new_epoch)
         return self
 
     # -- rollback ---------------------------------------------------------
@@ -465,6 +473,9 @@ class ResizePlan:
         self._retired = []
         self.state = "aborted"
         _M_RESIZE.labels("aborted").inc()
+        _emit_event("resize", phase="aborted",
+                     group=",".join(self._group.group_id),
+                     restore_failures=len(failures))
         _flight.record_failure(
             "resize_aborted",
             group=",".join(self._group.group_id),

@@ -37,6 +37,18 @@ The runtime's telemetry layer (the subsystem the paper's
   cooldown-rate-limited, size-bounded, counted in
   ``cluster_autoscale_actions_total{action}``, and flight-recorded
   with the triggering rule.
+- :mod:`~mxnet_tpu.observability.slo` — declarative SLO error budgets
+  (availability / latency objectives over a window) computed from the
+  serving tier's existing counters and histograms, multi-window
+  fast/slow burn-rate rules riding the watchdog machinery, the
+  ``/slo`` JSON report, and ``slo_error_budget_remaining{slo}`` /
+  ``slo_burn_rate{slo,window}`` gauges.
+- :mod:`~mxnet_tpu.observability.events` — the structured ops event
+  log: a bounded JSON-lines ring (model swaps, resize phases,
+  fences, autoscale actions, alert edges, checkpoints, per-request
+  access records) with each event carrying the active trace token;
+  served at ``/events``, federated per member, drained into flight
+  bundles.
 - :mod:`~mxnet_tpu.observability.efficiency` — compute-efficiency
   accounting: per-jit-cache HLO cost analysis (FLOPs / bytes /
   arithmetic intensity / memory footprint), measured MFU
@@ -58,7 +70,7 @@ from __future__ import annotations
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       dump_metrics, reset_metrics, metrics_enabled,
                       DEFAULT_BUCKETS)
-from .tracing import (span, capture_context, attach_context,
+from .tracing import (span, record_span, capture_context, attach_context,
                       capture_wire_context, attach_wire_context,
                       enable_tracing, disable_tracing, tracing_enabled,
                       spans, clear_spans, Span)
@@ -70,6 +82,10 @@ from .flight_recorder import record_failure, flight_enabled
 from .attribution import (attributor, StepAttribution, sample_memory,
                           attribution_table, format_attribution, PHASES)
 from .watchdog import Rule, Alert, Watchdog, default_rules
+from .slo import (SLO, BurnRateRule, default_slos, burn_rules,
+                  report as slo_report, FAST_BURN_RULES)
+from .events import (Event, emit, events, clear_events, render_jsonl,
+                     default_buffer)
 from .autoscaler import Autoscaler, ScaleAction, WATCHED_RULES
 from .efficiency import (peak_flops, record_compile, record_step_rate,
                          model_flops_per_step, GoodputLedger, ledger,
@@ -80,9 +96,9 @@ from .efficiency import (peak_flops, record_compile, record_step_rate,
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
     "dump_metrics", "reset_metrics", "metrics_enabled", "DEFAULT_BUCKETS",
-    "span", "capture_context", "attach_context", "capture_wire_context",
-    "attach_wire_context", "enable_tracing", "disable_tracing",
-    "tracing_enabled", "spans", "clear_spans", "Span",
+    "span", "record_span", "capture_context", "attach_context",
+    "capture_wire_context", "attach_wire_context", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "spans", "clear_spans", "Span",
     "render_prometheus", "start_metrics_server", "export_chrome_trace",
     "merge_chrome_traces", "MetricsServer",
     "FederatedCollector", "federate",
@@ -90,6 +106,10 @@ __all__ = [
     "attributor", "StepAttribution", "sample_memory",
     "attribution_table", "format_attribution", "PHASES",
     "Rule", "Alert", "Watchdog", "default_rules",
+    "SLO", "BurnRateRule", "default_slos", "burn_rules", "slo_report",
+    "FAST_BURN_RULES",
+    "Event", "emit", "events", "clear_events", "render_jsonl",
+    "default_buffer",
     "Autoscaler", "ScaleAction", "WATCHED_RULES",
     "peak_flops", "record_compile", "record_step_rate",
     "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",

@@ -34,13 +34,18 @@ import os
 import threading
 import time as _time
 
+from .events import emit as _emit_event
 from . import flight_recorder as _flight
 from . import metrics as _metrics
+from . import slo as _slo
 
 __all__ = ["Autoscaler", "ScaleAction", "WATCHED_RULES"]
 
-# the alert names that mean "capacity is short" (PR-6 stock rule set)
-WATCHED_RULES = ("queue_saturation", "request_p99_slo", "straggler")
+# the alert names that mean "capacity is short": the PR-6 stock rule
+# set plus the SLO fast-burn rules — an error budget dying fast is a
+# capacity signal, not just a page
+WATCHED_RULES = ("queue_saturation", "request_p99_slo",
+                 "straggler") + _slo.FAST_BURN_RULES
 
 _M_ACTIONS = _metrics.counter(
     "cluster_autoscale_actions_total",
@@ -193,6 +198,8 @@ class Autoscaler(object):
         self._busy_until = now  # graftcheck: disable=lock-discipline
         self.actions.append(action)
         _M_ACTIONS.labels(direction).inc()
+        _emit_event("autoscale", action=direction, rule=rule,
+                     epoch=action.epoch, size=size)
         _flight.record_failure(
             "autoscale_action", None, rule=rule, action=direction,
             epoch=action.epoch, size=size,

@@ -1,0 +1,156 @@
+"""Structured ops event log: a bounded ring of JSON-lines events.
+
+Metrics answer "how much"; traces answer "where did the time go"; this
+module answers "**what happened**" — the discrete control-plane
+transitions an operator greps for first in any incident: a model swap,
+a resize phase, a failover fence, an autoscale action, an alert edge,
+a checkpoint.  Each event is one JSON object carrying:
+
+- ``seq`` — a process-monotonic sequence number (total order within
+  one member's log);
+- ``kind`` — the dotted event name (``serving.model_swap``,
+  ``serving.fence``, ``autoscale``, ``alert``, ``resize``,
+  ``checkpoint``, ``serving.access``...);
+- ``trace`` — the emitting thread's ACTIVE trace token
+  (``tracing.capture_wire_context()``, the PR-5 ``"pid:span_id"``
+  format), so an ops event links straight into the merged Chrome
+  trace when tracing was on;
+- ``time_unix`` / ``pid`` and the caller's keyword ``fields``.
+
+Events land in a bounded ring (capacity ``MXNET_TPU_EVENTS_BUFFER``,
+default 4096; oldest evicted first, evictions counted in
+``ops_events_dropped_total``) and leave it three ways: the ``/events``
+endpoint (``exporters.start_metrics_server``) serves the ring as
+JSON lines, :class:`~.federation.FederatedCollector.render_events`
+merges every member's ring into one cluster-wide log, and the flight
+recorder drains the tail into each postmortem bundle
+(``events.jsonl``).
+
+Gated by ``MXNET_TPU_METRICS`` like the rest of the plane: with
+metrics off, :func:`emit` is a constant-time guard (call-count
+asserted in tests via the :func:`_record` seam).
+
+Import note: the package exports the :func:`events` accessor FUNCTION
+under the same name as this submodule, so ``obs.events`` (and any
+``from ..observability import events`` after package init) is the
+function.  In-tree consumers import what they need by the submodule's
+full path (``from ..observability.events import emit``).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ["Event", "emit", "events", "clear_events", "render_jsonl",
+           "default_buffer"]
+
+_M_EVENTS = _metrics.counter(
+    "ops_events_total", "Structured ops events emitted, by kind",
+    ["kind"])
+_M_DROPPED = _metrics.counter(
+    "ops_events_dropped_total",
+    "Ops events evicted from the bounded ring before export")
+
+_lock = threading.Lock()
+_seq = itertools.count(1)
+_buffer = None     # created lazily so the env cap is read at first use
+
+
+def default_buffer():
+    """``MXNET_TPU_EVENTS_BUFFER``: ring capacity (oldest evicted)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_EVENTS_BUFFER", "4096"))
+    except ValueError:
+        return 4096
+
+
+def _buf():
+    global _buffer
+    if _buffer is None:
+        with _lock:
+            if _buffer is None:
+                _buffer = collections.deque(
+                    maxlen=max(default_buffer(), 1))
+    return _buffer
+
+
+class Event(object):
+    """One structured ops event (see module doc for the envelope)."""
+
+    __slots__ = ("seq", "kind", "time_unix", "pid", "trace", "fields")
+
+    def __init__(self, seq, kind, time_unix, pid, trace, fields):
+        self.seq = seq
+        self.kind = kind
+        self.time_unix = time_unix
+        self.pid = pid
+        self.trace = trace
+        self.fields = fields
+
+    def as_dict(self):
+        """JSON-safe dict: non-primitive field values degrade to
+        ``repr`` (an event log must never fail to serialize)."""
+        d = {"seq": self.seq, "kind": self.kind,
+             "time_unix": self.time_unix, "pid": self.pid,
+             "trace": self.trace}
+        for k, v in self.fields.items():
+            d[k] = v if isinstance(
+                v, (str, int, float, bool, type(None))) else repr(v)
+        return d
+
+
+def _record(ev):
+    """Append one event to the ring.  Module-level seam so tests can
+    monkeypatch it to count calls on the disabled path."""
+    buf = _buf()
+    with _lock:
+        if len(buf) == buf.maxlen:
+            _M_DROPPED.inc()
+        buf.append(ev)
+
+
+def emit(kind, **fields):
+    """Emit one ops event; returns the :class:`Event`, or ``None`` when
+    metrics are disabled (constant-time guard).  The emitting thread's
+    active trace token rides along automatically."""
+    if not _metrics.metrics_enabled():
+        return None
+    ev = Event(next(_seq), str(kind), time.time(), os.getpid(),
+               _tracing.capture_wire_context(), fields)
+    _record(ev)
+    _M_EVENTS.labels(ev.kind).inc()
+    return ev
+
+
+def events(kind=None):
+    """Snapshot (list) of the ring, oldest first; ``kind`` filters."""
+    buf = _buf()
+    with _lock:
+        evs = list(buf)
+    if kind is not None:
+        evs = [e for e in evs if e.kind == kind]
+    return evs
+
+
+def clear_events():
+    buf = _buf()
+    with _lock:
+        buf.clear()
+
+
+def render_jsonl(tail=None):
+    """The ring as JSON lines (the ``/events`` body and the flight
+    bundle's ``events.jsonl``).  ``tail`` keeps only the last N."""
+    evs = events()
+    if tail is not None:
+        evs = evs[-int(tail):]
+    return "".join(json.dumps(e.as_dict(), sort_keys=True) + "\n"
+                   for e in evs)

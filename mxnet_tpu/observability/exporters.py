@@ -89,6 +89,12 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
     processes and feed them to :func:`merge_chrome_traces` for one
     cluster timeline.  The ``X-Profile-Source`` response header says
     which capture path served it.
+
+    ``/slo`` returns the SLO error-budget report (:func:`~.slo.report`
+    over this endpoint's registry) as JSON; ``/events`` streams the
+    structured ops event ring as JSON lines (``?tail=N`` keeps the last
+    N).  ``/metrics?exemplars=1`` opts into the OpenMetrics exemplar
+    annotations on histogram buckets.
     """
     import http.server
     import urllib.parse
@@ -115,8 +121,30 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
                 trace, source = _efficiency.capture_profile(ms)
                 body = json.dumps(trace).encode("utf-8")
                 ctype = "application/json; charset=utf-8"
+            elif path == "/slo":
+                from . import slo as _slo
+
+                body = json.dumps(_slo.report(reg)).encode("utf-8")
+                ctype = "application/json; charset=utf-8"
+            elif path == "/events":
+                from .events import render_jsonl as _render_jsonl
+
+                try:
+                    tail_q = urllib.parse.parse_qs(query).get("tail")
+                    tail = int(tail_q[0]) if tail_q else None
+                except (ValueError, IndexError):
+                    tail = None
+                body = _render_jsonl(tail=tail).encode("utf-8")
+                ctype = "application/x-ndjson; charset=utf-8"
             elif path in ("/metrics", "/"):
-                body = reg.render().encode("utf-8")
+                exm = "exemplars" in urllib.parse.parse_qs(query)
+                try:
+                    text = reg.render(exemplars=True) if exm \
+                        else reg.render()
+                except TypeError:
+                    # renderers without exemplar support (federated)
+                    text = reg.render()
+                body = text.encode("utf-8")
                 ctype = CONTENT_TYPE
             else:
                 self.send_error(404)

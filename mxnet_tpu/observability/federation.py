@@ -39,6 +39,7 @@ guard like every other handle.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import urllib.request
@@ -87,6 +88,22 @@ def _scrape_one(target, timeout):
         return resp.read().decode("utf-8")
 
 
+def _scrape_events(target, timeout):
+    """JSON-lines ops-event text from one target.  URL targets answer
+    from their ``/events`` endpoint (derived from the metrics URL);
+    in-process targets (registry/text) read the process-global event
+    ring directly.  Module-level seam for disabled-path call counting."""
+    if "url" in target:
+        url = target["url"]
+        if url.endswith("/metrics"):
+            url = url[:-len("/metrics")] + "/events"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode("utf-8")
+    from .events import render_jsonl
+    return render_jsonl()
+
+
 def _source_key(target):
     """Identity of the underlying source, for exactly-once dedup."""
     if "text" in target:
@@ -122,6 +139,10 @@ def _parse(text):
             continue
         if line.startswith("#"):
             continue
+        # OpenMetrics exemplar annotation (metrics.Registry.render with
+        # exemplars=True): everything after " # {" is not the sample
+        if " # {" in line:
+            line = line.split(" # {", 1)[0].rstrip()
         try:
             series_id, value = line.rsplit(" ", 1)
         except ValueError:
@@ -326,6 +347,49 @@ class FederatedCollector(object):
         for ident in errors:
             w("cluster_scrape_errors_total{%s} 1\n" % ident)
         return "".join(out)
+
+    def render_events(self):
+        """Every member's structured ops event ring merged into ONE
+        JSON-lines log, each line annotated with the member's identity
+        labels and sorted by wall time across members.  In-process
+        targets (registry/text sources) all read the same
+        process-global ring, so it contributes exactly once — under
+        the first member naming it — mirroring the metrics dedup.
+        Unreachable members are skipped (a half-dead cluster must
+        still yield its surviving members' history)."""
+        if not _metrics.metrics_enabled():
+            return ""
+        rows = []
+        seen = set()
+        for t in self.targets:
+            # unlike metrics registries, the event ring is per-PROCESS:
+            # every non-url target collapses to one local source
+            key = ("url", t["url"]) if "url" in t else ("local",)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                text = _scrape_events(t, self.timeout)
+            except Exception:
+                continue
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                for k in _IDENTITY:
+                    # identity rides as label-style strings, same as
+                    # the relabeled metrics exposition
+                    ev[k] = str(t.get(k, ""))
+                rows.append(ev)
+        rows.sort(key=lambda e: (e.get("time_unix", 0) or 0,
+                                 e.get("pid", 0) or 0,
+                                 e.get("seq", 0) or 0))
+        return "".join(json.dumps(e, sort_keys=True) + "\n"
+                       for e in rows)
 
 
 def federate(targets, timeout=2.0):

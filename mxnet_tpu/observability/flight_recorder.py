@@ -12,6 +12,7 @@ directory::
                         # membership epochs, extra context, pid, time
         spans.json      # last-N spans from the trace ring buffer
         metrics.prom    # full Prometheus snapshot of the registry
+        events.jsonl    # tail of the structured ops event ring
 
 The recorder is **off by default**: it activates only when
 ``MXNET_TPU_FLIGHT_DIR`` names a directory AND metrics are enabled
@@ -141,6 +142,12 @@ def _write_bundle(kind, exc, extra):
     with open(os.path.join(tmp, "metrics.prom"), "w",
               encoding="utf-8") as f:
         f.write(_metrics.dump_metrics())
+    # the ops event tail: what the control plane DID leading up to the
+    # failure (lazy import — events itself records through emit only)
+    from .events import render_jsonl as _render_jsonl
+    with open(os.path.join(tmp, "events.jsonl"), "w",
+              encoding="utf-8") as f:
+        f.write(_render_jsonl(tail=_SPAN_TAIL))
     os.rename(tmp, final)
     _prune_bundles(root)
     return final

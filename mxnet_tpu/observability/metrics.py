@@ -110,7 +110,8 @@ class Counter(object):
         with self._lock:
             self._value = 0.0
 
-    def _render(self, name, label_names, label_values, w):
+    def _render(self, name, label_names, label_values, w,
+                exemplars=False):
         w("%s %s\n" % (_series(name, label_names, label_values),
                        _fmt_value(self._value)))
 
@@ -156,15 +157,25 @@ class Gauge(object):
         with self._lock:
             self._value = 0.0
 
-    def _render(self, name, label_names, label_values, w):
+    def _render(self, name, label_names, label_values, w,
+                exemplars=False):
         w("%s %s\n" % (_series(name, label_names, label_values),
                        _fmt_value(self._value)))
 
 
 class Histogram(object):
-    """Cumulative-bucket histogram handle (Prometheus semantics)."""
+    """Cumulative-bucket histogram handle (Prometheus semantics).
 
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+    ``observe(v, exemplar=...)`` attaches an OpenMetrics-style exemplar
+    — the LAST trace token seen per bucket — so a latency blip in the
+    exposition links to a concrete trace
+    (``serving_request_seconds`` carries the request's root-span wire
+    token).  Exemplars render only on request
+    (``Registry.render(exemplars=True)``): the plain exposition stays
+    Prometheus-0.0.4 parseable."""
+
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets):
         self._lock = threading.Lock()
@@ -172,21 +183,33 @@ class Histogram(object):
         self._counts = [0] * len(buckets)
         self._sum = 0.0
         self._count = 0
+        self._exemplars = {}           # bucket upper bound -> (token, v)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         if not metrics_enabled():
             return
-        self._record(v)
+        self._record(v, exemplar)
 
-    def _record(self, v):
+    def _record(self, v, exemplar=None):
         v = float(v)
         with self._lock:
+            ub_hit = float("inf")
             for i, ub in enumerate(self._buckets):
                 if v <= ub:
                     self._counts[i] += 1
+                    ub_hit = ub
                     break
             self._sum += v
             self._count += 1
+            if isinstance(exemplar, str) and exemplar:
+                self._exemplars[ub_hit] = (exemplar, v)
+
+    def exemplars(self):
+        """Snapshot ``{bucket_upper_bound: (trace_token, value)}`` of
+        the last exemplar recorded per bucket (``float("inf")`` keys
+        the overflow bucket)."""
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self):
@@ -216,18 +239,27 @@ class Histogram(object):
             self._counts = [0] * len(self._buckets)
             self._sum = 0.0
             self._count = 0
+            self._exemplars = {}
 
-    def _render(self, name, label_names, label_values, w):
+    @staticmethod
+    def _exm(ex):
+        return (" # {trace_id=\"%s\"} %s" % (ex[0], _fmt_value(ex[1]))
+                if ex is not None else "")
+
+    def _render(self, name, label_names, label_values, w,
+                exemplars=False):
         with self._lock:
             counts, total, ssum = list(self._counts), self._count, self._sum
+            exm = dict(self._exemplars) if exemplars else {}
         cum = 0
         for ub, n in zip(self._buckets, counts):
             cum += n
-            w("%s %d\n" % (_series(name, label_names, label_values,
-                                   "_bucket", [("le", _fmt_value(ub))]),
-                           cum))
-        w("%s %d\n" % (_series(name, label_names, label_values, "_bucket",
-                               [("le", "+Inf")]), total))
+            w("%s %d%s\n" % (_series(name, label_names, label_values,
+                                     "_bucket", [("le", _fmt_value(ub))]),
+                             cum, self._exm(exm.get(ub))))
+        w("%s %d%s\n" % (_series(name, label_names, label_values, "_bucket",
+                                 [("le", "+Inf")]), total,
+                         self._exm(exm.get(float("inf")))))
         w("%s %s\n" % (_series(name, label_names, label_values, "_sum"),
                        _fmt_value(ssum)))
         w("%s %d\n" % (_series(name, label_names, label_values, "_count"),
@@ -290,8 +322,8 @@ class Family(object):
     def dec(self, v=1.0):
         self._default.dec(v)
 
-    def observe(self, v):
-        self._default.observe(v)
+    def observe(self, v, exemplar=None):
+        self._default.observe(v, exemplar)
 
     @property
     def value(self):
@@ -317,14 +349,15 @@ class Family(object):
             for child in self._children.values():
                 child._reset()
 
-    def _render(self, w):
+    def _render(self, w, exemplars=False):
         w("# HELP %s %s\n" % (self.name,
                               self.help.replace("\n", " ").strip()))
         w("# TYPE %s %s\n" % (self.name, self.kind))
         with self._lock:
             items = sorted(self._children.items())
         for key, child in items:
-            child._render(self.name, self.label_names, key, w)
+            child._render(self.name, self.label_names, key, w,
+                          exemplars=exemplars)
 
 
 class Registry(object):
@@ -362,15 +395,18 @@ class Registry(object):
     def get(self, name):
         return self._families.get(name)
 
-    def render(self):
-        """Prometheus text exposition (version 0.0.4) of every family."""
+    def render(self, exemplars=False):
+        """Prometheus text exposition (version 0.0.4) of every family.
+        ``exemplars=True`` appends OpenMetrics-style exemplar
+        annotations after histogram bucket samples (opt-in: the default
+        exposition stays strictly 0.0.4)."""
         import io
 
         buf = io.StringIO()
         with self._lock:
             fams = sorted(self._families.values(), key=lambda f: f.name)
         for fam in fams:
-            fam._render(buf.write)
+            fam._render(buf.write, exemplars=exemplars)
         return buf.getvalue()
 
     def reset(self):
@@ -401,9 +437,9 @@ def histogram(name, help, labels=(), buckets=None):
     return REGISTRY.histogram(name, help, labels, buckets)
 
 
-def dump_metrics():
+def dump_metrics(exemplars=False):
     """Snapshot the global registry as Prometheus text exposition."""
-    return REGISTRY.render()
+    return REGISTRY.render(exemplars=exemplars)
 
 
 def reset_metrics():

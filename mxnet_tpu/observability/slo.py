@@ -1,0 +1,290 @@
+"""SLO error budgets and multi-window burn-rate alerting.
+
+PR-8's serving SLO enforcement was a single static p99 threshold rule
+(``request_p99_slo``).  This module upgrades the serving tier to real
+**error budgets** (the SRE-workbook model): a declarative
+:class:`SLO` states an objective over a window — "99.9% of requests
+answered" (availability), "99% of requests under 500 ms" (latency) —
+and budget consumption is *computed from the metrics the tier already
+emits* (``serving_requests_total``, ``serving_rejected_total``, the
+``serving_request_seconds`` histogram), never double-counted by new
+instrumentation.
+
+Two consumption surfaces:
+
+- :func:`report` — the ``/slo`` JSON (``exporters.start_metrics_
+  server``) and ``tools/slo_report.py``: per-SLO good/bad totals,
+  error rate, and the fraction of error budget remaining (negative =
+  exhausted).  Also sets ``slo_error_budget_remaining{slo}`` so the
+  budget itself federates like any gauge.
+- :func:`burn_rules` — multi-window **burn-rate** rules registered
+  into :func:`~.watchdog.default_rules`: for each SLO a *fast* window
+  (default 5 min, threshold 14.4× — the classic "2% of a 30-day
+  budget in one hour" page) at ``severity="terminal"`` (rising edge →
+  exactly one flight-recorder bundle) and a *slow* window (default
+  1 h, threshold 6×) at warning.  Burn rate is
+  ``(Δbad / Δtotal) / (1 - objective)`` over the trailing window — 1×
+  means "consuming exactly the budget", sustained >1× means the
+  budget dies before the window does.  The fast-burn rule names are in
+  the autoscaler's default ``WATCHED_RULES``: a sustained fast burn
+  drives a scale-up.
+
+Burn rules ride the stock :class:`~.watchdog.Watchdog` machinery via
+the ``value_fn`` seam (the rule computes its quantity from the parsed
+exposition itself), so they evaluate identically over the local
+registry or a :class:`~.federation.FederatedCollector` — a
+cluster-wide error budget needs no extra code.  Thresholds and
+windows come from the ``MXNET_TPU_SLO_*`` env rows (docs/env_vars.md).
+With ``MXNET_TPU_METRICS=0`` :func:`report` returns an empty report
+without parsing anything — the standard constant-time guard.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import federation as _federation
+from . import metrics as _metrics
+from . import watchdog as _watchdog
+
+__all__ = ["SLO", "BurnRateRule", "default_slos", "burn_rules",
+           "report", "FAST_BURN_RULES"]
+
+_M_BUDGET = _metrics.gauge(
+    "slo_error_budget_remaining",
+    "Fraction of the SLO's error budget left (1 = untouched, <=0 = "
+    "exhausted)", ["slo"])
+_M_BURN = _metrics.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate over the trailing window (1 = consuming "
+    "exactly the budget)", ["slo", "window"])
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+class SLO(object):
+    """One declarative objective over a window.
+
+    ``kind="availability"``: ``objective`` is the fraction of requests
+    that must be answered (good = ``serving_requests_total``, bad =
+    ``serving_rejected_total``).  ``kind="latency"``: ``objective`` is
+    the fraction that must finish under ``threshold_s`` (good/bad from
+    the ``serving_request_seconds`` buckets).  ``window_s`` is the
+    budget window burn rates are normalized against."""
+
+    def __init__(self, name, objective, window_s=3600.0,
+                 kind="availability", threshold_s=None):
+        if not 0.0 < float(objective) < 1.0:
+            raise ValueError("objective must be in (0, 1), got %r"
+                             % (objective,))
+        if kind not in ("availability", "latency"):
+            raise ValueError("kind must be availability|latency, got %r"
+                             % (kind,))
+        self.name = str(name)
+        self.objective = float(objective)
+        self.window_s = float(window_s)
+        self.kind = kind
+        self.threshold_s = (None if threshold_s is None
+                            else float(threshold_s))
+        if kind == "latency" and self.threshold_s is None:
+            self.threshold_s = _env_float(
+                "MXNET_TPU_SLO_LATENCY_THRESHOLD_S", 0.5)
+
+    @property
+    def budget(self):
+        """The error budget: the tolerated bad fraction."""
+        return 1.0 - self.objective
+
+    # -- counting from parsed exposition ------------------------------
+
+    def counts(self, fams):
+        """``(good, bad)`` cumulative totals from parsed exposition
+        ``fams`` (``federation._parse``), or ``None`` when the serving
+        tier has emitted nothing yet."""
+        if self.kind == "availability":
+            good = self._sum(fams, "serving_requests_total")
+            bad = self._sum(fams, "serving_rejected_total")
+            if good is None and bad is None:
+                return None
+            return (good or 0.0, bad or 0.0)
+        return self._latency_counts(fams)
+
+    @staticmethod
+    def _sum(fams, metric, suffix=""):
+        fam = fams.get(metric)
+        if fam is None:
+            return None
+        vals = [v for _, v in _watchdog._matching(fam, metric, None,
+                                                  suffix)]
+        return sum(vals) if vals else None
+
+    def _latency_counts(self, fams):
+        # untyped exposition (no ``# TYPE`` line) groups the bucket
+        # samples under the sample name rather than the family name
+        fam = (fams.get("serving_request_seconds")
+               or fams.get("serving_request_seconds_bucket"))
+        if fam is None:
+            return None
+        cum = {}
+        for ld, v in _watchdog._matching(fam, "serving_request_seconds",
+                                         None, "_bucket"):
+            le = ld.get("le", "")
+            try:
+                ub = float("inf") if le == "+Inf" else float(le)
+            except ValueError:
+                continue
+            cum[ub] = cum.get(ub, 0.0) + v
+        if not cum:
+            return None
+        total = cum[max(cum)]
+        under = 0.0
+        for ub in sorted(cum):
+            if ub >= self.threshold_s:
+                under = cum[ub]
+                break
+        else:
+            under = total
+        return (under, max(total - under, 0.0))
+
+    def snapshot(self, fams):
+        """The ``/slo`` row: totals, error rate, budget remaining."""
+        counts = self.counts(fams)
+        good, bad = counts if counts is not None else (0.0, 0.0)
+        total = good + bad
+        error_rate = (bad / total) if total else 0.0
+        consumed = error_rate / self.budget if self.budget else 0.0
+        row = {
+            "slo": self.name, "kind": self.kind,
+            "objective": self.objective, "window_s": self.window_s,
+            "good": good, "bad": bad, "total": total,
+            "error_rate": round(error_rate, 6),
+            "budget": round(self.budget, 6),
+            "budget_consumed": round(consumed, 6),
+            "budget_remaining": round(1.0 - consumed, 6),
+            "exhausted": bool(total and consumed >= 1.0),
+        }
+        if self.kind == "latency":
+            row["threshold_s"] = self.threshold_s
+        return row
+
+
+class BurnRateRule(_watchdog.Rule):
+    """A watchdog rule whose quantity is an SLO's burn rate over the
+    trailing ``window_s``: ``(Δbad / Δtotal) / budget``.  Uses the
+    ``value_fn`` seam — the rule derives (good, bad) from the parsed
+    exposition itself, then delegates the threshold/sustain/edge logic
+    to the stock :class:`~.watchdog.Rule` machinery."""
+
+    def __init__(self, name, slo, window_name, *, window_s, threshold,
+                 severity, description=""):
+        super().__init__(
+            name, "serving_requests_total", stat="value", op=">=",
+            threshold=threshold, kind="threshold", window_s=window_s,
+            severity=severity, description=description)
+        self.slo = slo
+        self.window_name = window_name
+        self.value_fn = self._burn_rate
+        self._counts = []        # [(t, good, bad)] within window_s
+        self._m_burn = _M_BURN.labels(slo.name, window_name)
+
+    def _burn_rate(self, fams):
+        # called by Watchdog.evaluate with the parsed scrape; time is
+        # injected through update(), so stamp samples there
+        self._pending = self.slo.counts(fams)
+        return self._pending
+
+    def update(self, raw, now):
+        if raw is not None:
+            good, bad = raw
+            self._counts = [(t, g, b) for t, g, b in self._counts
+                            if now - t <= self.window_s]
+            if self._counts and (good < self._counts[0][1]
+                                 or bad < self._counts[0][2]):
+                # counters went backwards (registry reset): restart
+                self._counts = []
+            base = self._counts[0] if self._counts else (now, good, bad)
+            self._counts.append((now, good, bad))
+            d_total = (good + bad) - (base[1] + base[2])
+            d_bad = bad - base[2]
+            if d_total <= 0:
+                raw = None           # no traffic in window: no burn
+            else:
+                raw = (d_bad / d_total) / self.slo.budget
+                self._m_burn.set(raw)
+        return super().update(raw, now)
+
+
+def default_slos():
+    """The stock SLO pair from the ``MXNET_TPU_SLO_*`` env rows:
+    availability (default 99.9%) and latency (default 99% under
+    ``MXNET_TPU_SLO_LATENCY_THRESHOLD_S``)."""
+    window = _env_float("MXNET_TPU_SLO_WINDOW_S", 3600.0)
+    return [
+        SLO("availability",
+            _env_float("MXNET_TPU_SLO_AVAILABILITY", 0.999),
+            window_s=window, kind="availability"),
+        SLO("latency", _env_float("MXNET_TPU_SLO_LATENCY", 0.99),
+            window_s=window, kind="latency"),
+    ]
+
+
+#: The burn-rule names that mean "the error budget is dying fast" —
+#: grown into the autoscaler's default ``WATCHED_RULES``.
+FAST_BURN_RULES = ("slo_availability_fast_burn", "slo_latency_fast_burn")
+
+
+def burn_rules(slos=None):
+    """Fast + slow burn-rate rules for every SLO (registered into
+    :func:`~.watchdog.default_rules`).  Fast: trailing
+    ``MXNET_TPU_SLO_FAST_WINDOW_S`` (default 5 min) vs
+    ``MXNET_TPU_SLO_FAST_BURN`` (default 14.4×), terminal — the rising
+    edge dumps exactly one flight bundle.  Slow: trailing
+    ``MXNET_TPU_SLO_SLOW_WINDOW_S`` (default 1 h) vs
+    ``MXNET_TPU_SLO_SLOW_BURN`` (default 6×), warning."""
+    fast_w = _env_float("MXNET_TPU_SLO_FAST_WINDOW_S", 300.0)
+    slow_w = _env_float("MXNET_TPU_SLO_SLOW_WINDOW_S", 3600.0)
+    fast_t = _env_float("MXNET_TPU_SLO_FAST_BURN", 14.4)
+    slow_t = _env_float("MXNET_TPU_SLO_SLOW_BURN", 6.0)
+    rules = []
+    for slo in (slos if slos is not None else default_slos()):
+        rules.append(BurnRateRule(
+            "slo_%s_fast_burn" % slo.name, slo, "fast",
+            window_s=fast_w, threshold=fast_t, severity="terminal",
+            description="the %s error budget is burning >= %gx over "
+                        "the fast window — at this rate it exhausts "
+                        "in %.0fs" % (slo.name, fast_t,
+                                      slo.window_s / fast_t)))
+        rules.append(BurnRateRule(
+            "slo_%s_slow_burn" % slo.name, slo, "slow",
+            window_s=slow_w, threshold=slow_t, severity="warning",
+            description="the %s error budget is burning >= %gx over "
+                        "the slow window" % (slo.name, slow_t)))
+    return rules
+
+
+def report(source=None, slos=None):
+    """The ``/slo`` payload: one row per SLO (see
+    :meth:`SLO.snapshot`), computed from ``source`` — ``None`` (the
+    process-global registry), anything with ``render()``, or raw
+    exposition text.  Sets ``slo_error_budget_remaining{slo}``.  An
+    empty report (no parsing) when metrics are disabled."""
+    if not _metrics.metrics_enabled():
+        return {"slos": [], "disabled": True}
+    if source is None:
+        text = _metrics.REGISTRY.render()
+    elif callable(getattr(source, "render", None)):
+        text = source.render()
+    else:
+        text = str(source)
+    fams = _federation._parse(text)
+    rows = []
+    for slo in (slos if slos is not None else default_slos()):
+        row = slo.snapshot(fams)
+        _M_BUDGET.labels(slo.name).set(row["budget_remaining"])
+        rows.append(row)
+    return {"slos": rows}

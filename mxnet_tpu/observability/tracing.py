@@ -42,7 +42,7 @@ import time
 
 from . import metrics as _metrics
 
-__all__ = ["span", "capture_context", "attach_context",
+__all__ = ["span", "record_span", "capture_context", "attach_context",
            "capture_wire_context", "attach_wire_context",
            "enable_tracing", "disable_tracing", "tracing_enabled",
            "spans", "clear_spans", "Span"]
@@ -219,6 +219,13 @@ class span(object):
         self._attrs = attrs
         self._live = False
 
+    def set(self, **attrs):
+        """Attach attrs to a span already open (facts learned mid-body,
+        e.g. the batch a request landed in).  No-op when tracing is off."""
+        if self._live:
+            self._attrs.update(attrs)
+        return self
+
     def __enter__(self):
         if not _enabled:
             return self
@@ -245,6 +252,43 @@ class span(object):
                         threading.get_ident() % 100000, self._id,
                         self._parent, self._attrs))
         return False
+
+
+def record_span(name, cat="frontend", start_us=None, end_us=None,
+                parent=None, **attrs):
+    """Record a span with EXPLICIT timestamps — for intervals measured
+    before the recording site runs (e.g. a request's queue wait, whose
+    start is stamped at admit but whose span can only be emitted at
+    dispatch).  ``parent`` may be a local span id, a wire token (kept as
+    a remote parent, stitched at export), or ``None`` to parent under
+    the calling thread's current stack top.  Returns the new span id, or
+    ``None`` while tracing is off (constant-time guard)."""
+    if not _enabled:
+        return None
+    now = int(time.monotonic() * 1e6)
+    if end_us is None:
+        end_us = now
+    if start_us is None:
+        start_us = end_us
+    if parent is None:
+        st = getattr(_tls, "stack", None)
+        parent = st[-1] if st else 0
+    elif isinstance(parent, str):
+        # wire token: a same-pid token parents locally, else remote
+        try:
+            pid_s, span_s = parent.split(":", 1)
+            pid, sid = int(pid_s), int(span_s)
+            parent = (sid if pid == os.getpid() else parent) \
+                if pid > 0 and sid > 0 else 0
+        except ValueError:
+            parent = 0
+    sid = next(_ids)
+    buf = _buf()
+    if len(buf) == buf.maxlen:
+        _M_DROPPED.inc()
+    buf.append(Span(name, cat, int(start_us), int(end_us),
+                    threading.get_ident() % 100000, sid, parent, attrs))
+    return sid
 
 
 def spans():

@@ -55,6 +55,7 @@ import os
 import threading
 import time as _time
 
+from .events import emit as _emit_event
 from . import federation as _federation
 from . import flight_recorder as _flight
 from . import metrics as _metrics
@@ -216,6 +217,10 @@ class Rule(object):
         # neither fire nor poison the baseline.
         self.direction = direction
         self.skip_zero = bool(skip_zero)
+        # value_fn seam: when set (slo.BurnRateRule), the rule derives
+        # its own raw quantity from the parsed exposition instead of
+        # the stock _stat_of(metric, stat, selector) lookup
+        self.value_fn = None
         # evaluation state
         self.firing = False
         self.value = None          # the quantity last compared
@@ -331,7 +336,11 @@ class Watchdog(object):
         _M_EVALS.inc()
         with self._lock:
             for rule in self.rules:
-                raw = _stat_of(fams, rule.metric, rule.stat, rule.selector)
+                if rule.value_fn is not None:
+                    raw = rule.value_fn(fams)
+                else:
+                    raw = _stat_of(fams, rule.metric, rule.stat,
+                                   rule.selector)
                 was = rule.firing
                 firing = rule.update(raw, now)
                 if firing and not was:
@@ -339,6 +348,9 @@ class Watchdog(object):
                     self._active[rule.name] = alert
                     _M_ALERT.labels(rule.name, rule.severity).set(1)
                     _M_FIRED.labels(rule.name).inc()
+                    _emit_event("alert", name=rule.name,
+                                 severity=rule.severity, state="firing",
+                                 value=rule.value)
                     if rule.severity == "terminal":
                         # one bundle per firing episode: the edge, not
                         # every evaluation while it stays red
@@ -350,6 +362,9 @@ class Watchdog(object):
                 elif was:
                     self._active.pop(rule.name, None)
                     _M_ALERT.labels(rule.name, rule.severity).set(0)
+                    _emit_event("alert", name=rule.name,
+                                 severity=rule.severity,
+                                 state="resolved")
             return list(self._active.values())
 
     def firing(self):
@@ -415,11 +430,16 @@ def default_rules():
     """The stock SLO rule set: trace-buffer pressure, heartbeat age,
     replication lag, step-p99 self-regression, (when evaluated over a
     federated source) straggler skew, MFU self-regression, the goodput
-    floor, and the serving tier's request-p99 SLO + queue-saturation
-    rules.  Thresholds come from the ``MXNET_TPU_WATCHDOG_*`` env rows
+    floor, the serving tier's request-p99 SLO + queue-saturation
+    rules, and the error-budget burn-rate rules
+    (:func:`~.slo.burn_rules`: fast-burn terminal, slow-burn warning,
+    for each default SLO).  Thresholds come from the
+    ``MXNET_TPU_WATCHDOG_*`` / ``MXNET_TPU_SLO_*`` env rows
     (docs/env_vars.md)."""
+    from . import slo as _slo   # function-level: slo imports this module
+
     dead_after = _env_float("MXNET_TPU_PS_DEAD_AFTER", 30.0)
-    return [
+    rules = [
         Rule("spans_dropped", "spans_dropped_total", kind="increase",
              threshold=0.0, window_s=300.0, severity="warning",
              description="trace ring buffer is evicting unexported "
@@ -479,3 +499,5 @@ def default_rules():
                          "(depth/max_queue) — overload shedding is "
                          "imminent; add replicas or widen buckets"),
     ]
+    rules.extend(_slo.burn_rules())
+    return rules

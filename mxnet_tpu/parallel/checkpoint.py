@@ -22,6 +22,7 @@ import os
 import jax
 
 from .. import chaos as _chaos
+from ..observability.events import emit as _emit_event
 
 __all__ = ["save_sharded", "restore_sharded", "latest_step", "all_steps",
            "save_fit_meta", "load_fit_meta", "close_all"]
@@ -77,6 +78,8 @@ def save_sharded(directory, step, params, moms=None, aux=None, wait=True,
     state = {"params": params, "moms": moms or {}, "aux": aux or {}}
     mgr = _manager(directory, max_to_keep=max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(state))
+    _emit_event("checkpoint", step=int(step), directory=str(directory),
+                 wait=bool(wait))
     if wait:
         mgr.wait_until_finished()
         # corrupt-mode counterpart (bit-rot / torn write): garble the

@@ -42,7 +42,7 @@ from ..observability import metrics as _metrics
 __all__ = ["ServingError", "ServerOverloadedError", "ServerDrainingError",
            "DeadlineExceededError", "UnknownModelError", "ReplicaDeadError",
            "AdmissionController", "deadline_from_ms", "default_deadline_ms",
-           "max_queue_default"]
+           "max_queue_default", "reject_reason"]
 
 
 class ServingError(MXNetError):
@@ -85,6 +85,23 @@ class ReplicaDeadError(ServingError):
     peer is left."""
 
     http_status = 503
+
+
+#: Canonical shed-reason tag per typed rejection — the vocabulary the
+#: ``serving.shed`` span attr and the access-log event share.
+_REASONS = {
+    ServerOverloadedError: "overload",
+    DeadlineExceededError: "deadline",
+    ServerDrainingError: "draining",
+    ReplicaDeadError: "replica_dead",
+    UnknownModelError: "unknown_model",
+}
+
+
+def reject_reason(exc):
+    """The canonical shed-reason tag for a typed serving error (or for
+    its type), ``None`` for anything that is not a typed rejection."""
+    return _REASONS.get(exc if isinstance(exc, type) else type(exc))
 
 
 _M_REJECTED = _metrics.counter(

@@ -25,19 +25,51 @@ A tiny stdlib ``http.server`` endpoint (same loopback posture as
 Typed serving errors map to the wire via their ``http_status``
 (429 overload, 503 draining/dead, 504 deadline, 404 unknown model);
 the body is ``{"error": ..., "type": ...}``.
+
+Per-request observability: every ``/v1/predict`` request runs inside a
+root ``serving.request`` span and answers with an
+``X-MXTPU-Request-Id`` header — on typed errors too, so a shed request
+is support-debuggable.  The id IS the root span's wire token when
+tracing is on (paste it into the merged Chrome trace), a
+``"pid:rN"`` counter otherwise.  Callers may send an optional
+``X-MXTPU-Trace`` header carrying a PR-5 ``"pid:span_id"`` token; the
+root span then parents under the caller's span (malformed tokens are
+silently ignored, never a 4xx — the wire contract).  The ingress is
+gated by ``MXNET_TPU_SERVING_TRACE_HEADER`` (default on).  Each
+request also emits one ``serving.access`` event (status, latency,
+model, shed reason) into the structured ops log.
 """
 
 from __future__ import annotations
 
 import io
+import itertools
 import json
+import os
 import threading
+import time
 
 import numpy as _np
 
 from ..base import MXNetError
+# the submodule path matters: the package exports an ``events()``
+# accessor FUNCTION under the same name as the submodule
+from ..observability.events import emit as _emit_event
+from ..observability import tracing as _tracing
+from . import admission as _admission
 
-__all__ = ["ServingFrontend", "start_frontend"]
+__all__ = ["ServingFrontend", "start_frontend", "trace_header_enabled"]
+
+# fallback request-id counter for when tracing is off (the id is then
+# "pid:rN" — still unique, just not resolvable in a trace)
+_req_ids = itertools.count(1)
+
+
+def trace_header_enabled():
+    """``MXNET_TPU_SERVING_TRACE_HEADER``: accept the caller's
+    ``X-MXTPU-Trace`` token as the root span's remote parent (default
+    on; ``0`` ignores the header entirely)."""
+    return os.environ.get("MXNET_TPU_SERVING_TRACE_HEADER", "1") != "0"
 
 
 class ServingFrontend(object):
@@ -107,9 +139,13 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def _reply(self, status, body, ctype, extra=()):
+            self._status = status
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            rid = getattr(self, "_rid", None)
+            if rid:
+                self.send_header("X-MXTPU-Request-Id", rid)
             for k, v in extra:
                 self.send_header(k, v)
             self.end_headers()
@@ -123,10 +159,12 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
             status = getattr(exc, "http_status", None)
             if status is None:
                 status = 400 if isinstance(exc, MXNetError) else 500
+            self._shed = _admission.reject_reason(exc)
             self._reply_json(status, {"error": str(exc),
                                       "type": type(exc).__name__})
 
         def do_GET(self):
+            self._rid = None     # keep-alive: no id leak from a POST
             path, _, _query = self.path.partition("?")
             if path == "/v1/models":
                 self._reply_json(200, {"models": _target_models(target)})
@@ -145,23 +183,48 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
             if path != "/v1/predict":
                 self.send_error(404)
                 return
-            try:
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length)
-                ctype = (self.headers.get("Content-Type") or "").lower()
-                if ctype.startswith("application/octet-stream"):
-                    self._predict_raw(body, query)
-                else:
-                    self._predict_json(body)
-            except MXNetError as exc:
-                self._reply_error(exc)
-            except (ValueError, KeyError, TypeError) as exc:
-                self._reply_json(400, {"error": str(exc),
-                                       "type": type(exc).__name__})
+            t0 = time.monotonic()
+            self._model = None
+            self._shed = None
+            self._status = 500
+            # the caller's trace token (when the gate is open) parents
+            # the root span; attach_wire_context silently ignores
+            # malformed tokens — never a 4xx over a bad trace header
+            tok = (self.headers.get("X-MXTPU-Trace")
+                   if trace_header_enabled() else None)
+            with _tracing.attach_wire_context(tok):
+                with _tracing.span("serving.request", cat="serving",
+                                   method="POST") as root:
+                    self._rid = (_tracing.capture_wire_context()
+                                 or "%d:r%d" % (os.getpid(),
+                                                next(_req_ids)))
+                    try:
+                        length = int(self.headers.get(
+                            "Content-Length", "0"))
+                        body = self.rfile.read(length)
+                        ctype = (self.headers.get("Content-Type")
+                                 or "").lower()
+                        if ctype.startswith("application/octet-stream"):
+                            self._predict_raw(body, query)
+                        else:
+                            self._predict_json(body)
+                    except MXNetError as exc:
+                        self._reply_error(exc)
+                    except (ValueError, KeyError, TypeError) as exc:
+                        self._reply_json(400, {"error": str(exc),
+                                               "type": type(exc).__name__})
+                    root.set(model=self._model, status=self._status,
+                             request_id=self._rid)
+                    _emit_event(
+                        "serving.access", status=self._status,
+                        latency_ms=round((time.monotonic() - t0) * 1e3,
+                                         3),
+                        model=self._model, request_id=self._rid,
+                        shed=self._shed)
 
         def _predict_json(self, body):
             payload = json.loads(body.decode("utf-8"))
-            model = payload["model"]
+            model = self._model = payload["model"]
             inputs = {n: _np.asarray(v, dtype=_np.float32)
                       for n, v in payload["inputs"].items()}
             outs = _target_request(target, model, inputs,
@@ -172,7 +235,7 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
 
         def _predict_raw(self, body, query):
             q = urllib.parse.parse_qs(query)
-            model = q["model"][0]
+            model = self._model = q["model"][0]
             name = q.get("input", ["data"])[0]
             deadline = q.get("deadline_ms", [None])[0]
             row = _np.load(io.BytesIO(body), allow_pickle=False)

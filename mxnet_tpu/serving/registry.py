@@ -34,6 +34,7 @@ import threading
 import numpy as _np
 
 from ..base import MXNetError
+from ..observability.events import emit as _emit_event
 from . import admission as _admission
 
 __all__ = ["Backend", "PredictorBackend", "ExportedBackend", "as_backend",
@@ -249,6 +250,9 @@ class ModelRegistry(object):
                 % (name, entry.buckets, backend.buckets))
         with entry.dispatch_lock:
             old, entry.backend = entry.backend, backend
+        _emit_event("serving.model_swap", model=name,
+                     backend=type(backend).__name__,
+                     old_backend=type(old).__name__)
         return old
 
     def get(self, name):

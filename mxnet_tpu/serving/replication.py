@@ -49,6 +49,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..observability.events import emit as _emit_event
 from ..observability import metrics as _metrics
 from . import admission as _admission
 from .scheduler import Scheduler
@@ -179,6 +180,8 @@ class ReplicaGroup(object):
         zombie.fence(epoch)
         _M_UP.labels(zombie.name).set(0)
         _M_FAILOVER.labels(self.group).inc()
+        _emit_event("serving.fence", group=self.group, replica=zombie.name,
+                     index=index, epoch=epoch)
         survivors = [s.name for i, s in enumerate(self.schedulers)
                      if s is not None and i not in fenced]
         for i, s in enumerate(self.schedulers):
@@ -284,6 +287,8 @@ class ReplicaGroup(object):
             _M_UP.labels(sched.name).set(1)
             added.append(idx)
         epoch = self._advance_epoch()
+        _emit_event("serving.resize", group=self.group, action="grow",
+                     added=len(added), epoch=epoch)
         return {"epoch": epoch, "added": added}
 
     def shrink(self, n=1, timeout=10.0):
@@ -318,6 +323,8 @@ class ReplicaGroup(object):
             # queues are empty, so the fence fails nothing — it only
             # turns the retiree into a refusing zombie at the new epoch
             sched.fence(epoch)
+        _emit_event("serving.resize", group=self.group, action="shrink",
+                     removed=len(removed), epoch=epoch)
         return {"epoch": epoch, "removed": removed}
 
     # -- observability ------------------------------------------------

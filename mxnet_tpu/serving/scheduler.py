@@ -45,6 +45,7 @@ import numpy as _np
 from .. import chaos
 from ..base import MXNetError
 from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 from . import admission as _admission
 from .registry import ModelRegistry
 
@@ -66,10 +67,13 @@ class InferenceRequest(object):
     ``result()`` blocks the submitting thread; the scheduler's dispatch
     thread calls ``_resolve``/``_fail`` exactly once.  ``latency_s``
     (admission -> resolution) feeds ``serving_request_seconds``.
+    ``trace`` is the submitter's wire token (the request's root span
+    context) — the dispatch loop parents this request's queue-wait span
+    under it and lists it in the batch span's fan-in links.
     """
 
     __slots__ = ("model", "inputs", "deadline", "t_admit", "_event",
-                 "outputs", "error", "latency_s")
+                 "outputs", "error", "latency_s", "trace")
 
     def __init__(self, model, inputs, deadline):
         self.model = model
@@ -80,6 +84,7 @@ class InferenceRequest(object):
         self.outputs = None
         self.error = None
         self.latency_s = None
+        self.trace = None
 
     @property
     def done(self):
@@ -283,7 +288,22 @@ class Scheduler(object):
         future.  ``force=True`` bypasses overload/drain shedding — used
         by the router to re-admit a request that a DEAD peer had
         already accepted (accepted work is never shed twice); kill and
-        fencing still refuse."""
+        fencing still refuse.
+
+        A typed rejection closes a terminal ``serving.shed`` span tagged
+        with the reject reason, parented under the submitter's current
+        span (the frontend's ``serving.request`` root)."""
+        try:
+            return self._submit(name, inputs, deadline_ms, force)
+        except _admission.ServingError as exc:
+            if _tracing.tracing_enabled():
+                _tracing.record_span(
+                    "serving.shed", cat="serving", model=name,
+                    reason=_admission.reject_reason(exc) or "error",
+                    error=type(exc).__name__)
+            raise
+
+    def _submit(self, name, inputs, deadline_ms, force):
         if self._killed or self._fenced_epoch is not None:
             raise _admission.ReplicaDeadError(
                 "replica %r is %s" % (self.name,
@@ -293,22 +313,27 @@ class Scheduler(object):
         lane = self._lane(name)
         rows = self._check_inputs(lane.entry, inputs)
         deadline = _admission.deadline_from_ms(deadline_ms)
-        # chaos fires OUTSIDE the queue lock: an injected delay stalls
-        # this caller, not every lane's dispatch loop
-        chaos.visit("serving.admit", name=name)
         req = InferenceRequest(name, rows, deadline)
-        with self._cond:
-            if self._stopping and not force:
-                self.admission.reject(name, "draining")
-            if not force:
-                self.admission.admit(name, len(lane.queue),
-                                     lane.entry.max_queue, deadline)
-            lane.queue.append(req)
-            if _metrics.metrics_enabled():
-                depth = len(lane.queue)
-                lane.m_depth.set(depth)
-                lane.m_sat.set(depth / float(lane.entry.max_queue))
-            self._cond.notify_all()
+        # the submitter's context (e.g. the frontend root span) is this
+        # request's identity in the trace: queue-wait spans parent under
+        # it and the batch span lists it as a fan-in link
+        req.trace = _tracing.capture_wire_context()
+        with _tracing.span("serving.admit", cat="serving", model=name):
+            # chaos fires OUTSIDE the queue lock: an injected delay
+            # stalls this caller, not every lane's dispatch loop
+            chaos.visit("serving.admit", name=name)
+            with self._cond:
+                if self._stopping and not force:
+                    self.admission.reject(name, "draining")
+                if not force:
+                    self.admission.admit(name, len(lane.queue),
+                                         lane.entry.max_queue, deadline)
+                lane.queue.append(req)
+                if _metrics.metrics_enabled():
+                    depth = len(lane.queue)
+                    lane.m_depth.set(depth)
+                    lane.m_sat.set(depth / float(lane.entry.max_queue))
+                self._cond.notify_all()
         return req
 
     def request(self, name, inputs, deadline_ms=None, timeout=30.0):
@@ -343,12 +368,20 @@ class Scheduler(object):
 
     def _dispatch(self, name, lane, window):
         now = time.monotonic()
+        traced = _tracing.tracing_enabled()
         live = []
         for req in window:
             # second deadline check: expired while queued -> shed
             # BEFORE costing device time
             if _admission.AdmissionController.expired(req.deadline, now):
                 self.admission.account(name, "deadline")
+                if traced:
+                    _tracing.record_span(
+                        "serving.shed", cat="serving",
+                        start_us=int(req.t_admit * 1e6),
+                        end_us=int(now * 1e6), parent=req.trace,
+                        model=name, reason="deadline",
+                        error="DeadlineExceededError")
                 req._fail(_admission.DeadlineExceededError(
                     "model %r: deadline expired while queued "
                     "(waited %.3fs)" % (name, now - req.t_admit)))
@@ -358,6 +391,17 @@ class Scheduler(object):
             return
         entry = lane.entry
         outs = None
+        # fan-in: N request root spans converge on ONE batch span, so
+        # the batch records every packed request's token and each
+        # request gets a queue-wait span (true timestamps, synthesized
+        # here because the wait only ends at dispatch)
+        req_uids = [r.trace for r in live] if traced else ()
+        if traced:
+            for r in live:
+                _tracing.record_span(
+                    "serving.queue_wait", cat="serving",
+                    start_us=int(r.t_admit * 1e6), end_us=int(now * 1e6),
+                    parent=r.trace, model=name)
         # dispatch_lock is the hot-reload atomicity boundary: a swap
         # can never land mid-window
         with entry.dispatch_lock:
@@ -367,9 +411,17 @@ class Scheduler(object):
                 if self._killed:
                     break
                 try:
-                    chaos.visit("serving.dispatch",
-                                name="%s:%d" % (name, bucket))
-                    outs, cold = backend.infer(batch)
+                    with _tracing.span("serving.dispatch", cat="serving",
+                                       model=name, bucket=bucket,
+                                       rows=len(live), attempt=attempt,
+                                       requests=req_uids) as dsp:
+                        try:
+                            chaos.visit("serving.dispatch",
+                                        name="%s:%d" % (name, bucket))
+                            outs, cold = backend.infer(batch)
+                        except Exception as exc:  # noqa: BLE001
+                            dsp.set(error=type(exc).__name__)
+                            raise
                     break
                 except Exception as exc:   # noqa: BLE001 - fault path
                     if _metrics.metrics_enabled():
@@ -400,7 +452,9 @@ class Scheduler(object):
             if _metrics.metrics_enabled():
                 lane.m_requests.inc()
                 lane.m_wait.observe(now - req.t_admit)
-                lane.m_req.observe(t_done - req.t_admit)
+                # the request's trace token rides as the bucket's
+                # exemplar: a p99 blip links to a concrete trace
+                lane.m_req.observe(t_done - req.t_admit, req.trace)
 
     # -- lifecycle ----------------------------------------------------
 

@@ -47,6 +47,7 @@ def _metrics_clean():
     obs.reset_metrics()
     obs.disable_tracing()
     obs.clear_spans()
+    obs.clear_events()
 
 
 @pytest.fixture(autouse=True)

@@ -259,6 +259,12 @@ _SERVING_TEXT = (
     "# HELP serving_request_seconds End-to-end request latency, "
     "admission to response\n"
     "# TYPE serving_request_seconds histogram\n"
+    # the bucket lines carry OpenMetrics-style exemplars (a member
+    # scraped with ?exemplars=1): federation strips the suffix before
+    # parsing, so the relabeled series carry plain values
+    'serving_request_seconds_bucket{model="mlp",le="0.05"} 4'
+    ' # {trace_id="777:42"} 0.031\n'
+    'serving_request_seconds_bucket{model="mlp",le="+Inf"} 5\n'
     'serving_request_seconds_count{model="mlp"} 5\n'
     'serving_request_seconds_sum{model="mlp"} 0.25\n'
     "# HELP serving_queue_depth Requests currently queued per model "

@@ -38,6 +38,9 @@ from mxnet_tpu.parallel.trainer import ShardedTrainer
 
 GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "golden", "metrics_exposition.txt")
+GOLDEN_EXEMPLARS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "metrics_exposition_exemplars.txt")
 
 # a valid exposition line: comment, or series (optional labels) + value
 _SERIES_RE = re.compile(
@@ -161,7 +164,8 @@ def test_disabled_metrics_skip_record_entirely(monkeypatch):
     monkeypatch.setattr(metrics.Gauge, "_record",
                         lambda self, v, op: calls.append("gauge"))
     monkeypatch.setattr(metrics.Histogram, "_record",
-                        lambda self, v: calls.append("histogram"))
+                        lambda self, v, exemplar=None:
+                            calls.append("histogram"))
     c = metrics.counter("obs_gate_probe_total", "gate probe")
     g = metrics.gauge("obs_gate_probe", "gate probe")
     h = metrics.histogram("obs_gate_probe_seconds", "gate probe")
@@ -286,10 +290,16 @@ def test_prometheus_exposition_matches_golden(monkeypatch):
     reg.gauge("demo_queue_depth", "Items waiting.").set(7)
     lat = reg.histogram("demo_latency_seconds", "Request latency.",
                         buckets=(0.5, 2.0, 8.0))
-    for v in (0.25, 0.5, 2.0, 8.0):
-        lat.observe(v)
+    # two observations carry exemplar trace tokens: the default 0.0.4
+    # exposition must stay byte-identical (exemplars are opt-in), and
+    # render(exemplars=True) pins the OpenMetrics-style suffix format
+    for v, tok in ((0.25, None), (0.5, "41:7"), (2.0, "41:9"),
+                   (8.0, None)):
+        lat.observe(v, exemplar=tok)
     with open(GOLDEN, encoding="utf-8") as fh:
         assert reg.render() == fh.read()
+    with open(GOLDEN_EXEMPLARS, encoding="utf-8") as fh:
+        assert reg.render(exemplars=True) == fh.read()
 
 
 def test_registry_semantics():

@@ -1,0 +1,604 @@
+"""Per-request observability (PR-12): end-to-end serving traces with
+request ids, the structured ops event log, histogram exemplars, and
+SLO error-budget burn-rate alerting.
+
+Everything runs on a pure-numpy backend — no compile, no accelerator:
+the subject is the observability plane, not the model.  The final
+chaos-marked test is the acceptance run: seeded ``serving.dispatch``
+faults under 4-thread HTTP load must yield ONE merged Chrome trace
+where an accepted request's root span links into its batch dispatch
+span (and the retry after the injected fault), a shed request's span
+carries its typed reject reason, a latency exemplar resolves to a span
+in the trace, and a synthetic fast-burn breach fires the SLO watchdog
+rule exactly once with exactly one flight bundle.
+"""
+
+import collections
+import importlib
+import json
+import os
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — env bootstrap
+from mxnet_tpu import chaos, serving
+from mxnet_tpu import observability as obs
+from mxnet_tpu.observability import federation
+from mxnet_tpu.observability import metrics as omet
+from mxnet_tpu.observability import slo as oslo
+from mxnet_tpu.observability import tracing
+from mxnet_tpu.observability.watchdog import Watchdog
+
+# ``obs.events`` is the accessor FUNCTION (it shadows the submodule on
+# the package), so the module itself — whose private seams the
+# disabled-path tests monkeypatch — comes via its full import path
+oevents = importlib.import_module("mxnet_tpu.observability.events")
+
+FEAT = 4
+ROW = [0.25] * FEAT
+
+
+class _SumBackend(serving.Backend):
+    """Pure-numpy backend: instant infer, no executors."""
+
+    input_shapes = {"data": (FEAT,)}
+    buckets = None
+
+    def infer(self, batch):
+        return [batch["data"].sum(axis=1, keepdims=True)], False
+
+
+def _sched(max_queue=64, buckets=(1, 4), name="req-obs"):
+    sched = serving.Scheduler(name=name)
+    sched.register("m", _SumBackend(), buckets=list(buckets),
+                   max_queue=max_queue)
+    return sched
+
+
+def _post(url, payload, headers=None, timeout=10):
+    """POST JSON; returns (status, headers, body) — errors included."""
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"), headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.headers, json.load(resp)
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers, json.load(err)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "1")
+
+
+# ---------------------------------------------------------------------------
+# request ids: on every response, including typed errors
+# ---------------------------------------------------------------------------
+
+def test_request_id_on_success_and_typed_errors():
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        predict = fe.url + "/v1/predict"
+        status, hdrs, out = _post(predict, {"model": "m",
+                                            "inputs": {"data": ROW}})
+        assert status == 200 and out["outputs"][0] == [1.0]
+        rid_ok = hdrs.get("X-MXTPU-Request-Id")
+        # tracing is off: the id is the "pid:rN" fallback counter
+        assert rid_ok and re.match(r"^\d+:r\d+$", rid_ok)
+
+        status, hdrs, err = _post(predict, {"model": "nope",
+                                            "inputs": {"data": ROW}})
+        assert status == 404 and err["type"] == "UnknownModelError"
+        rid_404 = hdrs.get("X-MXTPU-Request-Id")
+        assert rid_404 and rid_404 != rid_ok
+
+        sched.drain()
+        status, hdrs, err = _post(predict, {"model": "m",
+                                            "inputs": {"data": ROW}})
+        assert status == 503 and err["type"] == "ServerDrainingError"
+        assert hdrs.get("X-MXTPU-Request-Id")
+    sched.close()
+
+
+def test_access_log_event_per_request():
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        predict = fe.url + "/v1/predict"
+        _, hdrs, _ = _post(predict, {"model": "m",
+                                     "inputs": {"data": ROW}})
+        rid = hdrs.get("X-MXTPU-Request-Id")
+        _post(predict, {"model": "nope", "inputs": {"data": ROW}})
+        sched.drain()
+        _post(predict, {"model": "m", "inputs": {"data": ROW}})
+    sched.close()
+
+    access = obs.events("serving.access")
+    assert [e.fields["status"] for e in access] == [200, 404, 503]
+    ok, unknown, shed = access
+    assert ok.fields["model"] == "m" and ok.fields["shed"] is None
+    assert ok.fields["request_id"] == rid
+    assert isinstance(ok.fields["latency_ms"], float)
+    assert unknown.fields["shed"] == "unknown_model"
+    assert shed.fields["shed"] == "draining"
+
+
+# ---------------------------------------------------------------------------
+# trace ingress: X-MXTPU-Trace parents the root span; malformed is a no-op
+# ---------------------------------------------------------------------------
+
+def test_trace_header_parents_root_span_in_merged_trace():
+    obs.enable_tracing()
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        status, hdrs, _ = _post(
+            fe.url + "/v1/predict",
+            {"model": "m", "inputs": {"data": ROW}},
+            headers={"X-MXTPU-Trace": "424242:77"})
+    sched.close()
+    assert status == 200
+    rid = hdrs.get("X-MXTPU-Request-Id")
+
+    roots = [s for s in tracing.spans() if s.name == "serving.request"]
+    assert len(roots) == 1
+    # a foreign pid stays a string token, stitched at export time
+    assert roots[0].parent_id == "424242:77"
+    assert rid == "%d:%d" % (os.getpid(), roots[0].span_id)
+
+    merged = obs.merge_chrome_traces(
+        [obs.export_chrome_trace(include_native=False, track="server")])
+    ev = [e for e in merged["traceEvents"]
+          if e.get("name") == "serving.request"][0]
+    assert ev["args"]["parent_uid"] == "424242:77"
+    assert ev["args"]["span_uid"] == rid
+    assert ev["args"]["status"] == 200
+    assert ev["args"]["request_id"] == rid
+
+
+def test_malformed_trace_header_is_ignored_never_4xx():
+    obs.enable_tracing()
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        for bad in ("garbage", ":::", "12:xx", "-3:9", "0:0", ""):
+            status, hdrs, _ = _post(
+                fe.url + "/v1/predict",
+                {"model": "m", "inputs": {"data": ROW}},
+                headers={"X-MXTPU-Trace": bad})
+            assert status == 200, bad
+            assert hdrs.get("X-MXTPU-Request-Id")
+    sched.close()
+    roots = [s for s in tracing.spans() if s.name == "serving.request"]
+    assert len(roots) == 6
+    assert all(s.parent_id == 0 for s in roots)
+
+
+def test_trace_header_gate_disables_ingress_only(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SERVING_TRACE_HEADER", "0")
+    obs.enable_tracing()
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        status, hdrs, _ = _post(
+            fe.url + "/v1/predict",
+            {"model": "m", "inputs": {"data": ROW}},
+            headers={"X-MXTPU-Trace": "424242:77"})
+    sched.close()
+    assert status == 200
+    root = [s for s in tracing.spans() if s.name == "serving.request"][0]
+    # ingress gated off: local root span + request id survive
+    assert root.parent_id == 0
+    assert hdrs.get("X-MXTPU-Request-Id") \
+        == "%d:%d" % (os.getpid(), root.span_id)
+
+
+# ---------------------------------------------------------------------------
+# scheduler spans: admit, queue-wait, dispatch fan-in, shed, exemplars
+# ---------------------------------------------------------------------------
+
+def test_scheduler_spans_fan_in_to_the_batch_dispatch():
+    obs.enable_tracing()
+    sched = _sched()
+    with tracing.span("client") as client:
+        reqs = [sched.submit("m", {"data": np.ones(FEAT, np.float32)})
+                for _ in range(3)]
+    for r in reqs:
+        r.result(timeout=10)
+    sched.close()
+
+    spans = tracing.spans()
+    client_id = [s for s in spans if s.name == "client"][0].span_id
+    token = "%d:%d" % (os.getpid(), client_id)
+    admits = [s for s in spans if s.name == "serving.admit"]
+    waits = [s for s in spans if s.name == "serving.queue_wait"]
+    dispatches = [s for s in spans if s.name == "serving.dispatch"]
+    assert len(admits) == 3 and len(waits) == 3
+    # all three parent under the submitter's span — admit inline on the
+    # submit thread, queue-wait synthesized at dispatch with the true
+    # admit->dispatch timestamps
+    assert all(s.parent_id == client_id for s in admits)
+    assert all(s.parent_id == client_id for s in waits)
+    assert all(s.start_us <= s.end_us for s in waits)
+    # fan-in: every dispatch window lists the packed requests' tokens
+    packed = [tok for d in dispatches for tok in d.attrs["requests"]]
+    assert packed.count(token) == 3
+    # the request latency histogram carries the token as an exemplar
+    text = obs.dump_metrics(exemplars=True)
+    assert 'trace_id="%s"' % token in text
+    assert " # {" not in obs.dump_metrics()      # default stays 0.0.4
+
+
+def test_shed_span_carries_typed_reject_reason():
+    obs.enable_tracing()
+    sched = _sched()
+    sched.drain()
+    with pytest.raises(serving.ServerDrainingError):
+        sched.submit("m", {"data": np.ones(FEAT, np.float32)})
+    with pytest.raises(serving.UnknownModelError):
+        sched.submit("nope", {"data": np.ones(FEAT, np.float32)})
+    sched.close()
+    sheds = [s for s in tracing.spans() if s.name == "serving.shed"]
+    assert [s.attrs["reason"] for s in sheds] \
+        == ["draining", "unknown_model"]
+    assert sheds[0].attrs["error"] == "ServerDrainingError"
+
+
+def test_metrics_endpoint_exemplars_are_opt_in():
+    obs.enable_tracing()
+    sched = _sched()
+    with tracing.span("client"):
+        sched.request("m", {"data": np.ones(FEAT, np.float32)})
+    sched.close()
+    with obs.start_metrics_server(port=0) as srv:
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            plain = resp.read().decode("utf-8")
+        with urllib.request.urlopen(srv.url + "?exemplars=1",
+                                    timeout=10) as resp:
+            rich = resp.read().decode("utf-8")
+    assert " # {" not in plain
+    assert re.search(r'serving_request_seconds_bucket\{[^}]*\} \S+'
+                     r' # \{trace_id="\d+:\d+"\}', rich)
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets
+# ---------------------------------------------------------------------------
+
+def test_slo_report_tracks_the_availability_budget():
+    sched = _sched()
+    for _ in range(8):
+        sched.request("m", {"data": np.ones(FEAT, np.float32)})
+    rows = {r["slo"]: r for r in oslo.report()["slos"]}
+    avail = rows["availability"]
+    assert avail["good"] == 8 and avail["bad"] == 0
+    assert not avail["exhausted"] and avail["budget_remaining"] == 1.0
+    assert rows["latency"]["kind"] == "latency"
+
+    sched.drain()
+    for _ in range(4):
+        with pytest.raises(serving.ServingError):
+            sched.submit("m", {"data": np.ones(FEAT, np.float32)})
+    sched.close()
+    avail = {r["slo"]: r for r in oslo.report()["slos"]}["availability"]
+    assert avail["bad"] == 4 and avail["exhausted"]
+    # the budget federates as a gauge
+    gauge = omet.REGISTRY.get("slo_error_budget_remaining")
+    assert gauge.labels("availability").value <= 0
+
+
+def test_slo_latency_counts_split_on_the_threshold_bucket():
+    text = (
+        'serving_request_seconds_bucket{model="m",le="0.1"} 7\n'
+        'serving_request_seconds_bucket{model="m",le="0.5"} 9\n'
+        'serving_request_seconds_bucket{model="m",le="+Inf"} 10\n')
+    slo = oslo.SLO("latency", 0.99, kind="latency", threshold_s=0.5)
+    assert slo.counts(federation._parse(text)) == (9.0, 1.0)
+
+
+def test_burn_rules_ride_default_rules_and_the_autoscaler():
+    names = [r.name for r in obs.default_rules()]
+    for want in ("slo_availability_fast_burn", "slo_latency_fast_burn",
+                 "slo_availability_slow_burn", "slo_latency_slow_burn"):
+        assert want in names
+    by_name = {r.name: r for r in obs.default_rules()}
+    assert by_name["slo_availability_fast_burn"].severity == "terminal"
+    assert by_name["slo_availability_slow_burn"].severity == "warning"
+    for rule in oslo.FAST_BURN_RULES:
+        assert rule in obs.WATCHED_RULES
+
+
+def _exposition(good, bad):
+    return ("serving_requests_total %d\n" % good
+            + "serving_rejected_total %d\n" % bad)
+
+
+def test_fast_burn_fires_once_with_exactly_one_flight_bundle(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    state = {"text": _exposition(1000, 0)}
+    slo = oslo.SLO("availability", 0.999)
+    wd = Watchdog(oslo.burn_rules(slos=[slo]),
+                  source=lambda: state["text"])
+    assert wd.evaluate(now=1000.0) == []          # baseline sample
+    state["text"] = _exposition(1000, 200)        # 100% errors: 1000x burn
+    active = {a.name for a in wd.evaluate(now=1010.0)}
+    assert "slo_availability_fast_burn" in active
+    assert "slo_availability_slow_burn" in active
+    # terminal fast burn: exactly ONE bundle on the rising edge...
+    bundles = [d for d in os.listdir(str(tmp_path))
+               if d.startswith("flight_")]
+    assert len(bundles) == 1 and "fast_burn" in bundles[0]
+    # ...and staying red adds none
+    wd.evaluate(now=1020.0)
+    assert len([d for d in os.listdir(str(tmp_path))
+                if d.startswith("flight_")]) == 1
+    fired = omet.REGISTRY.get("cluster_alerts_fired_total")
+    assert fired.labels("slo_availability_fast_burn").value == 1
+    # burn rate gauge carries the windowed value
+    burn = omet.REGISTRY.get("slo_burn_rate")
+    assert burn.labels("availability", "fast").value \
+        == pytest.approx(1000.0)
+    # alert edges land in the ops event log; quiet window resolves
+    wd.evaluate(now=1500.0)   # samples pruned, no traffic: burn clears
+    edges = [(e.fields["name"], e.fields["state"])
+             for e in obs.events("alert")
+             if e.fields["name"] == "slo_availability_fast_burn"]
+    assert edges == [("slo_availability_fast_burn", "firing"),
+                     ("slo_availability_fast_burn", "resolved")]
+
+
+def test_slo_endpoint_serves_the_report():
+    sched = _sched()
+    sched.request("m", {"data": np.ones(FEAT, np.float32)})
+    sched.close()
+    with obs.start_metrics_server(port=0) as srv:
+        with urllib.request.urlopen(
+                srv.url.replace("/metrics", "/slo"), timeout=10) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/json")
+            payload = json.load(r)
+    rows = {row["slo"]: row for row in payload["slos"]}
+    assert rows["availability"]["good"] == 1
+
+
+# ---------------------------------------------------------------------------
+# structured ops event log
+# ---------------------------------------------------------------------------
+
+def test_event_ring_is_bounded_and_counts_drops(monkeypatch):
+    monkeypatch.setattr(oevents, "_buffer",
+                        collections.deque(maxlen=2))
+    for i in range(5):
+        obs.emit("test.tick", i=i)
+    evs = obs.events("test.tick")
+    assert [e.fields["i"] for e in evs] == [3, 4]
+    assert omet.REGISTRY.get("ops_events_dropped_total").value == 3
+    assert omet.REGISTRY.get("ops_events_total").labels(
+        "test.tick").value == 5
+
+
+def test_event_serialization_never_fails():
+    ev = obs.emit("test.blob", arr=np.zeros(2), ok=True, n=3, f=0.5,
+                  s="x", none=None)
+    d = ev.as_dict()
+    assert isinstance(d["arr"], str)          # repr-degraded
+    assert d["ok"] is True and d["n"] == 3 and d["f"] == 0.5
+    assert d["s"] == "x" and d["none"] is None
+    json.dumps(d)                              # JSON-safe by contract
+    # the emitting thread's active trace rides along
+    obs.enable_tracing()
+    with tracing.span("holder"):
+        ev = obs.emit("test.traced")
+    holder = [s for s in tracing.spans() if s.name == "holder"][0]
+    assert ev.trace == "%d:%d" % (os.getpid(), holder.span_id)
+
+
+def test_model_swap_emits_an_event():
+    sched = _sched()
+    sched.swap("m", _SumBackend())
+    sched.close()
+    swaps = obs.events("serving.model_swap")
+    assert len(swaps) == 1
+    assert swaps[0].fields["model"] == "m"
+    assert swaps[0].fields["backend"] == "_SumBackend"
+
+
+def test_events_endpoint_serves_jsonl_with_tail():
+    obs.emit("test.first", n=1)
+    obs.emit("test.second", n=2)
+    with obs.start_metrics_server(port=0) as srv:
+        with urllib.request.urlopen(
+                srv.url.replace("/metrics", "/events"), timeout=10) as r:
+            assert "x-ndjson" in r.headers["Content-Type"]
+            lines = r.read().decode("utf-8").splitlines()
+        with urllib.request.urlopen(
+                srv.url.replace("/metrics", "/events?tail=1"),
+                timeout=10) as r:
+            tail = r.read().decode("utf-8").splitlines()
+    kinds = [json.loads(l)["kind"] for l in lines]
+    assert kinds == ["test.first", "test.second"]
+    assert [json.loads(l)["kind"] for l in tail] == ["test.second"]
+
+
+def test_federation_merges_events_with_identity_labels():
+    obs.emit("test.fed", n=1)
+    # two in-process targets share ONE process-global ring: exactly-once
+    # under the first member's identity, mirroring the metrics dedup
+    fc = federation.FederatedCollector([
+        {"shard": 0, "role": "primary", "epoch": 1,
+         "registry": omet.REGISTRY},
+        {"shard": 0, "role": "standby", "epoch": 1,
+         "registry": omet.REGISTRY},
+    ])
+    rows = [json.loads(l) for l in fc.render_events().splitlines()]
+    fed = [r for r in rows if r["kind"] == "test.fed"]
+    assert len(fed) == 1
+    assert fed[0]["shard"] == "0" and fed[0]["role"] == "primary"
+
+
+def test_federation_scrapes_events_from_url_targets():
+    obs.emit("test.remote", n=7)
+    with obs.start_metrics_server(port=0) as srv:
+        fc = federation.FederatedCollector([
+            {"shard": 3, "role": "serving", "epoch": 0,
+             "url": srv.url}])
+        rows = [json.loads(l) for l in fc.render_events().splitlines()]
+    remote = [r for r in rows if r["kind"] == "test.remote"]
+    assert remote and remote[0]["shard"] == "3"
+
+
+def test_flight_bundle_drains_the_event_ring(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.emit("test.incident", n=1)
+    bundle = obs.record_failure("test", RuntimeError("boom"))
+    path = os.path.join(bundle, "events.jsonl")
+    assert os.path.exists(path)
+    with open(path, encoding="utf-8") as f:
+        kinds = [json.loads(l)["kind"] for l in f if l.strip()]
+    assert "test.incident" in kinds
+
+
+# ---------------------------------------------------------------------------
+# MXNET_TPU_METRICS=0: every new path is a constant-time guard
+# ---------------------------------------------------------------------------
+
+def test_disabled_paths_are_constant_time(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    calls = []
+    monkeypatch.setattr(oevents, "_record",
+                        lambda ev: calls.append(ev))
+    assert obs.emit("test.gated", n=1) is None
+    assert calls == []
+
+    # slo.report answers without parsing anything
+    monkeypatch.setattr(
+        federation, "_parse",
+        lambda text: pytest.fail("parsed under METRICS=0"))
+    assert oslo.report() == {"slos": [], "disabled": True}
+
+    # event federation never scrapes
+    monkeypatch.setattr(
+        federation, "_scrape_events",
+        lambda target, timeout: pytest.fail("scraped under METRICS=0"))
+    fc = federation.FederatedCollector(
+        [{"shard": 0, "role": "primary", "epoch": 0, "text": "x 1\n"}])
+    assert fc.render_events() == ""
+
+    # the watchdog (and with it the burn rules) stands down
+    wd = Watchdog(oslo.burn_rules(), source="serving_requests_total 1\n")
+    assert wd.evaluate(now=1.0) == []
+
+
+def test_disabled_frontend_still_answers_with_request_ids(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    sched = _sched()
+    with serving.start_frontend(sched) as fe:
+        status, hdrs, out = _post(fe.url + "/v1/predict",
+                                  {"model": "m", "inputs": {"data": ROW}})
+    sched.close()
+    assert status == 200 and out["outputs"][0] == [1.0]
+    assert re.match(r"^\d+:r\d+$", hdrs.get("X-MXTPU-Request-Id", ""))
+    assert obs.events("serving.access") == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chaos + 4-thread load -> one merged trace + one bundle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_load_yields_one_linked_trace_and_one_bundle(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable_tracing()
+    sched = _sched(max_queue=128)
+    fe = serving.start_frontend(sched)
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(8):
+            status, hdrs, _ = _post(fe.url + "/v1/predict",
+                                    {"model": "m",
+                                     "inputs": {"data": ROW}})
+            with lock:
+                results.append((status, hdrs.get("X-MXTPU-Request-Id")))
+
+    # the first two dispatch windows raise; retries recover, so every
+    # accepted request still answers 200
+    with chaos.inject("serving.dispatch", "raise", prob=1.0, seed=7,
+                      limit=2):
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert [s for s, _ in results] == [200] * 32
+    accepted_rids = [rid for _, rid in results]
+    assert all(rid for rid in accepted_rids)
+
+    # one shed request after drain: typed reason on the wire + in trace
+    sched.drain()
+    status, hdrs, err = _post(fe.url + "/v1/predict",
+                              {"model": "m", "inputs": {"data": ROW}})
+    assert status == 503 and err["type"] == "ServerDrainingError"
+    shed_rid = hdrs.get("X-MXTPU-Request-Id")
+    fe.close()
+    sched.close()
+
+    # ---- ONE merged Chrome trace carries every link -----------------
+    merged = obs.merge_chrome_traces(
+        [obs.export_chrome_trace(include_native=False, track="server")],
+        path=str(tmp_path / "merged.json"))
+    events = merged["traceEvents"]
+    uids = {e["args"].get("span_uid") for e in events if "args" in e}
+    dispatches = [e for e in events if e.get("name") == "serving.dispatch"]
+
+    # an accepted request's root span links into its batch dispatch
+    linked = {tok for d in dispatches for tok in d["args"]["requests"]}
+    assert set(accepted_rids) <= linked
+    # the chaos fault produced a failed attempt AND its retry, over the
+    # same packed request set
+    failed = [d for d in dispatches if "error" in d["args"]]
+    assert failed and all(d["args"]["error"] == "ChaosError"
+                          for d in failed)
+    for d in failed:
+        retry = [r for r in dispatches
+                 if r["args"]["requests"] == d["args"]["requests"]
+                 and r["args"]["attempt"] == d["args"]["attempt"] + 1]
+        assert retry, "no retry dispatch span after the injected fault"
+    # the shed request's terminal span carries the typed reason, inside
+    # the request's root span
+    sheds = [e for e in events if e.get("name") == "serving.shed"]
+    assert sheds and sheds[-1]["args"]["reason"] == "draining"
+    shed_roots = [e for e in events
+                  if e.get("name") == "serving.request"
+                  and e["args"].get("request_id") == shed_rid]
+    assert shed_roots \
+        and sheds[-1]["args"]["parent_uid"] \
+        == shed_roots[0]["args"]["span_uid"]
+
+    # a latency exemplar resolves to a span in the merged trace
+    rich = obs.dump_metrics(exemplars=True)
+    tokens = set(re.findall(r'trace_id="(\d+:\d+)"', rich))
+    assert tokens and tokens <= uids
+
+    # ---- synthetic fast-burn breach: fires once, ONE bundle ---------
+    wd = Watchdog(oslo.burn_rules(slos=[oslo.SLO("availability",
+                                                 0.999)]))
+    assert wd.evaluate(now=5000.0) == []        # baseline over registry
+    rejected = omet.REGISTRY.get("serving_rejected_total")
+    rejected.labels("m", "overload").inc(50)    # synthetic breach
+    active = [a.name for a in wd.evaluate(now=5010.0)]
+    assert "slo_availability_fast_burn" in active
+    wd.evaluate(now=5020.0)                     # staying red adds none
+    bundles = [d for d in os.listdir(str(tmp_path))
+               if d.startswith("flight_")]
+    assert len(bundles) == 1 and "fast_burn" in bundles[0]
+    fired = [e for e in obs.events("alert")
+             if e.fields["name"] == "slo_availability_fast_burn"
+             and e.fields["state"] == "firing"]
+    assert len(fired) == 1

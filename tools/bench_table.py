@@ -43,7 +43,10 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               "step_ms_p50": False, "step_ms_p99": False,
               # schema-5 serving keys (BENCH_SERVING=1 rounds)
               "requests_per_sec": True, "batch_occupancy": True,
-              "request_ms_p50": False, "request_ms_p99": False}
+              "request_ms_p50": False, "request_ms_p99": False,
+              # schema-8 observability keys (BENCH_SERVING=1 rounds)
+              "slo_availability": True,
+              "request_trace_overhead_pct": False}
 TREND_TOLERANCE = 0.10
 
 

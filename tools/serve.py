@@ -181,7 +181,16 @@ def smoke():
             "model": "mlp", "inputs": {"data": [0.2] * feat}})
         assert status == 200, out
         assert group.membership()["epoch"] == 1
-        print("predict, shed, and failover paths all answered")
+        # every request left a structured access-log event behind
+        from mxnet_tpu import observability as obs
+
+        access = obs.events("serving.access")
+        assert access, "no serving.access event in the ops log"
+        ok = [e for e in access if e.fields.get("status") == 200
+              and e.fields.get("model") == "mlp"]
+        assert ok and ok[-1].fields.get("latency_ms") is not None, [
+            e.as_dict() for e in access]
+        print("predict, shed, failover, and access-log paths all answered")
     group.close()
     print("serve smoke OK")
     return 0
