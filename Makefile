@@ -43,6 +43,9 @@ watchdog:
 elastic:
 	python tools/elastic_fit.py
 
+continuous:
+	python tools/continuous_fit.py
+
 serve:
 	python tools/serve.py --smoke
 
@@ -53,4 +56,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	dryrun dist-test chaos trace watchdog elastic serve slo clean
+	dryrun dist-test chaos trace watchdog elastic continuous serve slo \
+	clean

@@ -78,7 +78,10 @@ def _obs_counters():
 # v8: request_trace_overhead_pct (serving throughput with the metrics
 # plane on vs MXNET_TPU_METRICS=0) / slo_availability from the
 # per-request observability plane
-_SCHEMA_VERSION = 8
+# v9: stream_mb_per_sec / data_wait_pct / swap_downtime_ms from the
+# BENCH_CONTINUOUS=1 continuous-training lane (streamed recordio fit
+# on the prefetch feeder + one hot-swap under a client hammer)
+_SCHEMA_VERSION = 9
 
 
 def _bench_peak():
@@ -523,6 +526,120 @@ def elastic_main():
     }))
 
 
+def continuous_main():
+    """Continuous-training lane (BENCH_CONTINUOUS=1): a streamed
+    recordio fit on the pipelined prefetch feeder, then one gated
+    hot-swap under a hammering client.  Emits the schema-9 additive
+    keys: ``stream_mb_per_sec`` (recordio bytes decoded per fit
+    second), ``data_wait_pct`` (data-wait badput share of the fit
+    wall — the stall the background decode is supposed to overlap
+    away) and ``swap_downtime_ms`` (longest gap between answered
+    requests across the ``ModelRegistry.swap``)."""
+    import tempfile
+    import threading
+
+    import jax
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu import serving, stream
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    batch = int(os.environ.get("BENCH_STREAM_BATCH", "32"))
+    dim = int(os.environ.get("BENCH_STREAM_DIM", "256"))
+    hidden = int(os.environ.get("BENCH_STREAM_HIDDEN", "512"))
+    n = int(os.environ.get("BENCH_STREAM_RECORDS", str(48 * batch)))
+
+    rs = np.random.RandomState(0)
+    rec = os.path.join(tempfile.mkdtemp(prefix="mxtpu_bench_stream_"),
+                       "train.rec")
+    stream.write_ndarray_records(
+        rec, rs.randn(n, dim).astype(np.float32),
+        (np.arange(n) % 8).astype(np.float32))
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(net, mesh, data_shapes={"data": (batch, dim)},
+                        label_shapes={"softmax_label": (batch,)},
+                        optimizer="sgd",
+                        optimizer_params={"lr": 0.1,
+                                          "rescale_grad": 1.0 / batch},
+                        pipeline_steps=4)
+
+    def _counter(name, label=None):
+        fam = obs.REGISTRY.get(name)
+        if fam is None:
+            return 0.0
+        return fam.labels(label).value if label else fam.total()
+
+    wait0 = _counter("badput_seconds_total", "data_wait")
+    bytes0 = _counter("stream_bytes_read_total")
+    t0 = time.perf_counter()
+    (params, _, _), _ = tr.fit(
+        stream.StreamDataIter([rec], (dim,), batch, seed=7),
+        num_epoch=2, seed=5, log_every=0)
+    wall = time.perf_counter() - t0
+    mb_s = (_counter("stream_bytes_read_total") - bytes0) / wall / 2**20
+    wait_pct = 100.0 * (_counter("badput_seconds_total", "data_wait")
+                        - wait0) / wall
+
+    # one hot-swap under live single-row traffic: downtime = longest
+    # answer gap a hammering client saw across the swap window
+    class _NpBackend(serving.Backend):
+        def __init__(self, p):
+            self.p = {k: np.asarray(v) for k, v in p.items()}
+            self.input_shapes = {"data": (dim,)}
+
+        def infer(self, b):
+            h = np.maximum(np.asarray(b["data"], np.float64)
+                           @ self.p["fc1_weight"].T + self.p["fc1_bias"],
+                           0)
+            return [h @ self.p["fc2_weight"].T + self.p["fc2_bias"]], \
+                False
+
+    sched = serving.Scheduler()
+    sched.register("mlp", _NpBackend(params), buckets=[1, 4])
+    row = {"data": rs.randn(dim).astype(np.float32)}
+    stamps = []
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            sched.request("mlp", dict(row), timeout=10)
+            stamps.append(time.perf_counter())
+
+    client = threading.Thread(target=pound)
+    client.start()
+    time.sleep(0.1)
+    sched.swap("mlp", _NpBackend(params))
+    time.sleep(0.1)
+    stop.set()
+    client.join()
+    gaps = np.diff(np.asarray(stamps)) if len(stamps) > 1 else [0.0]
+    swap_ms = float(np.max(gaps)) * 1e3
+
+    print(json.dumps({
+        "metric": "stream_throughput",
+        "value": round(mb_s, 3),
+        "unit": "MB/s",
+        "vs_baseline": 0.0,  # the 2017 reference has no streamed lane
+        "stream_mb_per_sec": round(mb_s, 3),
+        "data_wait_pct": round(wait_pct, 3),
+        "swap_downtime_ms": round(swap_ms, 3),
+        "requests_across_swap": len(stamps),
+        "elapsed_s": round(wall, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"batch": batch, "dim": dim, "hidden": hidden,
+                   "records": n},
+    }))
+
+
 def main():
     import jax
     import mxnet_tpu  # noqa: F401
@@ -530,6 +647,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_CONTINUOUS") == "1":
+        continuous_main()
+        return
     if os.environ.get("BENCH_ELASTIC") == "1":
         elastic_main()
         return

@@ -109,6 +109,8 @@ from . import rtc
 from . import torch_bridge
 from . import torch_bridge as th
 from . import parallel
+from . import stream
+from . import deployd
 from . import contrib
 from . import models
 from . import test_utils

@@ -18,6 +18,7 @@ __all__ = [
     "ResizeAbortedError",
     "TruncatedMessageError",
     "CorruptMessageError",
+    "StreamStallError",
     "string_types",
     "numeric_types",
     "DTYPE_TO_STR",
@@ -90,7 +91,25 @@ class CorruptMessageError(MXNetError, ValueError):
     ``MXNET_TPU_PS_MAX_MSG_MB`` cap.  The socket may be desynchronized
     mid-stream, so the client tears the connection down before
     surfacing it.  Subclasses ``ValueError`` so pre-existing corrupt-
-    frame handlers keep classifying it."""
+    frame handlers keep classifying it.
+
+    Also raised by ``recordio.MXRecordIO.read`` for a truncated or
+    garbled on-disk record (bad magic, short header, short payload):
+    a data-plane frame failing validation is the same failure class as
+    a wire frame failing it, and a typed error is what lets the
+    streaming loader's skip-and-count mode exist at all."""
+
+
+class StreamStallError(MXNetError, TimeoutError):
+    """A streaming data source stopped producing past its staleness
+    bound: ``PrefetchFeeder.next_chunk`` waited longer than the
+    configured stall deadline with the upstream chunk still pending,
+    or ``fit_stream``'s bounded retries exhausted against a stalled
+    iterator.  The feeder is NOT poisoned by this — the caller may
+    retry the same ``next_chunk`` once the source recovers — which is
+    exactly how the trainer's bounded-retry/backoff loop uses it.
+    Subclasses ``TimeoutError`` so generic deadline handlers classify
+    it without importing the framework."""
 
 
 string_types = (str,)

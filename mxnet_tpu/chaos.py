@@ -38,6 +38,13 @@ site                      planted at
                           ``grow:<group>`` / ``shrink:<group>`` — a fired
                           rule aborts the action before any membership
                           change)
+``data.read``             RecordIO record read (``MXRecordIO.read``;
+                          ``name`` is the stream's uri).  ``corrupt``
+                          garbles the record header so the magic check
+                          trips; ``drop`` raises the typed
+                          ``CorruptMessageError`` the production
+                          skip-and-count handler catches; ``delay``
+                          stretches the stream-stall window
 ========================  ==================================================
 
 Four failure modes:
@@ -88,7 +95,7 @@ SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
     "kvstore.resize_drop", "checkpoint.write", "serving.admit",
-    "serving.dispatch", "serving.scale",
+    "serving.dispatch", "serving.scale", "data.read",
 })
 
 
@@ -117,6 +124,10 @@ def _drop_exc(site):
         return ConnectionResetError("chaos: replication frame dropped")
     if site == "kvstore.resize_drop":
         return ConnectionResetError("chaos: resize transfer dropped")
+    if site == "data.read":
+        from . import base
+
+        return base.CorruptMessageError("chaos: record dropped mid-read")
     return ChaosDrop("chaos: dropped at %s" % site)
 
 

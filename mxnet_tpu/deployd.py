@@ -1,0 +1,408 @@
+"""Deployment daemon: gated checkpoint hot-swap with automatic rollback.
+
+The continuous-training loop's consumer half.  ``fit_stream`` (the
+producer) drops sharded checkpoints into a directory every N steps;
+:class:`DeployDaemon` watches that directory and walks each new step
+through a promotion pipeline:
+
+1. **Restore-validate** — the caller's ``loader(checkpoint_dir, step)``
+   builds a serving backend from the checkpoint; any exception
+   (corrupt shard, layout mismatch) rejects the candidate, it never
+   touches traffic.
+2. **Eval floor** — ``eval_fn(backend)`` must return a finite score,
+   and at least ``eval_floor`` (default
+   ``MXNET_TPU_DEPLOYD_EVAL_FLOOR``) when a floor is set.
+3. **Golden-metrics diff** — the candidate runs a pinned golden batch;
+   non-finite outputs always reject, and when ``golden_max_drift`` is
+   set its outputs must stay within that max-abs-diff of the currently
+   serving model's on the same batch (a guard against a checkpoint
+   that loads fine but answers garbage).
+
+A candidate that clears the gate is promoted with
+:meth:`~mxnet_tpu.serving.registry.ModelRegistry.swap` on every live
+replica — each swap lands between dispatch windows under the entry's
+``dispatch_lock``, and the replica group's router keeps answering from
+peers mid-swap, so accepted requests are never dropped (brownout, not
+blackout).  The displaced backends are **pinned**.
+
+Promotion opens a **probation window** (``probation_s``, default
+``MXNET_TPU_DEPLOYD_PROBATION_S``): a fresh :class:`~mxnet_tpu.
+observability.watchdog.Watchdog` over the error-budget burn-rate rules
+(:func:`~mxnet_tpu.observability.slo.burn_rules`) — or the caller's
+``rules`` factory — is evaluated on every poll.  A **terminal** alert
+inside the window triggers exactly ONE rollback: every replica swaps
+back to its pinned previous backend, the decision is emitted as a
+``deploy.rollback`` ops event naming the rule, and a flight-recorder
+bundle (``deployd.rollback``) captures the postmortem.  No new
+candidate is considered while probation is open — one change in
+flight at a time.
+
+Every decision (``deploy.promote`` / ``deploy.reject`` /
+``deploy.rollback``) is an ops event and a metrics increment, so "the
+daemon rolled back exactly once, for this rule" is a testable
+statement.  ``poll_once(now=)`` takes an injectable clock for
+deterministic tests; :meth:`start` runs the same poll on a daemon
+thread every ``MXNET_TPU_DEPLOYD_POLL_S`` seconds for real deploys.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time as _time
+
+import numpy as _np
+
+from .base import MXNetError
+from .observability import flight_recorder as _flight
+from .observability import metrics as _metrics
+from .observability import watchdog as _watchdog
+from .observability.events import emit as _emit_event
+from .parallel import checkpoint as _ckpt
+
+__all__ = ["DeployDaemon"]
+
+_M_PROMOTE = _metrics.counter(
+    "deployd_promotions_total",
+    "Checkpoint candidates that cleared the validation gate and were "
+    "hot-swapped onto the serving replicas")
+_M_REJECT = _metrics.counter(
+    "deployd_rejections_total",
+    "Checkpoint candidates rejected by the validation gate, by stage",
+    ["reason"])
+_M_ROLLBACK = _metrics.counter(
+    "deployd_rollbacks_total",
+    "Automatic rollbacks: a terminal watchdog alert fired inside the "
+    "post-promotion probation window")
+_M_LIVE = _metrics.gauge(
+    "deployd_live_step",
+    "Checkpoint step currently serving traffic (0 = the pre-daemon "
+    "baseline backend)")
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _default_rules():
+    from .observability import slo as _slo
+
+    return _slo.burn_rules()
+
+
+def _finite(arrays):
+    for a in arrays:
+        if not _np.all(_np.isfinite(_np.asarray(a, dtype=_np.float64))):
+            return False
+    return True
+
+
+class DeployDaemon(object):
+    """Watch ``checkpoint_dir`` and gate-promote new steps onto ``group``.
+
+    Parameters
+    ----------
+    checkpoint_dir : str
+        Directory ``fit_stream``/``fit`` saves sharded checkpoints into.
+    group : ReplicaGroup | Scheduler | ModelRegistry
+        Where promotions land.  A :class:`~mxnet_tpu.serving.replication.
+        ReplicaGroup` swaps every live replica; a single scheduler or
+        bare registry swaps just itself.
+    model : str
+        The registered model name being continuously redeployed.
+    loader : callable(checkpoint_dir, step) -> Backend
+        Restore-validate: build a serving backend from the checkpoint.
+        Called once per replica on promotion (replicas never share
+        executors); any exception rejects the candidate.
+    eval_fn : callable(backend) -> float, optional
+        Offline eval score for the gate; non-finite always rejects.
+    eval_floor : float, optional
+        Minimum accepted ``eval_fn`` score (default
+        ``MXNET_TPU_DEPLOYD_EVAL_FLOOR``; unset = finite-only check).
+    golden_batch : dict name -> array, optional
+        A pinned batch for the golden-metrics diff (already padded to a
+        served bucket shape).
+    golden_max_drift : float, optional
+        Max abs output drift vs the CURRENT model on the golden batch.
+    probation_s : float
+        Post-promotion watch window (default
+        ``MXNET_TPU_DEPLOYD_PROBATION_S``).
+    rules : callable() -> [Rule], optional
+        Factory for the probation watchdog's rules — called fresh per
+        promotion, because rules are stateful.  Default:
+        :func:`~mxnet_tpu.observability.slo.burn_rules` (the fast-burn
+        rules are terminal and trigger rollback).
+    watchdog_source : optional
+        Metrics source for the probation watchdog (default: the
+        process-global registry).
+    """
+
+    def __init__(self, checkpoint_dir, group, model, loader,
+                 eval_fn=None, eval_floor=None, golden_batch=None,
+                 golden_max_drift=None, probation_s=None, rules=None,
+                 watchdog_source=None, logger=None):
+        self.checkpoint_dir = checkpoint_dir
+        self.model = model
+        self._group = group
+        self._loader = loader
+        self._eval_fn = eval_fn
+        if eval_floor is None:
+            raw = os.environ.get("MXNET_TPU_DEPLOYD_EVAL_FLOOR", "")
+            eval_floor = float(raw) if raw else None
+        self._eval_floor = eval_floor
+        self._golden = golden_batch
+        self._golden_max_drift = golden_max_drift
+        self._probation_s = (
+            _env_float("MXNET_TPU_DEPLOYD_PROBATION_S", 60.0)
+            if probation_s is None else float(probation_s))
+        self._rules = rules if rules is not None else _default_rules
+        self._watch_source = watchdog_source
+        self._log = logger or logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        self._last_scanned = -1   # newest step already decided on
+        self._live_step = None    # step serving traffic (None = baseline)
+        self._pinned = None       # {"step", "prev_step", "olds": [(t, b)]}
+        self._probation_until = None
+        self._dog = None
+        self.history = []         # decision dicts, oldest first
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- targets --------------------------------------------------------
+
+    def _targets(self):
+        """The swap targets: every live replica of a group, or the bare
+        scheduler/registry itself."""
+        if hasattr(self._group, "live"):
+            return [s for _, s in self._group.live()]
+        return [self._group]
+
+    def _current_backend(self):
+        targets = self._targets()
+        if not targets:
+            return None
+        t = targets[0]
+        registry = getattr(t, "registry", t)
+        return registry.get(self.model).backend
+
+    # -- the gate -------------------------------------------------------
+
+    def _reject(self, step, reason, detail):
+        _M_REJECT.labels(reason).inc()
+        _emit_event("deploy.reject", model=self.model, step=int(step),
+                    reason=reason, detail=str(detail)[:500])
+        decision = {"action": "reject", "step": step, "reason": reason,
+                    "detail": str(detail)}
+        self.history.append(decision)
+        self._log.warning("deployd: rejected step %d at gate %r: %s",
+                          step, reason, detail)
+        return decision
+
+    def _gate(self, step):
+        """Run the candidate through the gate; returns the validated
+        backend or None (rejection already recorded)."""
+        try:
+            backend = self._loader(self.checkpoint_dir, step)
+        except Exception as exc:  # noqa: BLE001 — any load failure rejects
+            self._reject(step, "restore", exc)
+            return None
+        if self._eval_fn is not None:
+            try:
+                score = float(self._eval_fn(backend))
+            except Exception as exc:  # noqa: BLE001
+                self._reject(step, "eval", exc)
+                return None
+            if not math.isfinite(score):
+                self._reject(step, "eval", "non-finite score %r" % score)
+                return None
+            if self._eval_floor is not None and score < self._eval_floor:
+                self._reject(step, "eval_floor",
+                             "score %.6g < floor %.6g"
+                             % (score, self._eval_floor))
+                return None
+        if self._golden is not None:
+            try:
+                outs, _cold = backend.infer(dict(self._golden))
+            except Exception as exc:  # noqa: BLE001
+                self._reject(step, "golden", exc)
+                return None
+            if not _finite(outs):
+                self._reject(step, "golden",
+                             "non-finite outputs on the golden batch")
+                return None
+            if self._golden_max_drift is not None:
+                current = self._current_backend()
+                if current is not None:
+                    ref, _ = current.infer(dict(self._golden))
+                    drift = max(
+                        float(_np.max(_np.abs(_np.asarray(a, _np.float64)
+                                              - _np.asarray(b, _np.float64))))
+                        for a, b in zip(outs, ref))
+                    if drift > self._golden_max_drift:
+                        self._reject(
+                            step, "golden_drift",
+                            "max output drift %.6g > bound %.6g"
+                            % (drift, self._golden_max_drift))
+                        return None
+        return backend
+
+    # -- promote / rollback --------------------------------------------
+
+    def _promote_locked(self, step, backend, now):
+        targets = self._targets()
+        if not targets:
+            raise MXNetError("deployd: no live replicas to promote onto")
+        backends = [backend]
+        for _ in targets[1:]:
+            # each replica gets its own backend (executors not shared);
+            # a load that succeeded once and fails now still rejects
+            try:
+                backends.append(self._loader(self.checkpoint_dir, step))
+            except Exception as exc:  # noqa: BLE001
+                self._reject(step, "restore", exc)
+                return None
+        olds = []
+        for t, b in zip(targets, backends):
+            olds.append((t, t.swap(self.model, b)))
+        prev = self._live_step
+        self._pinned = {"step": step, "prev_step": prev, "olds": olds}
+        self._live_step = step
+        self._probation_until = now + self._probation_s
+        # fresh rules per probation: rule state (burn windows, sustain
+        # timers) must start at the promotion edge, not carry history
+        self._dog = _watchdog.Watchdog(rules=self._rules(),
+                                       source=self._watch_source)
+        self._dog.evaluate(now=now)  # baseline sample for the windows
+        _M_PROMOTE.inc()
+        _M_LIVE.set(step)
+        _emit_event("deploy.promote", model=self.model, step=int(step),
+                    replicas=len(olds), prev_step=prev,
+                    probation_s=self._probation_s)
+        decision = {"action": "promote", "step": step, "prev_step": prev,
+                    "replicas": len(olds)}
+        self.history.append(decision)
+        self._log.info("deployd: promoted step %d onto %d replica(s); "
+                       "probation %.1fs", step, len(olds),
+                       self._probation_s)
+        return decision
+
+    def _rollback_locked(self, rule_name, alert, now):
+        pinned, self._pinned = self._pinned, None
+        self._probation_until = None
+        self._dog = None
+        for t, old in pinned["olds"]:
+            try:
+                t.swap(self.model, old)
+            except Exception:  # noqa: BLE001 — a fenced replica mid-swap
+                self._log.exception(
+                    "deployd: rollback swap failed on one replica "
+                    "(fenced mid-probation?) — continuing")
+        self._live_step = pinned["prev_step"]
+        _M_ROLLBACK.inc()
+        _M_LIVE.set(pinned["prev_step"] or 0)
+        _emit_event("deploy.rollback", model=self.model,
+                    step=int(pinned["step"]),
+                    restored_step=pinned["prev_step"], rule=rule_name)
+        _flight.record_failure(
+            "deployd.rollback", None, rule=rule_name,
+            step=int(pinned["step"]),
+            restored_step=pinned["prev_step"],
+            alert=alert.as_dict() if alert is not None else None)
+        decision = {"action": "rollback", "step": pinned["step"],
+                    "restored_step": pinned["prev_step"],
+                    "rule": rule_name}
+        self.history.append(decision)
+        self._log.error(
+            "deployd: rolled back step %r -> %r (watchdog rule %r fired "
+            "in probation)", pinned["step"], pinned["prev_step"],
+            rule_name)
+        return decision
+
+    # -- the poll -------------------------------------------------------
+
+    def poll_once(self, now=None):
+        """One state-machine turn; returns the decision made (a dict
+        with ``action`` of ``promote``/``reject``/``rollback``/
+        ``probation_pass``) or None when nothing changed.  ``now``
+        (monotonic seconds) is injectable so tests drive the probation
+        and burn-rate windows deterministically."""
+        if now is None:
+            now = _time.monotonic()
+        with self._lock:
+            if self._probation_until is not None:
+                alerts = self._dog.evaluate(now=now)
+                terminal = [a for a in alerts if a.severity == "terminal"]
+                if terminal:
+                    return self._rollback_locked(terminal[0].name,
+                                                 terminal[0], now)
+                if now >= self._probation_until:
+                    step = self._pinned["step"]
+                    self._probation_until = None
+                    self._dog = None
+                    decision = {"action": "probation_pass", "step": step}
+                    self.history.append(decision)
+                    self._log.info(
+                        "deployd: step %d survived probation", step)
+                    return decision
+                return None
+            steps = [s for s in _ckpt.all_steps(self.checkpoint_dir)
+                     if s > self._last_scanned]
+            if not steps:
+                return None
+            # newest candidate wins; the ones it lapped are superseded,
+            # not gated — a backlog never triggers N swaps
+            step = steps[-1]
+            self._last_scanned = step
+            for lapped in steps[:-1]:
+                self.history.append({"action": "superseded",
+                                     "step": lapped, "by": step})
+            backend = self._gate(step)
+            if backend is None:
+                return self.history[-1]
+            return self._promote_locked(step, backend, now)
+
+    # -- background loop ------------------------------------------------
+
+    def start(self, poll_s=None):
+        """Poll every ``poll_s`` (default ``MXNET_TPU_DEPLOYD_POLL_S``)
+        on a daemon thread."""
+        interval = (_env_float("MXNET_TPU_DEPLOYD_POLL_S", 5.0)
+                    if poll_s is None else float(poll_s))
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001
+                    # the daemon must outlive a bad poll; the decision
+                    # trail and flight bundles carry the evidence
+                    self._log.exception("deployd: poll failed")
+
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=loop, name="mxtpu-deployd", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def describe(self):
+        """Current state for ops endpoints/logs."""
+        with self._lock:
+            return {"model": self.model, "live_step": self._live_step,
+                    "probation_open": self._probation_until is not None,
+                    "last_scanned": self._last_scanned,
+                    "decisions": len(self.history)}

@@ -485,6 +485,18 @@ def default_rules():
              description="the last fit's goodput ratio fell below the "
                          "floor — badput_seconds_total{cause} says "
                          "where the wall time went"),
+        # streaming data plane (parallel/trainer.py fit_stream): each
+        # stall is one bounded-retry episode, so a sustained run of them
+        # inside the window means the source is down, not hiccuping
+        Rule("stream_stall", "stream_stalls_total", kind="increase",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_STREAM_STALLS",
+                                  3.0),
+             window_s=_env_float(
+                 "MXNET_TPU_WATCHDOG_STREAM_STALLS_WINDOW_S", 300.0),
+             severity="critical",
+             description="the streaming source kept stalling past the "
+                         "bounded-staleness limit — fit_stream is in "
+                         "its retry/backoff loop, not making progress"),
         # serving-tier SLOs (serving/scheduler.py)
         Rule("request_p99_slo", "serving_request_seconds", stat="p99",
              threshold=_env_float("MXNET_TPU_WATCHDOG_REQUEST_P99", 1.0),

@@ -27,14 +27,26 @@ Built on the engine's var machinery rather than ad-hoc threads:
 
 from __future__ import annotations
 
+import os as _os
 import time as _time
 from collections import namedtuple
 
 from .. import engine as _engine
+from ..base import StreamStallError
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 
 __all__ = ["PrefetchFeeder", "Chunk"]
+
+
+def _stall_default():
+    """``MXNET_TPU_PREFETCH_STALL_S``: default bounded-staleness limit
+    for ``next_chunk`` (seconds; 0/unset = wait forever, the classic
+    in-memory-iterator behavior where the data always arrives)."""
+    try:
+        return float(_os.environ.get("MXNET_TPU_PREFETCH_STALL_S", "0") or 0)
+    except ValueError:
+        return 0.0
 
 # pre-resolved handles; one feeder at a time per name is the normal shape,
 # so the series are unlabeled process aggregates
@@ -159,19 +171,31 @@ class PrefetchFeeder(object):
                      name="%s.fetch%d" % (self._name, i), on_drop=lost)
 
     # -- consumer side (training loop thread) --------------------------
-    def next_chunk(self):
+    def next_chunk(self, timeout=None):
         """Block until the next chunk is staged; return it, or ``None``
         once the iterator is exhausted.  Re-raises (at this sync point) the
         ORIGINAL exception of a failed fetch; raises ``RuntimeError`` when
         a fetch op was silently dropped.  Consuming a chunk immediately
-        pushes the refill fetch for its slot."""
+        pushes the refill fetch for its slot.
+
+        ``timeout`` (seconds; default ``MXNET_TPU_PREFETCH_STALL_S``,
+        unset = wait forever) is the bounded-staleness guard for
+        unbounded streams: if the slot's fetch is still pending past the
+        deadline, raises :class:`~mxnet_tpu.base.StreamStallError`
+        WITHOUT corrupting feeder state — the in-flight fetch keeps its
+        slot, and the same ``next_chunk`` call may simply be retried
+        once the source recovers."""
         if self._closed:
             raise RuntimeError("%s is closed" % self._name)
         if self._done:
             return None
+        if timeout is None:
+            timeout = _stall_default()
         i = self._cursor
         t0 = _time.monotonic()
         with _tracing.span("prefetch.wait", cat="prefetch", slot=i):
+            if timeout and timeout > 0:
+                self._await_slot(i, t0 + timeout)
             _engine.wait_for_var(self._vars[i])  # poison re-raises here
         _M_STALL.inc(_time.monotonic() - t0)
         if self._broken is not None:
@@ -192,6 +216,22 @@ class PrefetchFeeder(object):
         _M_CHUNKS.inc()
         self._push(i)
         return chunk
+
+    def _await_slot(self, i, deadline):
+        """Poll until slot ``i`` resolves (staged / END / poisoned /
+        broken) or the deadline passes.  ``wait_for_var`` has no timeout
+        — it parks on the engine's completion event — so the bounded
+        wait watches the slot state the fetch op publishes instead, and
+        only falls through to the (then-instant) var wait."""
+        while (self._slots[i] is _PENDING
+               and getattr(self._vars[i], "_poison", None) is None
+               and self._broken is None):
+            if _time.monotonic() >= deadline:
+                raise StreamStallError(
+                    "%s: slot %d still pending after stall limit — "
+                    "upstream data source is stalled (retryable: the "
+                    "fetch stays in flight)" % (self._name, i))
+            _time.sleep(0.005)
 
     def reset(self):
         """Recovery/restart point: drain in-flight fetches (swallowing

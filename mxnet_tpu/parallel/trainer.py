@@ -45,6 +45,15 @@ _M_COMPILES = _metrics.counter(
 _M_COMPILE_T = _metrics.histogram(
     "trainer_compile_seconds",
     "Wall time of each first-call trace+compile, by cache key", ["cache"])
+_M_STREAM_STALLS = _metrics.counter(
+    "stream_stalls_total",
+    "Stream-source stall timeouts surfaced to fit_stream; each is one "
+    "bounded-retry episode, never a silent hang (watchdog rule "
+    "stream_stall fires on a sustained run of them)")
+_M_STREAM_SKIPPED = _metrics.counter(
+    "stream_skipped_total",
+    "Chunks abandoned by fit_stream's skip-and-count degraded mode "
+    "after a typed corrupt-stream error")
 
 
 def auto_tp_specs(symbol, arg_shapes, mesh, data_axis="data", model_axis="model"):
@@ -1094,10 +1103,31 @@ class ShardedTrainer:
         base_key = _jax.random.fold_in(_jax.random.PRNGKey(rng_seed),
                                        rng_anchor)
 
+        # stream-capable iterators (state()/load_state(): StreamDataIter)
+        # carry their serialized cursor in the meta sidecar, so a
+        # mid-epoch resume restores the EXACT read position (file,
+        # offset, shuffle epoch) instead of replaying the epoch head;
+        # epoch starts go through seek_epoch(epoch) so the shuffle
+        # schedule is a pure function of the loop epoch on fresh and
+        # resumed runs alike
+        streamable = (hasattr(train_data, "state")
+                      and hasattr(train_data, "load_state"))
+        stream_state = [None]
+        stream_loaded = False
+        if streamable and resume_meta is not None:
+            st = resume_meta.get("stream")
+            if st is not None and int(st.get("epoch", -1)) == start_epoch:
+                train_data.load_state(st)
+                skip_batches = 0
+                stream_loaded = True
+
         def fit_meta(epoch, batch_in_epoch):
-            return {"global_step": global_step, "epoch": epoch,
+            meta = {"global_step": global_step, "epoch": epoch,
                     "batch_in_epoch": batch_in_epoch, "seed": rng_seed,
                     "base_epoch": rng_anchor}
+            if stream_state[0] is not None:
+                meta["stream"] = stream_state[0]
+            return meta
 
         # observability: handles resolved ONCE here; the loop pays one
         # method call per event (MXNET_TPU_METRICS=0 short-circuits it)
@@ -1187,7 +1217,12 @@ class ShardedTrainer:
 
         for epoch in range(start_epoch, end_epoch):
             metric.reset()
-            train_data.reset()
+            if stream_loaded and epoch == start_epoch:
+                pass  # cursor already at the bitwise mid-epoch position
+            elif streamable and hasattr(train_data, "seek_epoch"):
+                train_data.seek_epoch(epoch)
+            else:
+                train_data.reset()
             nbatch = 0
             if K == 1:
                 it = iter(train_data)
@@ -1209,6 +1244,11 @@ class ShardedTrainer:
                         skip_batches -= 1
                         nbatch += 1
                         continue
+                    if streamable:
+                        # the batch just pulled left the cursor exactly
+                        # at its end — the watermark the next periodic
+                        # checkpoint's meta will carry
+                        stream_state[0] = train_data.state()
                     arrays, data_names = batch_arrays(batch, train_data)
                     with _obs.span("trainer.step", step=global_step):
                         with att.phase("placement"):
@@ -1266,14 +1306,24 @@ class ShardedTrainer:
                     planned[0] += k
                     return k
 
+                def extract(b):
+                    # runs on the IO worker right after the iterator
+                    # pull, so a stream-capable iterator's cursor is
+                    # exactly at this batch's end: the snapshot rides
+                    # with the batch and the checkpoint at a flush end
+                    # gets the watermark of the last CONSUMED batch,
+                    # immune to the feeder's read-ahead
+                    arrays, data_names = batch_arrays(b, train_data)
+                    return (arrays, data_names,
+                            train_data.state() if streamable else None)
+
                 with _obs.span("trainer.prefetch_start"):
                     # fetch ops pushed by the constructor inherit this
                     # span as their cross-thread parent
                     feeder = _prefetch.PrefetchFeeder(
-                        iter(train_data),
-                        extract=lambda b: batch_arrays(b, train_data),
+                        iter(train_data), extract=extract,
                         place=lambda host: self.place_superbatch(
-                            [a for a, _ in host]),
+                            [h[0] for h in host]),
                         sizes=plan_size, depth=2, name="fit.prefetch")
                 try:
                     while True:
@@ -1306,7 +1356,9 @@ class ShardedTrainer:
                                 outs_host = [_np.asarray(o)
                                              for o in outs_stack]
                         for j in range(n):
-                            arrays, data_names = chunk.host[j]
+                            arrays, data_names = chunk.host[j][:2]
+                            if streamable:
+                                stream_state[0] = chunk.host[j][2]
                             ok = (True if verdicts is None
                                   else bool(verdicts[j]))
                             global_step += 1
@@ -1554,6 +1606,323 @@ class ShardedTrainer:
                          history[epoch]["eval"])
         led.close(_time.monotonic() - t_fit)
         return (params, moms, aux), history
+
+    def fit_stream(self, train_data, seed=0, max_steps=None,
+                   checkpoint_dir=None, checkpoint_every=100,
+                   checkpoint_every_s=None, resume=None,
+                   initializer=None, state=None, max_bad_steps=5,
+                   retries=None, backoff_s=None, stall_timeout=None,
+                   skip_on_error=False, log_every=0, logger=None,
+                   batch_end_callback=None):
+        """Online learning: consume an UNBOUNDED iterator (e.g. a
+        ``loop=True`` :class:`~mxnet_tpu.stream.StreamDataIter`),
+        checkpointing every ``checkpoint_every`` steps and/or every
+        ``checkpoint_every_s`` seconds — the producer side of the
+        continuous-training loop (``deployd`` is the consumer).
+
+        There are no epochs: the loop runs until ``max_steps``
+        optimizer steps land (``None`` = forever), pulling
+        feeder-staged chunks whose decode runs on the engine IO lane.
+        Every checkpoint's meta sidecar carries the stream iterator's
+        serialized cursor, so ``resume="auto"`` continues **bitwise**
+        from the last saved step: same records, same shuffle order,
+        same per-step RNG keys.
+
+        Failure contract (never a silent hang):
+
+        - a stalled source surfaces as a typed
+          :class:`~mxnet_tpu.base.StreamStallError` after
+          ``stall_timeout`` seconds (default
+          ``MXNET_TPU_PREFETCH_STALL_S``), is retried with exponential
+          backoff up to ``retries`` times (default
+          ``MXNET_TPU_STREAM_RETRIES``, backoff base
+          ``MXNET_TPU_STREAM_BACKOFF_S``), each stall counted in
+          ``stream_stalls_total`` — the watchdog's ``stream_stall``
+          rule fires on a sustained run of them — and the final miss
+          re-raises;
+        - a truncated/garbled source surfaces as
+          ``CorruptMessageError``; with ``skip_on_error=True`` the bad
+          chunk is counted (``stream_skipped_total``) and skipped
+          (feeder reset, stream keeps moving), bounded by
+          ``max_bad_steps`` consecutive losses;
+        - a cleanly-ending finite iterator just ends the loop.
+
+        Returns ``((params, moms, aux), info)`` where ``info`` has
+        ``steps``/``global_step``/``stalls``/``skipped``/
+        ``last_checkpoint``.  A terminal escape is flight-recorded
+        (``trainer.fit_stream``)."""
+        try:
+            return self._fit_stream_impl(
+                train_data, seed=seed, max_steps=max_steps,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                checkpoint_every_s=checkpoint_every_s, resume=resume,
+                initializer=initializer, state=state,
+                max_bad_steps=max_bad_steps, retries=retries,
+                backoff_s=backoff_s, stall_timeout=stall_timeout,
+                skip_on_error=skip_on_error, log_every=log_every,
+                logger=logger, batch_end_callback=batch_end_callback)
+        except Exception as exc:
+            from ..observability import flight_recorder as _flight
+
+            _flight.record_failure("trainer.fit_stream", exc)
+            raise
+
+    def _fit_stream_impl(self, train_data, seed=0, max_steps=None,
+                         checkpoint_dir=None, checkpoint_every=100,
+                         checkpoint_every_s=None, resume=None,
+                         initializer=None, state=None, max_bad_steps=5,
+                         retries=None, backoff_s=None, stall_timeout=None,
+                         skip_on_error=False, log_every=0, logger=None,
+                         batch_end_callback=None):
+        import logging
+        import os as _os
+
+        import jax as _jax
+
+        from .. import observability as _obs
+        from ..base import CorruptMessageError, StreamStallError
+        from ..io import batch_arrays as _io_batch_arrays
+        from ..model import BatchEndParam
+        from . import checkpoint as _ckpt
+        from . import prefetch as _prefetch
+
+        log = logger or logging.getLogger(__name__)
+        if checkpoint_every is not None:
+            checkpoint_every = int(checkpoint_every)
+            if checkpoint_every < 1:
+                raise MXNetError("checkpoint_every must be >= 1")
+        if checkpoint_dir is None:
+            # no directory = no checkpointing (checkpoint_every keeps
+            # its default so callers opting IN only pass the dir)
+            checkpoint_every = None
+            checkpoint_every_s = None
+        if retries is None:
+            try:
+                retries = int(_os.environ.get(
+                    "MXNET_TPU_STREAM_RETRIES", "5") or 5)
+            except ValueError:
+                retries = 5
+        if backoff_s is None:
+            try:
+                backoff_s = float(_os.environ.get(
+                    "MXNET_TPU_STREAM_BACKOFF_S", "0.05") or 0.05)
+            except ValueError:
+                backoff_s = 0.05
+
+        # -- resume="auto": the fit ladder, stream cursor included -------
+        resume_meta = None
+        if resume not in (None, False, "auto"):
+            raise MXNetError("resume must be None or 'auto', got %r"
+                             % (resume,))
+        if resume == "auto" and checkpoint_dir is not None:
+            for ckpt_step in reversed(_ckpt.all_steps(checkpoint_dir)):
+                try:
+                    state = _ckpt.restore_sharded(checkpoint_dir,
+                                                  ckpt_step, trainer=self)
+                except Exception as exc:  # noqa: BLE001 — fall back a step
+                    log.warning(
+                        "resume: checkpoint step %d failed validation "
+                        "(%r); falling back to the previous checkpoint",
+                        ckpt_step, exc)
+                    continue
+                resume_meta = _ckpt.load_fit_meta(checkpoint_dir,
+                                                  ckpt_step)
+                log.info("resume: restored checkpoint step %d", ckpt_step)
+                break
+            else:
+                log.info("resume: no restorable checkpoint under %r — "
+                         "starting fresh", checkpoint_dir)
+
+        params, moms, aux = (state if state is not None
+                             else self.init(initializer=initializer,
+                                            seed=seed))
+        if resume_meta is not None:
+            global_step = int(resume_meta.get("global_step", 0))
+            rng_seed = int(resume_meta.get("seed", seed))
+            rng_anchor = int(resume_meta.get("base_epoch", 0))
+        else:
+            global_step = 0
+            rng_seed = seed
+            rng_anchor = 0
+        base_key = _jax.random.fold_in(_jax.random.PRNGKey(rng_seed),
+                                       rng_anchor)
+        streamable = (hasattr(train_data, "state")
+                      and hasattr(train_data, "load_state"))
+        if (streamable and resume_meta is not None
+                and resume_meta.get("stream") is not None):
+            train_data.load_state(resume_meta["stream"])
+        stream_state = [train_data.state() if streamable else None]
+
+        def fit_meta():
+            meta = {"global_step": global_step,
+                    "epoch": (stream_state[0] or {}).get("epoch", 0),
+                    "batch_in_epoch": 0, "seed": rng_seed,
+                    "base_epoch": rng_anchor, "mode": "stream"}
+            if stream_state[0] is not None:
+                meta["stream"] = stream_state[0]
+            return meta
+
+        K = self.pipeline_steps
+        stop_at = None if max_steps is None else global_step + int(max_steps)
+        planned = [global_step]
+
+        def plan_size():
+            # every flush END lands on a checkpoint boundary and never
+            # overshoots the stop step (extra read-ahead is harmless:
+            # the watermark advances only with consumed batches)
+            k = K
+            if checkpoint_every:
+                k = min(k, checkpoint_every - planned[0] % checkpoint_every)
+            if stop_at is not None:
+                k = max(min(k, stop_at - planned[0]), 1)
+            planned[0] += k
+            return k
+
+        def extract(b):
+            arrays, names = _io_batch_arrays(b, train_data,
+                                             self._input_names)
+            return (arrays, names,
+                    train_data.state() if streamable else None)
+
+        callbacks = (list(batch_end_callback)
+                     if isinstance(batch_end_callback, (list, tuple))
+                     else [batch_end_callback] if batch_end_callback
+                     else [])
+        _m_step = _obs.histogram(
+            "trainer_step_seconds",
+            "Optimizer-step wall time seen by the fit loop; pipelined "
+            "flushes are amortized over their K fused steps")
+        _m_steps = _obs.counter("trainer_steps_total",
+                                "Optimizer steps applied by fit")
+        led = _eff.ledger()
+        t_fit = _time.monotonic()
+        guard = self._skip_nonfinite
+        steps_done = stalls = skipped = 0
+        bad_streak = corrupt_streak = 0
+        last_saved = None
+        last_save_t = _time.monotonic()
+
+        with _obs.span("trainer.stream_prefetch_start"):
+            feeder = _prefetch.PrefetchFeeder(
+                iter(train_data), extract=extract,
+                place=lambda host: self.place_superbatch(
+                    [h[0] for h in host]),
+                sizes=plan_size, depth=2, name="fit_stream.prefetch")
+        try:
+            while stop_at is None or global_step < stop_at:
+                att = _attr.attributor()
+                t_flush = _time.monotonic()
+                attempt = 0
+                while True:
+                    try:
+                        with att.phase("data_wait"):
+                            chunk = feeder.next_chunk(
+                                timeout=stall_timeout)
+                        corrupt_streak = 0
+                        break
+                    except StreamStallError:
+                        stalls += 1
+                        _M_STREAM_STALLS.inc()
+                        attempt += 1
+                        if attempt > retries:
+                            raise StreamStallError(
+                                "stream source stalled: %d consecutive "
+                                "next_chunk timeouts at global step %d "
+                                "(retries=%d exhausted)"
+                                % (attempt, global_step, retries))
+                        delay = min(backoff_s * (2 ** (attempt - 1)), 5.0)
+                        log.warning(
+                            "stream stall at global step %d (attempt "
+                            "%d/%d) — backing off %.3fs", global_step,
+                            attempt, retries, delay)
+                        led.bad("data_wait", delay)
+                        _time.sleep(delay)
+                    except CorruptMessageError:
+                        if not skip_on_error:
+                            raise
+                        skipped += 1
+                        corrupt_streak += 1
+                        _M_STREAM_SKIPPED.inc()
+                        if corrupt_streak > max_bad_steps:
+                            raise
+                        log.warning(
+                            "corrupt stream chunk at global step %d — "
+                            "skipped and counted (%d consecutive)",
+                            global_step, corrupt_streak)
+                        feeder.reset()
+                if chunk is None:
+                    break  # finite source ended cleanly
+                n = chunk.count
+                with _obs.span("trainer.stream_flush", step=global_step):
+                    with att.phase("compute"):
+                        outs_stack, params, moms, aux = \
+                            self.pipeline_fn(n)(
+                                params, moms, aux, chunk.placed,
+                                base_key, _np.int32(global_step))
+                verdicts = None
+                with att.phase("flush"):
+                    if guard:
+                        verdicts = _np.asarray(outs_stack[-1])
+                        outs_stack = outs_stack[:-1]
+                for j in range(n):
+                    if streamable:
+                        stream_state[0] = chunk.host[j][2]
+                    ok = True if verdicts is None else bool(verdicts[j])
+                    global_step += 1
+                    steps_done += 1
+                    if ok:
+                        bad_streak = 0
+                    else:
+                        bad_streak += 1
+                        if bad_streak >= max_bad_steps:
+                            raise MXNetError(
+                                "aborting fit_stream: %d consecutive "
+                                "non-finite steps (last at global step "
+                                "%d)" % (bad_streak, global_step - 1))
+                    for cb in callbacks:
+                        cb(BatchEndParam(epoch=0, nbatch=global_step,
+                                         eval_metric=None, locals=None))
+                    due_n = (checkpoint_every
+                             and global_step % checkpoint_every == 0)
+                    due_t = (checkpoint_every_s is not None
+                             and _time.monotonic() - last_save_t
+                             >= checkpoint_every_s)
+                    if (j == n - 1 and checkpoint_dir is not None
+                            and (due_n or due_t)):
+                        with att.phase("checkpoint"):
+                            _ckpt.save_sharded(checkpoint_dir,
+                                               global_step, params,
+                                               moms, aux)
+                            _ckpt.save_fit_meta(checkpoint_dir,
+                                                global_step, fit_meta())
+                        last_saved = global_step
+                        last_save_t = _time.monotonic()
+                        _attr.sample_memory()
+                dt = _time.monotonic() - t_flush
+                led.step(dt, att.close(dt))
+                _m_steps.inc(n)
+                for _ in range(n):
+                    _m_step.observe(dt / n)
+                _eff.record_step_rate(n, dt)
+                if log_every and steps_done % max(int(log_every), 1) == 0:
+                    log.info("fit_stream: %d steps (global %d), "
+                             "%d stalls, %d skipped", steps_done,
+                             global_step, stalls, skipped)
+        finally:
+            feeder.close()
+        if checkpoint_dir is not None and last_saved != global_step:
+            # the exit checkpoint: deployd's next scan sees the final
+            # state even when the loop stopped off the periodic boundary
+            _ckpt.save_sharded(checkpoint_dir, global_step, params,
+                               moms, aux)
+            _ckpt.save_fit_meta(checkpoint_dir, global_step, fit_meta())
+            last_saved = global_step
+        led.close(_time.monotonic() - t_fit)
+        return (params, moms, aux), {
+            "steps": steps_done, "global_step": global_step,
+            "stalls": stalls, "skipped": skipped,
+            "last_checkpoint": last_saved}
 
     def _with_mesh(self, jitted):
         """Call `jitted` with this trainer's mesh ambient, so mesh-aware ops

@@ -21,9 +21,17 @@ from collections import namedtuple
 import numpy as np
 
 from . import _native
+from . import base
+from . import chaos as _chaos
+from .observability import metrics as _metrics
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
+
+_M_CORRUPT = _metrics.counter(
+    "stream_records_corrupt_total",
+    "RecordIO records skipped by skip_corrupt=True readers, by stream",
+    ["stream"])
 
 _FORCE_PYTHON = False  # test hook: force the pure-Python backend
 
@@ -40,12 +48,26 @@ def _decode_lrec(rec):
 
 
 class MXRecordIO(object):
-    """Sequential RecordIO reader/writer (parity: ``recordio.py:MXRecordIO``)."""
+    """Sequential RecordIO reader/writer (parity: ``recordio.py:MXRecordIO``).
 
-    def __init__(self, uri, flag):
+    A truncated or garbled record surfaces as
+    :class:`~mxnet_tpu.base.CorruptMessageError` — never ``struct.error``
+    and never silent garbage.  ``skip_corrupt=True`` opts a reader into
+    degraded streaming mode: a corrupt record is counted
+    (``stream_records_corrupt_total`` and :attr:`skipped_corrupt`), the
+    stream resyncs by scanning for the next 4-byte-aligned magic word,
+    and reading continues; corruption at EOF counts and ends the stream
+    cleanly (``None``).  Resync needs ``seek``, so a skipping reader
+    always uses the Python file handle, never the sequential-only native
+    reader.
+    """
+
+    def __init__(self, uri, flag, skip_corrupt=False):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self.skip_corrupt = bool(skip_corrupt)
+        self.skipped_corrupt = 0
         self.open()
 
     def open(self):
@@ -59,8 +81,10 @@ class MXRecordIO(object):
             self.writable = True
         elif self.flag == "r":
             # native reader is sequential-only; subclasses needing seek()
-            # (MXIndexedRecordIO) stay on the Python file handle
-            if self._nlib is not None and type(self) is MXRecordIO:
+            # (MXIndexedRecordIO, skip_corrupt resync) stay on the Python
+            # file handle
+            if (self._nlib is not None and type(self) is MXRecordIO
+                    and not self.skip_corrupt):
                 self._nh = self._nlib.mxtpu_recordio_reader_open(
                     self.uri.encode())
             self.handle = None if self._nh else open(self.uri, "rb")
@@ -111,8 +135,13 @@ class MXRecordIO(object):
             self.handle.write(b"\x00" * pad)
 
     def read(self):
+        """Next record payload, or ``None`` at EOF.  A truncated/garbled
+        record raises :class:`~mxnet_tpu.base.CorruptMessageError`
+        unless ``skip_corrupt=True``, which counts it and resyncs to the
+        next record boundary instead (see class doc)."""
         assert not self.writable
         if self._nh:
+            _chaos.visit("data.read", name=self.uri)
             out = ctypes.POINTER(ctypes.c_char)()
             n = ctypes.c_size_t()
             r = self._nlib.mxtpu_recordio_reader_next(
@@ -121,7 +150,29 @@ class MXRecordIO(object):
                 return _native.buf_to_bytes(self._nlib, out, n.value)
             if r == 0:
                 return None
-            raise IOError("Invalid RecordIO magic number")
+            raise base.CorruptMessageError(
+                "Invalid RecordIO magic number in %r" % self.uri)
+        while True:
+            start = self.handle.tell()
+            try:
+                return self._read_record()
+            except base.CorruptMessageError:
+                if not self.skip_corrupt:
+                    # transactional read: the failed read leaves the
+                    # cursor at the record start, so a caller-level
+                    # retry (fit_stream's skip-and-count) re-reads the
+                    # record instead of inheriting a mid-record cursor
+                    # that would cascade misalignment errors forever
+                    self.handle.seek(start)
+                    raise
+                self.skipped_corrupt += 1
+                _M_CORRUPT.labels(os.path.basename(self.uri)).inc()
+                if not self._resync(start + 4):
+                    return None    # corruption ran into EOF: stream ends
+
+    def _read_record(self):
+        """One record from the Python handle; raises
+        ``CorruptMessageError`` on any framing violation."""
         # reassemble continuation-framed records (kind 0 = whole record,
         # 1 = first part, 2 = middle, 3 = last) like the native reader
         parts = []
@@ -133,26 +184,45 @@ class MXRecordIO(object):
                 # (the native reader errors here too) — returning a partial
                 # join / None would be silent data corruption
                 if parts:
-                    raise IOError("truncated multi-part RecordIO record "
-                                  "at EOF")
+                    raise base.CorruptMessageError(
+                        "truncated multi-part RecordIO record at EOF "
+                        "in %r" % self.uri)
                 if header:
-                    raise IOError("truncated RecordIO header at EOF "
-                                  "(%d of 8 bytes)" % len(header))
+                    raise base.CorruptMessageError(
+                        "truncated RecordIO header at EOF (%d of 8 "
+                        "bytes) in %r" % (len(header), self.uri))
                 return None
+            header = _chaos.visit("data.read", header, name=self.uri)
             magic, lrec = struct.unpack("<II", header)
             if magic != _MAGIC:
-                raise IOError("Invalid RecordIO magic number")
+                raise base.CorruptMessageError(
+                    "Invalid RecordIO magic number in %r" % self.uri)
             kind, length = _decode_lrec(lrec)
             payload = self.handle.read(length)
             if len(payload) < length:
-                raise IOError("truncated RecordIO payload "
-                              "(%d < %d bytes)" % (len(payload), length))
+                raise base.CorruptMessageError(
+                    "truncated RecordIO payload (%d < %d bytes) in %r"
+                    % (len(payload), length, self.uri))
             parts.append(payload)
             pad = (4 - length % 4) % 4
             if pad:
                 self.handle.read(pad)
             if kind == 0 or kind == 3:
                 return b"".join(parts)
+
+    def _resync(self, pos):
+        """Scan forward from ``pos`` (rounded up to 4-byte alignment —
+        writers pad every record to 4 bytes) for the next magic word;
+        leaves the handle at the record boundary.  False at EOF."""
+        pos += (-pos) % 4
+        self.handle.seek(pos)
+        while True:
+            word = self.handle.read(4)
+            if len(word) < 4:
+                return False
+            if struct.unpack("<I", word)[0] == _MAGIC:
+                self.handle.seek(-4, os.SEEK_CUR)
+                return True
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -1,0 +1,343 @@
+"""Sharded streaming data plane over RecordIO files.
+
+The reference stack's layer 0 is ``dmlc::InputSplit`` — deterministic
+``(rank, num_ranks)`` splits of a file set — feeding a threaded decode
+pipeline (``ThreadedIter``).  :class:`StreamDataIter` is that role
+rebuilt on this repo's primitives:
+
+- **Deterministic splits.**  Each epoch reads the file set in a
+  permutation that is a pure function of ``(seed, epoch)``; records are
+  framed into global batches over the concatenated stream, and rank
+  ``r`` of ``n`` owns exactly the batches with ``global_batch % n ==
+  r`` — the same ownership rule as ``elastic.WorkerRoster.owns``, so a
+  roster join/drain re-split changes only *future* ownership and a
+  resumed rank replays bit-identical batches.
+- **Decode on the engine IO lane.**  The iterator itself is cheap and
+  synchronous; wrapped in :class:`~mxnet_tpu.parallel.PrefetchFeeder`
+  (what ``ShardedTrainer.fit``/``fit_stream`` do), every ``next()`` —
+  record read + decode — runs inside the feeder's fetch ops on the
+  engine's IO worker lane, overlapped with device compute.  Unowned
+  batches are scanned but never decoded.
+- **Serializable position.**  :meth:`state` is a small JSON-safe dict
+  (shuffle seed + epoch, permuted file index, byte offset, batch
+  watermark, shard) and :meth:`load_state` restores it exactly; the
+  trainer persists it into the fit-meta checkpoint sidecars so
+  ``resume="auto"`` continues mid-epoch **bitwise** — same records,
+  same shuffle order, same batch boundaries — instead of replaying the
+  epoch from its head.
+- **Typed degradation.**  Corrupt records surface as
+  ``base.CorruptMessageError`` from the RecordIO layer; with
+  ``skip_corrupt=True`` they are counted and skipped
+  (``stream_records_corrupt_total``) and the stream keeps moving.
+
+``loop=True`` turns the epoch boundary into a reshuffle instead of
+``StopIteration`` — the unbounded source ``fit_stream`` consumes.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import recordio as _recordio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .observability import metrics as _metrics
+
+__all__ = ["StreamDataIter", "write_ndarray_records"]
+
+_M_BYTES = _metrics.counter(
+    "stream_bytes_read_total",
+    "Bytes of RecordIO payload read by streaming iterators")
+_M_BATCHES = _metrics.counter(
+    "stream_batches_total",
+    "Owned batches decoded and served by streaming iterators")
+
+_STATE_VERSION = 1
+
+
+class _SeekableRecordIO(_recordio.MXRecordIO):
+    """RecordIO reader pinned to the Python file handle (the native
+    reader is sequential-only): resume needs ``seek`` and the byte-exact
+    ``tell`` the state watermark is made of.  Being a subclass is what
+    pins it — ``MXRecordIO.open`` only hands ``type(self) is
+    MXRecordIO`` to the native backend."""
+
+
+def write_ndarray_records(path, data, labels):
+    """Pack ``data[i]`` (float32 array) + scalar ``labels[i]`` into a
+    RecordIO file — the writer half tests and demos use to build
+    streamable datasets from in-memory arrays."""
+    writer = _recordio.MXRecordIO(path, "w")
+    try:
+        for i in range(len(data)):
+            header = _recordio.IRHeader(0, float(labels[i]), i, 0)
+            writer.write(_recordio.pack(
+                header, _np.ascontiguousarray(
+                    data[i], dtype=_np.float32).tobytes()))
+    finally:
+        writer.close()
+    return path
+
+
+class StreamDataIter(DataIter):
+    """Deterministic sharded stream over RecordIO files (see module doc).
+
+    Parameters
+    ----------
+    files : list of str
+        RecordIO file paths; the *set* is the dataset, the per-epoch
+        order is the seeded permutation.
+    data_shape : tuple
+        Per-sample shape decoded from each record payload.
+    batch_size : int
+        Records per batch; the epoch's partial tail batch is dropped
+        (every rank sees the same batch count).
+    label_shape : tuple
+        Per-sample label shape; ``()`` (default) = scalar label from
+        the record header.
+    rank, num_ranks : int
+        This worker's shard: it owns batches with
+        ``global_batch % num_ranks == rank``.
+    shuffle : bool
+        Permute file order per epoch (seeded); ``False`` reads files in
+        the given order every epoch.
+    seed : int
+        The shuffle RNG — with ``epoch`` it IS the entire shuffle
+        state, which is why :meth:`state` serializes in a dozen bytes.
+    loop : bool
+        ``True``: the epoch boundary reshuffles and continues
+        (unbounded stream for ``fit_stream``); ``False``: classic
+        ``StopIteration`` epochs.
+    skip_corrupt : bool
+        Passed to the RecordIO readers: corrupt records are counted and
+        skipped instead of raising (degraded streaming mode).
+    decode : callable(payload_bytes) -> (data_array, label) or None
+        Override the default ``recordio.unpack`` + ``frombuffer``
+        decode.
+    """
+
+    def __init__(self, files, data_shape, batch_size, label_shape=(),
+                 rank=0, num_ranks=1, shuffle=True, seed=0, loop=False,
+                 skip_corrupt=False, decode=None, dtype="float32",
+                 data_name="data", label_name="softmax_label"):
+        super().__init__(int(batch_size))
+        self.files = [str(f) for f in files]
+        if not self.files:
+            raise MXNetError("StreamDataIter needs at least one file")
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.loop = bool(loop)
+        self.skip_corrupt = bool(skip_corrupt)
+        self._decode = decode
+        self._dtype = _np.dtype(dtype)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.bytes_read = 0
+        self.skipped_corrupt = 0
+        self.set_shard(rank, num_ranks)
+        self.epoch = 0
+        self._reader = None
+        self._seek(0, 0, 0, 0)
+
+    # -- sharding ------------------------------------------------------
+
+    def set_shard(self, rank, num_ranks):
+        """Re-split: ownership of FUTURE batches only — the read cursor
+        does not move, which is what keeps a mid-epoch roster change
+        compatible with bitwise resume."""
+        rank, num_ranks = int(rank), int(num_ranks)
+        if not 0 <= rank < num_ranks:
+            raise MXNetError("rank %d outside num_ranks %d"
+                             % (rank, num_ranks))
+        self.rank = rank
+        self.num_ranks = num_ranks
+
+    def _owns(self, batch_idx):
+        return batch_idx % self.num_ranks == self.rank
+
+    # -- position ------------------------------------------------------
+
+    def _perm(self, epoch):
+        order = list(range(len(self.files)))
+        if self.shuffle:
+            _np.random.RandomState(
+                (self.seed * 1000003 + epoch) % (2 ** 31)).shuffle(order)
+        return order
+
+    def _seek(self, epoch, file_idx, offset, batch_in_epoch):
+        """Point the cursor at an exact (epoch, permuted-file, byte)
+        position; the unit of both epoch starts and state restores."""
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+        self.epoch = int(epoch)
+        self._order = self._perm(self.epoch)
+        self._file_idx = int(file_idx)
+        self.batch_in_epoch = int(batch_in_epoch)
+        if self._file_idx < len(self._order):
+            self._open_current()
+            if offset:
+                self._reader.handle.seek(int(offset))
+
+    def _open_current(self):
+        self._reader = _SeekableRecordIO(
+            self.files[self._order[self._file_idx]], "r",
+            skip_corrupt=self.skip_corrupt)
+
+    def state(self):
+        """JSON-safe snapshot of the exact read position: restoring it
+        with :meth:`load_state` resumes on the next unread record.
+        Always taken at a batch boundary (``next`` leaves the cursor
+        there)."""
+        return {
+            "version": _STATE_VERSION,
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+            "epoch": self.epoch,
+            "file_idx": self._file_idx,
+            "offset": (self._reader.handle.tell()
+                       if self._reader is not None else 0),
+            "batch_in_epoch": self.batch_in_epoch,
+            "rank": self.rank,
+            "num_ranks": self.num_ranks,
+            "files": list(self.files),
+        }
+
+    def load_state(self, state):
+        """Restore a :meth:`state` snapshot (bitwise resume point).
+        The file set must match — a changed dataset makes every offset
+        in the snapshot meaningless."""
+        if state.get("version") != _STATE_VERSION:
+            raise MXNetError("unsupported stream state version %r"
+                             % (state.get("version"),))
+        if list(state.get("files", [])) != self.files:
+            raise MXNetError(
+                "stream state was taken over a different file set: "
+                "%r != %r" % (state.get("files"), self.files))
+        if (state.get("seed") != self.seed
+                or bool(state.get("shuffle")) != self.shuffle):
+            raise MXNetError(
+                "stream state disagrees on shuffle identity "
+                "(seed %r/%r, shuffle %r/%r)"
+                % (state.get("seed"), self.seed, state.get("shuffle"),
+                   self.shuffle))
+        self.set_shard(state["rank"], state["num_ranks"])
+        self._seek(state["epoch"], state["file_idx"], state["offset"],
+                   state["batch_in_epoch"])
+
+    def seek_epoch(self, epoch):
+        """Jump to the start of ``epoch`` (its shuffle order included)."""
+        self._seek(int(epoch), 0, 0, 0)
+
+    def reset(self):
+        """Advance to the next epoch: new seeded shuffle, cursor at its
+        head.  (The DataIter epoch contract; under ``loop=True`` the
+        boundary is crossed internally and ``reset`` is never needed.)"""
+        self._seek(self.epoch + 1, 0, 0, 0)
+
+    # -- reading -------------------------------------------------------
+
+    def _next_record(self):
+        """Next raw payload across the epoch's file sequence, or None
+        at epoch end."""
+        while self._file_idx < len(self._order):
+            before = self._reader.skipped_corrupt
+            rec = self._reader.read()
+            self.skipped_corrupt += self._reader.skipped_corrupt - before
+            if rec is not None:
+                self.bytes_read += len(rec)
+                _M_BYTES.inc(len(rec))
+                return rec
+            self._reader.close()
+            self._reader = None
+            self._file_idx += 1
+            if self._file_idx < len(self._order):
+                self._open_current()
+        return None
+
+    def _decode_record(self, payload):
+        if self._decode is not None:
+            return self._decode(payload)
+        header, content = _recordio.unpack(payload)
+        data = _np.frombuffer(
+            content, dtype=self._dtype).reshape(self.data_shape)
+        label = (_np.asarray(header.label, dtype=_np.float32)
+                 .reshape(self.label_shape))
+        return data, label
+
+    def next(self):
+        """The next OWNED batch (decoded); unowned batches are scanned
+        past without decoding.  Raises ``StopIteration`` at epoch end
+        unless ``loop=True``, which reshuffles and continues."""
+        while True:
+            raw = []
+            while len(raw) < self.batch_size:
+                rec = self._next_record()
+                if rec is None:
+                    break
+                raw.append(rec)
+            if len(raw) < self.batch_size:
+                # partial tail dropped: every rank agrees on batch count
+                if not self.loop:
+                    raise StopIteration
+                self._seek(self.epoch + 1, 0, 0, 0)
+                continue
+            owned = self._owns(self.batch_in_epoch)
+            self.batch_in_epoch += 1
+            if not owned:
+                continue
+            decoded = [self._decode_record(r) for r in raw]
+            data = _np.stack([d for d, _ in decoded])
+            label = _np.stack([lb for _, lb in decoded])
+            _M_BATCHES.inc()
+            return DataBatch([data], [label], pad=0, index=None,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+    def skip(self, n):
+        """Advance past ``n`` owned batches without decoding — the
+        cheap replay a resume uses to close the gap between a state
+        snapshot and the exact step a checkpoint was taken at."""
+        skipped = 0
+        while skipped < int(n):
+            got = 0
+            while got < self.batch_size:
+                if self._next_record() is None:
+                    break
+                got += 1
+            if got < self.batch_size:
+                if not self.loop:
+                    raise StopIteration
+                self._seek(self.epoch + 1, 0, 0, 0)
+                continue
+            if self._owns(self.batch_in_epoch):
+                skipped += 1
+            self.batch_in_epoch += 1
+        return skipped
+
+    # -- DataIter protocol ---------------------------------------------
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape,
+                         _np.float32)]
+
+    def close(self):
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

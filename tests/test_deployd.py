@@ -1,0 +1,267 @@
+"""DeployDaemon: gated checkpoint hot-swap with automatic rollback.
+
+These tests drive the daemon with an injectable clock (``poll_once(now=)``)
+and plain-numpy sharded checkpoints — no trainer in the loop — so every
+decision (reject / promote / probation_pass / rollback) is deterministic.
+The rollback test burns the availability error budget with seeded chaos
+(a delay at ``serving.admit`` plus a 1 ms deadline), exactly the driver
+``tools/continuous_fit.py`` uses.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, deployd
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import checkpoint as ckpt
+from mxnet_tpu.serving.registry import Backend, ModelRegistry
+from mxnet_tpu.serving.replication import ReplicaGroup, ServingRouter
+
+D, C = 6, 4
+
+
+class NpBackend(Backend):
+    """Pure-numpy softmax(x @ w.T + b) backend; ``tag`` identifies which
+    checkpoint a replica is answering from."""
+
+    def __init__(self, params, tag):
+        self.p = {n: np.asarray(v, dtype=np.float64)
+                  for n, v in params.items()}
+        self.tag = tag
+        self.input_shapes = {"data": (D,)}
+
+    def infer(self, batch):
+        x = np.asarray(batch["data"], dtype=np.float64)
+        o = x @ self.p["w"].T + self.p["b"]
+        e = np.exp(o - o.max(axis=-1, keepdims=True))
+        return [e / e.sum(axis=-1, keepdims=True)], False
+
+
+def _params(seed, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return {"w": (rng.randn(C, D) * scale).astype(np.float32),
+            "b": np.zeros(C, dtype=np.float32)}
+
+
+def _save(ckdir, step, params):
+    ckpt.save_sharded(ckdir, step, params)
+
+
+def _loader(ckdir, step):
+    params, _, _ = ckpt.restore_sharded(ckdir, step)
+    return NpBackend(params, "step%d" % step)
+
+
+def _golden():
+    return {"data": np.random.RandomState(1).randn(4, D).astype("float32")}
+
+
+def _registry(baseline):
+    reg = ModelRegistry()
+    reg.register("m", baseline, buckets=[1, 4])
+    return reg
+
+
+# -- the gate ------------------------------------------------------------
+
+
+def test_gate_rejects_corrupt_checkpoint(tmp_path):
+    ckdir = str(tmp_path)
+    _save(ckdir, 1, _params(0))
+    # garble the checkpoint on disk: drop the params item so restore fails
+    stepdir = os.path.join(ckdir, "1")
+    victims = [os.path.join(stepdir, d) for d in os.listdir(stepdir)
+               if os.path.isdir(os.path.join(stepdir, d))]
+    assert victims, "expected orbax item dirs under the step dir"
+    for v in victims:
+        shutil.rmtree(v)
+    reg = _registry(NpBackend(_params(9), "baseline"))
+    dd = deployd.DeployDaemon(ckdir, reg, "m", _loader, probation_s=30.0)
+    dec = dd.poll_once(now=100.0)
+    assert dec["action"] == "reject" and dec["reason"] == "restore"
+    # the candidate never touched traffic
+    assert reg.get("m").backend.tag == "baseline"
+    ev = obs.events(kind="deploy.reject")
+    assert ev and ev[-1].fields["reason"] == "restore"
+    rej = obs.REGISTRY.get("deployd_rejections_total")
+    assert rej.total() == 1
+    # rejected steps are not re-scanned
+    assert dd.poll_once(now=101.0) is None
+
+
+def test_gate_rejects_eval_floor_then_nonfinite(tmp_path):
+    ckdir = str(tmp_path)
+    reg = _registry(NpBackend(_params(9), "baseline"))
+    scores = {2: 0.1, 3: float("nan")}
+    dd = deployd.DeployDaemon(
+        ckdir, reg, "m", _loader,
+        eval_fn=lambda b: scores[int(b.tag[4:])],
+        eval_floor=0.5, probation_s=30.0)
+    _save(ckdir, 2, _params(2))
+    dec = dd.poll_once(now=100.0)
+    assert dec["action"] == "reject" and dec["reason"] == "eval_floor"
+    _save(ckdir, 3, _params(3))
+    dec = dd.poll_once(now=101.0)
+    assert dec["action"] == "reject" and dec["reason"] == "eval"
+    assert reg.get("m").backend.tag == "baseline"
+
+
+def test_gate_rejects_golden_nonfinite_and_drift(tmp_path):
+    ckdir = str(tmp_path)
+    baseline = _params(9)
+    reg = _registry(NpBackend(baseline, "baseline"))
+    bad = dict(baseline)
+    bad["w"] = np.full_like(baseline["w"], np.nan)
+    _save(ckdir, 4, bad)
+    dd = deployd.DeployDaemon(
+        ckdir, reg, "m", _loader, golden_batch=_golden(),
+        golden_max_drift=1e-6, probation_s=30.0)
+    dec = dd.poll_once(now=100.0)
+    assert dec["action"] == "reject" and dec["reason"] == "golden"
+    # loads fine, answers finite, but far from the serving model
+    _save(ckdir, 5, _params(77, scale=50.0))
+    dec = dd.poll_once(now=101.0)
+    assert dec["action"] == "reject" and dec["reason"] == "golden_drift"
+    assert reg.get("m").backend.tag == "baseline"
+
+
+def test_newest_candidate_wins_superseded(tmp_path):
+    ckdir = str(tmp_path)
+    reg = _registry(NpBackend(_params(9), "baseline"))
+    for step in (1, 2, 3):
+        _save(ckdir, step, _params(step))
+    dd = deployd.DeployDaemon(ckdir, reg, "m", _loader, probation_s=30.0)
+    dec = dd.poll_once(now=100.0)
+    assert dec["action"] == "promote" and dec["step"] == 3
+    lapped = [h for h in dd.history if h["action"] == "superseded"]
+    assert [h["step"] for h in lapped] == [1, 2]
+    assert reg.get("m").backend.tag == "step3"
+
+
+# -- promote / probation -------------------------------------------------
+
+
+def test_promote_then_probation_pass(tmp_path):
+    ckdir = str(tmp_path)
+    reg = _registry(NpBackend(_params(9), "baseline"))
+    _save(ckdir, 10, _params(10))
+    dd = deployd.DeployDaemon(ckdir, reg, "m", _loader, probation_s=30.0)
+    dec = dd.poll_once(now=100.0)
+    assert dec["action"] == "promote" and dec["step"] == 10
+    assert reg.get("m").backend.tag == "step10"
+    assert obs.events(kind="deploy.promote")[-1].fields["step"] == 10
+    assert obs.REGISTRY.get("deployd_live_step").value == 10
+    # probation open: new candidates are NOT considered (one change in
+    # flight at a time)
+    _save(ckdir, 11, _params(11))
+    assert dd.poll_once(now=110.0) is None
+    assert reg.get("m").backend.tag == "step10"
+    dec = dd.poll_once(now=131.0)
+    assert dec["action"] == "probation_pass" and dec["step"] == 10
+    # window closed: the queued candidate promotes on the next poll
+    dec = dd.poll_once(now=132.0)
+    assert dec["action"] == "promote" and dec["step"] == 11
+    assert dd.describe()["live_step"] == 11
+
+
+def test_no_replicas_is_typed_error(tmp_path):
+    class _EmptyGroup(object):
+        def live(self):
+            return []
+
+    ckdir = str(tmp_path)
+    _save(ckdir, 1, _params(1))
+    dd = deployd.DeployDaemon(ckdir, _EmptyGroup(), "m", _loader,
+                              probation_s=5.0)
+    with pytest.raises(MXNetError, match="no live replicas"):
+        dd.poll_once(now=100.0)
+
+
+# -- rollback ------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_seeded_burn_rolls_back_exactly_once(tmp_path, monkeypatch):
+    """The acceptance scenario: promote onto a live replica group, keep
+    serving through probation, burn the availability budget with seeded
+    chaos, and observe exactly ONE rollback — ops event + flight bundle
+    naming the rule — after which serving answers from the previous
+    model."""
+    flight = tmp_path / "flight"
+    flight.mkdir()
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(flight))
+    ckdir = str(tmp_path / "ckpt")
+    _save(ckdir, 7, _params(7))
+
+    base = _params(9)
+    group = ReplicaGroup(replicas=2, group="deployd-burn")
+    group.register("m", lambda: NpBackend(base, "baseline"), buckets=[1, 4])
+    router = ServingRouter(group)
+    golden = _golden()
+
+    dd = deployd.DeployDaemon(ckdir, group, "m", _loader,
+                              golden_batch=golden, probation_s=60.0)
+    now = 1000.0
+    dec = dd.poll_once(now=now)
+    assert dec["action"] == "promote" and dec["step"] == 7
+    for _, sched in group.live():
+        assert sched.registry.get("m").backend.tag == "step7"
+
+    # serving keeps answering during probation
+    out = router.request("m", {"data": golden["data"][0]}, timeout=10)
+    assert np.asarray(out[0]).shape[-1] == C
+
+    # burn: seeded delay at admission + 1ms deadline -> typed deadline
+    # rejections -> availability fast burn over the probation watchdog
+    with chaos.inject("serving.admit", "delay", prob=1.0, delay=0.05,
+                      seed=11):
+        for _ in range(8):
+            try:
+                router.request("m", {"data": golden["data"][0]},
+                               deadline_ms=1, timeout=5)
+            except Exception:
+                pass
+
+    dec = dd.poll_once(now=now + 5)
+    assert dec["action"] == "rollback", dec
+    assert dec["rule"] in ("slo_availability_fast_burn",
+                           "slo_latency_fast_burn")
+    assert dec["step"] == 7 and dec["restored_step"] is None
+    # every replica answers from the previous model again
+    for _, sched in group.live():
+        assert sched.registry.get("m").backend.tag == "baseline"
+    out = router.request("m", {"data": golden["data"][0]}, timeout=10)
+    assert np.asarray(out[0]).shape[-1] == C
+
+    ev = obs.events(kind="deploy.rollback")
+    assert len(ev) == 1 and ev[0].fields["rule"] == dec["rule"]
+    assert obs.REGISTRY.get("deployd_rollbacks_total").total() == 1
+
+    # exactly once: the next poll neither rolls back again nor re-gates
+    # the rolled-back step
+    assert dd.poll_once(now=now + 6) is None
+    assert obs.REGISTRY.get("deployd_rollbacks_total").total() == 1
+
+    bundles = [d for d in os.listdir(str(flight))
+               if d.startswith("flight_deployd.rollback")]
+    assert len(bundles) == 1
+    with open(os.path.join(str(flight), bundles[0], "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["extra"]["rule"] == dec["rule"]
+    assert manifest["extra"]["step"] == 7
+
+
+def test_daemon_thread_start_stop(tmp_path):
+    reg = _registry(NpBackend(_params(9), "baseline"))
+    dd = deployd.DeployDaemon(str(tmp_path), reg, "m", _loader,
+                              probation_s=5.0)
+    dd.start(poll_s=0.05)
+    assert dd.start(poll_s=0.05) is dd  # idempotent
+    dd.stop()
+    dd.stop()
+    assert dd.describe()["model"] == "m"

@@ -1,0 +1,434 @@
+"""Continuous-training data plane (round 13): typed RecordIO
+corruption + skip-and-count, the ``data.read`` chaos site, the
+bounded-staleness prefetch guard, ``StreamDataIter``'s serializable
+sharded cursor, and the two bitwise kill/resume contracts —
+``fit`` mid-epoch and ``fit_stream`` online."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, recordio, stream
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import (CorruptMessageError, MXNetError,
+                            StreamStallError)
+from mxnet_tpu.parallel.prefetch import PrefetchFeeder
+
+B, D, C = 4, 6, 8
+REC = 8 + 24 + 24  # frame word + IRHeader + 6 float32s (4-aligned)
+
+
+def _write(path, n, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.randn(n, D).astype(np.float32)
+    labels = (np.arange(n) % C).astype(np.float32)
+    stream.write_ndarray_records(str(path), data, labels)
+    return data, labels
+
+
+# ---------------------------------------------------------------------
+# recordio: typed corruption, skip-and-count, resync
+# ---------------------------------------------------------------------
+
+
+def test_corrupt_magic_is_typed(tmp_path):
+    f = tmp_path / "a.rec"
+    _write(f, 4)
+    with open(f, "r+b") as fh:       # garble record 2's magic word
+        fh.seek(REC)
+        fh.write(b"\xde\xad\xbe\xef")
+    r = recordio.MXRecordIO(str(f), "r")
+    assert r.read() is not None
+    with pytest.raises(CorruptMessageError):
+        r.read()
+    r.close()
+
+
+def test_corrupt_read_is_transactional(tmp_path):
+    """A failed read leaves the cursor at the record start — the error
+    is deterministic on retry, never a misalignment cascade."""
+    f = tmp_path / "a.rec"
+    _write(f, 3)
+    with open(f, "r+b") as fh:
+        fh.seek(REC)
+        fh.write(b"\xde\xad\xbe\xef")
+    r = stream._SeekableRecordIO(str(f), "r")  # pinned Python handle
+    r.read()
+    pos = r.handle.tell()
+    for _ in range(3):
+        with pytest.raises(CorruptMessageError):
+            r.read()
+        assert r.handle.tell() == pos
+    r.close()
+
+
+def test_skip_corrupt_counts_and_resyncs(tmp_path):
+    f = tmp_path / "a.rec"
+    data, _ = _write(f, 6)
+    with open(f, "r+b") as fh:
+        fh.seek(2 * REC)
+        fh.write(b"\xde\xad\xbe\xef")
+    r = recordio.MXRecordIO(str(f), "r", skip_corrupt=True)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(recordio.unpack(rec)[0].id)
+    # record 2 lost, all others intact, loss counted
+    assert got == [0, 1, 3, 4, 5]
+    assert r.skipped_corrupt == 1
+    fam = obs.REGISTRY.get("stream_records_corrupt_total")
+    assert fam is not None and fam.total() >= 1
+    r.close()
+
+
+def test_skip_corrupt_truncated_tail_ends_stream(tmp_path):
+    f = tmp_path / "a.rec"
+    _write(f, 5)
+    with open(f, "r+b") as fh:
+        fh.truncate(4 * REC + 12)    # cut the last record's payload
+    r = recordio.MXRecordIO(str(f), "r", skip_corrupt=True)
+    n = 0
+    while r.read() is not None:
+        n += 1
+    assert n == 4 and r.skipped_corrupt == 1
+    r.close()
+
+
+@pytest.mark.chaos
+def test_chaos_data_read_drop_is_typed(tmp_path):
+    f = tmp_path / "a.rec"
+    _write(f, 4)
+    with chaos.inject("data.read", "drop", prob=1.0, limit=1):
+        r = recordio.MXRecordIO(str(f), "r")
+        with pytest.raises(CorruptMessageError):
+            r.read()
+        r.close()
+
+
+@pytest.mark.chaos
+def test_chaos_data_read_corrupt_feeds_skip_path(tmp_path):
+    f = tmp_path / "a.rec"
+    _write(f, 6)
+    with chaos.inject("data.read", "corrupt", prob=1.0, seed=2,
+                      limit=1) as inj:
+        r = recordio.MXRecordIO(str(f), "r", skip_corrupt=True)
+        n = 0
+        while r.read() is not None:
+            n += 1
+        r.close()
+    assert inj.fires == 1
+    assert r.skipped_corrupt == 1 and n == 5
+
+
+# ---------------------------------------------------------------------
+# PrefetchFeeder hardening
+# ---------------------------------------------------------------------
+
+
+class _BadIter(object):
+    """Raises once at item 1, then yields 2..6."""
+
+    def __init__(self):
+        self.n = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.n += 1
+        if self.n == 1:
+            raise ValueError("poisoned batch")
+        if self.n > 6:
+            raise StopIteration
+        return self.n
+
+
+def test_feeder_reset_recovers_after_poison():
+    fd = PrefetchFeeder(_BadIter(), extract=lambda b: b,
+                        place=lambda h: h, sizes=2, name="t")
+    with pytest.raises(ValueError):
+        fd.next_chunk()
+    fd.reset()
+    counts = []
+    while True:
+        c = fd.next_chunk()
+        if c is None:
+            break
+        counts.append(c.count)
+    assert sum(counts) == 5          # items 2..6, original error drained
+    fd.close()
+
+
+def test_feeder_close_idempotent():
+    fd = PrefetchFeeder(iter([1, 2]), extract=lambda b: b,
+                        place=lambda h: h, sizes=1, name="t")
+    fd.close()
+    fd.close()                        # second close is a no-op
+    with pytest.raises(RuntimeError):
+        fd.next_chunk()
+
+
+def test_feeder_bounded_staleness_is_typed_and_retryable():
+    import threading
+    import time
+
+    gate = threading.Event()
+
+    class Hang(object):
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            gate.wait(30)
+            return 1
+
+    fd = PrefetchFeeder(Hang(), extract=lambda b: b, place=lambda h: h,
+                        sizes=1, name="hang")
+    t0 = time.monotonic()
+    with pytest.raises(StreamStallError):
+        fd.next_chunk(timeout=0.1)
+    assert time.monotonic() - t0 < 5
+    gate.set()                        # source recovers: same call succeeds
+    chunk = fd.next_chunk(timeout=5)
+    assert chunk is not None and chunk.count == 1
+    fd.close()
+
+
+# ---------------------------------------------------------------------
+# StreamDataIter: determinism, sharding, serializable cursor
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture()
+def recfiles(tmp_path):
+    files = []
+    for k in range(2):
+        f = tmp_path / ("part%d.rec" % k)
+        _write(f, 24, seed=k)
+        files.append(str(f))
+    return files
+
+
+def _collect(it):
+    return [np.asarray(b.data[0]) for b in iter(it)]
+
+
+def test_stream_iter_deterministic_and_epoch_shuffled(recfiles):
+    a = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3))
+    b = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3))
+    assert len(a) == 12
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    # the seeded shuffle permutes file order per epoch: some epoch in
+    # the next few visits the files differently from epoch 0
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=3)
+    _collect(it)
+    differed = False
+    for _ in range(4):
+        it.reset()
+        epoch = [np.asarray(bt.data[0]) for bt in iter(it)]
+        if any(not np.array_equal(x, y) for x, y in zip(a, epoch)):
+            differed = True
+            break
+    assert differed
+
+
+def test_stream_iter_shard_split_partitions_batches(recfiles):
+    full = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3))
+    r0 = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3,
+                                        rank=0, num_ranks=2))
+    r1 = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3,
+                                        rank=1, num_ranks=2))
+    assert len(r0) + len(r1) == len(full)
+    it0, it1 = iter(r0), iter(r1)
+    for k, want in enumerate(full):
+        got = next(it0) if k % 2 == 0 else next(it1)
+        assert np.array_equal(got, want)
+
+
+def test_stream_iter_state_roundtrip_bitwise(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=3)
+    seq = iter(it)
+    next(seq)
+    next(seq)
+    st = it.state()
+    tail = [np.asarray(b.data[0]) for b in seq]
+
+    it2 = stream.StreamDataIter(recfiles, (D,), B, seed=3)
+    it2.load_state(st)
+    tail2 = [np.asarray(b.data[0]) for b in iter(it2)]
+    assert len(tail) == len(tail2) > 0
+    for x, y in zip(tail, tail2):
+        assert np.array_equal(x, y)
+
+
+def test_stream_iter_state_validates_identity(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=3)
+    st = it.state()
+    other = stream.StreamDataIter(recfiles, (D,), B, seed=4)
+    with pytest.raises(MXNetError):
+        other.load_state(st)          # different shuffle seed
+    st2 = dict(st, files=list(reversed(st["files"])))
+    with pytest.raises(MXNetError):
+        it.load_state(st2)            # different file set/order
+
+
+def test_stream_iter_resplit_mid_stream(recfiles):
+    """A roster re-split changes FUTURE batch ownership only: global
+    batch numbering (and therefore the data each rank sees for a given
+    index) is unchanged — mirrors ``WorkerRoster.owns``."""
+    full = _collect(stream.StreamDataIter(recfiles, (D,), B, seed=3))
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=3,
+                               rank=0, num_ranks=1)
+    seq = iter(it)
+    got = [np.asarray(next(seq).data[0]) for _ in range(3)]
+    it.set_shard(0, 2)                # a peer joined: now 2-way split
+    got += [np.asarray(b.data[0]) for b in seq]
+    want = full[:3] + [full[k] for k in range(3, len(full)) if k % 2 == 0]
+    assert len(got) == len(want)
+    for x, y in zip(got, want):
+        assert np.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------
+# bitwise kill/resume: fit (mid-epoch) and fit_stream (online)
+# ---------------------------------------------------------------------
+
+
+class _Boom(Exception):
+    pass
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _trainer():
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    return ShardedTrainer(
+        _mlp(), mesh, data_shapes={"data": (B, D)},
+        label_shapes={"softmax_label": (B,)}, optimizer="sgd",
+        optimizer_params={"lr": 0.1, "rescale_grad": 1.0 / B})
+
+
+def _params_equal(a, b):
+    return all(np.array_equal(np.asarray(a[n]), np.asarray(b[n]))
+               for n in a)
+
+
+def test_fit_stream_iter_midepoch_kill_resume_bitwise(recfiles,
+                                                      tmp_path):
+    """The tentpole contract: kill mid-epoch-1, resume='auto', final
+    params bitwise-equal to the uninterrupted run — stream cursor AND
+    shuffle RNG restored from the fit-meta sidecar."""
+    def make_it():
+        return stream.StreamDataIter(recfiles, (D,), B, seed=7)
+
+    ck_ref = str(tmp_path / "ref")
+    (p_ref, _, _), _ = _trainer().fit(
+        make_it(), num_epoch=2, seed=5, log_every=0,
+        checkpoint_dir=ck_ref, checkpoint_every=5)
+
+    ck = str(tmp_path / "killed")
+
+    def killer(bep):
+        if bep.epoch == 1 and bep.nbatch == 3:
+            raise _Boom()
+
+    with pytest.raises(_Boom):
+        _trainer().fit(make_it(), num_epoch=2, seed=5, log_every=0,
+                       checkpoint_dir=ck, checkpoint_every=5,
+                       batch_end_callback=killer)
+    (p_res, _, _), _ = _trainer().fit(
+        make_it(), num_epoch=2, seed=5, log_every=0,
+        checkpoint_dir=ck, checkpoint_every=5, resume="auto")
+    assert _params_equal(p_ref, p_res)
+
+
+def test_fit_stream_kill_resume_bitwise(recfiles, tmp_path):
+    def make_it():
+        return stream.StreamDataIter(recfiles, (D,), B, seed=7,
+                                     loop=True)
+
+    ck_ref = str(tmp_path / "ref")
+    (p_ref, _, _), info = _trainer().fit_stream(
+        make_it(), seed=5, max_steps=10, checkpoint_dir=ck_ref,
+        checkpoint_every=4)
+    assert info["global_step"] == 10
+
+    ck = str(tmp_path / "killed")
+
+    def killer(bep):
+        if bep.nbatch == 6:
+            raise _Boom()
+
+    with pytest.raises(_Boom):
+        _trainer().fit_stream(make_it(), seed=5, max_steps=10,
+                              checkpoint_dir=ck, checkpoint_every=4,
+                              batch_end_callback=killer)
+    # resume restores step 4 + its stream cursor; 6 more steps land on
+    # the same global steps 5..10 with the same data and RNG keys
+    (p_res, _, _), info2 = _trainer().fit_stream(
+        make_it(), seed=5, max_steps=6, checkpoint_dir=ck,
+        checkpoint_every=4, resume="auto")
+    assert info2["global_step"] == 10
+    assert _params_equal(p_ref, p_res)
+
+
+@pytest.mark.chaos
+def test_fit_stream_stall_bounded_retry(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=7, loop=True)
+    with chaos.inject("data.read", "delay", prob=1.0, delay=0.03,
+                      seed=1, limit=8):
+        _, info = _trainer().fit_stream(it, seed=5, max_steps=4,
+                                        stall_timeout=0.02, retries=10,
+                                        backoff_s=0.01)
+    assert info["steps"] == 4 and info["stalls"] > 0
+    assert obs.REGISTRY.get("stream_stalls_total").total() > 0
+
+
+@pytest.mark.chaos
+def test_fit_stream_stall_retries_exhausted_is_typed(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=7, loop=True)
+    with chaos.inject("data.read", "delay", prob=1.0, delay=0.5,
+                      seed=1):
+        with pytest.raises(StreamStallError):
+            _trainer().fit_stream(it, seed=5, max_steps=4,
+                                  stall_timeout=0.02, retries=2,
+                                  backoff_s=0.005)
+
+
+@pytest.mark.chaos
+def test_fit_stream_skip_and_count_degraded_mode(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=7, loop=True)
+    with chaos.inject("data.read", "drop", prob=0.4, seed=3, limit=3):
+        _, info = _trainer().fit_stream(it, seed=5, max_steps=6,
+                                        skip_on_error=True)
+    assert info["steps"] == 6 and info["skipped"] > 0
+    assert obs.REGISTRY.get("stream_skipped_total").total() > 0
+
+
+@pytest.mark.chaos
+def test_fit_stream_corruption_without_skip_is_typed(recfiles):
+    it = stream.StreamDataIter(recfiles, (D,), B, seed=7, loop=True)
+    with chaos.inject("data.read", "drop", prob=1.0, seed=3, limit=1):
+        with pytest.raises(CorruptMessageError):
+            _trainer().fit_stream(it, seed=5, max_steps=4)
+
+
+def test_stream_stall_watchdog_rule_registered():
+    from mxnet_tpu.observability.watchdog import default_rules
+
+    names = [r.name for r in default_rules()]
+    assert "stream_stall" in names

@@ -303,6 +303,7 @@ def test_default_rules_clean_registry_fires_nothing():
     assert names == ["spans_dropped", "heartbeat_stale",
                      "replication_lag", "step_p99_regression",
                      "straggler", "mfu_regression", "goodput_floor",
+                     "stream_stall",
                      "request_p99_slo", "queue_saturation",
                      "slo_availability_fast_burn",
                      "slo_availability_slow_burn",

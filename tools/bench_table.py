@@ -46,7 +46,10 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               "request_ms_p50": False, "request_ms_p99": False,
               # schema-8 observability keys (BENCH_SERVING=1 rounds)
               "slo_availability": True,
-              "request_trace_overhead_pct": False}
+              "request_trace_overhead_pct": False,
+              # schema-9 continuous-training keys (BENCH_CONTINUOUS=1)
+              "stream_mb_per_sec": True, "data_wait_pct": False,
+              "swap_downtime_ms": False}
 TREND_TOLERANCE = 0.10
 
 
