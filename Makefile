@@ -49,6 +49,9 @@ continuous:
 serve:
 	python tools/serve.py --smoke
 
+generate:
+	python tools/generate_demo.py
+
 slo:
 	python tools/slo_report.py
 
@@ -56,5 +59,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	dryrun dist-test chaos trace watchdog elastic continuous serve slo \
-	clean
+	dryrun dist-test chaos trace watchdog elastic continuous serve \
+	generate slo clean

@@ -81,7 +81,12 @@ def _obs_counters():
 # v9: stream_mb_per_sec / data_wait_pct / swap_downtime_ms from the
 # BENCH_CONTINUOUS=1 continuous-training lane (streamed recordio fit
 # on the prefetch feeder + one hot-swap under a client hammer)
-_SCHEMA_VERSION = 9
+# v10: tokens_per_sec / tokens_per_sec_per_user / inter_token_ms_p99 /
+# prefill_ms_p50 / kv_cache_occupancy (+ tokens_per_sec_naive, the
+# re-prefill-per-token baseline the ≥2x acceptance ratio is taken
+# against) from the BENCH_GENERATE=1 autoregressive generation lane —
+# the v6 reservation, filled
+_SCHEMA_VERSION = 10
 
 
 def _bench_peak():
@@ -640,6 +645,126 @@ def continuous_main():
     }))
 
 
+def generate_main():
+    """Autoregressive generation lane (BENCH_GENERATE=1): the
+    prefill/decode split with the paged KV cache vs the naive
+    re-prefill-per-token baseline (one full-sequence forward per
+    generated token, at a FIXED padded shape so the baseline pays no
+    recompiles either — the ≥2x acceptance ratio measures the
+    algorithm, not compile noise).  Schema-10 additive keys:
+    ``tokens_per_sec`` (aggregate across concurrent users),
+    ``tokens_per_sec_per_user``, ``inter_token_ms_p99`` (client-side,
+    measured off the chunked token stream the way a user would),
+    ``prefill_ms_p50`` (admission to first token), and
+    ``kv_cache_occupancy`` (used/total blocks at full load)."""
+    import threading as _threading
+
+    import jax
+
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu import serving
+    from mxnet_tpu.models import transformer as tfm
+
+    platform = jax.devices()[0].platform
+    users = int(os.environ.get("BENCH_GEN_USERS", "4"))
+    prompt_len = int(os.environ.get("BENCH_GEN_PROMPT", "8"))
+    new_tokens = int(os.environ.get("BENCH_GEN_TOKENS", "32"))
+    embed = int(os.environ.get("BENCH_GEN_EMBED",
+                               "256" if platform == "tpu" else "64"))
+    layers = int(os.environ.get("BENCH_GEN_LAYERS", "2"))
+    vocab = int(os.environ.get("BENCH_GEN_VOCAB", "512"))
+    seq_len = prompt_len + new_tokens
+
+    cfg = tfm.lm_config(num_classes=vocab, seq_len=seq_len,
+                        num_embed=embed, num_heads=4, num_layers=layers)
+    params = tfm.init_lm_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    prompts = rs.randint(0, vocab, size=(users, prompt_len)).astype(
+        np.int32)
+
+    # naive baseline: every token re-runs the FULL forward over the
+    # whole context (what serving looks like without a KV cache) —
+    # one warm fixed-shape executor, one dispatch per token
+    naive = serving.LMBackend(params, cfg, num_blocks=4)
+    toks = list(prompts[0])
+    naive.prefill(np.pad(prompts[0], (0, seq_len - prompt_len)),
+                  prompt_len)                      # warm the executor
+    t0 = time.perf_counter()
+    for _ in range(new_tokens):
+        padded = np.zeros(seq_len, np.int32)
+        padded[:len(toks)] = toks
+        logits, _, _, _ = naive.prefill(padded, len(toks))
+        toks.append(int(np.argmax(logits)))
+    tps_naive = new_tokens / (time.perf_counter() - t0)
+
+    # the generation lane: paged cache, iteration-level batching
+    blocks_needed = users * -(-seq_len // 16) + 4
+    be = serving.LMBackend(params, cfg, block_size=16,
+                           num_blocks=blocks_needed, model="bench_lm")
+    sched = serving.GenerationScheduler(name="bench")
+    decode_buckets = sorted({1, max(1, users // 2), users})
+    sched.register("bench_lm", be, decode_buckets=decode_buckets,
+                   prefill_buckets=[prompt_len])
+    sched.warmup("bench_lm")
+    compiles = obs.REGISTRY.get("generation_compiles_total")
+    warm_compiles = int(compiles.total()) if compiles else 0
+
+    arrivals = [[] for _ in range(users)]
+    peak_occ = [0.0]
+
+    def _consume(i, req):
+        for _ in req.tokens(timeout=120):
+            arrivals[i].append(time.perf_counter())
+            peak_occ[0] = max(peak_occ[0],
+                              be.cache.stats()["occupancy"])
+
+    t0 = time.perf_counter()
+    reqs = [sched.submit("bench_lm", prompts[i],
+                         max_new_tokens=new_tokens)
+            for i in range(users)]
+    consumers = [_threading.Thread(target=_consume, args=(i, r))
+                 for i, r in enumerate(reqs)]
+    for c in consumers:
+        c.start()
+    for c in consumers:
+        c.join(timeout=300)
+    wall = time.perf_counter() - t0
+    total_tokens = sum(len(r.generated) for r in reqs)
+    tps = total_tokens / wall
+    itl_ms = np.concatenate(
+        [np.diff(np.asarray(a)) for a in arrivals if len(a) > 1]) * 1e3
+    prefill_ms = np.asarray(
+        [r.first_token_s for r in reqs if r.first_token_s]) * 1e3
+    recompiles = (int(compiles.total()) if compiles else 0) \
+        - warm_compiles
+    sched.close()
+
+    print(json.dumps({
+        "metric": "generation_throughput" if platform == "tpu"
+                  else "generation_cpu_smoke_throughput",
+        "value": round(tps, 2), "unit": "tokens/s",
+        "vs_baseline": 0.0,  # the 2017 reference has no generation lane
+        "tokens_per_sec": round(tps, 2),
+        "tokens_per_sec_per_user": round(tps / users, 2),
+        "inter_token_ms_p99": round(
+            float(np.percentile(itl_ms, 99)) if itl_ms.size else 0.0, 3),
+        "prefill_ms_p50": round(
+            float(np.percentile(prefill_ms, 50))
+            if prefill_ms.size else 0.0, 3),
+        "kv_cache_occupancy": round(peak_occ[0], 4),
+        "tokens_per_sec_naive": round(tps_naive, 2),
+        "speedup_vs_naive": round(tps / tps_naive, 2)
+        if tps_naive > 0 else None,
+        "recompiles_after_warmup": recompiles,
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"users": users, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "embed": embed,
+                   "layers": layers, "vocab": vocab,
+                   "decode_buckets": decode_buckets},
+    }))
+
+
 def main():
     import jax
     import mxnet_tpu  # noqa: F401
@@ -647,6 +772,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_GENERATE") == "1":
+        generate_main()
+        return
     if os.environ.get("BENCH_CONTINUOUS") == "1":
         continuous_main()
         return
@@ -859,6 +987,9 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_GENERATE") == "1":
+        return ("generation_throughput",
+                "generation_cpu_smoke_throughput", "tokens/s")
     if os.environ.get("BENCH_SERVING") == "1":
         return ("serving_throughput", "serving_cpu_smoke_throughput",
                 "req/s")

@@ -38,6 +38,18 @@ site                      planted at
                           ``grow:<group>`` / ``shrink:<group>`` — a fired
                           rule aborts the action before any membership
                           change)
+``serving.decode``        generation decode-step dispatch, just before the
+                          device call (``GenerationScheduler``; ``name`` is
+                          ``<model>:<bucket>``; retried
+                          ``MXNET_TPU_SERVING_RETRIES`` times — cache
+                          writes happen only after a successful step, so a
+                          retry can never corrupt another sequence's
+                          blocks)
+``serving.kv_alloc``      paged KV-cache block allocation
+                          (``PagedKVCache.allocate``; ``name`` is the
+                          sequence id; ``raise``/``drop`` surface as the
+                          typed 429 ``CacheExhaustedError`` path, ``delay``
+                          stretches the admission window)
 ``data.read``             RecordIO record read (``MXRecordIO.read``;
                           ``name`` is the stream's uri).  ``corrupt``
                           garbles the record header so the magic check
@@ -95,7 +107,8 @@ SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
     "kvstore.resize_drop", "checkpoint.write", "serving.admit",
-    "serving.dispatch", "serving.scale", "data.read",
+    "serving.dispatch", "serving.scale", "serving.decode",
+    "serving.kv_alloc", "data.read",
 })
 
 

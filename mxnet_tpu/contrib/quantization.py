@@ -32,7 +32,22 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["fold_bn", "quantize_symbol", "calibrate_ranges",
-           "quantize_model", "quantize_aware_symbol", "quantize_model_qat"]
+           "quantize_model", "quantize_aware_symbol", "quantize_model_qat",
+           "quantize_weight_int8"]
+
+
+def quantize_weight_int8(w):
+    """Symmetric max-abs int8/127 grid for ONE weight array.
+
+    The same grid :func:`quantize_symbol` deploys, exposed as an
+    array-level helper so other subsystems (the generation lane's
+    opt-in int8 vocab head) stage int8 weights without a graph rewrite.
+    Returns ``(w_q int8, scale fp32)`` with ``w ≈ w_q * scale``.
+    """
+    w = _np.asarray(w)
+    wmax = float(_np.abs(w).max()) or 1e-8
+    wq = _np.clip(_np.round(w / wmax * 127.0), -127, 127).astype(_np.int8)
+    return wq, _np.float32(wmax / 127.0)
 
 
 # ---------------------------------------------------------------------

@@ -109,3 +109,192 @@ def get_symbol(num_classes=32000, seq_len=1024, num_embed=512, num_heads=8,
     if dtype != "float32":
         pred = sym.Cast(pred, dtype="float32")
     return with_aux(sym.SoftmaxOutput(data=pred, label=label, name="softmax"))
+
+
+# ----------------------------------------------------------------------
+# functional LM path: prefill + single-token decode for the generation
+# lane (serving/generation.py)
+# ----------------------------------------------------------------------
+#
+# The Symbol graph above trains the model; serving generation needs two
+# *inference* entry points the executor does not offer: a prefill that
+# returns every layer's K/V for the paged cache, and a single-token step
+# that reads K/V back through a block table.  Both are plain functions
+# over a params dict keyed by the SAME checkpoint names ``get_symbol``
+# produces (``embed_weight``, ``l0_ln1_gamma``, ``l0_attn_qkv_weight``,
+# ``pred_weight``, ...), so a trained ``save_checkpoint`` arg dict drops
+# straight in.
+#
+# Every op is drawn from the shape-stable set in ``ops/attention.py``
+# (mul-reduce scores, elementwise fp32 softmax, ``einsum("btc,fc->btf")``
+# projections, minor-axis layernorm): the bits of token ``t``'s logits
+# are identical whether computed in a T-row prefill, a full-sequence
+# forward, or a 1-row decode step — the KV-cache correctness gate in
+# tests/test_generation.py asserts exact equality.
+
+import numpy as np
+import jax.numpy as jnp
+from jax import nn as jnn
+
+from ..ops.attention import paged_decode_attention, stable_causal_attention
+
+_LN_EPS = 1e-5
+
+
+def lm_config(num_classes=128, seq_len=64, num_embed=32, num_heads=4,
+              num_layers=2):
+    """Config dict shared by :func:`init_lm_params` / :func:`lm_prefill`
+    / :func:`lm_decode_step`; mirrors :func:`get_symbol`'s signature."""
+    if num_embed % num_heads:
+        raise ValueError("num_embed %d not divisible by num_heads %d"
+                         % (num_embed, num_heads))
+    return {"num_classes": num_classes, "seq_len": seq_len,
+            "num_embed": num_embed, "num_heads": num_heads,
+            "num_layers": num_layers}
+
+
+def init_lm_params(cfg, seed=0, scale=0.02):
+    """Random fp32 params under the ``get_symbol`` checkpoint name
+    scheme (numpy, so they serialize like any other arg dict)."""
+    rng = np.random.RandomState(seed)
+    c, v, t = cfg["num_embed"], cfg["num_classes"], cfg["seq_len"]
+
+    def w(*shape):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    params = {"embed_weight": w(v, c), "pos_embed_weight": w(1, t, c),
+              "final_ln_gamma": np.ones(c, np.float32),
+              "final_ln_beta": np.zeros(c, np.float32),
+              "pred_weight": w(v, c), "pred_bias": np.zeros(v, np.float32)}
+    for i in range(cfg["num_layers"]):
+        params.update({
+            "l%d_ln1_gamma" % i: np.ones(c, np.float32),
+            "l%d_ln1_beta" % i: np.zeros(c, np.float32),
+            "l%d_ln2_gamma" % i: np.ones(c, np.float32),
+            "l%d_ln2_beta" % i: np.zeros(c, np.float32),
+            "l%d_attn_qkv_weight" % i: w(3 * c, c),
+            "l%d_attn_out_weight" % i: w(c, c),
+            "l%d_ffn1_weight" % i: w(4 * c, c),
+            "l%d_ffn1_bias" % i: np.zeros(4 * c, np.float32),
+            "l%d_ffn2_weight" % i: w(c, 4 * c),
+            "l%d_ffn2_bias" % i: np.zeros(c, np.float32),
+        })
+    return params
+
+
+def _lm_ln(x, gamma, beta):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + _LN_EPS)
+    return y * gamma + beta
+
+
+def _lm_qkv(x, qkv_weight, cfg):
+    """Fused QKV projection of [B, T, C] → q, k, v each [B, H, T, D]."""
+    b, t, c = x.shape
+    h = cfg["num_heads"]
+    d = c // h
+    qkv = jnp.einsum("btc,fc->btf", x, qkv_weight)
+    qkv = qkv.reshape(b, t, 3, h, d).transpose(2, 0, 3, 1, 4)
+    return qkv[0], qkv[1], qkv[2]
+
+
+def _lm_ffn(x, i, params):
+    h = jnp.einsum("btc,fc->btf", x, params["l%d_ffn1_weight" % i])
+    h = jnn.gelu(h + params["l%d_ffn1_bias" % i])
+    h = jnp.einsum("btc,fc->btf", h, params["l%d_ffn2_weight" % i])
+    return h + params["l%d_ffn2_bias" % i]
+
+
+def _lm_logits(x, params, int8_head=False):
+    """Vocab projection.  ``int8_head`` reads the quantized grid staged
+    by :func:`quantize_lm_head` — int8 weights dequantized on the fly
+    (the storage/bandwidth win), fp32 accumulate, shared scale."""
+    if int8_head:
+        wq = params["pred_weight_q"].astype(jnp.float32)
+        return (jnp.einsum("btc,fc->btf", x, wq) * params["pred_scale"]
+                + params["pred_bias"])
+    return (jnp.einsum("btc,fc->btf", x, params["pred_weight"])
+            + params["pred_bias"])
+
+
+def lm_prefill(params, tokens, cfg, int8_head=False):
+    """Full-sequence forward of ``tokens`` int32 ``[B, T]``.
+
+    Returns ``(logits [B, T, V], k [L, B, T, H, D], v [L, B, T, H, D])``
+    — K/V in cache page layout, ready for ``PagedKVCache.write_prefill``
+    (per sequence: ``k[:, b, :length]``).  This is also the lane's
+    "naive" full forward: the parity gate compares its row ``t`` logits
+    against decode step ``t``.
+    """
+    t = tokens.shape[1]
+    x = params["embed_weight"][tokens] + params["pos_embed_weight"][:, :t]
+    x = x.astype(jnp.float32)
+    ks, vs = [], []
+    for i in range(cfg["num_layers"]):
+        h = _lm_ln(x, params["l%d_ln1_gamma" % i], params["l%d_ln1_beta" % i])
+        q, k, v = _lm_qkv(h, params["l%d_attn_qkv_weight" % i], cfg)
+        a = stable_causal_attention(q, k, v)
+        b, heads, tt, d = a.shape
+        a = a.transpose(0, 2, 1, 3).reshape(b, tt, heads * d)
+        x = x + jnp.einsum("btc,fc->btf", a,
+                           params["l%d_attn_out_weight" % i])
+        h = _lm_ln(x, params["l%d_ln2_gamma" % i], params["l%d_ln2_beta" % i])
+        x = x + _lm_ffn(h, i, params)
+        ks.append(k.transpose(0, 2, 1, 3))   # [B, T, H, D] page layout
+        vs.append(v.transpose(0, 2, 1, 3))
+    x = _lm_ln(x, params["final_ln_gamma"], params["final_ln_beta"])
+    return _lm_logits(x, params, int8_head), jnp.stack(ks), jnp.stack(vs)
+
+
+def lm_decode_step(params, tokens, positions, k_pages, v_pages,
+                   block_tables, context_lens, cfg, int8_head=False):
+    """One decode step for a batch of sequences through the paged cache.
+
+    ``tokens``/``positions`` int32 ``[B]`` (position = context_len - 1);
+    ``k_pages``/``v_pages`` ``[L, num_blocks, block_size, H, D]``;
+    ``block_tables`` int32 ``[B, max_blocks]``; ``context_lens`` int32
+    ``[B]`` counting the current token.  Returns ``(logits [B, V],
+    k_step [L, B, H, D], v_step [L, B, H, D])`` — the caller writes
+    ``k_step``/``v_step`` into the pool only after the dispatch
+    succeeds, so chaos retries cannot corrupt other sequences' blocks.
+    """
+    x = (params["embed_weight"][tokens]
+         + params["pos_embed_weight"][0][positions])[:, None, :]
+    x = x.astype(jnp.float32)
+    ks, vs = [], []
+    for i in range(cfg["num_layers"]):
+        h = _lm_ln(x, params["l%d_ln1_gamma" % i], params["l%d_ln1_beta" % i])
+        q, k, v = _lm_qkv(h, params["l%d_attn_qkv_weight" % i], cfg)
+        k1, v1 = k[:, :, 0], v[:, :, 0]      # [B, H, D]
+        a = paged_decode_attention(q[:, :, 0], k1, v1, k_pages[i],
+                                   v_pages[i], block_tables, context_lens)
+        b, heads, d = a.shape
+        a = a.reshape(b, 1, heads * d)
+        x = x + jnp.einsum("btc,fc->btf", a,
+                           params["l%d_attn_out_weight" % i])
+        h = _lm_ln(x, params["l%d_ln2_gamma" % i], params["l%d_ln2_beta" % i])
+        x = x + _lm_ffn(h, i, params)
+        ks.append(k1)
+        vs.append(v1)
+    x = _lm_ln(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = _lm_logits(x, params, int8_head)
+    return logits[:, 0], jnp.stack(ks), jnp.stack(vs)
+
+
+def quantize_lm_head(params):
+    """Opt-in int8 vocab head: stage ``pred_weight`` on the
+    ``contrib.quantization`` symmetric int8/127 grid.
+
+    Returns a new params dict with ``pred_weight_q`` (int8) and
+    ``pred_scale`` added; ``lm_prefill``/``lm_decode_step`` read them
+    when called with ``int8_head=True``.  The fp32 ``pred_weight`` stays
+    for the parity gate — int8 logits are approximate by construction
+    and excluded from the bitwise contract.
+    """
+    from ..contrib.quantization import quantize_weight_int8
+    wq, scale = quantize_weight_int8(params["pred_weight"])
+    out = dict(params)
+    out["pred_weight_q"] = wq
+    out["pred_scale"] = scale
+    return out

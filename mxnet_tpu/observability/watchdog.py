@@ -503,6 +503,17 @@ def default_rules():
              severity="critical",
              description="serving request p99 (admission to response) "
                          "broke the MXNET_TPU_WATCHDOG_REQUEST_P99 SLO"),
+        # generation lane (serving/generation.py): the token stream's
+        # UX is inter-token latency, not request latency — one slow
+        # decode step stalls EVERY live sequence at once
+        Rule("inter_token_p99", "generation_inter_token_seconds",
+             stat="p99",
+             threshold=_env_float("MXNET_TPU_WATCHDOG_ITL_P99", 0.5),
+             severity="critical",
+             description="inter-token latency p99 across live "
+                         "generations broke the MXNET_TPU_WATCHDOG_"
+                         "ITL_P99 SLO — decode steps are stalling the "
+                         "whole batch"),
         Rule("queue_saturation", "serving_queue_saturation", stat="max",
              threshold=_env_float("MXNET_TPU_WATCHDOG_QUEUE_SAT", 0.9),
              for_s=_env_float("MXNET_TPU_WATCHDOG_QUEUE_SAT_FOR_S", 0.0),

@@ -8,3 +8,6 @@ from . import nn  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import attention  # noqa: F401
 from . import contrib_op  # noqa: F401
+
+# not an op: the generation lane's paged KV-cache allocator
+from . import kv_cache  # noqa: F401

@@ -28,7 +28,8 @@ from jax import lax
 
 from .registry import ParamSpec as P, register
 
-__all__ = ["flash_attention", "ring_attention"]
+__all__ = ["flash_attention", "ring_attention", "paged_decode_attention",
+           "stable_causal_attention"]
 
 _NEG_INF = -1e30
 # Mosaic tiles the last two block dims as (8 sublanes, 128 lanes); per-row
@@ -64,6 +65,100 @@ def _attention_fwd_ref(q, k, v, causal, sm_scale, return_lse=False):
     if return_lse:
         return out, (m + jnp.log(l))[..., 0]  # [B, H, T] fp32
     return out
+
+
+# ----------------------------------------------------------------------
+# shape-stable attention for the generation lane (prefill/decode parity)
+# ----------------------------------------------------------------------
+#
+# The autoregressive lane promises *bitwise* parity between incremental
+# decode through the paged cache and a full-sequence forward pass.  On
+# XLA:CPU the dot-general behind ``einsum("bhqd,bhkd->bhqk", ...)`` picks
+# different reduction strategies for different q-lengths, so the same
+# row's score differs in the last bit between a T-row prefill and a
+# 1-row decode step.  A multiply-and-reduce over the head dim is an
+# independent per-(b,h,q,k) reduction and compiles to the same sequence
+# of adds regardless of how many query rows ride along — that, plus an
+# elementwise fp32 softmax and ``-1e30`` masking applied *before* the
+# row max (masked lanes underflow to exact 0.0 in exp, contributing
+# exact zeros to the p·v contraction), makes every op here stable across
+# both the query-length axis and key-dim padding.  Prefill, full
+# forward, and paged decode all route through these two helpers so the
+# three paths cannot drift.
+
+
+def _stable_scores(q, k):
+    """fp32 [B, H, T, K] scores via mul-reduce (bitwise stable in T/K)."""
+    return jnp.sum(q.astype(jnp.float32)[:, :, :, None, :] *
+                   k.astype(jnp.float32)[:, :, None, :, :], axis=-1)
+
+
+def _stable_softmax(s):
+    """Row softmax of fp32 scores; masked lanes must already be -1e30."""
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+def stable_causal_attention(q, k, v, sm_scale=None):
+    """Exact causal attention on ``[B, H, T, D]``, shape-stable bits.
+
+    The generation lane's prefill / full-forward path.  Slower than
+    :func:`flash_attention` (materialises the score matrix) but its
+    output bits do not depend on the query length — the property the
+    paged-decode parity gate relies on.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
+    s = _stable_scores(q, k) * sm_scale
+    mask = _causal_mask(q.shape[2], k.shape[2], k.shape[2] - q.shape[2], 0)
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = _stable_softmax(s)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def paged_decode_attention(q, k_step, v_step, k_pages, v_pages,
+                           block_tables, context_lens, sm_scale=None):
+    """One decode step's attention, K/V gathered through the block table.
+
+    - ``q`` / ``k_step`` / ``v_step``: ``[B, H, D]`` — this step's
+      single query per sequence and its freshly projected K/V (written
+      back to the pool by the caller *after* the step succeeds, so a
+      retried dispatch never leaves half-written pages).
+    - ``k_pages`` / ``v_pages``: ``[num_blocks, block_size, H, D]`` —
+      one layer's slice of the shared :class:`~mxnet_tpu.ops.kv_cache.
+      PagedKVCache` pool.
+    - ``block_tables``: ``int32 [B, max_blocks]`` — per-sequence page
+      lists, zero-padded (pad rows are masked off below).
+    - ``context_lens``: ``int32 [B]`` — valid tokens per sequence,
+      INCLUDING the current one (whose K/V arrives via ``k_step``).
+
+    Returns ``[B, H, D]``.  The current token is scattered into the
+    gathered keys at position ``context_len - 1`` so the valid keys form
+    the same contiguous prefix a full-sequence forward sees — identical
+    reduction order, and the padded-key masking keeps garbage in
+    unwritten page tails away from the output bits.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
+    bsz, max_blocks = block_tables.shape
+    blk = k_pages.shape[1]
+    heads, dim = k_pages.shape[2], k_pages.shape[3]
+    kmax = max_blocks * blk
+    rows = jnp.arange(bsz)
+    positions = context_lens - 1
+    k = k_pages[block_tables].reshape(bsz, kmax, heads, dim)
+    v = v_pages[block_tables].reshape(bsz, kmax, heads, dim)
+    k = k.at[rows, positions].set(k_step)
+    v = v.at[rows, positions].set(v_step)
+    k = k.transpose(0, 2, 1, 3)            # [B, H, Kmax, D]
+    v = v.transpose(0, 2, 1, 3)
+    s = _stable_scores(q[:, :, None, :], k) * sm_scale   # [B, H, 1, Kmax]
+    pos = lax.broadcasted_iota(jnp.int32, (1, 1, 1, kmax), 3)
+    s = jnp.where(pos < context_lens[:, None, None, None], s, _NEG_INF)
+    p = _stable_softmax(s)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out[:, :, 0, :]
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,236 @@
+"""Block-allocated paged KV cache for autoregressive decode.
+
+The vLLM idea (Kwon et al., SOSP '23) sized for this runtime: instead of
+one contiguous ``[max_len, heads, dim]`` buffer per sequence — which
+fragments HBM and caps concurrency at ``pool / max_len`` — K/V state
+lives in fixed-size **token blocks** drawn from a shared pool.  Each
+sequence owns an ordered **block table** (list of block ids); logical
+token position ``t`` lives at ``(table[t // block_size], t % block_size)``.
+Allocation is a free-list pop, release is a free-list push, and a full
+pool surfaces as the typed :class:`CacheExhaustedError` (HTTP 429)
+through the serving admission machinery rather than an OOM.
+
+Layout: one pair of pools per cache, shaped
+
+    ``k_pages / v_pages : [num_layers, num_blocks, block_size, heads, dim]``
+
+so a decode step can ship the *whole* pool to the device plus per-batch
+``int32`` block tables, and :func:`~mxnet_tpu.ops.attention.
+paged_decode_attention` gathers K/V rows through the table inside the
+jitted step — the pool shape is static, so decode dispatches never
+recompile as sequences come and go.
+
+The cache is **backend state**: ``serving.generation.LMBackend`` owns
+one, the ``ModelRegistry`` swap machinery replaces cache and weights
+together, and the generation lane re-prefills live sequences after a
+hot-swap (stale pages are never mixed with new weights).
+
+Chaos site ``serving.kv_alloc`` fires at the top of :meth:`allocate`
+(name = sequence id) so tests can drill the exhaustion/429 path and
+allocation delay without filling the pool.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .. import chaos
+from ..base import MXNetError
+from ..observability import metrics as _metrics
+
+__all__ = ["CacheExhaustedError", "PagedKVCache", "default_block_size",
+           "default_num_blocks"]
+
+
+class CacheExhaustedError(MXNetError):
+    """No free KV-cache blocks for a new sequence or a grown one.
+
+    Carries ``http_status = 429`` so the serving front-end maps it like
+    the other typed admission rejections (the client should back off and
+    retry; accepted sequences are never evicted to make room).
+    """
+
+    http_status = 429
+
+
+def default_block_size():
+    """Tokens per cache block (``MXNET_TPU_GEN_BLOCK_SIZE``, default 16)."""
+    return int(os.environ.get("MXNET_TPU_GEN_BLOCK_SIZE", "16"))
+
+
+def default_num_blocks():
+    """Blocks in the shared pool (``MXNET_TPU_GEN_BLOCKS``, default 64)."""
+    return int(os.environ.get("MXNET_TPU_GEN_BLOCKS", "64"))
+
+
+_M_OCC = _metrics.gauge(
+    "serving_kv_cache_occupancy",
+    "Fraction of KV-cache blocks in use, by model", ["model"])
+_M_BLOCKS = _metrics.gauge(
+    "serving_kv_cache_used_blocks",
+    "KV-cache blocks currently allocated, by model", ["model"])
+_M_EXHAUSTED = _metrics.counter(
+    "serving_kv_cache_exhausted_total",
+    "Allocations rejected because the block pool was empty, by model",
+    ["model"])
+
+
+class PagedKVCache(object):
+    """Free-list block allocator + per-sequence block tables + the pools.
+
+    Thread-safe: the generation lane allocates/frees from its loop
+    thread while the front-end frees on client disconnect.  All index
+    math is host-side numpy; the pools are plain ``np.ndarray`` so the
+    dispatch path hands them to jit as-is (XLA:CPU aliases the buffer,
+    device backends stage them once per step).
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, block_size=None,
+                 num_blocks=None, dtype=np.float32, model="default"):
+        self.block_size = int(block_size or default_block_size())
+        self.num_blocks = int(num_blocks or default_num_blocks())
+        if self.block_size <= 0 or self.num_blocks <= 0:
+            raise MXNetError("PagedKVCache needs positive block_size/"
+                             "num_blocks (got %d/%d)"
+                             % (self.block_size, self.num_blocks))
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.model = model
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k_pages = np.zeros(shape, dtype=dtype)
+        self.v_pages = np.zeros(shape, dtype=dtype)
+        self._lock = threading.Lock()
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._tables = {}      # seq_id -> [block ids]
+        self._lengths = {}     # seq_id -> tokens written
+        self._occ = _M_OCC.labels(model)
+        self._used = _M_BLOCKS.labels(model)
+        self._exhausted = _M_EXHAUSTED.labels(model)
+
+    # -- allocation --------------------------------------------------
+
+    def _blocks_for(self, num_tokens):
+        return -(-max(num_tokens, 1) // self.block_size)
+
+    def allocate(self, seq_id, num_tokens):
+        """Reserve capacity for ``num_tokens`` total tokens of ``seq_id``.
+
+        Idempotent growth: call again with a larger total to extend.
+        Raises :class:`CacheExhaustedError` (and allocates nothing) if
+        the free list cannot cover the extension — a failed grow never
+        strands partially-allocated blocks.
+        """
+        chaos.visit("serving.kv_alloc", name=str(seq_id))
+        need_total = self._blocks_for(num_tokens)
+        with self._lock:
+            table = self._tables.get(seq_id, [])
+            grow = need_total - len(table)
+            if grow > len(self._free):
+                self._exhausted.inc()
+                raise CacheExhaustedError(
+                    "kv cache exhausted: seq %r needs %d more block(s), "
+                    "%d free of %d" % (seq_id, grow, len(self._free),
+                                       self.num_blocks))
+            if grow > 0:
+                fresh = [self._free.pop() for _ in range(grow)]
+                self._tables[seq_id] = table + fresh
+                self._lengths.setdefault(seq_id, 0)
+            self._set_gauges_locked()
+
+    def free(self, seq_id):
+        """Return ``seq_id``'s blocks to the pool; returns the freed
+        block ids (empty for an unknown sequence — freeing is always
+        safe to call from retire paths)."""
+        with self._lock:
+            table = self._tables.pop(seq_id, None) or []
+            self._lengths.pop(seq_id, None)
+            if table:
+                self._free.extend(reversed(table))
+            self._set_gauges_locked()
+            return list(table)
+
+    def _set_gauges_locked(self):
+        used = self.num_blocks - len(self._free)
+        self._used.set(used)
+        self._occ.set(used / float(self.num_blocks))
+
+    # -- reads -------------------------------------------------------
+
+    def length(self, seq_id):
+        return self._lengths.get(seq_id, 0)
+
+    def sequences(self):
+        with self._lock:
+            return sorted(self._tables)
+
+    def block_table(self, seq_id, max_blocks):
+        """Padded ``int32[max_blocks]`` table for a decode dispatch.
+
+        Pad entries point at block 0 — harmless, because decode
+        attention masks scores past the context length before softmax
+        (``-1e30`` → exp underflows to exact ``0.0``), so whatever those
+        rows hold never reaches the output bits.
+        """
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise MXNetError("unknown sequence %r" % (seq_id,))
+        if len(table) > max_blocks:
+            raise MXNetError(
+                "sequence %r spans %d blocks > table width %d"
+                % (seq_id, len(table), max_blocks))
+        out = np.zeros(max_blocks, dtype=np.int32)
+        out[:len(table)] = table
+        return out
+
+    # -- writes ------------------------------------------------------
+
+    def write_prefill(self, seq_id, k, v):
+        """Store prompt K/V: ``k``/``v`` shaped ``[L, T, heads, dim]``.
+
+        Requires a prior :meth:`allocate` covering ``T`` tokens.  Writes
+        happen only after a successful prefill dispatch, so a retried
+        (chaos-dropped) dispatch never leaves half-written pages.
+        """
+        k = np.asarray(k)
+        num = k.shape[1]
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None or len(table) < self._blocks_for(num):
+                raise MXNetError(
+                    "write_prefill(%r, %d tokens) exceeds allocation"
+                    % (seq_id, num))
+            for t in range(num):
+                blk, off = table[t // self.block_size], t % self.block_size
+                self.k_pages[:, blk, off] = k[:, t]
+                self.v_pages[:, blk, off] = np.asarray(v)[:, t]
+            self._lengths[seq_id] = max(self._lengths.get(seq_id, 0), num)
+
+    def write_token(self, seq_id, pos, k, v):
+        """Store one decoded token's K/V: ``k``/``v`` ``[L, heads, dim]``."""
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None or pos >= len(table) * self.block_size:
+                raise MXNetError(
+                    "write_token(%r, pos=%d) exceeds allocation"
+                    % (seq_id, pos))
+            blk, off = table[pos // self.block_size], pos % self.block_size
+            self.k_pages[:, blk, off] = np.asarray(k)
+            self.v_pages[:, blk, off] = np.asarray(v)
+            self._lengths[seq_id] = max(self._lengths.get(seq_id, 0),
+                                        pos + 1)
+
+    # -- introspection ----------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            used = self.num_blocks - len(self._free)
+            return {"blocks": self.num_blocks, "used": used,
+                    "free": len(self._free),
+                    "occupancy": used / float(self.num_blocks),
+                    "sequences": len(self._tables),
+                    "block_size": self.block_size}

@@ -15,8 +15,12 @@ traffic in, predictions out:
   steady-state recompiles.
 - :mod:`~mxnet_tpu.serving.replication` — replica groups + failover
   router; accepted requests are never dropped, new load sheds typed.
+- :mod:`~mxnet_tpu.serving.generation` — the autoregressive lane:
+  prefill/decode split, iteration-level batching, paged KV cache
+  (:mod:`~mxnet_tpu.ops.kv_cache`), streamed tokens.
 - :mod:`~mxnet_tpu.serving.frontend` — the stdlib HTTP surface
-  (``/v1/predict``, ``/v1/models``, ``/healthz``, ``/readyz``).
+  (``/v1/predict``, ``/v1/generate``, ``/v1/models``, ``/healthz``,
+  ``/readyz``).
 
 Quickstart (one replica)::
 
@@ -32,25 +36,30 @@ See ``docs/how_to/serving.md`` for the batching model, SLO knobs, and
 the brownout story.
 """
 
-from . import admission, frontend, registry, replication, scheduler
-from .admission import (AdmissionController, DeadlineExceededError,
-                        ReplicaDeadError, ServerDrainingError,
-                        ServerOverloadedError, ServingError,
-                        UnknownModelError, deadline_from_ms,
+from . import (admission, frontend, generation, registry, replication,
+               scheduler)
+from .admission import (AdmissionController, CacheExhaustedError,
+                        DeadlineExceededError, ReplicaDeadError,
+                        ServerDrainingError, ServerOverloadedError,
+                        ServingError, UnknownModelError, deadline_from_ms,
                         default_deadline_ms)
 from .frontend import ServingFrontend, start_frontend
+from .generation import (GenerationRequest, GenerationScheduler,
+                         LMBackend)
 from .registry import (Backend, ExportedBackend, ModelRegistry,
                        PredictorBackend, as_backend, default_buckets)
 from .replication import ReplicaGroup, ServingRouter
 from .scheduler import InferenceRequest, Scheduler
 
 __all__ = [
-    "AdmissionController", "Backend", "DeadlineExceededError",
-    "ExportedBackend", "InferenceRequest", "ModelRegistry",
-    "PredictorBackend", "ReplicaDeadError", "ReplicaGroup", "Scheduler",
-    "ServerDrainingError", "ServerOverloadedError", "ServingError",
-    "ServingFrontend", "ServingRouter", "UnknownModelError",
-    "admission", "as_backend", "deadline_from_ms", "default_buckets",
-    "default_deadline_ms", "frontend", "registry", "replication",
-    "scheduler", "start_frontend",
+    "AdmissionController", "Backend", "CacheExhaustedError",
+    "DeadlineExceededError", "ExportedBackend", "GenerationRequest",
+    "GenerationScheduler", "InferenceRequest", "LMBackend",
+    "ModelRegistry", "PredictorBackend", "ReplicaDeadError",
+    "ReplicaGroup", "Scheduler", "ServerDrainingError",
+    "ServerOverloadedError", "ServingError", "ServingFrontend",
+    "ServingRouter", "UnknownModelError", "admission", "as_backend",
+    "deadline_from_ms", "default_buckets", "default_deadline_ms",
+    "frontend", "generation", "registry", "replication", "scheduler",
+    "start_frontend",
 ]

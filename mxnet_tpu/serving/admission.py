@@ -38,11 +38,12 @@ import time
 
 from ..base import MXNetError
 from ..observability import metrics as _metrics
+from ..ops.kv_cache import CacheExhaustedError
 
 __all__ = ["ServingError", "ServerOverloadedError", "ServerDrainingError",
            "DeadlineExceededError", "UnknownModelError", "ReplicaDeadError",
-           "AdmissionController", "deadline_from_ms", "default_deadline_ms",
-           "max_queue_default", "reject_reason"]
+           "CacheExhaustedError", "AdmissionController", "deadline_from_ms",
+           "default_deadline_ms", "max_queue_default", "reject_reason"]
 
 
 class ServingError(MXNetError):
@@ -89,12 +90,16 @@ class ReplicaDeadError(ServingError):
 
 #: Canonical shed-reason tag per typed rejection — the vocabulary the
 #: ``serving.shed`` span attr and the access-log event share.
+#: ``CacheExhaustedError`` (429) comes from the generation lane's paged
+#: KV cache: it lives in ``ops.kv_cache`` (the allocator can't import
+#: the serving tier) but sheds through this same machinery.
 _REASONS = {
     ServerOverloadedError: "overload",
     DeadlineExceededError: "deadline",
     ServerDrainingError: "draining",
     ReplicaDeadError: "replica_dead",
     UnknownModelError: "unknown_model",
+    CacheExhaustedError: "cache_exhausted",
 }
 
 

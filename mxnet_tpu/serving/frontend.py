@@ -14,6 +14,19 @@ A tiny stdlib ``http.server`` endpoint (same loopback posture as
     per-sample array (``numpy.save`` bytes), the response the first
     output as ``.npy`` bytes (``X-MXTPU-Outputs`` carries the count) —
     no JSON float round-trip on the hot path.
+``POST /v1/generate``
+    JSON body ``{"model": ..., "prompt": [token ids],
+    "max_new_tokens": ..., "eos_id": ..., "deadline_ms": ...}`` →
+    a **chunked** ``application/x-ndjson`` stream, one
+    ``{"token": id}`` line per generated token as the decode loop
+    produces it, closed by a ``{"done": true, "finish_reason": ...,
+    "tokens": [...]}`` summary line.  Tokens reach the client
+    mid-generation (chunked transfer encoding, flushed per token);
+    a client that disconnects mid-stream cancels the request, which
+    retires the sequence and frees its KV-cache blocks at the next
+    decode iteration.  Served when ``target`` (or the optional
+    ``generator=``) is a
+    :class:`~.generation.GenerationScheduler`.
 ``GET /v1/models``
     The registry listing (name, input signature, buckets, max_queue).
 ``GET /healthz`` / ``GET /readyz``
@@ -122,13 +135,18 @@ def _target_ready(target):
     return False
 
 
-def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
+def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
+                   generator=None):
     """Serve the v1 API for ``target`` (a Scheduler or ServingRouter)
     on a daemon thread; returns a :class:`ServingFrontend`.
 
     ``port=None`` reads ``MXNET_TPU_SERVING_PORT`` (default 0 = a
     kernel-assigned free port, reported via ``.port``).  Loopback-bound
     unless ``addr`` says otherwise — the endpoint is unauthenticated.
+
+    ``generator`` optionally serves ``/v1/generate`` from a separate
+    :class:`~.generation.GenerationScheduler`; by default generation is
+    served from ``target`` itself when it has a generation lane.
     """
     import http.server
     import os
@@ -180,7 +198,7 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
 
         def do_POST(self):
             path, _, query = self.path.partition("?")
-            if path != "/v1/predict":
+            if path not in ("/v1/predict", "/v1/generate"):
                 self.send_error(404)
                 return
             t0 = time.monotonic()
@@ -204,7 +222,10 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
                         body = self.rfile.read(length)
                         ctype = (self.headers.get("Content-Type")
                                  or "").lower()
-                        if ctype.startswith("application/octet-stream"):
+                        if path == "/v1/generate":
+                            self._generate(body)
+                        elif ctype.startswith(
+                                "application/octet-stream"):
                             self._predict_raw(body, query)
                         else:
                             self._predict_json(body)
@@ -232,6 +253,69 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0):
             self._reply_json(200, {
                 "model": model,
                 "outputs": [_np.asarray(o).tolist() for o in outs]})
+
+        def _chunk(self, data):
+            # manual chunked-transfer framing: hex length, CRLF, data,
+            # CRLF — flushed per token so the client reads the stream
+            # mid-generation, not after it
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
+        def _generate(self, body):
+            payload = json.loads(body.decode("utf-8"))
+            model = self._model = payload["model"]
+            gen = generator if generator is not None else target
+            if not hasattr(gen, "generate"):
+                raise _admission.UnknownModelError(
+                    "this endpoint has no generation lane "
+                    "(target is %s)" % type(gen).__name__)
+            # submit raises the typed admission errors (429/503/504)
+            # BEFORE any byte of the response is written, so they still
+            # map onto proper HTTP statuses via _reply_error
+            req = gen.submit(
+                model,
+                _np.asarray(payload["prompt"], dtype=_np.int32),
+                max_new_tokens=payload.get("max_new_tokens"),
+                eos_id=payload.get("eos_id"),
+                deadline_ms=payload.get("deadline_ms"))
+            self._status = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            if self._rid:
+                self.send_header("X-MXTPU-Request-Id", self._rid)
+            self.end_headers()
+            try:
+                try:
+                    for tok in req.tokens(timeout=timeout):
+                        self._chunk(json.dumps(
+                            {"token": int(tok)}).encode("utf-8") + b"\n")
+                    tail = {"done": True, "model": model,
+                            "finish_reason": req.finish_reason,
+                            "tokens": list(req.generated)}
+                except MXNetError as exc:
+                    # generation failed after the 200 was committed: the
+                    # error rides the stream, and the missing final
+                    # 0-chunk... is NOT missing — the tail line carries
+                    # the typed error instead of a token list
+                    self._shed = _admission.reject_reason(exc)
+                    tail = {"done": True, "model": model,
+                            "finish_reason": "error",
+                            "error": str(exc),
+                            "type": type(exc).__name__}
+                self._chunk(json.dumps(tail).encode("utf-8") + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away mid-stream: cancel() retires the
+                # sequence and frees its cache blocks at the next
+                # decode iteration
+                req.cancel()
+                self._shed = "disconnect"
+                self._status = 499
+                self.close_connection = True
 
         def _predict_raw(self, body, query):
             q = urllib.parse.parse_qs(query)

@@ -1,0 +1,913 @@
+"""Autoregressive generation lane: prefill/decode split + paged KV cache.
+
+The :mod:`~mxnet_tpu.serving.scheduler` batches *fixed-shape* forward
+passes — one dispatch answers one request.  Token generation inverts
+the economics: a request is answered over hundreds of dispatches, and
+the batch composition changes every step as sequences finish.  This
+module is the serving tier's second dispatch discipline, the
+Orca/vLLM model (Yu et al., OSDI '22; Kwon et al., SOSP '23) the
+scheduler was already styled after:
+
+- **Prefill/decode split.**  Each admitted request runs ONE prefill
+  dispatch (the whole prompt, padded to a prompt-length bucket from
+  ``MXNET_TPU_GEN_PREFILL_BUCKETS``), which fills its KV-cache pages
+  and yields the first token.  After that it joins the shared *decode*
+  batch: one token per sequence per step, padded to a batch bucket from
+  ``MXNET_TPU_GEN_DECODE_BUCKETS``.  Both bucket ladders are shape keys
+  into the backend's jit cache, so steady state recompiles **zero**
+  times (``generation_compiles_total`` flat after :meth:`warmup` — the
+  same tested contract as the classifier lane).
+- **Iteration-level admission.**  The generation loop re-packs the
+  decode batch EVERY step: a request submitted mid-generation is
+  prefilled and joins the *next* decode step as finished sequences
+  retire — nothing waits for the batch to drain
+  (``generation_decode_occupancy`` and per-step row stats are the
+  tested evidence).
+- **Paged KV state.**  K/V lives in the backend's
+  :class:`~mxnet_tpu.ops.kv_cache.PagedKVCache`; exhaustion sheds the
+  new request with the typed 429
+  :class:`~mxnet_tpu.ops.kv_cache.CacheExhaustedError` through the
+  stock admission accounting.  Cache writes happen only AFTER a decode
+  dispatch succeeds, so a chaos-retried step can never corrupt another
+  sequence's blocks.
+- **Cache is backend state.**  ``ModelRegistry.swap`` replaces backend
+  and cache together (the registry machinery is untouched); the loop
+  notices the swap under ``dispatch_lock`` and transparently
+  re-prefills live sequences on the new backend
+  (``generation_reprefills_total``) — stale pages never mix with new
+  weights, and hot-swap/brownout/rollback keep working.
+
+Chaos sites: ``serving.decode`` fires inside the decode window before
+the device call (name ``<model>:<bucket>``, retried
+``MXNET_TPU_SERVING_RETRIES`` times); ``serving.kv_alloc`` fires in the
+allocator.  Prefill dispatches visit the existing ``serving.dispatch``
+site (name ``<model>:prefill:<bucket>``).
+
+Streaming: each :class:`GenerationRequest` is a token queue —
+:meth:`GenerationRequest.tokens` yields ids as the loop produces them
+(the front-end turns this into chunked HTTP on ``/v1/generate``), and
+:meth:`GenerationRequest.cancel` (client disconnect) retires the
+sequence and frees its blocks at the next iteration.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue as _queue
+import threading
+import time
+
+import numpy as _np
+
+from .. import chaos
+from ..base import MXNetError
+from ..models import transformer as _tfm
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+from ..observability.events import emit as _emit_event
+from ..ops.kv_cache import CacheExhaustedError, PagedKVCache
+from . import admission as _admission
+from .registry import Backend, ModelRegistry
+from .scheduler import default_retries
+
+__all__ = ["GenerationRequest", "GenerationScheduler", "LMBackend",
+           "default_decode_buckets", "default_prefill_buckets",
+           "default_max_new_tokens"]
+
+
+def default_prefill_buckets():
+    """``MXNET_TPU_GEN_PREFILL_BUCKETS``: prompt-length pad targets."""
+    raw = os.environ.get("MXNET_TPU_GEN_PREFILL_BUCKETS", "8,16,32,64")
+    try:
+        buckets = sorted({int(b) for b in raw.split(",") if b.strip()})
+    except ValueError:
+        buckets = [8, 16, 32, 64]
+    return [b for b in buckets if b > 0] or [8]
+
+
+def default_decode_buckets():
+    """``MXNET_TPU_GEN_DECODE_BUCKETS``: decode batch pad targets."""
+    raw = os.environ.get("MXNET_TPU_GEN_DECODE_BUCKETS", "1,2,4,8")
+    try:
+        buckets = sorted({int(b) for b in raw.split(",") if b.strip()})
+    except ValueError:
+        buckets = [1, 2, 4, 8]
+    return [b for b in buckets if b > 0] or [1]
+
+
+def default_max_new_tokens():
+    """``MXNET_TPU_GEN_MAX_TOKENS``: per-request generation cap."""
+    try:
+        return int(os.environ.get("MXNET_TPU_GEN_MAX_TOKENS", "32"))
+    except ValueError:
+        return 32
+
+
+_DONE = object()
+
+
+class GenerationRequest(object):
+    """One admitted generation request: a token stream plus a future.
+
+    The generation loop pushes token ids as decode steps complete;
+    :meth:`tokens` yields them live (the streaming front-end's source)
+    and :meth:`result` blocks for the full list.  ``trace`` is the
+    submitter's wire token, the request's identity in the merged trace.
+    """
+
+    __slots__ = ("model", "prompt", "max_new_tokens", "eos_id", "deadline",
+                 "t_admit", "trace", "generated", "error", "finish_reason",
+                 "latency_s", "first_token_s", "seq_id", "_tokens", "_event",
+                 "_cancelled")
+
+    def __init__(self, model, prompt, max_new_tokens, eos_id, deadline):
+        self.model = model
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.t_admit = time.monotonic()
+        self.trace = None
+        self.generated = []
+        self.error = None
+        self.finish_reason = None
+        self.latency_s = None
+        self.first_token_s = None
+        self.seq_id = None
+        self._tokens = _queue.Queue()
+        self._event = threading.Event()
+        self._cancelled = False
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    @property
+    def cancelled(self):
+        return self._cancelled
+
+    def cancel(self):
+        """Client went away: the loop retires the sequence and frees its
+        cache blocks at the next iteration.  Safe from any thread."""
+        self._cancelled = True
+
+    # -- loop side ---------------------------------------------------
+
+    def _push(self, token):
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic() - self.t_admit
+        self.generated.append(int(token))
+        self._tokens.put(int(token))
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self.latency_s = time.monotonic() - self.t_admit
+        self._tokens.put(_DONE)
+        self._event.set()
+
+    def _fail(self, error):
+        self.error = error
+        self.finish_reason = "error"
+        self.latency_s = time.monotonic() - self.t_admit
+        self._tokens.put(_DONE)
+        self._event.set()
+
+    # -- client side -------------------------------------------------
+
+    def tokens(self, timeout=30.0):
+        """Yield generated token ids as they arrive; raises the typed
+        serving error if generation failed."""
+        while True:
+            tok = self._tokens.get(timeout=timeout)
+            if tok is _DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield tok
+
+    def result(self, timeout=30.0):
+        """Block until generation finishes; returns the generated ids."""
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                "generation on model %r timed out after %.1fs"
+                % (self.model, timeout))
+        if self.error is not None:
+            raise self.error
+        return list(self.generated)
+
+
+class LMBackend(Backend):
+    """Generative serving backend: transformer params + paged KV cache
+    + shape-keyed jit caches for prefill and decode.
+
+    Registers through the stock :class:`~.registry.ModelRegistry` (it IS
+    a :class:`~.registry.Backend`), so ``swap``'s ``dispatch_lock``
+    atomicity and signature checks apply unchanged — and because the
+    cache lives HERE, a hot swap replaces weights and KV state as one
+    unit.
+
+    ``int8_head=True`` opts into the
+    :func:`~mxnet_tpu.contrib.quantization.quantize_weight_int8` vocab
+    head for decode logits (storage/bandwidth win on the model's
+    largest matmul); prefill keeps the fp32 head so the first token
+    stays on the parity contract.
+    """
+
+    def __init__(self, params, cfg, block_size=None, num_blocks=None,
+                 int8_head=False, model="lm"):
+        self.cfg = dict(cfg)
+        self.int8_head = bool(int8_head)
+        self.params = _tfm.quantize_lm_head(params) if int8_head \
+            else dict(params)
+        self.input_shapes = {"data": (self.cfg["seq_len"],)}
+        self.cache = PagedKVCache(
+            num_layers=self.cfg["num_layers"],
+            num_heads=self.cfg["num_heads"],
+            head_dim=self.cfg["num_embed"] // self.cfg["num_heads"],
+            block_size=block_size, num_blocks=num_blocks, model=model)
+        # every sequence gets a fixed-width block table: the decode jit
+        # signature depends only on the batch bucket, never on how long
+        # any sequence has run — the zero-recompile contract
+        self.max_blocks_per_seq = -(-self.cfg["seq_len"]
+                                    // self.cache.block_size)
+        self._jits = {}
+        self._jit_lock = threading.Lock()
+
+    def _jit(self, key, build):
+        """Shape-keyed jit cache; returns (fn, cold)."""
+        with self._jit_lock:
+            fn = self._jits.get(key)
+            cold = fn is None
+            if cold:
+                import jax
+
+                fn = jax.jit(build())
+                self._jits[key] = fn
+        return fn, cold
+
+    # -- Backend protocol (full forward; also the naive baseline) ----
+
+    def infer(self, batch):
+        """Full-sequence forward (no cache) — the classifier-lane
+        protocol, and the bench's naive re-prefill baseline."""
+        tokens = _np.asarray(batch["data"], dtype=_np.int32)
+        fn, cold = self._jit(("infer",) + tokens.shape, self._build_prefill)
+        logits, _, _ = fn(self.params, tokens)
+        return [_np.asarray(logits)], cold
+
+    def _build_prefill(self):
+        cfg = self.cfg
+
+        def run(params, tokens):
+            return _tfm.lm_prefill(params, tokens, cfg)
+        return run
+
+    def _build_decode(self):
+        cfg, int8 = self.cfg, self.int8_head
+
+        def run(params, tokens, positions, k_pages, v_pages,
+                block_tables, context_lens):
+            return _tfm.lm_decode_step(
+                params, tokens, positions, k_pages, v_pages,
+                block_tables, context_lens, cfg, int8_head=int8)
+        return run
+
+    # -- generation entry points -------------------------------------
+
+    def prefill(self, tokens, length):
+        """One prompt (``tokens`` int32 ``[T_bucket]`` padded, ``length``
+        real) → ``(last_logits [V], k [L, length, H, D], v)``; ``cold``
+        reports the jit-cache miss for compile accounting."""
+        tokens = _np.asarray(tokens, dtype=_np.int32)[None]
+        fn, cold = self._jit(("prefill",) + tokens.shape,
+                             self._build_prefill)
+        logits, k, v = fn(self.params, tokens)
+        k = _np.asarray(k)[:, 0, :length]
+        v = _np.asarray(v)[:, 0, :length]
+        return _np.asarray(logits)[0, length - 1], k, v, cold
+
+    def decode(self, tokens, positions, block_tables, context_lens):
+        """One decode step over a padded batch.  Returns ``(logits
+        [B, V], k_step [L, B, H, D], v_step, cold)`` — the caller writes
+        K/V back into the cache after the step succeeds."""
+        fn, cold = self._jit(("decode", len(tokens)), self._build_decode)
+        logits, k, v = fn(
+            self.params,
+            _np.asarray(tokens, dtype=_np.int32),
+            _np.asarray(positions, dtype=_np.int32),
+            self.cache.k_pages, self.cache.v_pages,
+            _np.asarray(block_tables, dtype=_np.int32),
+            _np.asarray(context_lens, dtype=_np.int32))
+        return (_np.asarray(logits), _np.asarray(k), _np.asarray(v), cold)
+
+    def describe(self):
+        d = Backend.describe(self)
+        d.update({"generative": True, "int8_head": self.int8_head,
+                  "kv_cache": self.cache.stats()})
+        return d
+
+
+class _Sequence(object):
+    """One live generation: its request, cache identity, and progress."""
+
+    __slots__ = ("req", "seq_id", "length", "last_token", "backend_ref",
+                 "new_tokens", "t_last_token")
+
+    def __init__(self, req, seq_id, backend_ref):
+        self.req = req
+        self.seq_id = seq_id
+        self.backend_ref = backend_ref
+        self.length = 0          # tokens with K/V in the cache
+        self.last_token = 0      # input to the next decode step
+        self.new_tokens = 0
+        self.t_last_token = time.monotonic()
+
+
+class _GenLane(object):
+    """Per-model waiting queue + live sequences + the generation thread
+    + pre-resolved metric handles."""
+
+    __slots__ = ("entry", "queue", "active", "thread", "steps", "tokens",
+                 "rows", "slots", "max_step_rows", "seq_counter",
+                 "m_req", "m_prefill", "m_itl", "m_depth", "m_occ",
+                 "m_active", "m_requests", "m_tokens", "m_steps",
+                 "m_compiles", "m_errors", "m_reprefills")
+
+    def __init__(self, entry):
+        self.entry = entry
+        self.queue = collections.deque()
+        self.active = []
+        self.thread = None
+        self.steps = 0
+        self.tokens = 0
+        self.rows = 0
+        self.slots = 0
+        self.max_step_rows = 0
+        self.seq_counter = 0
+
+
+class GenerationScheduler(object):
+    """Iteration-level generation scheduler for one serving replica.
+
+    Mirrors :class:`~.scheduler.Scheduler`'s lifecycle (drain / close /
+    kill, heartbeat, per-model lanes) but each lane runs the
+    prefill/decode loop instead of one-shot dispatch windows.
+    """
+
+    def __init__(self, registry=None, metrics_registry=None, name="gen0"):
+        self.name = name
+        self.registry = registry if registry is not None else ModelRegistry()
+        self._reg = (metrics_registry if metrics_registry is not None
+                     else _metrics.REGISTRY)
+        self.admission = _admission.AdmissionController(
+            reject_counter=self._reg.counter(
+                "serving_rejected_total",
+                "Serving requests shed, by model and reason "
+                "(overload | deadline | draining)", ["model", "reason"]))
+        self._fam = self._families(self._reg)
+        self._cond = threading.Condition()
+        self._lanes = {}
+        self._stopping = False
+        self._killed = False
+        self.last_beat = time.monotonic()
+
+    @staticmethod
+    def _families(reg):
+        return {
+            "req": reg.histogram(
+                "generation_request_seconds",
+                "End-to-end generation latency, admission to last token",
+                ["model"]),
+            "prefill": reg.histogram(
+                "generation_prefill_seconds",
+                "Prefill dispatch latency (prompt -> first token)",
+                ["model"]),
+            "itl": reg.histogram(
+                "generation_inter_token_seconds",
+                "Inter-token latency across live sequences", ["model"]),
+            "depth": reg.gauge(
+                "generation_queue_depth",
+                "Generation requests waiting for prefill", ["model"]),
+            "occ": reg.gauge(
+                "generation_decode_occupancy",
+                "Live sequences / decode bucket of the last step",
+                ["model"]),
+            "active": reg.gauge(
+                "generation_active_sequences",
+                "Sequences currently in the decode batch", ["model"]),
+            "requests": reg.counter(
+                "generation_requests_total",
+                "Generation requests finished successfully", ["model"]),
+            "tokens": reg.counter(
+                "generation_tokens_total",
+                "Tokens generated across all sequences", ["model"]),
+            "steps": reg.counter(
+                "generation_decode_steps_total",
+                "Decode steps dispatched", ["model"]),
+            "compiles": reg.counter(
+                "generation_compiles_total",
+                "Cold (compiling) prefill/decode shapes; flat after "
+                "warmup", ["model"]),
+            "errors": reg.counter(
+                "generation_dispatch_errors_total",
+                "Prefill/decode attempts that raised (chaos or backend "
+                "fault)", ["model"]),
+            "reprefills": reg.counter(
+                "generation_reprefills_total",
+                "Live sequences re-prefilled after a backend hot swap",
+                ["model"]),
+        }
+
+    # -- registration -------------------------------------------------
+
+    def register(self, name, backend, decode_buckets=None,
+                 prefill_buckets=None, max_queue=None):
+        """Register an :class:`LMBackend` and start its generation loop.
+
+        ``decode_buckets`` ride the registry entry's bucket slot (they
+        are batch buckets, exactly like the classifier lane's);
+        ``prefill_buckets`` are prompt-length pad targets, clipped to
+        the model's ``seq_len``.
+        """
+        if not isinstance(backend, LMBackend):
+            raise MXNetError(
+                "generation lane serves LMBackend models, got %r"
+                % (type(backend).__name__,))
+        entry = self.registry.register(
+            name, backend, buckets=decode_buckets or default_decode_buckets(),
+            max_queue=max_queue)
+        lane = _GenLane(entry)
+        seq_len = backend.cfg["seq_len"]
+        lane_prefill = sorted({min(b, seq_len) for b in
+                               (prefill_buckets or
+                                default_prefill_buckets())})
+        # stash on the lane (the registry entry's buckets stay the
+        # decode ladder the swap-compat check sees)
+        self._prefill_buckets = getattr(self, "_prefill_buckets", {})
+        self._prefill_buckets[name] = lane_prefill
+        for key, attr in (("req", "m_req"), ("prefill", "m_prefill"),
+                          ("itl", "m_itl"), ("depth", "m_depth"),
+                          ("occ", "m_occ"), ("active", "m_active"),
+                          ("requests", "m_requests"),
+                          ("tokens", "m_tokens"), ("steps", "m_steps"),
+                          ("compiles", "m_compiles"),
+                          ("errors", "m_errors"),
+                          ("reprefills", "m_reprefills")):
+            setattr(lane, attr, self._fam[key].labels(name))
+        with self._cond:
+            self._lanes[name] = lane
+        lane.thread = threading.Thread(
+            target=self._loop, args=(name, lane),
+            name="%s-generate-%s" % (self.name, name), daemon=True)
+        lane.thread.start()
+        return entry
+
+    def swap(self, name, backend):
+        """Hot reload (new weights + fresh cache as one unit)."""
+        return self.registry.swap(name, backend)
+
+    def warmup(self, name):
+        """Pre-compile every prefill bucket (B=1) and decode bucket so
+        steady-state generation never compiles.  Returns cold count."""
+        lane = self._lane(name)
+        entry = lane.entry
+        cold_n = 0
+        with entry.dispatch_lock:
+            backend = entry.backend
+            for t in self._prefill_buckets[name]:
+                _, _, _, cold = backend.prefill(
+                    _np.zeros(t, dtype=_np.int32), 1)
+                cold_n += bool(cold)
+            for b in entry.buckets:
+                sid = "__warm%d" % b
+                backend.cache.allocate(sid, 1)
+                tables = _np.stack(
+                    [backend.cache.block_table(
+                        sid, backend.max_blocks_per_seq)] * b)
+                _, _, _, cold = backend.decode(
+                    _np.zeros(b, _np.int32), _np.zeros(b, _np.int32),
+                    tables, _np.ones(b, _np.int32))
+                backend.cache.free(sid)
+                cold_n += bool(cold)
+        if cold_n and _metrics.metrics_enabled():
+            lane.m_compiles.inc(cold_n)
+        return cold_n
+
+    # -- admission ----------------------------------------------------
+
+    def _lane(self, name):
+        with self._cond:
+            lane = self._lanes.get(name)
+        if lane is None:
+            self.registry.get(name)
+            raise _admission.UnknownModelError(
+                "model %r has no generation lane" % (name,))
+        return lane
+
+    def submit(self, name, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None):
+        """Admit one generation request; returns its
+        :class:`GenerationRequest` (stream + future)."""
+        try:
+            return self._submit(name, prompt, max_new_tokens, eos_id,
+                                deadline_ms)
+        except _admission.ServingError as exc:
+            if _tracing.tracing_enabled():
+                _tracing.record_span(
+                    "serving.shed", cat="serving", model=name,
+                    reason=_admission.reject_reason(exc) or "error",
+                    error=type(exc).__name__)
+            raise
+
+    def _submit(self, name, prompt, max_new_tokens, eos_id, deadline_ms):
+        if self._killed:
+            raise _admission.ReplicaDeadError(
+                "replica %r is dead" % self.name)
+        lane = self._lane(name)
+        backend = lane.entry.backend
+        prompt = _np.asarray(prompt, dtype=_np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise MXNetError("empty prompt")
+        if max_new_tokens is None:
+            max_new_tokens = default_max_new_tokens()
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        seq_len = backend.cfg["seq_len"]
+        if prompt.size + max_new_tokens > seq_len:
+            raise MXNetError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the model's "
+                "seq_len %d" % (prompt.size, max_new_tokens, seq_len))
+        vocab = backend.cfg["num_classes"]
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise MXNetError("prompt token ids outside [0, %d)" % vocab)
+        deadline = _admission.deadline_from_ms(deadline_ms)
+        req = GenerationRequest(name, prompt, max_new_tokens, eos_id,
+                                deadline)
+        req.trace = _tracing.capture_wire_context()
+        with _tracing.span("serving.admit", cat="serving", model=name):
+            chaos.visit("serving.admit", name=name)
+            with self._cond:
+                if self._stopping:
+                    self.admission.reject(name, "draining")
+                self.admission.admit(name, len(lane.queue),
+                                     lane.entry.max_queue, deadline)
+                lane.queue.append(req)
+                if _metrics.metrics_enabled():
+                    lane.m_depth.set(len(lane.queue))
+                self._cond.notify_all()
+        return req
+
+    def generate(self, name, prompt, max_new_tokens=None, eos_id=None,
+                 deadline_ms=None, timeout=60.0):
+        """Synchronous convenience: :meth:`submit` + ``result()``."""
+        return self.submit(name, prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    # -- the generation loop ------------------------------------------
+
+    def _loop(self, name, lane):
+        while True:
+            self.last_beat = time.monotonic()  # graftcheck: disable=lock-discipline
+            with self._cond:
+                while (not lane.queue and not lane.active
+                       and not self._killed and not self._stopping):
+                    self._cond.wait(0.05)
+                    self.last_beat = time.monotonic()
+                if self._killed:
+                    return
+                if self._stopping and not lane.queue and not lane.active:
+                    return
+            self._iterate(name, lane)
+
+    def _iterate(self, name, lane):
+        """ONE iteration: retire finished/cancelled sequences, admit
+        waiting requests up to the decode capacity, then run one decode
+        step — the Orca schedule."""
+        entry = lane.entry
+        with entry.dispatch_lock:
+            backend = entry.backend
+            self._retire_stale_backend(name, lane, backend)
+            self._retire(lane, backend)
+            capacity = entry.buckets[-1] - len(lane.active)
+            admitted = []
+            with self._cond:
+                while lane.queue and capacity > 0:
+                    admitted.append(lane.queue.popleft())
+                    capacity -= 1
+                if _metrics.metrics_enabled():
+                    lane.m_depth.set(len(lane.queue))
+            for req in admitted:
+                self._prefill_one(name, lane, backend, req)
+            self._retire(lane, backend)
+            if lane.active:
+                self._decode_step(name, lane, backend)
+            self._retire(lane, backend)
+            if _metrics.metrics_enabled():
+                lane.m_active.set(len(lane.active))
+
+    def _retire_stale_backend(self, name, lane, backend):
+        """Hot swap landed: live sequences hold pages of the OLD
+        backend's cache — re-prefill them (prompt + tokens so far) on
+        the new one.  Caller holds dispatch_lock."""
+        stale = [s for s in lane.active if s.backend_ref is not backend]
+        if not stale:
+            return
+        for seq in stale:
+            lane.active.remove(seq)
+            # the old backend (and usually its cache) is on the way out,
+            # but freeing keeps its occupancy gauges honest during the
+            # brownout window where both backends are alive
+            seq.backend_ref.cache.free(seq.seq_id)
+            if seq.req.cancelled or seq.req.done:
+                continue
+            try:
+                self._start_sequence(name, lane, backend, seq.req,
+                                     resume=seq)
+                if _metrics.metrics_enabled():
+                    lane.m_reprefills.inc()
+            except Exception as exc:  # noqa: BLE001 - fault path
+                seq.req._fail(exc if isinstance(exc, MXNetError) else
+                              MXNetError("re-prefill after hot swap "
+                                         "failed: %s" % exc))
+
+    def _retire(self, lane, backend):
+        """Free cache blocks of finished/cancelled sequences."""
+        for seq in list(lane.active):
+            req = seq.req
+            finished = (seq.new_tokens >= req.max_new_tokens
+                        or (req.eos_id is not None and seq.new_tokens
+                            and req.generated
+                            and req.generated[-1] == req.eos_id))
+            if req.cancelled and not req.done:
+                req._finish("cancelled")
+            elif finished and not req.done:
+                req._finish("length" if seq.new_tokens
+                            >= req.max_new_tokens else "stop")
+                if _metrics.metrics_enabled():
+                    lane.m_requests.inc()
+                    lane.m_req.observe(req.latency_s, req.trace)
+                _emit_event("generation.complete", model=req.model,
+                            tokens=seq.new_tokens,
+                            reason=req.finish_reason)
+            if req.done:
+                backend.cache.free(seq.seq_id)
+                lane.active.remove(seq)
+
+    def _pick_prefill_bucket(self, name, t):
+        for b in self._prefill_buckets[name]:
+            if b >= t:
+                return b
+        return self._prefill_buckets[name][-1]
+
+    def _prefill_one(self, name, lane, backend, req, resume=None):
+        """Admit one request into the decode batch: deadline re-check,
+        cache allocation (typed 429 on exhaustion), ONE prefill
+        dispatch, first token out.  Caller holds dispatch_lock."""
+        now = time.monotonic()
+        if req.cancelled:
+            req._finish("cancelled")
+            return
+        if _admission.AdmissionController.expired(req.deadline, now):
+            self.admission.account(name, "deadline")
+            req._fail(_admission.DeadlineExceededError(
+                "model %r: deadline expired while queued (waited %.3fs)"
+                % (name, now - req.t_admit)))
+            return
+        try:
+            self._start_sequence(name, lane, backend, req, resume=resume)
+        except CacheExhaustedError as exc:
+            self.admission.account(name, "cache_exhausted")
+            if _tracing.tracing_enabled():
+                _tracing.record_span(
+                    "serving.shed", cat="serving", model=name,
+                    reason="cache_exhausted", parent=req.trace,
+                    error=type(exc).__name__)
+            req._fail(exc)
+        except Exception as exc:  # noqa: BLE001 - fault path
+            if _metrics.metrics_enabled():
+                lane.m_errors.inc()
+            req._fail(exc if isinstance(exc, MXNetError) else
+                      MXNetError("prefill failed: %s" % exc))
+
+    def _start_sequence(self, name, lane, backend, req, resume=None):
+        """Allocate pages, run the prefill dispatch, join the decode
+        batch.  ``resume`` re-prefills an existing sequence (hot swap)
+        over prompt + already-generated tokens."""
+        # on resume the LAST generated token stays OUT of the prefill:
+        # its K/V is written by the next decode step (it is that step's
+        # input), exactly as in the uninterrupted schedule — prefilling
+        # it too would key it at two positions and break parity
+        tokens = req.prompt if resume is None else _np.concatenate(
+            [req.prompt,
+             _np.asarray(req.generated[:-1], dtype=_np.int32)])
+        t = int(tokens.size)
+        lane.seq_counter += 1
+        seq_id = "%s/%d" % (name, lane.seq_counter)
+        # reserve the whole horizon up front: mid-generation allocation
+        # cannot fail, so accepted sequences always run to completion
+        budget = int(req.prompt.size) + req.max_new_tokens
+        backend.cache.allocate(seq_id, min(budget, backend.cfg["seq_len"]))
+        bucket = self._pick_prefill_bucket(name, t)
+        padded = _np.zeros(bucket, dtype=_np.int32)
+        padded[:t] = tokens
+        t0 = time.monotonic()
+        last_exc = None
+        out = None
+        for attempt in range(default_retries() + 1):
+            if self._killed:
+                break
+            try:
+                with _tracing.span("generation.prefill", cat="serving",
+                                   model=name, bucket=bucket, length=t,
+                                   attempt=attempt,
+                                   parent=req.trace) as sp:
+                    try:
+                        chaos.visit("serving.dispatch",
+                                    name="%s:prefill:%d" % (name, bucket))
+                        out = backend.prefill(padded, t)
+                    except Exception as exc:  # noqa: BLE001
+                        sp.set(error=type(exc).__name__)
+                        raise
+                break
+            except Exception as exc:  # noqa: BLE001 - fault path
+                if _metrics.metrics_enabled():
+                    lane.m_errors.inc()
+                last_exc = exc
+        if out is None:
+            backend.cache.free(seq_id)
+            raise MXNetError(
+                "model %r: prefill failed after %d attempts: %s"
+                % (name, default_retries() + 1, last_exc))
+        logits, k, v, cold = out
+        if cold and _metrics.metrics_enabled():
+            lane.m_compiles.inc()
+        # cache writes only after the dispatch succeeded
+        backend.cache.write_prefill(seq_id, k, v)
+        seq = _Sequence(req, seq_id, backend)
+        seq.length = t
+        if resume is None:
+            first = int(_np.argmax(logits))
+            req._push(first)
+            seq.last_token = first
+            seq.new_tokens = 1
+        else:
+            # resumed sequence: tokens so far already streamed; the next
+            # decode step continues from the last generated token
+            seq.last_token = int(req.generated[-1])
+            seq.new_tokens = resume.new_tokens
+        req.seq_id = seq_id
+        lane.active.append(seq)
+        if _metrics.metrics_enabled():
+            lane.m_prefill.observe(time.monotonic() - t0, req.trace)
+
+    def _decode_step(self, name, lane, backend):
+        """ONE iteration-level decode step over every live sequence,
+        padded to the decode bucket.  Caller holds dispatch_lock."""
+        live = lane.active
+        n = len(live)
+        bucket = lane.entry.pick_bucket(n)
+        tokens = _np.zeros(bucket, dtype=_np.int32)
+        positions = _np.zeros(bucket, dtype=_np.int32)
+        context = _np.ones(bucket, dtype=_np.int32)
+        tables = _np.zeros((bucket, backend.max_blocks_per_seq),
+                           dtype=_np.int32)
+        for i, seq in enumerate(live):
+            tokens[i] = seq.last_token
+            positions[i] = seq.length
+            context[i] = seq.length + 1
+            tables[i] = backend.cache.block_table(
+                seq.seq_id, backend.max_blocks_per_seq)
+        req_uids = ([s.req.trace for s in live]
+                    if _tracing.tracing_enabled() else ())
+        out = None
+        last_exc = None
+        for attempt in range(default_retries() + 1):
+            if self._killed:
+                break
+            try:
+                with _tracing.span("generation.decode", cat="serving",
+                                   model=name, bucket=bucket, rows=n,
+                                   attempt=attempt,
+                                   requests=req_uids) as sp:
+                    try:
+                        chaos.visit("serving.decode",
+                                    name="%s:%d" % (name, bucket))
+                        out = backend.decode(tokens, positions, tables,
+                                             context)
+                    except Exception as exc:  # noqa: BLE001
+                        sp.set(error=type(exc).__name__)
+                        raise
+                break
+            except Exception as exc:   # noqa: BLE001 - fault path
+                if _metrics.metrics_enabled():
+                    lane.m_errors.inc()
+                last_exc = exc
+        if self._killed:
+            for seq in live:
+                seq.req._fail(_admission.ReplicaDeadError(
+                    "replica %r died mid-generation" % self.name))
+            return
+        if out is None:
+            err = MXNetError(
+                "model %r: decode step failed after %d attempts: %s"
+                % (name, default_retries() + 1, last_exc))
+            for seq in live:
+                seq.req._fail(err)
+            return
+        logits, k_step, v_step, cold = out
+        now = time.monotonic()
+        lane.steps += 1
+        lane.rows += n
+        lane.slots += bucket
+        lane.max_step_rows = max(lane.max_step_rows, n)
+        if _metrics.metrics_enabled():
+            lane.m_steps.inc()
+            lane.m_occ.set(n / float(bucket))
+            if cold:
+                lane.m_compiles.inc()
+        # the step succeeded for the whole batch: NOW write K/V — a
+        # retried/failed dispatch above never touched the pool, so no
+        # other sequence's blocks can be corrupted by a fault here
+        for i, seq in enumerate(live):
+            backend.cache.write_token(seq.seq_id, seq.length,
+                                      k_step[:, i], v_step[:, i])
+            seq.length += 1
+            tok = int(_np.argmax(logits[i]))
+            seq.req._push(tok)
+            seq.last_token = tok
+            seq.new_tokens += 1
+            lane.tokens += 1
+            if _metrics.metrics_enabled():
+                lane.m_tokens.inc()
+                lane.m_itl.observe(now - seq.t_last_token, seq.req.trace)
+            seq.t_last_token = now
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def alive(self):
+        return not self._killed
+
+    def ready(self):
+        return self.alive and not self.admission.draining \
+            and not self._stopping
+
+    def queue_depth(self, name):
+        with self._cond:
+            lane = self._lanes.get(name)
+            return len(lane.queue) if lane else 0
+
+    def stats(self, name):
+        """Decode-step evidence for bench/tests: steps run, tokens
+        produced, per-step occupancy, and the largest step batch (the
+        iteration-level admission witness)."""
+        lane = self._lane(name)
+        occ = lane.rows / float(lane.slots) if lane.slots else 0.0
+        return {"steps": lane.steps, "tokens": lane.tokens,
+                "rows": lane.rows, "slots": lane.slots,
+                "occupancy": occ, "max_step_rows": lane.max_step_rows,
+                "active": len(lane.active),
+                "kv_cache": lane.entry.backend.cache.stats()}
+
+    def drain(self):
+        self.admission.start_drain()
+
+    def close(self, timeout=10.0):
+        """Drain, let live sequences finish, stop generation threads."""
+        self.drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                idle = not any(l.queue or l.active
+                               for l in self._lanes.values())
+            if idle:
+                break
+            time.sleep(0.005)
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        for lane in list(self._lanes.values()):
+            if lane.thread is not None:
+                lane.thread.join(timeout=timeout)
+
+    def kill(self):
+        """Crash simulation: fail queued and live generations with the
+        typed replica-dead error.  Idempotent."""
+        with self._cond:
+            if self._killed:
+                return
+            self._killed = True
+            orphans = []
+            for lane in self._lanes.values():
+                while lane.queue:
+                    orphans.append(lane.queue.popleft())
+                if _metrics.metrics_enabled():
+                    lane.m_depth.set(0)
+            self._cond.notify_all()
+        err = _admission.ReplicaDeadError(
+            "replica %r was killed with the request queued" % self.name)
+        for req in orphans:
+            req._fail(err)

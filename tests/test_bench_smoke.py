@@ -66,6 +66,26 @@ def test_bench_serving_keys():
         % (rec["requests_per_sec"], rec["requests_per_sec_sequential"]))
 
 
+def test_bench_generate_keys():
+    """BENCH_GENERATE=1: the schema-10 generation keys and the >= 2x
+    acceptance floor over the naive re-prefill-per-token baseline."""
+    rec = _run_bench({"BENCH_GENERATE": "1", "BENCH_GEN_TOKENS": "16",
+                      "BENCH_GEN_USERS": "4"})
+    assert rec["schema_version"] >= 10
+    assert rec["metric"] == "generation_cpu_smoke_throughput"
+    assert rec["unit"] == "tokens/s"
+    assert rec["tokens_per_sec"] > 0
+    assert rec["tokens_per_sec_per_user"] > 0
+    assert rec["inter_token_ms_p99"] > 0
+    assert rec["prefill_ms_p50"] > 0
+    assert 0.0 < rec["kv_cache_occupancy"] <= 1.0
+    assert rec["recompiles_after_warmup"] == 0
+    assert rec["tokens_per_sec"] >= 2.0 * rec["tokens_per_sec_naive"], (
+        "the paged-cache decode lane lost its edge: %.1f vs naive "
+        "%.1f tokens/s"
+        % (rec["tokens_per_sec"], rec["tokens_per_sec_naive"]))
+
+
 def test_bench_git_sha_override():
     rec = _run_bench({"BENCH_GIT_SHA": "cafef00d"})
     assert rec["git_sha"] == "cafef00d"

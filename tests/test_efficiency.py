@@ -319,7 +319,7 @@ def test_worker_serves_metrics_alerts_and_profile(monkeypatch):
             srv.url.replace("/metrics", "/alerts"), timeout=10)
             .read().decode())
         assert isinstance(alerts["alerts"], list)
-        assert alerts["rules"] == 14  # incl. efficiency + SLO burn + stream_stall
+        assert alerts["rules"] == 15  # incl. efficiency + SLO burn + inter_token_p99
         prof = json.loads(urllib.request.urlopen(
             srv.url.replace("/metrics", "/profile?ms=5"), timeout=60)
             .read().decode())

@@ -1,0 +1,424 @@
+"""Generation lane (serving/generation.py + ops/kv_cache.py): the
+round-14 acceptance gates.
+
+- **Bitwise parity**: incremental decode through the paged cache equals
+  the full-sequence forward exactly (``np.array_equal`` on logits) —
+  the KV cache is an optimization, never an approximation.
+- **Zero steady-state recompiles**: after :meth:`warmup`, generating at
+  any admitted prompt length / batch size compiles nothing.
+- **Iteration-level admission**: a request submitted mid-generation
+  joins the NEXT decode step (Orca), witnessed by the step-row stats.
+- **Paged-cache lifecycle**: alloc/free/exhaustion → typed 429 through
+  the stock admission accounting.
+- **Chaos**: a mid-generation ``serving.decode`` fault retries without
+  corrupting any other sequence's blocks (bitwise vs a no-chaos run).
+- **Streaming**: chunked-HTTP round-trip on ``/v1/generate``; an early
+  client disconnect cancels the request and frees its blocks.
+"""
+
+import http.client
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.quantization import quantize_weight_int8
+from mxnet_tpu.models import transformer as tfm
+from mxnet_tpu.ops.kv_cache import CacheExhaustedError, PagedKVCache
+
+VOCAB, SEQ_LEN, EMBED, HEADS, LAYERS = 64, 48, 16, 2, 2
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = tfm.lm_config(num_classes=VOCAB, seq_len=SEQ_LEN,
+                        num_embed=EMBED, num_heads=HEADS,
+                        num_layers=LAYERS)
+    return cfg, tfm.init_lm_params(cfg, seed=0)
+
+
+def _backend(lm, **kw):
+    cfg, params = lm
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    return serving.LMBackend(params, cfg, **kw)
+
+
+def _scheduler(lm, name="lm", **kw):
+    sched = serving.GenerationScheduler()
+    be = _backend(lm, **kw)
+    sched.register(name, be, decode_buckets=[1, 2, 4],
+                   prefill_buckets=[8, 16])
+    sched.warmup(name)
+    return sched, be
+
+
+# ---------------------------------------------------------------- parity
+
+def test_decode_bitwise_equals_full_forward(lm):
+    """The parity gate: token t's logits from the incremental decode
+    path (paged cache, padded block tables, padded decode batch) are
+    BITWISE identical to the full-sequence forward at row t."""
+    cfg, params = lm
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, VOCAB, size=5).astype(np.int32)
+    steps = 9
+
+    # reference: re-run the full forward at every length
+    toks = list(prompt)
+    ref_logits = []
+    for _ in range(steps):
+        logits, _, _ = tfm.lm_prefill(
+            params, np.asarray(toks, np.int32)[None], cfg)
+        row = np.asarray(logits)[0, len(toks) - 1]
+        ref_logits.append(row)
+        toks.append(int(np.argmax(row)))
+
+    # incremental: one prefill + paged decode steps
+    be = _backend(lm)
+    pref_logits, k, v, _ = be.prefill(
+        np.pad(prompt, (0, 8 - prompt.size)), prompt.size)
+    assert np.array_equal(pref_logits, ref_logits[0]), \
+        "prefill logits differ from full forward"
+    be.cache.allocate("s", prompt.size + steps)
+    be.cache.write_prefill("s", k, v)
+    last = int(np.argmax(pref_logits))
+    length = int(prompt.size)
+    for t in range(1, steps):
+        tables = be.cache.block_table("s", be.max_blocks_per_seq)[None]
+        logits, ks, vs, _ = be.decode(
+            np.array([last], np.int32), np.array([length], np.int32),
+            tables, np.array([length + 1], np.int32))
+        assert np.array_equal(logits[0], ref_logits[t]), \
+            "decode step %d logits differ bitwise from full forward" % t
+        be.cache.write_token("s", length, ks[:, 0], vs[:, 0])
+        length += 1
+        last = int(np.argmax(logits[0]))
+    assert toks[len(prompt):] == [int(np.argmax(r)) for r in ref_logits]
+
+
+def test_generate_matches_full_forward_argmax(lm):
+    """End-to-end scheduler path reproduces the naive re-prefill chain."""
+    cfg, params = lm
+    sched, _ = _scheduler(lm)
+    prompt = np.array([3, 9, 1, 7], np.int32)
+    out = sched.generate("lm", prompt, max_new_tokens=8)
+    toks = list(prompt)
+    ref = []
+    for _ in range(8):
+        logits, _, _ = tfm.lm_prefill(
+            params, np.asarray(toks, np.int32)[None], cfg)
+        nxt = int(np.argmax(np.asarray(logits)[0, len(toks) - 1]))
+        ref.append(nxt)
+        toks.append(nxt)
+    assert out == ref
+    sched.close()
+
+
+def test_zero_steady_state_recompiles(lm):
+    """After warmup, generation at every admitted shape compiles
+    nothing — generation_compiles_total stays flat."""
+    sched, _ = _scheduler(lm)
+    compiles = sched._fam["compiles"].labels("lm")
+    warm = compiles.value
+    assert warm > 0, "warmup should have compiled the bucket ladder"
+    for n, length in ((1, 3), (3, 6), (2, 12)):
+        reqs = [sched.submit("lm",
+                             np.arange(1, 1 + length).astype(np.int32),
+                             max_new_tokens=5) for _ in range(n)]
+        for r in reqs:
+            assert len(r.result(timeout=30)) == 5
+    assert compiles.value == warm, "steady-state generation recompiled"
+    sched.close()
+
+
+# ------------------------------------------------------------ int8 head
+
+def test_int8_quantization_grid():
+    w = np.linspace(-2.0, 3.0, 24, dtype=np.float32).reshape(6, 4)
+    wq, scale = quantize_weight_int8(w)
+    assert wq.dtype == np.int8 and wq.max() <= 127 and wq.min() >= -127
+    assert np.abs(wq.astype(np.float32) * scale - w).max() <= scale / 2 + 1e-6
+
+
+def test_int8_head_decode(lm):
+    """Opt-in int8 vocab head: decode still streams tokens, and its
+    logits stay within one quantization step of the fp32 head."""
+    cfg, params = lm
+    sched, be = _scheduler(lm, int8_head=True)
+    assert "pred_weight_q" in be.params and be.describe()["int8_head"]
+    prompt = np.array([3, 9, 1, 7], np.int32)
+    out = sched.generate("lm", prompt, max_new_tokens=6)
+    assert len(out) == 6
+    # bound the head error against the fp32 reference decode
+    fp = _backend(lm)
+    logits, k, v, _ = fp.prefill(np.pad(prompt, (0, 8 - 4)), 4)
+    fp.cache.allocate("s", 10)
+    fp.cache.write_prefill("s", k, v)
+    tables = fp.cache.block_table("s", fp.max_blocks_per_seq)[None]
+    ref, _, _, _ = fp.decode(np.array([out[0]], np.int32),
+                             np.array([4], np.int32), tables,
+                             np.array([5], np.int32))
+    q8 = be.cache  # int8 backend: replay the same step
+    be.cache.allocate("s", 10)
+    be.cache.write_prefill("s", k, v)
+    tables8 = be.cache.block_table("s", be.max_blocks_per_seq)[None]
+    got, _, _, _ = be.decode(np.array([out[0]], np.int32),
+                             np.array([4], np.int32), tables8,
+                             np.array([5], np.int32))
+    scale = float(be.params["pred_scale"])
+    # error budget: weight rounding (scale/2) times the activation l1
+    assert np.abs(got[0] - ref[0]).max() < scale * EMBED
+    sched.close()
+
+
+# ------------------------------------------------------- cache lifecycle
+
+def test_paged_cache_alloc_free_lifecycle():
+    cache = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                         block_size=4, num_blocks=8)
+    assert cache.stats()["free"] == 8
+    cache.allocate("a", 6)            # 2 blocks
+    cache.allocate("b", 9)            # 3 blocks
+    assert cache.stats()["used"] == 5
+    ta = cache.block_table("a", 4)
+    assert ta.shape == (4,) and ta.dtype == np.int32
+    # idempotent grow: re-allocating within the reservation adds nothing
+    cache.allocate("a", 6)
+    assert cache.stats()["used"] == 5
+    cache.allocate("a", 12)           # grows by 1 block
+    assert cache.stats()["used"] == 6
+    freed = cache.free("a")
+    assert len(freed) == 3 and cache.free("a") == []
+    cache.free("b")
+    assert cache.stats()["used"] == 0 and cache.stats()["free"] == 8
+    assert cache.free("unknown") == []
+
+
+def test_cache_exhaustion_is_typed_429():
+    cache = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                         block_size=4, num_blocks=4)
+    cache.allocate("a", 12)           # 3 of 4 blocks
+    with pytest.raises(CacheExhaustedError) as ei:
+        cache.allocate("b", 8)        # needs 2, only 1 left
+    assert ei.value.http_status == 429
+    # atomic: the failed allocate took nothing
+    assert cache.stats()["used"] == 3
+    assert "b" not in cache.sequences()
+
+
+def test_exhaustion_sheds_through_admission(lm):
+    """A prompt the cache cannot hold fails its request with the typed
+    429 and books reason=cache_exhausted — existing sequences and later
+    requests are untouched."""
+    # 6 blocks of 4 = 24 token slots; each request reserves
+    # prompt + max_new_tokens up front
+    sched, be = _scheduler(lm, num_blocks=6)
+    rejected = sched.admission._rejected.labels("lm", "cache_exhausted")
+    before = rejected.value
+    # slow decode keeps r1's 4 blocks held while r2 tries to allocate
+    with chaos.inject("serving.decode", "delay", prob=1.0, seed=1,
+                      delay=0.05):
+        r1 = sched.submit("lm", np.arange(1, 9, dtype=np.int32),
+                          max_new_tokens=8)   # 16 slots -> 4 blocks
+        r2 = sched.submit("lm", np.arange(1, 9, dtype=np.int32),
+                          max_new_tokens=8)   # 4 more blocks: exhausted
+        with pytest.raises(CacheExhaustedError):
+            r2.result(timeout=30)
+        assert len(r1.result(timeout=30)) == 8
+    assert rejected.value == before + 1
+    # blocks were released; the lane still serves
+    assert sched.generate("lm", [5, 6], max_new_tokens=4)
+    assert be.cache.stats()["used"] == 0
+    sched.close()
+
+
+def test_kv_alloc_chaos_site(lm):
+    sched, _ = _scheduler(lm)
+    with chaos.inject("serving.kv_alloc", "raise", prob=1.0, seed=7,
+                      limit=1) as inj:
+        with pytest.raises(MXNetError):
+            sched.generate("lm", [1, 2, 3], max_new_tokens=4, timeout=30)
+    assert inj.fires == 1
+    assert sched.generate("lm", [1, 2, 3], max_new_tokens=4)
+    sched.close()
+
+
+# ------------------------------------------------- iteration-level admit
+
+def test_iteration_level_admission(lm):
+    """A request submitted while another is mid-generation joins the
+    next decode step: some step ran with BOTH sequences in the batch."""
+    sched, _ = _scheduler(lm)
+    r1 = sched.submit("lm", np.array([1, 2, 3], np.int32),
+                      max_new_tokens=24)
+    # let r1 enter decode, then submit r2 mid-generation
+    deadline = time.monotonic() + 10
+    while sched.stats("lm")["steps"] < 2 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert sched.stats("lm")["steps"] >= 2, "r1 never started decoding"
+    r2 = sched.submit("lm", np.array([9, 8], np.int32),
+                      max_new_tokens=24)
+    assert len(r1.result(timeout=30)) == 24
+    assert len(r2.result(timeout=30)) == 24
+    st = sched.stats("lm")
+    assert st["max_step_rows"] >= 2, \
+        "r2 never shared a decode step with r1 (no iteration-level admission)"
+    # and joining mid-flight never changed r2's tokens: parity again
+    assert r2.generated == sched.generate("lm", [9, 8], max_new_tokens=24)
+    sched.close()
+
+
+# ------------------------------------------------------------- chaos
+
+def test_decode_fault_retries_without_corruption(lm):
+    """A seeded mid-generation decode fault is retried; every live
+    sequence's output stays bitwise identical to a no-chaos run —
+    failed dispatches never write the cache."""
+    prompts = [np.array([1, 2, 3], np.int32),
+               np.array([7, 5], np.int32),
+               np.array([11, 12, 13, 14], np.int32)]
+    sched, _ = _scheduler(lm)
+    clean = [sched.generate("lm", p, max_new_tokens=12) for p in prompts]
+    sched.close()
+
+    sched2, _ = _scheduler(lm)
+    errors = sched2._fam["errors"].labels("lm")
+    # limit=2 keeps any fire run inside the 3-attempt retry budget
+    with chaos.inject("serving.decode", "raise", prob=0.3, seed=13,
+                      limit=2) as inj:
+        reqs = [sched2.submit("lm", p, max_new_tokens=12)
+                for p in prompts]
+        outs = [r.result(timeout=60) for r in reqs]
+    assert inj.fires > 0, "seeded chaos never fired"
+    assert errors.value >= inj.fires
+    assert outs == clean, \
+        "decode retries corrupted another sequence's cache blocks"
+    sched2.close()
+
+
+# ------------------------------------------------------------ streaming
+
+def _raw_generate(port, payload, read_lines=None):
+    """Speak chunked HTTP by hand on a raw socket so the test controls
+    exactly how much is read (http.client buffers eagerly)."""
+    body = json.dumps(payload).encode()
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                 b"Host: t\r\nContent-Type: application/json\r\n"
+                 b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    buf = b""
+    lines = []
+    while read_lines is None or len(lines) < read_lines:
+        data = sock.recv(4096)
+        if not data:
+            break
+        buf += data
+        if b"0\r\n\r\n" in buf and read_lines is None:
+            break
+        lines = [l for l in buf.split(b"\n") if l.strip().startswith(b"{")]
+    return sock, buf
+
+
+def test_streaming_round_trip(lm):
+    sched, _ = _scheduler(lm)
+    fe = serving.start_frontend(sched)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"model": "lm", "prompt": [3, 9, 1, 7],
+                                 "max_new_tokens": 6}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/x-ndjson"
+        assert resp.getheader("X-MXTPU-Request-Id")
+        lines = [json.loads(l) for l in
+                 resp.read().decode().strip().split("\n")]
+        tail = lines[-1]
+        assert tail["done"] and tail["finish_reason"] == "length"
+        assert [l["token"] for l in lines[:-1]] == tail["tokens"]
+        assert tail["tokens"] == sched.generate("lm", [3, 9, 1, 7],
+                                                max_new_tokens=6)
+        # typed errors still map to HTTP statuses pre-stream
+        conn2 = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                           timeout=30)
+        conn2.request("POST", "/v1/generate",
+                      json.dumps({"model": "nope", "prompt": [1]}),
+                      {"Content-Type": "application/json"})
+        assert conn2.getresponse().status == 404
+    finally:
+        fe.close()
+        sched.close()
+
+
+def test_streaming_disconnect_frees_blocks(lm):
+    """A client that drops mid-stream cancels the request; the decode
+    loop retires the sequence and frees its cache blocks."""
+    sched, be = _scheduler(lm)
+    fe = serving.start_frontend(sched)
+    try:
+        with chaos.inject("serving.decode", "delay", prob=1.0, seed=1,
+                          delay=0.05):
+            sock, buf = _raw_generate(
+                fe.port, {"model": "lm", "prompt": [5, 2],
+                          "max_new_tokens": 40}, read_lines=2)
+            assert b"200" in buf.split(b"\r\n", 1)[0]
+            assert be.cache.stats()["used"] > 0
+            sock.close()                       # client disconnect
+            deadline = time.monotonic() + 15
+            while (be.cache.stats()["used"] and
+                   time.monotonic() < deadline):
+                time.sleep(0.01)
+        assert be.cache.stats()["used"] == 0, \
+            "disconnect leaked KV-cache blocks"
+        # the lane still serves after the disconnect
+        assert sched.generate("lm", [1, 2], max_new_tokens=3)
+    finally:
+        fe.close()
+        sched.close()
+
+
+# ------------------------------------------------------------- hot swap
+
+def test_hot_swap_reprefills_live_sequences(lm):
+    """A swap mid-generation re-prefills live sequences on the new
+    backend (same weights here, so the token stream is unchanged) and
+    the old cache is no longer written."""
+    cfg, params = lm
+    sched, be1 = _scheduler(lm)
+    clean = sched.generate("lm", [1, 2, 3], max_new_tokens=16)
+    base = sched.stats("lm")["steps"]      # lane counters are cumulative
+    with chaos.inject("serving.decode", "delay", prob=1.0, seed=1,
+                      delay=0.02):
+        req = sched.submit("lm", np.array([1, 2, 3], np.int32),
+                           max_new_tokens=16)
+        deadline = time.monotonic() + 10
+        while (sched.stats("lm")["steps"] < base + 2
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        assert sched.stats("lm")["active"] == 1, \
+            "the sequence should still be mid-generation at swap time"
+        be2 = serving.LMBackend(params, cfg, block_size=4, num_blocks=64)
+        sched.swap("lm", be2)
+        out = req.result(timeout=60)
+    assert out == clean, "hot swap changed the token stream"
+    reprefills = sched._fam["reprefills"].labels("lm")
+    assert reprefills.value >= 1
+    assert be2.cache.stats()["used"] == 0
+    sched.close()
+
+
+# ------------------------------------------------------------- watchdog
+
+def test_watchdog_has_inter_token_rule():
+    from mxnet_tpu.observability import watchdog
+    rules = {r.name: r for r in watchdog.default_rules()}
+    rule = rules["inter_token_p99"]
+    assert rule.metric == "generation_inter_token_seconds"
+    assert rule.stat == "p99"

@@ -49,7 +49,12 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               "request_trace_overhead_pct": False,
               # schema-9 continuous-training keys (BENCH_CONTINUOUS=1)
               "stream_mb_per_sec": True, "data_wait_pct": False,
-              "swap_downtime_ms": False}
+              "swap_downtime_ms": False,
+              # schema-10 generation keys (BENCH_GENERATE=1 rounds);
+              # "tokens_per_sec" above already covers the headline
+              "tokens_per_sec_per_user": True,
+              "inter_token_ms_p99": False, "prefill_ms_p50": False,
+              "kv_cache_occupancy": True}
 TREND_TOLERANCE = 0.10
 
 
