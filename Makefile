@@ -9,6 +9,7 @@ native:
 test: native check
 	$(MAKE) -C native test
 	python -m pytest tests/ -q
+	python tools/wire_report.py
 
 test-fast: check
 	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
@@ -24,6 +25,9 @@ bench-trend:
 
 efficiency:
 	python tools/efficiency_report.py
+
+wire:
+	python tools/wire_report.py
 
 dryrun:
 	python __graft_entry__.py
@@ -59,5 +63,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	dryrun dist-test chaos trace watchdog elastic continuous serve \
+	wire dryrun dist-test chaos trace watchdog elastic continuous serve \
 	generate slo clean

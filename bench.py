@@ -86,7 +86,11 @@ def _obs_counters():
 # re-prefill-per-token baseline the ≥2x acceptance ratio is taken
 # against) from the BENCH_GENERATE=1 autoregressive generation lane —
 # the v6 reservation, filled
-_SCHEMA_VERSION = 10
+# v11: kv_bytes_per_step / kv_header_overhead_pct / kv_codec_ms_share /
+# kv_rpcs_per_flush_p50 from the BENCH_WIRE=1 wire-bandwidth lane (a
+# 2-shard replicated in-process kvstore fit under the PR-15 byte
+# books) — the measured baseline the binary-wire lane must beat
+_SCHEMA_VERSION = 11
 
 
 def _bench_peak():
@@ -531,6 +535,85 @@ def elastic_main():
     }))
 
 
+def wire_main():
+    """Wire-bandwidth lane (BENCH_WIRE=1): a 2-shard replicated
+    in-process kvstore fit (sync replication, followers attached via
+    live state transfer) with the PR-15 byte books on.  Emits the
+    schema-11 additive keys — ``kv_bytes_per_step``,
+    ``kv_header_overhead_pct``, ``kv_codec_ms_share``,
+    ``kv_rpcs_per_flush_p50`` — plus ``wire_reconciles``: whether the
+    per-op byte books matched the socket-level truth within 1% (the
+    same falsifiability gate ``make wire`` exits nonzero on)."""
+    import jax
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore_async as ka
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.observability import wire as owire
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    os.environ["MXNET_TPU_KV_REPL_SYNC"] = "1"
+    os.environ.setdefault("MXNET_TPU_PS_SECRET", "bench")
+    secret = os.environ["MXNET_TPU_PS_SECRET"]
+    servers, addrs = [], []
+    for shard in range(2):
+        pri = ka.AsyncServer(server_id=shard * 2, secret=secret).start()
+        fol = ka.AsyncServer(server_id=shard * 2 + 1,
+                             secret=secret).start()
+        fol.rejoin(pri.address)
+        servers += [pri, fol]
+        addrs.append("%s|%s" % (pri.address, fol.address))
+    os.environ["MXNET_TPU_ASYNC_PS_ADDRS"] = ",".join(addrs)
+    ka.reset_membership()
+
+    B = int(os.environ.get("BENCH_BATCH", "8"))
+    D = 6
+    steps = max(int(os.environ.get("BENCH_STEPS", "4")), 2)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(3)
+    X = rs.randn(steps * B, D).astype(np.float32)
+    Y = rs.randint(0, 8, (steps * B,)).astype(np.float32)
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                      rescale_grad=1.0 / B, wd=0.0))
+    it = NDArrayIter({"data": X}, {"softmax_label": Y}, batch_size=B)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(net, mesh, data_shapes={"data": (B, D)},
+                        label_shapes={"softmax_label": (B,)},
+                        rescale_grad=1.0 / B)
+    t0 = time.perf_counter()
+    tr.fit(it, num_epoch=2, seed=5, log_every=0, kvstore=kv)
+    dt = time.perf_counter() - t0
+    for s in servers:
+        s.stop()
+    rep = owire.wire_report()
+    ok, _wire_b, _sock_b = owire.wire_reconciles()
+    codec_ok, _ck, _kp = owire.codec_reconciles()
+    print(json.dumps({
+        "metric": "kv_wire_bytes_per_step",
+        "value": round(rep["bytes_per_step"], 1),
+        "unit": "B/step",
+        "vs_baseline": 0.0,  # the 2017 reference has no byte books
+        "kv_bytes_per_step": round(rep["bytes_per_step"], 1),
+        "kv_header_overhead_pct": round(rep["header_overhead_pct"], 2),
+        "kv_codec_ms_share": round(
+            100.0 * rep["codec_share_of_step"], 4),
+        "kv_rpcs_per_flush_p50": round(rep["rpcs_per_flush_p50"], 1),
+        "wire_reconciles": bool(ok),
+        "codec_reconciles": bool(codec_ok),
+        "elapsed_s": round(dt, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"batch": B, "steps": steps, "shards": 2,
+                   "replicas": 2},
+    }))
+
+
 def continuous_main():
     """Continuous-training lane (BENCH_CONTINUOUS=1): a streamed
     recordio fit on the pipelined prefetch feeder, then one gated
@@ -772,6 +855,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_WIRE") == "1":
+        wire_main()
+        return
     if os.environ.get("BENCH_GENERATE") == "1":
         generate_main()
         return
@@ -987,6 +1073,9 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_WIRE") == "1":
+        return ("kv_wire_bytes_per_step",
+                "kv_wire_cpu_smoke_bytes_per_step", "B/step")
     if os.environ.get("BENCH_GENERATE") == "1":
         return ("generation_throughput",
                 "generation_cpu_smoke_throughput", "tokens/s")

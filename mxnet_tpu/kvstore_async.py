@@ -173,6 +173,49 @@ _M_SERVE = _metrics.histogram(
     "(REPL_SYNC follower-ack waits included; heartbeat/stats probes "
     "excluded) — the federation derives per-shard straggler skew from "
     "these series", ["op", "server"])
+# -- wire-bandwidth books (PR 15).  Byte accounting happens at the
+# _send_msg/_recv_msg seams, where the whole frame is in hand: the
+# header part is the 8-byte outer length prefix + 4-byte header length
+# + JSON header, the payload part is the raw tensor/opaque blobs, so
+# header+payload sums to exactly what the socket carries and the books
+# are falsifiable against kv_socket_bytes_total (tools/wire_report.py
+# exits nonzero when they drift past 1%).
+_WIRE_FRAME_BUCKETS = (64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0,
+                       262144.0, 1048576.0, 4194304.0, 16777216.0)
+_RPCS_FLUSH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                       24.0, 32.0, 48.0, 64.0)
+_M_WIRE_BYTES = _metrics.counter(
+    "kv_wire_bytes_total",
+    "Bytes crossing the kvstore wire by op, direction (send/recv on the "
+    "RPC path, replicate on the primary→follower log), and frame part "
+    "(header = length prefixes + JSON header; payload = raw blobs). "
+    "Decode failures book the consumed prefix once under op='corrupt'",
+    ["op", "dir", "part"])
+_M_WIRE_FRAME = _metrics.histogram(
+    "kv_wire_frame_bytes",
+    "Full wire-frame size (outer length prefix included) per message",
+    ["op", "dir"], buckets=_WIRE_FRAME_BUCKETS)
+_M_WIRE_RPCS = _metrics.histogram(
+    "kv_wire_rpcs_per_flush",
+    "Per-server RPCs one logical ServerGroup push/pull fans out to — "
+    "the small-RPC coalescing opportunity a batched binary wire would "
+    "collapse", buckets=_RPCS_FLUSH_BUCKETS)
+_M_WIRE_CODEC = _metrics.histogram(
+    "kv_wire_codec_seconds",
+    "Wall seconds serializing (stage=encode) or deserializing "
+    "(stage=decode) one wire frame — the CPU tax a zero-copy binary "
+    "wire would remove", ["op", "stage"])
+_M_SOCK_BYTES = _metrics.counter(
+    "kv_socket_bytes_total",
+    "Socket-level ground truth: bytes actually handed to send() or "
+    "returned by recv() on kvstore sockets, by direction — the book "
+    "kv_wire_bytes_total must reconcile against", ["dir"])
+_H_SOCK_SEND = _M_SOCK_BYTES.labels("send")
+_H_SOCK_RECV = _M_SOCK_BYTES.labels("recv")
+# per-thread scratch for the kv.rpc span attrs (bytes/encode_us): the
+# seams run under the span but deep in the call stack, so they drop the
+# numbers here and AsyncClient._call picks them up after _call_impl.
+_WIRE_TLS = threading.local()
 
 
 # -- tunables, read LAZILY so jobs and tests can reconfigure timeouts
@@ -336,9 +379,11 @@ def _sendall(sock, data):
     sent = 0
     while sent < len(view):
         try:
-            sent += sock.send(view[sent:])
+            n = sock.send(view[sent:])
         except InterruptedError:
             continue  # PEP 475 covers most of these; belt and braces
+        sent += n
+        _H_SOCK_SEND.inc(float(n))
 
 
 def _recv_exact(sock, n, what):
@@ -359,11 +404,29 @@ def _recv_exact(sock, n, what):
                 "peer closed after %d of %d bytes of %s — frame truncated"
                 % (len(buf), n, what))
         buf += chunk
+        _H_SOCK_RECV.inc(float(len(chunk)))
     return bytes(buf)
 
 
-def _send_msg(sock, obj):
+def _record_wire(op, dirn, stage, codec_s, payload):
+    """Book one frame into the wire families.  ``payload`` is the framed
+    body WITHOUT the 8-byte outer length prefix; the prefix is charged to
+    the header part so header+payload equals the socket bytes exactly."""
+    (hdr_len,) = struct.unpack_from("<I", payload, 0)
+    frame = 8 + len(payload)
+    header_b = min(8 + 4 + hdr_len, frame)
+    _M_WIRE_BYTES.labels(op, dirn, "header").inc(float(header_b))
+    _M_WIRE_BYTES.labels(op, dirn, "payload").inc(float(frame - header_b))
+    _M_WIRE_FRAME.labels(op, dirn).observe(float(frame))
+    _M_WIRE_CODEC.labels(op, stage).observe(codec_s)
+
+
+def _send_msg(sock, obj, *, op=None, wire_dir="send"):
+    rec = _metrics.metrics_enabled()
+    trace = _tracing.tracing_enabled()
+    t0 = time.monotonic() if (rec or trace) else 0.0
     payload = _encode_msg(obj)
+    codec_s = (time.monotonic() - t0) if (rec or trace) else 0.0
     cap = _max_msg_bytes()
     if len(payload) > cap:
         # refuse locally: the peer would cut the connection mid-frame and
@@ -373,15 +436,28 @@ def _send_msg(sock, obj):
             "raise the cap or shrink/stripe the arrays"
             % (len(payload), cap >> 20))
     # chaos site: drop raises ConnectionResetError (the retry path's
-    # exception), corrupt garbles the outgoing frame payload
+    # exception), corrupt garbles the outgoing frame payload.  Books come
+    # after the visit so dropped frames never reach the ledger.
     payload = _chaos.visit("kvstore.send", payload)
+    if rec:
+        _record_wire(str(op if op is not None else (obj.get("op") or "resp")),
+                     wire_dir, "encode", codec_s, payload)
+    if trace:
+        _WIRE_TLS.bytes_out = 8 + len(payload)
+        _WIRE_TLS.encode_us = codec_s * 1e6
     _sendall(sock, struct.pack("<Q", len(payload)) + payload)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, *, op=None, wire_dir="recv"):
+    rec = _metrics.metrics_enabled()
+    trace = _tracing.tracing_enabled()
     hdr = _recv_exact(sock, 8, "frame header")
     (n,) = struct.unpack("<Q", hdr)
     if n > _max_msg_bytes():
+        if rec:
+            # only the 8-byte prefix was consumed; book it once here —
+            # the caller tears the connection down, never re-reads it
+            _M_WIRE_BYTES.labels("corrupt", wire_dir, "header").inc(8.0)
         raise CorruptMessageError(
             "message of %d bytes exceeds MXNET_TPU_PS_MAX_MSG_MB" % n)
     buf = _recv_exact(sock, n, "frame body")
@@ -389,7 +465,25 @@ def _recv_msg(sock):
     # response lost in flight (the socket is torn down either way), a
     # corrupt models bit-rot — decode rejects it via length/JSON checks
     buf = _chaos.visit("kvstore.recv", buf)
-    return _decode_msg(bytes(buf))
+    t0 = time.monotonic() if (rec or trace) else 0.0
+    try:
+        msg = _decode_msg(bytes(buf))
+    except Exception:
+        if rec:
+            # the frame WAS consumed off the socket; book the prefix+body
+            # exactly once under op='corrupt'.  The retry opens a fresh
+            # frame with its own accounting — no double count.
+            _M_WIRE_BYTES.labels("corrupt", wire_dir, "header").inc(8.0)
+            _M_WIRE_BYTES.labels("corrupt", wire_dir, "payload").inc(float(n))
+        raise
+    codec_s = (time.monotonic() - t0) if (rec or trace) else 0.0
+    rop = str(op if op is not None else (msg.get("op") or "resp"))
+    if rec:
+        _record_wire(rop, wire_dir, "decode", codec_s, buf)
+    if trace:
+        _WIRE_TLS.bytes_in = 8 + n
+        _WIRE_TLS.decode_us = codec_s * 1e6
+    return msg
 
 
 def _optimizer_mac(secret, raw):
@@ -404,12 +498,14 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 msg = _recv_msg(self.request)
                 resp = srv.dispatch(msg)
+                op = msg.get("op")
                 try:
-                    _send_msg(self.request, resp)
+                    _send_msg(self.request, resp, op=op)
                 except _MessageTooBig as exc:
                     # tell the client WHY instead of dying mid-frame (a
                     # bare cut would read as 'peer closed' after retries)
-                    _send_msg(self.request, {"ok": False, "err": str(exc)})
+                    _send_msg(self.request, {"ok": False, "err": str(exc)},
+                              op=op)
         except (EOFError, ConnectionError, ValueError, OSError):
             return
         finally:
@@ -544,8 +640,11 @@ class _FollowerLink:
                 sock.settimeout(_call_timeout_s())
                 out = dict(entry)
                 out["epoch"] = self._owner.epoch
-                _send_msg(sock, out)
-                resp = _recv_msg(sock)
+                # byte books ride under dir="replicate", labeled by the
+                # replicated op (rop) so push traffic stays attributable
+                _send_msg(sock, out, op=out.get("rop"), wire_dir="replicate")
+                resp = _recv_msg(sock, op=out.get("rop"),
+                                 wire_dir="replicate")
             except (EOFError, ConnectionError, OSError, ValueError) as exc:
                 self._close_sock(sock)
                 sock = None
@@ -584,8 +683,10 @@ class _FollowerLink:
                 snap["op"] = "replicate"
                 snap["rop"] = "snapshot"
                 try:
-                    _send_msg(sock, snap)
-                    sresp = _recv_msg(sock)
+                    _send_msg(sock, snap, op="snapshot",
+                              wire_dir="replicate")
+                    sresp = _recv_msg(sock, op="snapshot",
+                                      wire_dir="replicate")
                 except (EOFError, ConnectionError, OSError,
                         ValueError):
                     self._close_sock(sock)
@@ -1534,11 +1635,19 @@ class AsyncClient:
         if not _tracing.tracing_enabled():
             return self._call_impl(msg, seq, deadline)
         with _tracing.span("kv.rpc", cat="kvstore", op=msg.get("op"),
-                           server="%s:%d" % self._addr):
+                           server="%s:%d" % self._addr) as sp:
             tok = _tracing.capture_wire_context()
             if tok is not None:
                 msg["trace"] = tok
-            return self._call_impl(msg, seq, deadline)
+            _WIRE_TLS.bytes_out = _WIRE_TLS.bytes_in = 0
+            _WIRE_TLS.encode_us = _WIRE_TLS.decode_us = 0.0
+            resp = self._call_impl(msg, seq, deadline)
+            # the _send_msg/_recv_msg seams dropped the frame sizes and
+            # codec wall into the per-thread scratch under this span
+            sp.set(bytes=int(_WIRE_TLS.bytes_out + _WIRE_TLS.bytes_in),
+                   encode_us=round(_WIRE_TLS.encode_us, 1),
+                   decode_us=round(_WIRE_TLS.decode_us, 1))
+            return resp
 
     def _call_impl(self, msg, seq=None, deadline=None):
         msg["rank"] = self._rank
@@ -1565,7 +1674,7 @@ class AsyncClient:
                     _chaos.visit("kvstore.call", name=msg.get("op"))
                     self._sock.settimeout(call_timeout)
                     _send_msg(self._sock, msg)
-                    resp = _recv_msg(self._sock)
+                    resp = _recv_msg(self._sock, op=msg.get("op"))
                     break
                 except _MessageTooBig:
                     raise  # deterministic; retrying resends the same bytes
@@ -2231,9 +2340,15 @@ class ServerGroup:
             delay = min(delay * 2, 0.5)
 
     def push(self, pairs):
-        self._routed(lambda: self._fanout(
-            [(s, lambda s=s, p=p: self._clients[s].push(p))
-             for s, p in self._scatter(pairs).items()]))
+        def go():
+            per = self._scatter(pairs)
+            # one logical flush → len(per) wire RPCs (re-observed on a
+            # topology-refresh retry, which really does fan out again)
+            _M_WIRE_RPCS.observe(float(len(per)))
+            return self._fanout(
+                [(s, lambda s=s, p=p: self._clients[s].push(p))
+                 for s, p in per.items()])
+        self._routed(go)
 
     def pull(self, keys, shapes=None):
         return self._routed(lambda: self._pull_impl(keys, shapes))
@@ -2270,6 +2385,7 @@ class ServerGroup:
                 slots.append(("plain", server, len(requests[server])))
                 requests[server].append(key)
         ordered = sorted(requests)
+        _M_WIRE_RPCS.observe(float(len(ordered)))
         resp_list = self._fanout(
             [(s, lambda s=s: self._clients[s].pull(requests[s]))
              for s in ordered])

@@ -57,6 +57,11 @@ The runtime's telemetry layer (the subsystem the paper's
   ``badput_seconds_total{cause}``, 5%-reconciled against the fit
   wall), and :func:`capture_profile` behind the ``/profile?ms=N``
   endpoint.
+- :mod:`~mxnet_tpu.observability.wire` — the wire-bandwidth ledger:
+  per-op byte books (header vs payload), encode/decode codec wall,
+  RPCs per flush, reconciliation against socket-level truth and the
+  attribution ``kv`` phase, and the explicitly-labeled projected
+  binary-wire savings line (the baseline ROADMAP item 3 must beat).
 
 Instrumented out of the box: engine push/run/poison per lane, prefetch
 occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
@@ -92,6 +97,8 @@ from .efficiency import (peak_flops, record_compile, record_step_rate,
                          BADPUT_CAUSES, efficiency_table,
                          format_efficiency, goodput_table, format_goodput,
                          goodput_reconciles, capture_profile)
+from .wire import (wire_table, wire_report, format_wire_report,
+                   wire_reconciles, codec_reconciles)
 
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
@@ -115,4 +122,6 @@ __all__ = [
     "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",
     "efficiency_table", "format_efficiency", "goodput_table",
     "format_goodput", "goodput_reconciles", "capture_profile",
+    "wire_table", "wire_report", "format_wire_report",
+    "wire_reconciles", "codec_reconciles",
 ]

@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 import urllib.request
 
 from . import metrics as _metrics
@@ -181,6 +182,9 @@ class FederatedCollector(object):
     def __init__(self, targets, timeout=2.0):
         self.targets = list(targets)
         self.timeout = timeout
+        # (monotonic, total kv wire bytes) of the previous render pass —
+        # the finite difference behind cluster_wire_mb_per_sec
+        self._last_wire = None
         for t in self.targets:
             _source_key(t)   # validate eagerly
 
@@ -202,6 +206,7 @@ class FederatedCollector(object):
         serve = {}           # server label -> [sum_s, count] (data ops)
         wsteps = {}          # member name -> [sum_s, count] (worker steps)
         mfu = {}             # member name -> model_flops_utilization
+        wire = {}            # (member, dir) -> kv wire bytes
         for t in self.targets:
             key = _source_key(t)
             if key in seen:
@@ -248,6 +253,13 @@ class FederatedCollector(object):
                         # zero = a lazily-registered gauge that never
                         # measured; it must not drag cluster_mfu_min
                         mfu[member] = fval
+                    elif name == "kv_wire_bytes_total":
+                        # byte books per member+direction (header and
+                        # payload parts collapse — the federation view
+                        # answers 'how much', the local one 'of what')
+                        ld = _label_dict(labels or "")
+                        k = (member, ld.get("dir", "?"))
+                        wire[k] = wire.get(k, 0.0) + fval
 
         # families sorted by name; series keep scrape order (histogram
         # buckets must stay in ascending-le order, which lexical
@@ -339,6 +351,31 @@ class FederatedCollector(object):
             w("# TYPE cluster_mfu_min gauge\n")
             w("cluster_mfu_min %s\n"
               % _metrics._fmt_value(min(mfu.values())))
+
+        # -- wire bandwidth: per-member byte books plus a cluster-wide
+        # MB/s rate from the delta against the previous render pass ----
+        if wire:
+            w("# HELP cluster_kv_wire_bytes Kvstore wire bytes per "
+              "federation member and direction (header+payload summed "
+              "from kv_wire_bytes_total)\n")
+            w("# TYPE cluster_kv_wire_bytes gauge\n")
+            for member, dirn in sorted(wire):
+                w('cluster_kv_wire_bytes{member="%s",dir="%s"} %s\n'
+                  % (_metrics._fmt_label(member), _metrics._fmt_label(dirn),
+                     _metrics._fmt_value(wire[(member, dirn)])))
+        wire_total = sum(wire.values())
+        now = time.monotonic()
+        rate = 0.0
+        if self._last_wire is not None:
+            t_prev, b_prev = self._last_wire
+            dt = now - t_prev
+            if dt > 0 and wire_total >= b_prev:
+                rate = (wire_total - b_prev) / dt / (1 << 20)
+        self._last_wire = (now, wire_total)
+        derived("cluster_wire_mb_per_sec",
+                "Cluster-wide kvstore wire bandwidth (MiB/s) since the "
+                "previous federation pass (0 on the first pass)",
+                "gauge", rate)
 
         w("# HELP cluster_scrape_errors_total Members whose source "
           "could not be scraped this pass\n")

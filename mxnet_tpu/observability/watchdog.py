@@ -426,12 +426,38 @@ class Watchdog(object):
             port=port, addr=addr, registry=registry, watchdog=self)
 
 
+def _wire_bytes_per_step(fams):
+    """Raw quantity for ``wire_bytes_regression``: total kvstore wire
+    bytes divided by trainer steps (both monotonic counters, so the
+    ratio is a stable per-step quantity the rolling baseline can hold).
+    None while nothing crossed the wire or no step completed — server
+    processes and fresh registries must neither fire nor seed the
+    baseline."""
+    total = _stat_of(fams, "kv_wire_bytes_total", "value", None)
+    steps = _stat_of(fams, "trainer_step_seconds", "count", None)
+    if not total or not steps:
+        return None
+    return total / steps
+
+
+def _wire_codec_share(fams):
+    """Raw quantity for ``wire_codec_share``: encode+decode wall as a
+    share of the measured step wall.  None before any step completes."""
+    codec = _stat_of(fams, "kv_wire_codec_seconds", "sum", None)
+    wall = _stat_of(fams, "trainer_step_seconds", "sum", None)
+    if codec is None or not wall:
+        return None
+    return codec / wall
+
+
 def default_rules():
     """The stock SLO rule set: trace-buffer pressure, heartbeat age,
     replication lag, step-p99 self-regression, (when evaluated over a
     federated source) straggler skew, MFU self-regression, the goodput
     floor, the serving tier's request-p99 SLO + queue-saturation
-    rules, and the error-budget burn-rate rules
+    rules, the wire-bandwidth pair (bytes/step rolling-baseline
+    regression at terminal severity + codec-share threshold), and the
+    error-budget burn-rate rules
     (:func:`~.slo.burn_rules`: fast-burn terminal, slow-burn warning,
     for each default SLO).  Thresholds come from the
     ``MXNET_TPU_WATCHDOG_*`` / ``MXNET_TPU_SLO_*`` env rows
@@ -522,5 +548,27 @@ def default_rules():
                          "(depth/max_queue) — overload shedding is "
                          "imminent; add replicas or widen buckets"),
     ]
+    # wire-bandwidth rules (observability/wire.py books): both derive a
+    # ratio from two families, so they ride the value_fn seam instead of
+    # the stock single-metric lookup
+    wire_regress = Rule(
+        "wire_bytes_regression", "kv_wire_bytes_total",
+        kind="regression",
+        factor=_env_float("MXNET_TPU_WATCHDOG_WIRE_FACTOR", 2.0),
+        window_s=600.0, severity="terminal",
+        description="kvstore wire bytes/step blew past the rolling "
+                    "baseline by MXNET_TPU_WATCHDOG_WIRE_FACTOR — a "
+                    "wire-format or striping change is resending bytes "
+                    "(the flight bundle carries the evaluation)")
+    wire_regress.value_fn = _wire_bytes_per_step
+    codec_share = Rule(
+        "wire_codec_share", "kv_wire_codec_seconds", op=">",
+        threshold=_env_float("MXNET_TPU_WATCHDOG_WIRE_CODEC_SHARE", 0.25),
+        severity="warning",
+        description="frame encode/decode wall exceeds the allowed share "
+                    "of step time — serialization is eating the step "
+                    "budget (the binary-wire lane's trigger condition)")
+    codec_share.value_fn = _wire_codec_share
+    rules.extend([wire_regress, codec_share])
     rules.extend(_slo.burn_rules())
     return rules

@@ -1,0 +1,216 @@
+"""Wire-bandwidth ledger: the measured baseline the binary wire must beat.
+
+The kvstore seams (PR 15) book every frame into four families —
+``kv_wire_bytes_total{op,dir,part}`` (header vs payload split),
+``kv_wire_frame_bytes{op,dir}``, ``kv_wire_rpcs_per_flush`` and
+``kv_wire_codec_seconds{op,stage}`` — plus the socket-level ground
+truth ``kv_socket_bytes_total{dir}``.  This module turns those books
+into the falsifiable report ROADMAP item 3 (binary zero-copy wire)
+will be judged against:
+
+- :func:`wire_table` / :func:`wire_report` — bytes/step, the JSON
+  header-overhead share, codec (encode+decode) share of the measured
+  step wall, and p50 RPCs per flush.
+- :func:`wire_reconciles` — the byte books vs the socket truth, the
+  gate ``tools/wire_report.py`` and ``make wire`` exit nonzero on.
+- :func:`codec_reconciles` — data-op codec seconds against the PR-6
+  attribution ``kv`` phase: the encode/decode wall of synchronous
+  worker RPCs happens INSIDE ``att.phase("kv")``, so it must be a
+  subset of that phase's booked wall (within tolerance).  Replication
+  and heartbeat frames run on background threads and are excluded.
+- a **projected** binary-wire savings line: the header bytes a binary
+  framing would eliminate plus the codec seconds a zero-copy path
+  would recover.  It is a projection, labeled as such in the report —
+  the one number the binary-wire PR must beat with measurement, never
+  quote as an achieved win.
+
+Everything reads the metrics registry only; with ``MXNET_TPU_METRICS=0``
+there are no books and the report degenerates to zeros.
+"""
+
+from __future__ import annotations
+
+from . import metrics as _metrics
+
+__all__ = ["wire_table", "wire_report", "format_wire_report",
+           "wire_reconciles", "codec_reconciles", "BACKGROUND_OPS"]
+
+#: ops whose frames ride background threads (replication sender,
+#: heartbeat prober) or are bookkeeping, so their codec wall is NOT part
+#: of the worker fit loop's ``kv`` attribution phase.
+BACKGROUND_OPS = frozenset(("heartbeat", "replicate", "snapshot",
+                            "promote", "corrupt", "resp", "stats",
+                            "sync_follower"))
+
+
+def _fam_children(reg, name):
+    fam = reg.get(name)
+    if fam is None:
+        return {}
+    with fam._lock:
+        return dict(fam._children)
+
+
+def _total(reg, name):
+    fam = reg.get(name)
+    return fam.total() if fam is not None else 0.0
+
+
+def wire_table(registry=None):
+    """Per-op wire rows ``(op, dir, frames, header_b, payload_b,
+    codec_s)`` sorted by total bytes descending.  ``frames`` comes from
+    the frame histogram's count; codec_s sums encode+decode for the
+    op across directions."""
+    reg = registry or _metrics.REGISTRY
+    bytes_ch = _fam_children(reg, "kv_wire_bytes_total")
+    frame_ch = _fam_children(reg, "kv_wire_frame_bytes")
+    codec_ch = _fam_children(reg, "kv_wire_codec_seconds")
+    acc = {}  # (op, dir) -> [header_b, payload_b]
+    for (op, dirn, part), child in bytes_ch.items():
+        slot = acc.setdefault((op, dirn), [0.0, 0.0])
+        slot[0 if part == "header" else 1] += child.value
+    codec = {}  # op -> seconds (encode+decode, all dirs)
+    for (op, _stage), child in codec_ch.items():
+        codec[op] = codec.get(op, 0.0) + child.sum
+    rows = []
+    for (op, dirn), (hdr_b, pay_b) in acc.items():
+        fch = frame_ch.get((op, dirn))
+        rows.append((op, dirn, fch.count if fch is not None else 0,
+                     hdr_b, pay_b, codec.get(op, 0.0)))
+    rows.sort(key=lambda r: -(r[3] + r[4]))
+    return rows
+
+
+def wire_report(registry=None):
+    """The aggregate ledger as a dict (all measured unless noted):
+
+    ``bytes_total`` / ``header_bytes`` / ``payload_bytes``
+        summed over every op/dir on the kvstore wire.
+    ``socket_bytes``
+        the ground-truth book the above must reconcile against.
+    ``steps`` / ``bytes_per_step``
+        from ``trainer_step_seconds``'s count (0 → bytes_per_step 0).
+    ``header_overhead_pct``
+        header share of total wire bytes.
+    ``codec_seconds`` / ``codec_share_of_step``
+        encode+decode wall, and its share of the measured step wall.
+    ``kv_phase_seconds`` / ``codec_kv_seconds``
+        the attribution ``kv`` phase wall and the data-op (foreground)
+        codec subset that must reconcile against it.
+    ``rpcs_per_flush_p50``
+        median wire RPCs one ServerGroup push/pull fanned out to.
+    ``projected_savings_bytes_per_step`` / ``projected_savings_codec_s``
+        the PROJECTION: header bytes/step a binary framing would
+        eliminate and total codec seconds a zero-copy wire would
+        recover.  Not a measurement.
+    """
+    reg = registry or _metrics.REGISTRY
+    header_b = payload_b = 0.0
+    for (op, dirn, part), child in _fam_children(
+            reg, "kv_wire_bytes_total").items():
+        if part == "header":
+            header_b += child.value
+        else:
+            payload_b += child.value
+    total_b = header_b + payload_b
+    socket_b = _total(reg, "kv_socket_bytes_total")
+
+    codec_s = codec_kv_s = 0.0
+    for (op, _stage), child in _fam_children(
+            reg, "kv_wire_codec_seconds").items():
+        codec_s += child.sum
+        if op not in BACKGROUND_OPS:
+            codec_kv_s += child.sum
+
+    steps = 0
+    step_wall = 0.0
+    sfam = reg.get("trainer_step_seconds")
+    if sfam is not None and sfam._default is not None:
+        steps = sfam._default.count
+        step_wall = sfam._default.sum
+    kv_phase_s = 0.0
+    pfam = reg.get("trainer_step_phase_seconds")
+    if pfam is not None:
+        with pfam._lock:
+            kv_child = pfam._children.get(("kv",))
+        if kv_child is not None:
+            kv_phase_s = kv_child.sum
+
+    rfam = reg.get("kv_wire_rpcs_per_flush")
+    p50 = rfam.percentile(0.5) if rfam is not None and rfam.count else 0.0
+
+    return {
+        "bytes_total": total_b,
+        "header_bytes": header_b,
+        "payload_bytes": payload_b,
+        "socket_bytes": socket_b,
+        "steps": steps,
+        "bytes_per_step": total_b / steps if steps else 0.0,
+        "header_overhead_pct": 100.0 * header_b / total_b if total_b else 0.0,
+        "codec_seconds": codec_s,
+        "codec_kv_seconds": codec_kv_s,
+        "kv_phase_seconds": kv_phase_s,
+        "step_wall_seconds": step_wall,
+        "codec_share_of_step": codec_s / step_wall if step_wall else 0.0,
+        "rpcs_per_flush_p50": p50,
+        "projected_savings_bytes_per_step":
+            header_b / steps if steps else 0.0,
+        "projected_savings_codec_s": codec_s,
+    }
+
+
+def wire_reconciles(tol=0.01, registry=None):
+    """The falsifiability gate: ``(ok, wire_bytes, socket_bytes)``.
+    ``ok`` means the per-op byte books sum to the socket-level truth
+    within ``tol`` (False when nothing crossed the wire — an empty
+    ledger must not pass a gate)."""
+    rep = wire_report(registry)
+    wire_b, sock_b = rep["bytes_total"], rep["socket_bytes"]
+    ok = sock_b > 0 and abs(wire_b - sock_b) <= tol * sock_b
+    return ok, wire_b, sock_b
+
+
+def codec_reconciles(tol=0.10, registry=None):
+    """``(ok, codec_kv_s, kv_phase_s)``: foreground (data-op) codec
+    seconds must be covered by the attribution ``kv`` phase wall within
+    ``tol`` slack — encode/decode happens inside ``att.phase("kv")``,
+    so codec exceeding the phase means a booking bug.  Vacuously ok
+    when no attribution ran (server-only processes have books but no
+    fit loop)."""
+    rep = wire_report(registry)
+    codec_kv, kv_phase = rep["codec_kv_seconds"], rep["kv_phase_seconds"]
+    if kv_phase <= 0.0:
+        return True, codec_kv, kv_phase
+    ok = codec_kv <= kv_phase * (1.0 + tol)
+    return ok, codec_kv, kv_phase
+
+
+def format_wire_report(registry=None):
+    """:func:`wire_report` + :func:`wire_table` as an aligned text
+    report, with the savings line explicitly labeled a projection."""
+    rep = wire_report(registry)
+    lines = ["%-22s %-10s %8s %12s %12s %10s"
+             % ("op", "dir", "frames", "header_b", "payload_b",
+                "codec_s")]
+    for op, dirn, frames, hdr_b, pay_b, codec_s in wire_table(registry):
+        lines.append("%-22s %-10s %8d %12d %12d %10.4f"
+                     % (op, dirn, frames, hdr_b, pay_b, codec_s))
+    lines.append("")
+    lines.append("bytes/step          %14.1f  (%d steps)"
+                 % (rep["bytes_per_step"], rep["steps"]))
+    lines.append("header overhead     %13.1f%%  (%d of %d bytes)"
+                 % (rep["header_overhead_pct"], rep["header_bytes"],
+                    rep["bytes_total"]))
+    lines.append("codec share of step %13.1f%%  (%.4fs of %.4fs wall)"
+                 % (100.0 * rep["codec_share_of_step"],
+                    rep["codec_seconds"], rep["step_wall_seconds"]))
+    lines.append("rpcs/flush p50      %14.1f" % rep["rpcs_per_flush_p50"])
+    lines.append("socket truth        %14d  (books %d)"
+                 % (rep["socket_bytes"], rep["bytes_total"]))
+    lines.append("PROJECTED binary-wire savings: %.1f header bytes/step "
+                 "+ %.4fs codec — a projection from today's books, not "
+                 "a measurement; the binary-wire PR must beat it with "
+                 "measured numbers."
+                 % (rep["projected_savings_bytes_per_step"],
+                    rep["projected_savings_codec_s"]))
+    return "\n".join(lines)

@@ -68,10 +68,22 @@ from ..base import MXNetError
 # the submodule path matters: the package exports an ``events()``
 # accessor FUNCTION under the same name as the submodule
 from ..observability.events import emit as _emit_event
+from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from . import admission as _admission
 
 __all__ = ["ServingFrontend", "start_frontend", "trace_header_enabled"]
+
+# raw-npy wire books: the serving analogue of kv_wire_bytes_total —
+# bytes of .npy request/response bodies on the octet-stream hot path
+# (JSON predict bodies are excluded; their float round-trip is the
+# thing this path exists to avoid).  Handles pre-resolved at import.
+_M_SERVING_WIRE = _metrics.counter(
+    "serving_wire_bytes_total",
+    "Raw-tensor (.npy) bytes crossing the serving frontend by "
+    "direction (recv = request body, send = response body)", ["dir"])
+_H_SWIRE_RECV = _M_SERVING_WIRE.labels("recv")
+_H_SWIRE_SEND = _M_SERVING_WIRE.labels("send")
 
 # fallback request-id counter for when tracing is off (the id is then
 # "pid:rN" — still unique, just not resolvable in a trace)
@@ -322,13 +334,16 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             model = self._model = q["model"][0]
             name = q.get("input", ["data"])[0]
             deadline = q.get("deadline_ms", [None])[0]
+            _H_SWIRE_RECV.inc(float(len(body)))
             row = _np.load(io.BytesIO(body), allow_pickle=False)
             outs = _target_request(
                 target, model, {name: row},
                 float(deadline) if deadline is not None else None, timeout)
             buf = io.BytesIO()
             _np.save(buf, _np.asarray(outs[0]))
-            self._reply(200, buf.getvalue(), "application/octet-stream",
+            out_bytes = buf.getvalue()
+            _H_SWIRE_SEND.inc(float(len(out_bytes)))
+            self._reply(200, out_bytes, "application/octet-stream",
                         extra=(("X-MXTPU-Outputs", str(len(outs))),))
 
         def log_message(self, *args):  # requests don't belong on stderr

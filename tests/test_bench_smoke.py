@@ -86,6 +86,22 @@ def test_bench_generate_keys():
         % (rec["tokens_per_sec"], rec["tokens_per_sec_naive"]))
 
 
+def test_bench_wire_keys():
+    """BENCH_WIRE=1: the schema-11 wire keys are present and >0 on the
+    CPU smoke, and the byte books reconcile with the socket truth (the
+    lane's falsifiability gate rides in the JSON row)."""
+    rec = _run_bench({"BENCH_WIRE": "1"})
+    assert rec["schema_version"] >= 11
+    assert rec["metric"] == "kv_wire_bytes_per_step"
+    assert rec["unit"] == "B/step"
+    assert rec["kv_bytes_per_step"] > 0
+    assert rec["kv_header_overhead_pct"] > 0
+    assert rec["kv_codec_ms_share"] > 0
+    assert rec["kv_rpcs_per_flush_p50"] > 0
+    assert rec["wire_reconciles"] is True
+    assert rec["codec_reconciles"] is True
+
+
 def test_bench_git_sha_override():
     rec = _run_bench({"BENCH_GIT_SHA": "cafef00d"})
     assert rec["git_sha"] == "cafef00d"

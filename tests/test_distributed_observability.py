@@ -246,6 +246,11 @@ _SHARD0_TEXT = (
     "# HELP model_flops_utilization Model FLOPs utilization\n"
     "# TYPE model_flops_utilization gauge\n"
     "model_flops_utilization 0.41\n"
+    "# HELP kv_wire_bytes_total Bytes crossing the kvstore wire\n"
+    "# TYPE kv_wire_bytes_total counter\n"
+    'kv_wire_bytes_total{op="push",dir="send",part="header"} 120\n'
+    'kv_wire_bytes_total{op="push",dir="send",part="payload"} 4096\n'
+    'kv_wire_bytes_total{op="push",dir="replicate",part="payload"} 4096\n'
 )
 _SHARD1_TEXT = (
     "# HELP kv_fenced_total Primaries fenced by a higher epoch\n"

@@ -319,7 +319,7 @@ def test_worker_serves_metrics_alerts_and_profile(monkeypatch):
             srv.url.replace("/metrics", "/alerts"), timeout=10)
             .read().decode())
         assert isinstance(alerts["alerts"], list)
-        assert alerts["rules"] == 15  # incl. efficiency + SLO burn + inter_token_p99
+        assert alerts["rules"] == 17  # incl. efficiency + SLO burn + wire rules
         prof = json.loads(urllib.request.urlopen(
             srv.url.replace("/metrics", "/profile?ms=5"), timeout=60)
             .read().decode())
@@ -438,6 +438,35 @@ def test_trend_gate_passes_and_flags_regressions(tmp_path):
     ok, lines = bt.trend_gate(bt.load_bench_rounds(root=str(tmp_path)))
     assert not ok
     assert any("REGRESSED" in l and "step_ms_p99" in l for l in lines)
+
+
+def test_trend_gate_covers_wire_keys_down_is_good(tmp_path):
+    """The schema-11 wire keys gate in the down-is-good direction:
+    bytes/step or codec-share creeping UP past tolerance fails the
+    gate (the whole point of the measured binary-wire baseline)."""
+    bt = _load_bench_table()
+    for key in ("kv_bytes_per_step", "kv_header_overhead_pct",
+                "kv_codec_ms_share", "kv_rpcs_per_flush_p50"):
+        assert bt.TREND_KEYS[key] is False
+    _write_round(tmp_path, 1, {"value": 100.0,
+                               "kv_bytes_per_step": 1000.0,
+                               "kv_codec_ms_share": 0.10,
+                               "git_sha": "aaa"})
+    _write_round(tmp_path, 2, {"value": 100.0,
+                               "kv_bytes_per_step": 2000.0,
+                               "kv_codec_ms_share": 0.10,
+                               "git_sha": "bbb"})
+    ok, lines = bt.trend_gate(bt.load_bench_rounds(root=str(tmp_path)))
+    assert not ok
+    assert any("REGRESSED" in l and "kv_bytes_per_step" in l
+               for l in lines)
+    # shrinking the wire is an improvement, never a regression
+    _write_round(tmp_path, 2, {"value": 100.0,
+                               "kv_bytes_per_step": 500.0,
+                               "kv_codec_ms_share": 0.05,
+                               "git_sha": "bbb"})
+    ok, lines = bt.trend_gate(bt.load_bench_rounds(root=str(tmp_path)))
+    assert ok, "\n".join(lines)
 
 
 def test_trend_gate_dedupes_rounds_by_git_sha(tmp_path):

@@ -304,7 +304,9 @@ def test_default_rules_clean_registry_fires_nothing():
                      "replication_lag", "step_p99_regression",
                      "straggler", "mfu_regression", "goodput_floor",
                      "stream_stall",
-                     "request_p99_slo", "queue_saturation",
+                     "request_p99_slo", "inter_token_p99",
+                     "queue_saturation",
+                     "wire_bytes_regression", "wire_codec_share",
                      "slo_availability_fast_burn",
                      "slo_availability_slow_burn",
                      "slo_latency_fast_burn", "slo_latency_slow_burn"]

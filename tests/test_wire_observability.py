@@ -1,0 +1,506 @@
+"""Wire-level bandwidth observability (PR 15): per-RPC byte accounting
+with the header/payload split, the encode/decode cost ledger, and the
+binary-wire savings report.
+
+The plane is FALSIFIABLE by construction: `_sendall`/`_recv_exact`
+count the actual socket bytes into ``kv_socket_bytes_total``, and every
+test that drives traffic closes with ``wire_reconciles()`` — the per-op
+books must sum to the socket truth.  The acceptance drill is the
+2-shard replicated fit: books vs socket within 1%, replicate frames on
+the ledger, codec wall covered by the attribution ``kv`` phase, and the
+``wire_bytes_regression`` watchdog firing exactly once on a synthetic
+2x byte inflation with the rule named in the flight bundle.
+"""
+
+import io
+import json
+import os
+import socket
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_async as ka
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import CorruptMessageError
+from mxnet_tpu.kvstore_async import AsyncClient, AsyncServer
+from mxnet_tpu.observability import metrics as omet
+from mxnet_tpu.observability import tracing
+from mxnet_tpu.observability import wire as owire
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_isolated(monkeypatch):
+    """Sub-second retry envelope + clean membership per test (mirrors
+    test_kvstore_replication.py)."""
+    monkeypatch.setattr(AsyncClient, "_BACKOFF_CAP_S", 0.1)
+    monkeypatch.setenv("MXNET_TPU_PS_CALL_TIMEOUT", "2")
+    monkeypatch.setenv("MXNET_TPU_PS_DEADLINE", "3")
+    monkeypatch.setenv("MXNET_TPU_PS_DEAD_AFTER", "2")
+    monkeypatch.setenv("MXNET_TPU_KV_REPL_SYNC", "1")
+    ka.reset_membership()
+    yield
+    ka.reset_membership()
+
+
+def _wire_children():
+    fam = obs.REGISTRY.get("kv_wire_bytes_total")
+    with fam._lock:
+        return {k: c.value for k, c in fam._children.items()}
+
+
+def _sgd_pickle(lr=0.1):
+    import pickle
+
+    from mxnet_tpu import optimizer as opt
+
+    return pickle.dumps(opt.SGD(learning_rate=lr, wd=0.0))
+
+
+# ---------------------------------------------------------------------------
+# backward compatibility: instrumentation never changes the frame
+# ---------------------------------------------------------------------------
+
+def test_encoded_frame_identical_with_books_on_and_off(monkeypatch):
+    """The byte accounting observes frames, it does not shape them: the
+    encoded payload is byte-identical whether the metrics plane is on or
+    off, so old and new peers interoperate unchanged."""
+    msg = {"op": "push", "rank": 1, "seq": 9,
+           "pairs": [("w", np.arange(6, dtype=np.float32))]}
+    with_books = ka._encode_msg(dict(msg))
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+    without = ka._encode_msg(dict(msg))
+    assert with_books == without
+    out = ka._decode_msg(with_books)
+    assert out["op"] == "push" and out["seq"] == 9
+    np.testing.assert_array_equal(out["pairs"][0][1], msg["pairs"][0][1])
+
+
+# ---------------------------------------------------------------------------
+# corrupt paths: the consumed prefix is booked exactly once
+# ---------------------------------------------------------------------------
+
+def test_corrupt_frame_books_consumed_prefix_exactly_once():
+    """A frame that fails to decode WAS consumed off the socket; it is
+    booked once under op='corrupt' at the raise site, and the retry
+    (the next frame on the wire) opens its own books — no double
+    count, and the totals still reconcile with the socket truth."""
+    a, b = socket.socketpair()
+    try:
+        bad = b"\xff" * 32                 # hdr_len garbage: decode raises
+        b.sendall(struct.pack("<Q", len(bad)) + bad)
+        with pytest.raises(Exception):
+            ka._recv_msg(a)
+        books = _wire_children()
+        assert books[("corrupt", "recv", "header")] == 8.0
+        assert books[("corrupt", "recv", "payload")] == 32.0
+
+        # retry: a good frame on the SAME socket books under its own op
+        good = ka._encode_msg({"op": "stats"})
+        b.sendall(struct.pack("<Q", len(good)) + good)
+        assert ka._recv_msg(a)["op"] == "stats"
+        books = _wire_children()
+        assert books[("corrupt", "recv", "header")] == 8.0   # unchanged
+        assert books[("corrupt", "recv", "payload")] == 32.0
+        ok, wire_b, sock_b = owire.wire_reconciles()
+        assert ok, "books %d vs socket %d" % (wire_b, sock_b)
+        assert wire_b == sock_b == (8 + 32) + (8 + len(good))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversize_frame_books_only_the_eight_byte_prefix(monkeypatch):
+    """An oversize length prefix tears the connection down before the
+    body is read: exactly the 8 consumed bytes land under 'corrupt',
+    with no payload part."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(struct.pack("<Q", 1 << 40))
+        with pytest.raises(CorruptMessageError):
+            ka._recv_msg(a)
+        books = _wire_children()
+        assert books[("corrupt", "recv", "header")] == 8.0
+        assert books.get(("corrupt", "recv", "payload"), 0.0) == 0.0
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the books vs the socket truth
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_books_reconcile_exactly_with_socket_truth():
+    """Client and server share this process's registry, so the per-op
+    byte books must equal the socket-level ground truth EXACTLY — both
+    directions of every frame (request, response, heartbeat-free)."""
+    s = AsyncServer(secret="t").start()
+    try:
+        cli = AsyncClient(s.address, rank=0, heartbeat=False, secret="t")
+        cli.init([("w", np.zeros(8, np.float32))])
+        cli._call({"op": "pull", "keys": ["w"]})
+        cli._call({"op": "stats"})
+        cli.close()
+    finally:
+        s.stop()
+    ok, wire_b, sock_b = owire.wire_reconciles()
+    assert ok and wire_b == sock_b > 0
+    books = _wire_children()
+    # request frames booked under their op on BOTH sides of the wire
+    assert books[("init", "send", "header")] > 0
+    assert books[("init", "recv", "header")] > 0
+    assert books[("pull", "send", "payload")] >= 0
+    # per-frame size histogram rides the same seams
+    ffam = obs.REGISTRY.get("kv_wire_frame_bytes")
+    with ffam._lock:
+        frames = sum(c.count for c in ffam._children.values())
+    assert frames > 0
+
+
+def test_fit_2shard_replicated_books_reconcile(monkeypatch):
+    """ACCEPTANCE: on a 2-shard replicated fit, summed
+    ``kv_wire_bytes_total`` matches the socket-level bytes within 1%,
+    replication frames ride the ledger under dir='replicate', the
+    codec wall reconciles against the attribution ``kv`` phase, and
+    the report carries nonzero bytes/step, header overhead and RPC
+    fan-out."""
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    secret = "wire-t"
+    monkeypatch.setenv("MXNET_TPU_PS_SECRET", secret)
+    servers, addrs = [], []
+    for shard in range(2):
+        pri = ka.AsyncServer(server_id=shard * 2, secret=secret).start()
+        fol = ka.AsyncServer(server_id=shard * 2 + 1,
+                             secret=secret).start()
+        fol.rejoin(pri.address)
+        servers += [pri, fol]
+        addrs.append("%s|%s" % (pri.address, fol.address))
+    monkeypatch.setenv("MXNET_TPU_ASYNC_PS_ADDRS", ",".join(addrs))
+    ka.reset_membership()
+    try:
+        B, D = 8, 6
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(net, num_hidden=8, name="fc2"),
+            name="softmax")
+        kv = mx.kv.create("dist_async")
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                          rescale_grad=1.0 / B, wd=0.0))
+        rs = np.random.RandomState(3)
+        it = NDArrayIter({"data": rs.randn(32, D).astype(np.float32)},
+                         {"softmax_label":
+                          rs.randint(0, 8, (32,)).astype(np.float32)},
+                         batch_size=B)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        tr = ShardedTrainer(net, mesh, data_shapes={"data": (B, D)},
+                            label_shapes={"softmax_label": (B,)},
+                            rescale_grad=1.0 / B)
+        tr.fit(it, num_epoch=2, seed=5, log_every=0, kvstore=kv)
+    finally:
+        for s in servers:
+            s.stop()
+
+    ok, wire_b, sock_b = owire.wire_reconciles(tol=0.01)
+    assert ok, "books %d vs socket %d diverge past 1%%" % (wire_b, sock_b)
+    # sync replication: every push re-sent to the follower, on the books
+    books = _wire_children()
+    repl = [k for k in books if k[1] == "replicate"]
+    assert repl, "no replicate frames on the ledger: %s" % sorted(books)
+    assert sum(books[k] for k in repl) > 0
+    cok, codec_kv, kv_phase = owire.codec_reconciles()
+    assert cok, ("foreground codec %.4fs exceeds the attribution kv "
+                 "phase %.4fs" % (codec_kv, kv_phase))
+    rep = owire.wire_report()
+    assert rep["steps"] > 0 and rep["bytes_per_step"] > 0
+    assert 0.0 < rep["header_overhead_pct"] < 100.0
+    assert rep["codec_seconds"] > 0
+    assert rep["rpcs_per_flush_p50"] >= 1.0
+    text = owire.format_wire_report()
+    assert "PROJECTED binary-wire savings" in text
+
+
+def test_wire_reconciles_rejects_an_empty_ledger():
+    """No traffic must not pass the gate: an empty ledger reconciling
+    '0 == 0' would make the falsifiability check vacuous."""
+    ok, wire_b, sock_b = owire.wire_reconciles()
+    assert not ok and wire_b == sock_b == 0
+
+
+# ---------------------------------------------------------------------------
+# spans, fan-out, serving
+# ---------------------------------------------------------------------------
+
+def test_rpc_span_carries_byte_and_codec_attrs():
+    """With tracing on, every kv.rpc span reports the frame bytes that
+    crossed the wire for that RPC plus the encode/decode wall — a slow
+    span shows whether the wire or the codec ate it."""
+    s = AsyncServer(secret="t").start()
+    try:
+        cli = AsyncClient(s.address, rank=0, heartbeat=False, secret="t")
+        obs.enable_tracing()
+        cli.init([("w", np.arange(16, dtype=np.float32))])
+        cli._call({"op": "pull", "keys": ["w"]})
+        cli.close()
+    finally:
+        s.stop()
+        obs.disable_tracing()
+    rpcs = [sp for sp in tracing.spans() if sp.name == "kv.rpc"]
+    assert rpcs
+    for sp in rpcs:
+        # request + response frames, each 8-byte prefixed
+        assert sp.attrs["bytes"] > 16
+        assert sp.attrs["encode_us"] >= 0.0
+        assert sp.attrs["decode_us"] >= 0.0
+    pull = [sp for sp in rpcs if sp.attrs["op"] == "pull"][-1]
+    # the pulled tensor dominates the frame: 16 f32 = 64B of payload
+    assert pull.attrs["bytes"] >= 64
+
+
+def test_rpcs_per_flush_histogram_observes_fanout():
+    """A striped push/pull through a 2-shard ServerGroup fans out to
+    both shards; kv_wire_rpcs_per_flush records exactly that width."""
+    servers = [AsyncServer(server_id=i, secret="t").start()
+               for i in range(2)]
+    try:
+        group = ka.ServerGroup([s.address for s in servers], rank=0,
+                               heartbeat=False, secret="t")
+        group._bound = 1 << 10        # stripe the big key across shards
+        big = np.ones(1 << 11, np.float32)
+        group.init([("big", big)])
+        group.set_optimizer(_sgd_pickle())
+        group.push([("big", big)])
+        group.pull(["big"])
+        group.shutdown()
+    finally:
+        for s in servers:
+            s.stop()
+    rfam = obs.REGISTRY.get("kv_wire_rpcs_per_flush")
+    assert rfam.count >= 2            # at least the push and the pull
+    assert rfam.percentile(0.5) == pytest.approx(2.0, abs=1.0)
+    ok, wire_b, sock_b = owire.wire_reconciles()
+    assert ok and wire_b == sock_b
+
+
+class _StubTarget(object):
+    """Minimal Scheduler stand-in for the frontend: request() echoes the
+    row doubled (the raw path only needs the shared signature)."""
+
+    def request(self, model, inputs, deadline_ms=None, timeout=None):
+        ((_, row),) = inputs.items()
+        return [np.asarray(row) * 2.0]
+
+
+def test_serving_raw_path_books_wire_bytes():
+    """The raw-npy serving path is the frontend's analogue of the kv
+    wire: request bodies land under dir='recv', response bodies under
+    dir='send', byte-exact."""
+    from mxnet_tpu import serving
+
+    row = np.arange(5, dtype=np.float32)
+    buf = io.BytesIO()
+    np.save(buf, row)
+    body = buf.getvalue()
+    with serving.start_frontend(_StubTarget()) as fe:
+        req = urllib.request.Request(
+            fe.url + "/v1/predict?model=m&input=data", data=body,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out_bytes = resp.read()
+        np.testing.assert_allclose(
+            np.load(io.BytesIO(out_bytes), allow_pickle=False), row * 2.0)
+    fam = obs.REGISTRY.get("serving_wire_bytes_total")
+    assert fam.labels("recv").value == float(len(body))
+    assert fam.labels("send").value == float(len(out_bytes))
+
+
+# ---------------------------------------------------------------------------
+# watchdog: the regression + codec-share rules
+# ---------------------------------------------------------------------------
+
+def _wire_rule(name):
+    rules = [r for r in obs.default_rules() if r.name == name]
+    assert rules, "default_rules() lost the %s rule" % name
+    return rules
+
+
+def test_wire_bytes_regression_fires_exactly_once(monkeypatch, tmp_path):
+    """ACCEPTANCE: a synthetic >=2x bytes/step inflation trips
+    wire_bytes_regression exactly once (one rising edge, one terminal
+    flight bundle naming the rule), evaluated over exposition text like
+    any other source."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    state = {"bytes": 1000.0}         # 10 steps -> 100 B/step baseline
+
+    def exposition():
+        return (
+            "# HELP kv_wire_bytes_total b\n"
+            "# TYPE kv_wire_bytes_total counter\n"
+            'kv_wire_bytes_total{op="push",dir="send",part="payload"} %r\n'
+            "# HELP trainer_step_seconds s\n"
+            "# TYPE trainer_step_seconds histogram\n"
+            "trainer_step_seconds_sum 0.5\n"
+            "trainer_step_seconds_count 10\n" % state["bytes"])
+
+    wd = obs.Watchdog(_wire_rule("wire_bytes_regression"),
+                      source=exposition)
+    for now in (0.0, 10.0, 20.0, 30.0):   # steady 100 B/step: quiet
+        assert wd.evaluate(now=now) == []
+    state["bytes"] = 2500.0               # 250 B/step: 2.5x the baseline
+    (alert,) = wd.evaluate(now=40.0)
+    assert alert.name == "wire_bytes_regression"
+    assert alert.severity == "terminal"
+    assert alert.value == pytest.approx(250.0)
+    state["bytes"] = 4000.0               # stays inflated: no second edge
+    assert len(wd.evaluate(now=50.0)) == 1
+    assert obs.REGISTRY.get("cluster_alerts_fired_total").labels(
+        "wire_bytes_regression").value == 1
+    bundles = [d for d in os.listdir(str(tmp_path))
+               if d.startswith("flight_watchdog.wire_bytes_regression")]
+    assert len(bundles) == 1, "expected exactly one postmortem bundle"
+    with open(os.path.join(str(tmp_path), bundles[0],
+                           "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["kind"] == "watchdog.wire_bytes_regression"
+    assert "wire_bytes_regression" in manifest["extra"]["alert"]
+
+
+def test_wire_codec_share_rule_fires_and_resolves():
+    """wire_codec_share: codec wall above the allowed share of step
+    wall fires a warning; a healthy share resolves it."""
+    state = {"wall": 1.0}
+
+    def exposition():
+        return (
+            "# HELP kv_wire_codec_seconds s\n"
+            "# TYPE kv_wire_codec_seconds histogram\n"
+            "kv_wire_codec_seconds_sum 0.5\n"
+            "kv_wire_codec_seconds_count 100\n"
+            "# HELP trainer_step_seconds s\n"
+            "# TYPE trainer_step_seconds histogram\n"
+            "trainer_step_seconds_sum %r\n"
+            "trainer_step_seconds_count 10\n" % state["wall"])
+
+    wd = obs.Watchdog(_wire_rule("wire_codec_share"), source=exposition)
+    (alert,) = wd.evaluate(now=0.0)       # 0.5/1.0 = 50% > 25%
+    assert alert.name == "wire_codec_share"
+    assert alert.severity == "warning"
+    assert alert.value == pytest.approx(0.5)
+    state["wall"] = 100.0                 # 0.5% of step wall: healthy
+    assert wd.evaluate(now=1.0) == []
+
+
+def test_wire_rules_stay_quiet_on_server_only_books(monkeypatch):
+    """A server process has byte books but no trainer steps: both wire
+    rules must see None (neither firing nor seeding the baseline)."""
+    text = ("# TYPE kv_wire_bytes_total counter\n"
+            'kv_wire_bytes_total{op="push",dir="recv",part="payload"} 4096\n')
+    wd = obs.Watchdog(_wire_rule("wire_bytes_regression")
+                      + _wire_rule("wire_codec_share"), source=text)
+    for now in (0.0, 1.0, 2.0, 3.0, 4.0):
+        assert wd.evaluate(now=now) == []
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+def test_federation_exports_cluster_wire_series():
+    """The federated view re-exports every member's byte books as
+    cluster_kv_wire_bytes{member,dir} and derives the cluster-wide
+    wire rate from consecutive passes (0 on the first)."""
+    fam = obs.REGISTRY.get("kv_wire_bytes_total")
+    fam.labels("push", "send", "header").inc(120.0)
+    fam.labels("push", "send", "payload").inc(4096.0)
+    fam.labels("push", "replicate", "payload").inc(4096.0)
+    fed = obs.FederatedCollector([
+        {"shard": 0, "role": "primary", "epoch": 0,
+         "registry": obs.REGISTRY},
+    ])
+    text = fed.render()
+    assert ('cluster_kv_wire_bytes{member="0:primary:0",dir="send"} '
+            "4216") in text
+    assert ('cluster_kv_wire_bytes{member="0:primary:0",'
+            'dir="replicate"} 4096') in text
+    assert "cluster_wire_mb_per_sec 0\n" in text     # first pass: no rate
+    fam.labels("push", "send", "payload").inc(1 << 20)
+    time.sleep(0.01)
+    text2 = fed.render()
+    rate = [l for l in text2.splitlines()
+            if l.startswith("cluster_wire_mb_per_sec")]
+    assert rate and float(rate[0].split()[-1]) > 0
+
+
+# ---------------------------------------------------------------------------
+# MXNET_TPU_METRICS=0: every new seam is a constant-time guard
+# ---------------------------------------------------------------------------
+
+def test_metrics_disabled_records_nothing_on_wire_seams(monkeypatch):
+    """With the plane off, driving EVERY new seam — client RPCs, server
+    handling, the replication stream, the ServerGroup flush fan-out,
+    the serving raw path, federation render and the report itself —
+    lands zero _record calls."""
+    calls = []
+    monkeypatch.setattr(omet.Counter, "_record",
+                        lambda self, v: calls.append(("c", v)))
+    monkeypatch.setattr(omet.Gauge, "_record",
+                        lambda self, v, op: calls.append(("g", v)))
+    monkeypatch.setattr(omet.Histogram, "_record",
+                        lambda self, v: calls.append(("h", v)))
+    monkeypatch.setenv("MXNET_TPU_METRICS", "0")
+
+    p = AsyncServer(secret="t").start()
+    f = AsyncServer(secret="t").start()
+    try:
+        f.rejoin(p.address)               # replication + snapshot seams
+        cli = AsyncClient(p.address, rank=0, heartbeat=False, secret="t")
+        cli.init([("w", np.zeros(4, np.float32))])
+        cli._call({"op": "pull", "keys": ["w"]})
+        cli.close()
+    finally:
+        p.stop()
+        f.stop()
+
+    servers = [AsyncServer(server_id=i, secret="g").start()
+               for i in range(2)]
+    try:
+        group = ka.ServerGroup([s.address for s in servers], rank=0,
+                               heartbeat=False, secret="g")
+        group.init([("k", np.ones(4, np.float32))])
+        group.set_optimizer(_sgd_pickle())
+        group.push([("k", np.ones(4, np.float32))])   # flush fan-out seam
+        group.pull(["k"])
+        group.shutdown()
+    finally:
+        for s in servers:
+            s.stop()
+
+    from mxnet_tpu import serving
+
+    row = np.arange(3, dtype=np.float32)
+    buf = io.BytesIO()
+    np.save(buf, row)
+    with serving.start_frontend(_StubTarget()) as fe:
+        req = urllib.request.Request(
+            fe.url + "/v1/predict?model=m&input=data", data=buf.getvalue(),
+            headers={"Content-Type": "application/octet-stream"})
+        urllib.request.urlopen(req, timeout=10).read()
+
+    fed = obs.FederatedCollector([
+        {"shard": 0, "role": "primary", "epoch": 0,
+         "registry": obs.REGISTRY}])
+    fed.render()                          # federation parse seam
+    rep = owire.wire_report()             # report degrades to zeros
+    assert rep["bytes_total"] == 0.0 and rep["socket_bytes"] == 0.0
+    assert calls == []

@@ -12,6 +12,11 @@ TPU-native measurements:
 
     python tools/bandwidth.py --size-mb 64
     python tools/launch.py -n 2 python tools/bandwidth.py --dist
+
+``--wire`` additionally runs an in-process 2-shard kvstore push/pull
+loop under the PR-15 byte books and prints ``wire_report()`` next to
+the transfer numbers, so one tool answers both "what can the hardware
+do" and "what does the wire actually use".
 """
 
 import argparse
@@ -46,6 +51,10 @@ def main():
     parser.add_argument("--platform", type=str, default=None,
                         help="force a jax platform (plugin envs ignore "
                              "JAX_PLATFORMS; this uses jax.config)")
+    parser.add_argument("--wire", action="store_true",
+                        help="also run an in-process 2-shard kvstore "
+                             "loop and print the wire-bandwidth books "
+                             "(observability.wire.wire_report)")
     args = parser.parse_args()
 
     if args.platform:
@@ -96,6 +105,42 @@ def main():
         t = _time(lambda: ag(sharded).block_until_ready(), args.repeat)
         print("all-gather (%d dev): %8.2f ms   %6.2f GB/s"
               % (len(devs), t * 1e3, gb / t))
+
+    # wire books: what the kvstore wire ACTUALLY uses, next to what the
+    # hardware can do above
+    if args.wire:
+        import pickle
+
+        from mxnet_tpu import kvstore_async as ka
+        from mxnet_tpu import optimizer as mx_opt
+        from mxnet_tpu.observability import wire as owire
+
+        servers = [ka.AsyncServer(server_id=i, secret="bw").start()
+                   for i in range(2)]
+        group = ka.ServerGroup([s.address for s in servers], rank=0,
+                               heartbeat=False, secret="bw")
+        group._bound = 1 << 10  # stripe the big key across both shards
+        big = np.random.rand(
+            max(int(args.size_mb * (1 << 20) / 4 / 16), 1 << 10)
+        ).astype(np.float32)
+        group.init([("big", big), ("small", np.ones(8, np.float32))])
+        group.set_optimizer(pickle.dumps(mx_opt.SGD(learning_rate=0.01)))
+        t0 = time.perf_counter()
+        for _ in range(args.repeat):
+            group.push([("big", big), ("small", np.ones(8, np.float32))])
+            group.pull(["big", "small"])
+        dt = time.perf_counter() - t0
+        group.shutdown()
+        for s in servers:
+            s.stop()
+        rep = owire.wire_report()
+        print()
+        print("kvstore wire books (%d push+pull rounds, 2 shards):"
+              % args.repeat)
+        print(owire.format_wire_report())
+        if dt > 0:
+            print("measured wire rate: %6.2f MB/s over %.3fs"
+                  % (rep["bytes_total"] / (1 << 20) / dt, dt))
 
     # cross-process (dist kvstore reduce path)
     if args.dist and jax.process_count() > 1:

@@ -54,7 +54,16 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               # "tokens_per_sec" above already covers the headline
               "tokens_per_sec_per_user": True,
               "inter_token_ms_p99": False, "prefill_ms_p50": False,
-              "kv_cache_occupancy": True}
+              "kv_cache_occupancy": True,
+              # schema-11 wire keys (BENCH_WIRE=1 rounds): bytes and
+              # codec share are gated down-is-good — the binary wire
+              # must SHRINK them; fewer RPCs per flush would also be
+              # an improvement, but p50 fan-out is topology-bound, so
+              # it rides the same down-is-good direction as a canary
+              "kv_bytes_per_step": False,
+              "kv_header_overhead_pct": False,
+              "kv_codec_ms_share": False,
+              "kv_rpcs_per_flush_p50": False}
 TREND_TOLERANCE = 0.10
 
 

@@ -1,0 +1,106 @@
+"""``make wire``: run a 2-shard replicated kvstore fit and print the
+wire-bandwidth books — per-op byte split (header vs payload), codec
+wall, RPCs per flush, and the explicitly-labeled projected binary-wire
+savings line.
+
+Drives the PR-15 wire observability plane end to end on the CPU
+backend: two primary+follower replica groups (followers attached via
+live state transfer, sync replication so the ack path is on the books
+too), an instrumented ``ShardedTrainer.fit`` through ``dist_async``,
+then :func:`mxnet_tpu.observability.wire.format_wire_report`.  Exits
+non-zero unless
+
+- the per-op byte books reconcile with the socket-level ground truth
+  (``kv_socket_bytes_total``) within 1%, and
+- foreground codec seconds reconcile against the attribution ``kv``
+  phase (encode/decode happens inside ``att.phase("kv")``),
+
+the same falsifiability contract tier-1 enforces.
+
+Run:  python tools/wire_report.py
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_TPU_METRICS", "1")
+os.environ["MXNET_TPU_KV_REPL_SYNC"] = "1"
+os.environ.setdefault("MXNET_TPU_PS_SECRET", "wire-report")
+
+
+def main():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore_async as ka
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.observability import wire as owire
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    secret = os.environ["MXNET_TPU_PS_SECRET"]
+    servers, addrs = [], []
+    for shard in range(2):
+        pri = ka.AsyncServer(server_id=shard * 2, secret=secret).start()
+        fol = ka.AsyncServer(server_id=shard * 2 + 1,
+                             secret=secret).start()
+        fol.rejoin(pri.address)
+        servers += [pri, fol]
+        addrs.append("%s|%s" % (pri.address, fol.address))
+    os.environ["MXNET_TPU_ASYNC_PS_ADDRS"] = ",".join(addrs)
+    ka.reset_membership()
+
+    B, D = 8, 6
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=8, name="fc2"),
+        name="softmax")
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                      rescale_grad=1.0 / B, wd=0.0))
+    rs = np.random.RandomState(3)
+    it = NDArrayIter({"data": rs.randn(32, D).astype(np.float32)},
+                     {"softmax_label":
+                      rs.randint(0, 8, (32,)).astype(np.float32)},
+                     batch_size=B)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    tr = ShardedTrainer(net, mesh, data_shapes={"data": (B, D)},
+                        label_shapes={"softmax_label": (B,)},
+                        rescale_grad=1.0 / B)
+    tr.fit(it, num_epoch=2, seed=5, log_every=0, kvstore=kv)
+    for s in servers:
+        s.stop()
+
+    print("Wire-bandwidth books (2-shard replicated fit):")
+    print(owire.format_wire_report())
+    print()
+
+    failed = False
+    ok, wire_b, sock_b = owire.wire_reconciles(tol=0.01)
+    if not ok:
+        failed = True
+        print("FAIL: byte books (%d B) do not reconcile with the "
+              "socket truth (%d B) within 1%%" % (wire_b, sock_b))
+    else:
+        print("byte books reconcile with the socket truth: "
+              "%d B vs %d B" % (wire_b, sock_b))
+    cok, codec_kv, kv_phase = owire.codec_reconciles()
+    if not cok:
+        failed = True
+        print("FAIL: foreground codec wall (%.4fs) exceeds the "
+              "attribution kv phase (%.4fs)" % (codec_kv, kv_phase))
+    else:
+        print("codec wall reconciles with the attribution kv phase: "
+              "%.4fs within %.4fs" % (codec_kv, kv_phase))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
