@@ -10,6 +10,7 @@ test: native check
 	$(MAKE) -C native test
 	python -m pytest tests/ -q
 	python tools/wire_report.py
+	python tools/loadgen.py
 
 test-fast: check
 	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
@@ -59,9 +60,12 @@ generate:
 slo:
 	python tools/slo_report.py
 
+fairness:
+	python tools/loadgen.py
+
 clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
 	wire dryrun dist-test chaos trace watchdog elastic continuous serve \
-	generate slo clean
+	generate slo fairness clean

@@ -90,7 +90,12 @@ def _obs_counters():
 # kv_rpcs_per_flush_p50 from the BENCH_WIRE=1 wire-bandwidth lane (a
 # 2-shard replicated in-process kvstore fit under the PR-15 byte
 # books) — the measured baseline the binary-wire lane must beat
-_SCHEMA_VERSION = 11
+# v12: fairness_p99_ratio (innocent tenant's p99 with a saturating
+# tenant present / alone — 1.0 is perfect isolation, down-is-good) /
+# quota_shed_rate (quota 429s over the saturating tenant's offered
+# load) / kv_affinity_hit_ratio (sessions landing on their KV blocks)
+# from the BENCH_FAIRNESS=1 multi-tenant robustness lane (PR-16)
+_SCHEMA_VERSION = 12
 
 
 def _bench_peak():
@@ -411,6 +416,129 @@ def serving_main():
         **_provenance(),
         "config": {"requests": n_requests, "features": feat,
                    "hidden": hidden, "buckets": buckets},
+    }))
+
+
+def fairness_main():
+    """Multi-tenant robustness lane (BENCH_FAIRNESS=1, PR-16).
+
+    Three measurements on the real serving stack, numpy-backed so the
+    lane is seconds on CPU:
+
+    - ``fairness_p99_ratio`` — the innocent tenant's p99 with a
+      quota-limited saturating tenant hammering the same lane, divided
+      by its p99 alone.  1.0 is perfect isolation; the WFQ + quota
+      contract is that a heavy tail costs the innocent tenant a
+      bounded factor, not a meltdown.
+    - ``quota_shed_rate`` — the saturating tenant's typed-429 fraction
+      (sheds / offered): the quota actually biting.
+    - ``kv_affinity_hit_ratio`` — sticky generation sessions landing
+      on the replica that already holds their KV blocks, from the
+      :class:`~mxnet_tpu.serving.KVAffinityRouter` gauge.
+    """
+    import threading
+
+    import jax
+
+    from mxnet_tpu import serving
+    from mxnet_tpu import observability as obs
+
+    platform = jax.devices()[0].platform
+    n_requests = int(os.environ.get("BENCH_FAIR_REQUESTS", "96"))
+
+    class _SlowEcho(serving.Backend):
+        input_shapes = {"data": (4,)}
+
+        def infer(self, batch):
+            time.sleep(0.002)
+            return [batch["data"] * 2.0], False
+
+    def _drive(sched, plan):
+        """Submit (tenant, count) bursts on threads; returns
+        ({tenant: [latency_s]}, {tenant: sheds})."""
+        lat, sheds = {}, {}
+        lock = threading.Lock()
+        row = {"data": np.ones(4, np.float32)}
+
+        def one(tenant):
+            try:
+                req = sched.submit("mlp", row, tenant=tenant)
+                req.result(timeout=60.0)
+            except (serving.QuotaExceededError,
+                    serving.ServerOverloadedError):
+                with lock:
+                    sheds[tenant] = sheds.get(tenant, 0) + 1
+                return
+            with lock:
+                lat.setdefault(tenant, []).append(req.latency_s)
+
+        threads = []
+        for tenant, count in plan:
+            for _ in range(count):
+                th = threading.Thread(target=one, args=(tenant,))
+                th.start()
+                threads.append(th)
+        for th in threads:
+            th.join(timeout=120.0)
+        return lat, sheds
+
+    def _p99(xs):
+        return float(np.percentile(np.asarray(xs) * 1e3, 99))
+
+    # innocent tenant alone: the isolation baseline
+    sched = serving.Scheduler(name="bench-fair")
+    sched.register("mlp", _SlowEcho(), buckets=[1, 2, 4, 8],
+                   max_queue=16 * n_requests,
+                   tenant_weights={"gold": 3.0})
+    sched.tenants.set_quota("bulk", rps=50.0)
+    lat, _ = _drive(sched, [("gold", n_requests)])
+    p99_alone = _p99(lat["gold"])
+
+    # the heavy tail: the saturating tenant offers 8x the innocent load
+    t0 = time.perf_counter()
+    lat, sheds = _drive(sched, [("bulk", 8 * n_requests),
+                                ("gold", n_requests)])
+    dt = time.perf_counter() - t0
+    sched.close()
+    p99_mixed = _p99(lat["gold"])
+    ratio = p99_mixed / p99_alone if p99_alone > 0 else 0.0
+    shed_rate = sheds.get("bulk", 0) / float(8 * n_requests)
+    rps_gold = len(lat["gold"]) / dt
+
+    # sticky sessions over a 2-replica generation group: the affinity
+    # hit ratio the router gauge accrues (3 sessions x 4 visits)
+    from mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.lm_config(num_classes=64, seq_len=48, num_embed=16,
+                        num_heads=2, num_layers=2)
+    params = tfm.init_lm_params(cfg, seed=0)
+    group = serving.ReplicaGroup(
+        replicas=2, group="bench-gen",
+        scheduler_cls=serving.GenerationScheduler)
+    group.register("lm", lambda: serving.LMBackend(
+        params, cfg, block_size=4, num_blocks=64))
+    router = serving.KVAffinityRouter(group)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    for i in range(12):
+        router.generate("lm", prompt, max_new_tokens=4,
+                        session="s%d" % (i % 3), timeout=120)
+    group.close()
+    hit_gauge = obs.REGISTRY.get("kv_affinity_hit_ratio")
+    hit_ratio = float(hit_gauge.labels("bench-gen").value)
+
+    print(json.dumps({
+        "metric": "fairness_throughput" if platform == "tpu"
+                  else "fairness_cpu_smoke_throughput",
+        "value": round(rps_gold, 2), "unit": "req/s",
+        "vs_baseline": 0.0,  # the 2017 reference has no serving tier
+        "fairness_p99_ratio": round(ratio, 3),
+        "quota_shed_rate": round(shed_rate, 4),
+        "kv_affinity_hit_ratio": round(hit_ratio, 4),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"requests": n_requests, "skew": 8,
+                   "p99_alone_ms": round(p99_alone, 3),
+                   "p99_contended_ms": round(p99_mixed, 3)},
     }))
 
 
@@ -855,6 +983,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_FAIRNESS") == "1":
+        fairness_main()
+        return
     if os.environ.get("BENCH_WIRE") == "1":
         wire_main()
         return
@@ -1073,6 +1204,9 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_FAIRNESS") == "1":
+        return ("fairness_throughput",
+                "fairness_cpu_smoke_throughput", "req/s")
     if os.environ.get("BENCH_WIRE") == "1":
         return ("kv_wire_bytes_per_step",
                 "kv_wire_cpu_smoke_bytes_per_step", "B/step")

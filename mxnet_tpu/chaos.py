@@ -45,6 +45,14 @@ site                      planted at
                           writes happen only after a successful step, so a
                           retry can never corrupt another sequence's
                           blocks)
+``serving.route``         replica selection in the KV-affinity router
+                          (``serving.routing.KVAffinityRouter``; fires
+                          once per candidate replica, ``name`` is
+                          ``<model>:<replica index>`` — a fired rule
+                          makes THAT replica unroutable for this
+                          attempt, so ``drop``/``raise`` drill the
+                          spill-to-peer and re-prefill fallback paths;
+                          ``delay`` stretches the routing step)
 ``serving.kv_alloc``      paged KV-cache block allocation
                           (``PagedKVCache.allocate``; ``name`` is the
                           sequence id; ``raise``/``drop`` surface as the
@@ -108,7 +116,7 @@ SITES = frozenset({
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
     "kvstore.resize_drop", "checkpoint.write", "serving.admit",
     "serving.dispatch", "serving.scale", "serving.decode",
-    "serving.kv_alloc", "data.read",
+    "serving.kv_alloc", "serving.route", "data.read",
 })
 
 

@@ -15,8 +15,11 @@ Two consumption surfaces:
 - :func:`report` — the ``/slo`` JSON (``exporters.start_metrics_
   server``) and ``tools/slo_report.py``: per-SLO good/bad totals,
   error rate, and the fraction of error budget remaining (negative =
-  exhausted).  Also sets ``slo_error_budget_remaining{slo}`` so the
-  budget itself federates like any gauge.
+  exhausted).  Also sets ``slo_error_budget_remaining{slo, tenant}``
+  so the budget itself federates like any gauge — ``tenant="all"`` is
+  the aggregate, and the availability SLO gets one row per tenant
+  (PR-16) so a quota-saturating tenant's exhausted budget never masks
+  an innocent tenant's healthy one.
 - :func:`burn_rules` — multi-window **burn-rate** rules registered
   into :func:`~.watchdog.default_rules`: for each SLO a *fast* window
   (default 5 min, threshold 14.4× — the classic "2% of a 30-day
@@ -53,7 +56,8 @@ __all__ = ["SLO", "BurnRateRule", "default_slos", "burn_rules",
 _M_BUDGET = _metrics.gauge(
     "slo_error_budget_remaining",
     "Fraction of the SLO's error budget left (1 = untouched, <=0 = "
-    "exhausted)", ["slo"])
+    "exhausted); tenant=\"all\" is the aggregate, per-tenant rows "
+    "cover availability", ["slo", "tenant"])
 _M_BURN = _metrics.gauge(
     "slo_burn_rate",
     "Error-budget burn rate over the trailing window (1 = consuming "
@@ -115,13 +119,28 @@ class SLO(object):
         return self._latency_counts(fams)
 
     @staticmethod
-    def _sum(fams, metric, suffix=""):
+    def _sum(fams, metric, suffix="", selector=None):
         fam = fams.get(metric)
         if fam is None:
             return None
-        vals = [v for _, v in _watchdog._matching(fam, metric, None,
+        vals = [v for _, v in _watchdog._matching(fam, metric, selector,
                                                   suffix)]
         return sum(vals) if vals else None
+
+    def tenant_counts(self, fams, tenant):
+        """``(good, bad)`` for one tenant (availability only: good =
+        ``serving_tenant_requests_total``, bad = the tenant's rows of
+        ``serving_rejected_total``), or ``None`` when the tenant has no
+        samples."""
+        if self.kind != "availability":
+            return None
+        sel = {"tenant": tenant}
+        good = self._sum(fams, "serving_tenant_requests_total",
+                         selector=sel)
+        bad = self._sum(fams, "serving_rejected_total", selector=sel)
+        if good is None and bad is None:
+            return None
+        return (good or 0.0, bad or 0.0)
 
     def _latency_counts(self, fams):
         # untyped exposition (no ``# TYPE`` line) groups the bucket
@@ -150,6 +169,13 @@ class SLO(object):
         else:
             under = total
         return (under, max(total - under, 0.0))
+
+    def _budget_row(self, counts):
+        good, bad = counts if counts is not None else (0.0, 0.0)
+        total = good + bad
+        error_rate = (bad / total) if total else 0.0
+        consumed = error_rate / self.budget if self.budget else 0.0
+        return good, bad, total, error_rate, consumed
 
     def snapshot(self, fams):
         """The ``/slo`` row: totals, error rate, budget remaining."""
@@ -267,12 +293,31 @@ def burn_rules(slos=None):
     return rules
 
 
+def _tenants_in(fams):
+    """Tenant label values present in the per-tenant serving counters."""
+    tenants = set()
+    for metric in ("serving_tenant_requests_total",
+                   "serving_rejected_total"):
+        fam = fams.get(metric)
+        if fam is None:
+            continue
+        for ld, _ in _watchdog._matching(fam, metric, None, ""):
+            t = ld.get("tenant")
+            if t:
+                tenants.add(t)
+    return sorted(tenants)
+
+
 def report(source=None, slos=None):
     """The ``/slo`` payload: one row per SLO (see
     :meth:`SLO.snapshot`), computed from ``source`` — ``None`` (the
     process-global registry), anything with ``render()``, or raw
-    exposition text.  Sets ``slo_error_budget_remaining{slo}``.  An
-    empty report (no parsing) when metrics are disabled."""
+    exposition text.  Sets ``slo_error_budget_remaining{slo, tenant}``:
+    ``tenant="all"`` is the aggregate every dashboard already reads;
+    availability additionally gets one row per tenant seen in the
+    per-tenant serving counters, so a saturating tenant's dead budget
+    never hides an innocent tenant's healthy one.  An empty report (no
+    parsing) when metrics are disabled."""
     if not _metrics.metrics_enabled():
         return {"slos": [], "disabled": True}
     if source is None:
@@ -282,9 +327,24 @@ def report(source=None, slos=None):
     else:
         text = str(source)
     fams = _federation._parse(text)
+    tenants = _tenants_in(fams)
     rows = []
     for slo in (slos if slos is not None else default_slos()):
         row = slo.snapshot(fams)
-        _M_BUDGET.labels(slo.name).set(row["budget_remaining"])
+        _M_BUDGET.labels(slo.name, "all").set(row["budget_remaining"])
+        if slo.kind == "availability" and tenants:
+            per_tenant = {}
+            for tenant in tenants:
+                counts = slo.tenant_counts(fams, tenant)
+                if counts is None:
+                    continue
+                _, _, total, _, consumed = slo._budget_row(counts)
+                remaining = round(1.0 - consumed, 6)
+                _M_BUDGET.labels(slo.name, tenant).set(remaining)
+                per_tenant[tenant] = {
+                    "total": total, "budget_remaining": remaining,
+                    "exhausted": bool(total and consumed >= 1.0)}
+            if per_tenant:
+                row["tenants"] = per_tenant
         rows.append(row)
     return {"slos": rows}

@@ -547,6 +547,21 @@ def default_rules():
              description="a model lane's queue is nearly full "
                          "(depth/max_queue) — overload shedding is "
                          "imminent; add replicas or widen buckets"),
+        # multi-tenant quotas (serving/tenancy.py): quota sheds are
+        # *correct* behaviour for a saturating tenant, so the rule only
+        # warns on a surge — a sudden pile of 429s usually means a
+        # misconfigured budget or a runaway client, not capacity
+        Rule("quota_shed_surge", "serving_rejected_total",
+             kind="increase", selector={"reason": "quota"},
+             threshold=_env_float("MXNET_TPU_WATCHDOG_QUOTA_SHEDS",
+                                  100.0),
+             window_s=_env_float(
+                 "MXNET_TPU_WATCHDOG_QUOTA_SHEDS_WINDOW_S", 60.0),
+             severity="warning",
+             description="per-tenant quota sheds surged inside the "
+                         "window — check serving_rejected_total"
+                         "{reason=quota} by tenant for the runaway "
+                         "client or a misconfigured budget"),
     ]
     # wire-bandwidth rules (observability/wire.py books): both derive a
     # ratio from two families, so they ride the value_fn seam instead of

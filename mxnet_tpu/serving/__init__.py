@@ -21,6 +21,12 @@ traffic in, predictions out:
 - :mod:`~mxnet_tpu.serving.frontend` — the stdlib HTTP surface
   (``/v1/predict``, ``/v1/generate``, ``/v1/models``, ``/healthz``,
   ``/readyz``).
+- :mod:`~mxnet_tpu.serving.tenancy` — multi-tenant fairness: weighted
+  fair queuing (deficit round-robin) and per-tenant token-bucket
+  quotas shared by both scheduler lanes.
+- :mod:`~mxnet_tpu.serving.routing` — KV-affinity routing for
+  generation sessions: stay on the replica holding your KV blocks,
+  spill with re-prefill on imbalance or death.
 
 Quickstart (one replica)::
 
@@ -37,9 +43,10 @@ the brownout story.
 """
 
 from . import (admission, frontend, generation, registry, replication,
-               scheduler)
+               routing, scheduler, tenancy)
 from .admission import (AdmissionController, CacheExhaustedError,
-                        DeadlineExceededError, ReplicaDeadError,
+                        DeadlineExceededError, InvalidDeadlineError,
+                        QuotaExceededError, ReplicaDeadError,
                         ServerDrainingError, ServerOverloadedError,
                         ServingError, UnknownModelError, deadline_from_ms,
                         default_deadline_ms)
@@ -49,17 +56,22 @@ from .generation import (GenerationRequest, GenerationScheduler,
 from .registry import (Backend, ExportedBackend, ModelRegistry,
                        PredictorBackend, as_backend, default_buckets)
 from .replication import ReplicaGroup, ServingRouter
+from .routing import KVAffinityRouter
 from .scheduler import InferenceRequest, Scheduler
+from .tenancy import (DEFAULT_TENANT, FairQueue, TenantPolicy,
+                      TokenBucket, clean_tenant)
 
 __all__ = [
     "AdmissionController", "Backend", "CacheExhaustedError",
-    "DeadlineExceededError", "ExportedBackend", "GenerationRequest",
-    "GenerationScheduler", "InferenceRequest", "LMBackend",
-    "ModelRegistry", "PredictorBackend", "ReplicaDeadError",
-    "ReplicaGroup", "Scheduler", "ServerDrainingError",
-    "ServerOverloadedError", "ServingError", "ServingFrontend",
-    "ServingRouter", "UnknownModelError", "admission", "as_backend",
-    "deadline_from_ms", "default_buckets", "default_deadline_ms",
-    "frontend", "generation", "registry", "replication", "scheduler",
-    "start_frontend",
+    "DEFAULT_TENANT", "DeadlineExceededError", "ExportedBackend",
+    "FairQueue", "GenerationRequest", "GenerationScheduler",
+    "InferenceRequest", "InvalidDeadlineError", "KVAffinityRouter",
+    "LMBackend", "ModelRegistry", "PredictorBackend",
+    "QuotaExceededError", "ReplicaDeadError", "ReplicaGroup",
+    "Scheduler", "ServerDrainingError", "ServerOverloadedError",
+    "ServingError", "ServingFrontend", "ServingRouter", "TenantPolicy",
+    "TokenBucket", "UnknownModelError", "admission", "as_backend",
+    "clean_tenant", "deadline_from_ms", "default_buckets",
+    "default_deadline_ms", "frontend", "generation", "registry",
+    "replication", "routing", "scheduler", "start_frontend", "tenancy",
 ]

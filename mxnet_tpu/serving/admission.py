@@ -22,28 +22,41 @@ bouncer — typed, HTTP-mappable rejections at the door:
   already accepted keeps flowing to completion — the graceful-restart
   half of a rolling deploy.
 
-Every rejection increments ``serving_rejected_total{model,reason}``
-with ``reason`` ∈ ``overload | deadline | draining`` so shed load is
-accounted, never inferred.  The scheduler consults the chaos site
-``serving.admit`` on every admit (outside the queue lock, so injected
-delays stall one caller, not the dispatch loop), letting fault drills
-shed or delay at the door deterministically (seeded — see
-``mxnet_tpu/chaos.py``).
+- **Per-tenant quotas** (PR-16).  Every tenant has token buckets for
+  requests/s and generated-tokens/s (``serving/tenancy.py``); a charge
+  past the budget raises :class:`QuotaExceededError` (HTTP 429) naming
+  the exhausted budget, carrying ``retry_after_s`` — the bucket's
+  refill time, which the front-end maps onto a ``Retry-After``
+  header.  One tenant exhausting its budget sheds *that tenant*,
+  never the lane.
+
+Every rejection increments
+``serving_rejected_total{model,reason,tenant}`` with ``reason`` ∈
+``overload | deadline | draining | quota | ...`` so shed load is
+accounted per tenant, never inferred.  The scheduler consults the
+chaos site ``serving.admit`` on every admit (outside the queue lock,
+so injected delays stall one caller, not the dispatch loop), letting
+fault drills shed or delay at the door deterministically (seeded —
+see ``mxnet_tpu/chaos.py``).
 """
 
 from __future__ import annotations
 
+import math
 import os
 import time
 
 from ..base import MXNetError
 from ..observability import metrics as _metrics
 from ..ops.kv_cache import CacheExhaustedError
+from .tenancy import DEFAULT_TENANT
 
 __all__ = ["ServingError", "ServerOverloadedError", "ServerDrainingError",
            "DeadlineExceededError", "UnknownModelError", "ReplicaDeadError",
+           "QuotaExceededError", "InvalidDeadlineError",
            "CacheExhaustedError", "AdmissionController", "deadline_from_ms",
-           "default_deadline_ms", "max_queue_default", "reject_reason"]
+           "default_deadline_ms", "default_retry_after_s",
+           "max_queue_default", "reject_reason"]
 
 
 class ServingError(MXNetError):
@@ -88,6 +101,31 @@ class ReplicaDeadError(ServingError):
     http_status = 503
 
 
+class QuotaExceededError(ServingError):
+    """The tenant's token-bucket budget is exhausted.  ``budget`` names
+    which bucket ran dry (``requests`` or ``tokens``) and
+    ``retry_after_s`` is the refill time — the ``Retry-After`` hint
+    the front-end puts on the wire.  Deliberately NOT a subclass of
+    :class:`ServerOverloadedError`: a quota shed is a per-tenant
+    verdict, so the failover router must surface it instead of burning
+    the budget again on every peer."""
+
+    http_status = 429
+
+    def __init__(self, msg, budget="requests", retry_after_s=None):
+        super().__init__(msg)
+        self.budget = budget
+        self.retry_after_s = retry_after_s
+
+
+class InvalidDeadlineError(ServingError):
+    """``deadline_ms`` was negative or non-finite — a malformed
+    request, rejected before it can mint an already-expired deadline
+    (0 stays the documented "no deadline" sentinel)."""
+
+    http_status = 400
+
+
 #: Canonical shed-reason tag per typed rejection — the vocabulary the
 #: ``serving.shed`` span attr and the access-log event share.
 #: ``CacheExhaustedError`` (429) comes from the generation lane's paged
@@ -100,6 +138,7 @@ _REASONS = {
     ReplicaDeadError: "replica_dead",
     UnknownModelError: "unknown_model",
     CacheExhaustedError: "cache_exhausted",
+    QuotaExceededError: "quota",
 }
 
 
@@ -109,10 +148,37 @@ def reject_reason(exc):
     return _REASONS.get(exc if isinstance(exc, type) else type(exc))
 
 
+#: Shared help/label schema for ``serving_rejected_total`` — every
+#: registry that re-registers the family (per-replica isolated
+#: registries) must agree on it, so there is exactly one source.
+REJECTED_HELP = ("Serving requests shed, by model, reason "
+                 "(overload | deadline | draining | quota | ...) and "
+                 "tenant")
+REJECTED_LABELS = ["model", "reason", "tenant"]
+
 _M_REJECTED = _metrics.counter(
-    "serving_rejected_total",
-    "Serving requests shed, by model and reason "
-    "(overload | deadline | draining)", ["model", "reason"])
+    "serving_rejected_total", REJECTED_HELP, REJECTED_LABELS)
+
+
+def default_retry_after_s():
+    """``MXNET_TPU_SERVING_RETRY_AFTER_S``: the backoff hint (seconds)
+    the front-end sends on 429-class sheds that carry no bucket refill
+    time of their own (overload, cache exhaustion)."""
+    try:
+        return float(os.environ.get("MXNET_TPU_SERVING_RETRY_AFTER_S",
+                                    "1"))
+    except ValueError:
+        return 1.0
+
+
+def retry_after_s(exc):
+    """The ``Retry-After`` value (whole seconds, >= 1) for a 429-class
+    shed: the quota bucket's refill time when the error carries one,
+    the env-default backoff otherwise."""
+    hint = getattr(exc, "retry_after_s", None)
+    if hint is None:
+        hint = default_retry_after_s()
+    return max(1, int(math.ceil(float(hint))))
 
 
 def default_deadline_ms():
@@ -134,11 +200,28 @@ def max_queue_default():
 def deadline_from_ms(deadline_ms=None, now=None):
     """Relative ``deadline_ms`` → absolute monotonic deadline (seconds),
     or None for no deadline.  ``deadline_ms=None`` falls back to the
-    ``MXNET_TPU_SERVING_DEADLINE_MS`` default."""
+    ``MXNET_TPU_SERVING_DEADLINE_MS`` default.
+
+    ``0`` is the documented "no deadline" sentinel (the env default and
+    the router's no-deadline retry depend on it).  Anything *negative*
+    or *non-finite* is a malformed request and raises the typed
+    :class:`InvalidDeadlineError` (HTTP 400) instead of minting an
+    already-expired — or never-expiring — deadline."""
     if deadline_ms is None:
         deadline_ms = default_deadline_ms()
-    deadline_ms = float(deadline_ms)
-    if deadline_ms <= 0:
+    try:
+        deadline_ms = float(deadline_ms)
+    except (TypeError, ValueError):
+        raise InvalidDeadlineError(
+            "deadline_ms must be a number, got %r" % (deadline_ms,))
+    if not math.isfinite(deadline_ms):
+        raise InvalidDeadlineError(
+            "deadline_ms must be finite, got %r" % (deadline_ms,))
+    if deadline_ms < 0:
+        raise InvalidDeadlineError(
+            "deadline_ms must be >= 0 (0 = no deadline), got %r"
+            % (deadline_ms,))
+    if deadline_ms == 0:
         return None
     return (time.monotonic() if now is None else now) + deadline_ms / 1e3
 
@@ -167,14 +250,14 @@ class AdmissionController(object):
         """Re-open admission (a drain that turned out unnecessary)."""
         self._draining = False
 
-    def account(self, model, reason):
+    def account(self, model, reason, tenant=DEFAULT_TENANT):
         """Book one shed request without raising (dispatch-side expiry,
         where the error lands on the request future instead)."""
-        self._rejected.labels(model, reason).inc()
+        self._rejected.labels(model, reason, tenant).inc()
 
-    def reject(self, model, reason, detail=""):
+    def reject(self, model, reason, detail="", tenant=DEFAULT_TENANT):
         """Account a shed request and raise its typed error."""
-        self.account(model, reason)
+        self.account(model, reason, tenant)
         if reason == "draining":
             raise ServerDrainingError(
                 "model %r: replica is draining%s" % (model, detail))
@@ -184,19 +267,32 @@ class AdmissionController(object):
         raise ServerOverloadedError(
             "model %r: queue full%s" % (model, detail))
 
-    def admit(self, model, depth, max_queue, deadline, now=None):
+    def quota_reject(self, model, tenant, budget, wait_s):
+        """Account a quota shed and raise the typed 429 naming the
+        exhausted budget, with the bucket's refill time as the
+        ``Retry-After`` hint."""
+        self.account(model, "quota", tenant)
+        raise QuotaExceededError(
+            "model %r: tenant %r exhausted its %s budget (retry in "
+            "%.2fs)" % (model, tenant, budget, wait_s),
+            budget=budget, retry_after_s=wait_s)
+
+    def admit(self, model, depth, max_queue, deadline, now=None,
+              tenant=DEFAULT_TENANT):
         """Gate one request at the door.  Raises the typed rejection
         (accounted in ``serving_rejected_total``) or returns silently.
         Pure policy — the scheduler fires the ``serving.admit`` chaos
         site before calling, outside its queue lock."""
         if self._draining:
-            self.reject(model, "draining")
+            self.reject(model, "draining", tenant=tenant)
         now = time.monotonic() if now is None else now
         if deadline is not None and now >= deadline:
-            self.reject(model, "deadline", " (expired at admission)")
+            self.reject(model, "deadline", " (expired at admission)",
+                        tenant=tenant)
         if depth >= max_queue:
             self.reject(model, "overload",
-                        " (depth %d >= max_queue %d)" % (depth, max_queue))
+                        " (depth %d >= max_queue %d)" % (depth, max_queue),
+                        tenant=tenant)
 
     @staticmethod
     def expired(deadline, now=None):

@@ -36,8 +36,19 @@ A tiny stdlib ``http.server`` endpoint (same loopback posture as
     half of drain mode.
 
 Typed serving errors map to the wire via their ``http_status``
-(429 overload, 503 draining/dead, 504 deadline, 404 unknown model);
-the body is ``{"error": ..., "type": ...}``.
+(429 overload/quota, 503 draining/dead, 504 deadline, 404 unknown
+model); the body is ``{"error": ..., "type": ...}``.  Every 429-class
+reply carries a ``Retry-After`` header: for a quota shed it is the
+token bucket's actual refill time (rounded up to whole seconds), for
+an overload shed the ``MXNET_TPU_SERVING_RETRY_AFTER_S`` default — a
+well-behaved client backs off exactly as long as the budget needs.
+
+**Multi-tenancy**: callers name their tenant with an optional
+``X-MXTPU-Tenant`` header; the id is sanitized
+(:func:`~.tenancy.clean_tenant`) and carried through admission, the
+weighted-fair queues, quotas, spans and the ``serving.access`` event.
+Requests without the header ride as tenant ``"default"`` — the
+single-tenant wire contract is unchanged.
 
 Per-request observability: every ``/v1/predict`` request runs inside a
 root ``serving.request`` span and answers with an
@@ -71,6 +82,7 @@ from ..observability.events import emit as _emit_event
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from . import admission as _admission
+from . import tenancy as _tenancy
 
 __all__ = ["ServingFrontend", "start_frontend", "trace_header_enabled"]
 
@@ -121,10 +133,11 @@ class ServingFrontend(object):
         return False
 
 
-def _target_request(target, model, inputs, deadline_ms, timeout):
+def _target_request(target, model, inputs, deadline_ms, timeout,
+                    tenant=None):
     # Scheduler and ServingRouter share the request() signature
     return target.request(model, inputs, deadline_ms=deadline_ms,
-                          timeout=timeout)
+                          timeout=timeout, tenant=tenant)
 
 
 def _target_models(target):
@@ -181,17 +194,25 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             self.end_headers()
             self.wfile.write(body)
 
-        def _reply_json(self, status, payload):
+        def _reply_json(self, status, payload, extra=()):
             self._reply(status, json.dumps(payload).encode("utf-8"),
-                        "application/json; charset=utf-8")
+                        "application/json; charset=utf-8", extra=extra)
 
         def _reply_error(self, exc):
             status = getattr(exc, "http_status", None)
             if status is None:
                 status = 400 if isinstance(exc, MXNetError) else 500
             self._shed = _admission.reject_reason(exc)
+            extra = ()
+            if status == 429:
+                # quota sheds carry the bucket's actual refill time,
+                # overload sheds the env default — either way a 429 is
+                # never headerless (tested contract)
+                extra = (("Retry-After",
+                          str(_admission.retry_after_s(exc))),)
             self._reply_json(status, {"error": str(exc),
-                                      "type": type(exc).__name__})
+                                      "type": type(exc).__name__},
+                             extra=extra)
 
         def do_GET(self):
             self._rid = None     # keep-alive: no id leak from a POST
@@ -217,6 +238,8 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             self._model = None
             self._shed = None
             self._status = 500
+            self._tenant = _tenancy.clean_tenant(
+                self.headers.get("X-MXTPU-Tenant"))
             # the caller's trace token (when the gate is open) parents
             # the root span; attach_wire_context silently ignores
             # malformed tokens — never a 4xx over a bad trace header
@@ -247,13 +270,13 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                         self._reply_json(400, {"error": str(exc),
                                                "type": type(exc).__name__})
                     root.set(model=self._model, status=self._status,
-                             request_id=self._rid)
+                             request_id=self._rid, tenant=self._tenant)
                     _emit_event(
                         "serving.access", status=self._status,
                         latency_ms=round((time.monotonic() - t0) * 1e3,
                                          3),
                         model=self._model, request_id=self._rid,
-                        shed=self._shed)
+                        tenant=self._tenant, shed=self._shed)
 
         def _predict_json(self, body):
             payload = json.loads(body.decode("utf-8"))
@@ -261,7 +284,8 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             inputs = {n: _np.asarray(v, dtype=_np.float32)
                       for n, v in payload["inputs"].items()}
             outs = _target_request(target, model, inputs,
-                                   payload.get("deadline_ms"), timeout)
+                                   payload.get("deadline_ms"), timeout,
+                                   tenant=self._tenant)
             self._reply_json(200, {
                 "model": model,
                 "outputs": [_np.asarray(o).tolist() for o in outs]})
@@ -291,7 +315,8 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                 _np.asarray(payload["prompt"], dtype=_np.int32),
                 max_new_tokens=payload.get("max_new_tokens"),
                 eos_id=payload.get("eos_id"),
-                deadline_ms=payload.get("deadline_ms"))
+                deadline_ms=payload.get("deadline_ms"),
+                tenant=self._tenant)
             self._status = 200
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
@@ -338,7 +363,8 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             row = _np.load(io.BytesIO(body), allow_pickle=False)
             outs = _target_request(
                 target, model, {name: row},
-                float(deadline) if deadline is not None else None, timeout)
+                float(deadline) if deadline is not None else None, timeout,
+                tenant=self._tenant)
             buf = io.BytesIO()
             _np.save(buf, _np.asarray(outs[0]))
             out_bytes = buf.getvalue()

@@ -52,7 +52,6 @@ sequence and frees its blocks at the next iteration.
 
 from __future__ import annotations
 
-import collections
 import os
 import queue as _queue
 import threading
@@ -68,6 +67,7 @@ from ..observability import tracing as _tracing
 from ..observability.events import emit as _emit_event
 from ..ops.kv_cache import CacheExhaustedError, PagedKVCache
 from . import admission as _admission
+from . import tenancy as _tenancy
 from .registry import Backend, ModelRegistry
 from .scheduler import default_retries
 
@@ -117,16 +117,19 @@ class GenerationRequest(object):
     """
 
     __slots__ = ("model", "prompt", "max_new_tokens", "eos_id", "deadline",
-                 "t_admit", "trace", "generated", "error", "finish_reason",
-                 "latency_s", "first_token_s", "seq_id", "_tokens", "_event",
-                 "_cancelled")
+                 "tenant", "t_admit", "trace", "generated", "error",
+                 "finish_reason", "latency_s", "first_token_s", "seq_id",
+                 "_tokens", "_event", "_cancelled", "_h_tenant",
+                 "_h_tokens")
 
-    def __init__(self, model, prompt, max_new_tokens, eos_id, deadline):
+    def __init__(self, model, prompt, max_new_tokens, eos_id, deadline,
+                 tenant=_tenancy.DEFAULT_TENANT):
         self.model = model
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.deadline = deadline
+        self.tenant = tenant
         self.t_admit = time.monotonic()
         self.trace = None
         self.generated = []
@@ -138,6 +141,11 @@ class GenerationRequest(object):
         self._tokens = _queue.Queue()
         self._event = threading.Event()
         self._cancelled = False
+        # pre-resolved per-tenant counter handles (attached at submit,
+        # None with metrics disabled) — the decode loop never resolves
+        # labels
+        self._h_tenant = None
+        self._h_tokens = None
 
     @property
     def done(self):
@@ -161,12 +169,16 @@ class GenerationRequest(object):
         self._tokens.put(int(token))
 
     def _finish(self, reason):
+        if self._event.is_set():   # idempotent: kill vs loop race
+            return
         self.finish_reason = reason
         self.latency_s = time.monotonic() - self.t_admit
         self._tokens.put(_DONE)
         self._event.set()
 
     def _fail(self, error):
+        if self._event.is_set():   # idempotent: kill vs loop race
+            return
         self.error = error
         self.finish_reason = "error"
         self.latency_s = time.monotonic() - self.t_admit
@@ -330,13 +342,15 @@ class _GenLane(object):
 
     __slots__ = ("entry", "queue", "active", "thread", "steps", "tokens",
                  "rows", "slots", "max_step_rows", "seq_counter",
+                 "tenant_handles",
                  "m_req", "m_prefill", "m_itl", "m_depth", "m_occ",
                  "m_active", "m_requests", "m_tokens", "m_steps",
                  "m_compiles", "m_errors", "m_reprefills")
 
-    def __init__(self, entry):
+    def __init__(self, entry, weight_fn=None):
         self.entry = entry
-        self.queue = collections.deque()
+        self.queue = _tenancy.FairQueue(weight_fn)
+        self.tenant_handles = {}
         self.active = []
         self.thread = None
         self.steps = 0
@@ -355,21 +369,27 @@ class GenerationScheduler(object):
     prefill/decode loop instead of one-shot dispatch windows.
     """
 
-    def __init__(self, registry=None, metrics_registry=None, name="gen0"):
+    def __init__(self, registry=None, metrics_registry=None, name="gen0",
+                 tenant_policy=None):
         self.name = name
         self.registry = registry if registry is not None else ModelRegistry()
         self._reg = (metrics_registry if metrics_registry is not None
                      else _metrics.REGISTRY)
+        self.tenants = (tenant_policy if tenant_policy is not None
+                        else _tenancy.TenantPolicy())
         self.admission = _admission.AdmissionController(
             reject_counter=self._reg.counter(
-                "serving_rejected_total",
-                "Serving requests shed, by model and reason "
-                "(overload | deadline | draining)", ["model", "reason"]))
+                "serving_rejected_total", _admission.REJECTED_HELP,
+                _admission.REJECTED_LABELS))
         self._fam = self._families(self._reg)
         self._cond = threading.Condition()
         self._lanes = {}
         self._stopping = False
         self._killed = False
+        # membership identity (replication.ReplicaGroup): a generation
+        # replica fences exactly like a classifier replica
+        self._fenced_epoch = None
+        self.epoch = 0
         self.last_beat = time.monotonic()
 
     @staticmethod
@@ -417,27 +437,53 @@ class GenerationScheduler(object):
                 "generation_reprefills_total",
                 "Live sequences re-prefilled after a backend hot swap",
                 ["model"]),
+            "tenant_req": reg.counter(
+                "serving_tenant_requests_total",
+                "Requests answered successfully per model and tenant "
+                "(the per-tenant SLO good-counter)",
+                ["model", "tenant"]),
+            "tenant_tok": reg.counter(
+                "generation_tenant_tokens_total",
+                "Tokens generated per model and tenant (the per-tenant "
+                "tokens/sec signal the autoscaler scales on)",
+                ["model", "tenant"]),
         }
 
     # -- registration -------------------------------------------------
 
+    def _weight_fn(self, entry):
+        overrides = entry.tenant_weights
+        policy = self.tenants
+
+        def weight(tenant):
+            w = overrides.get(tenant)
+            return policy.weight(tenant) if w is None else float(w)
+        return weight
+
     def register(self, name, backend, decode_buckets=None,
-                 prefill_buckets=None, max_queue=None):
+                 prefill_buckets=None, max_queue=None, buckets=None,
+                 tenant_weights=None):
         """Register an :class:`LMBackend` and start its generation loop.
 
         ``decode_buckets`` ride the registry entry's bucket slot (they
         are batch buckets, exactly like the classifier lane's);
-        ``prefill_buckets`` are prompt-length pad targets, clipped to
-        the model's ``seq_len``.
+        ``buckets`` is an alias for it, so a
+        :class:`~.replication.ReplicaGroup` can stamp models through the
+        classifier-shaped ``register`` signature.  ``prefill_buckets``
+        are prompt-length pad targets, clipped to the model's
+        ``seq_len``.  ``tenant_weights`` overrides WFQ weights for this
+        model.
         """
         if not isinstance(backend, LMBackend):
             raise MXNetError(
                 "generation lane serves LMBackend models, got %r"
                 % (type(backend).__name__,))
         entry = self.registry.register(
-            name, backend, buckets=decode_buckets or default_decode_buckets(),
-            max_queue=max_queue)
-        lane = _GenLane(entry)
+            name, backend,
+            buckets=(decode_buckets or buckets or
+                     default_decode_buckets()),
+            max_queue=max_queue, tenant_weights=tenant_weights)
+        lane = _GenLane(entry, weight_fn=self._weight_fn(entry))
         seq_len = backend.cfg["seq_len"]
         lane_prefill = sorted({min(b, seq_len) for b in
                                (prefill_buckets or
@@ -506,24 +552,34 @@ class GenerationScheduler(object):
         return lane
 
     def submit(self, name, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None):
+               deadline_ms=None, tenant=None, force=False):
         """Admit one generation request; returns its
-        :class:`GenerationRequest` (stream + future)."""
+        :class:`GenerationRequest` (stream + future).  ``tenant``
+        labels it for WFQ/quotas (the tokens budget is charged
+        ``max_new_tokens`` up front — a reservation, so admission is
+        the only quota door).  ``force=True`` re-admits accepted work
+        from a dead peer past overload/drain/quota (the affinity
+        router's brownout contract); kill and fencing still refuse."""
+        tenant = _tenancy.clean_tenant(tenant)
         try:
             return self._submit(name, prompt, max_new_tokens, eos_id,
-                                deadline_ms)
+                                deadline_ms, tenant, force)
         except _admission.ServingError as exc:
             if _tracing.tracing_enabled():
                 _tracing.record_span(
                     "serving.shed", cat="serving", model=name,
                     reason=_admission.reject_reason(exc) or "error",
-                    error=type(exc).__name__)
+                    tenant=tenant, error=type(exc).__name__)
             raise
 
-    def _submit(self, name, prompt, max_new_tokens, eos_id, deadline_ms):
-        if self._killed:
+    def _submit(self, name, prompt, max_new_tokens, eos_id, deadline_ms,
+                tenant, force):
+        if self._killed or self._fenced_epoch is not None:
             raise _admission.ReplicaDeadError(
-                "replica %r is dead" % self.name)
+                "replica %r is %s" % (self.name,
+                                      "fenced at epoch %r" % self._fenced_epoch
+                                      if self._fenced_epoch is not None
+                                      else "dead"))
         lane = self._lane(name)
         backend = lane.entry.backend
         prompt = _np.asarray(prompt, dtype=_np.int32).reshape(-1)
@@ -544,27 +600,43 @@ class GenerationScheduler(object):
             raise MXNetError("prompt token ids outside [0, %d)" % vocab)
         deadline = _admission.deadline_from_ms(deadline_ms)
         req = GenerationRequest(name, prompt, max_new_tokens, eos_id,
-                                deadline)
+                                deadline, tenant)
         req.trace = _tracing.capture_wire_context()
-        with _tracing.span("serving.admit", cat="serving", model=name):
+        with _tracing.span("serving.admit", cat="serving", model=name,
+                           tenant=tenant):
             chaos.visit("serving.admit", name=name)
             with self._cond:
-                if self._stopping:
-                    self.admission.reject(name, "draining")
-                self.admission.admit(name, len(lane.queue),
-                                     lane.entry.max_queue, deadline)
-                lane.queue.append(req)
+                if self._stopping and not force:
+                    self.admission.reject(name, "draining", tenant=tenant)
+                if not force:
+                    self.admission.admit(name, len(lane.queue),
+                                         lane.entry.max_queue, deadline,
+                                         tenant=tenant)
+                    # tokens budget charged up front (max_new_tokens is
+                    # the reservation): one admission-time verdict, no
+                    # mid-generation quota kills
+                    over = self.tenants.charge(tenant,
+                                               tokens=max_new_tokens)
+                    if over is not None:
+                        self.admission.quota_reject(name, tenant, *over)
+                lane.queue.push(tenant, req)
                 if _metrics.metrics_enabled():
                     lane.m_depth.set(len(lane.queue))
+                    pair = lane.tenant_handles.get(tenant)
+                    if pair is None:
+                        pair = lane.tenant_handles[tenant] = (
+                            self._fam["tenant_req"].labels(name, tenant),
+                            self._fam["tenant_tok"].labels(name, tenant))
+                    req._h_tenant, req._h_tokens = pair
                 self._cond.notify_all()
         return req
 
     def generate(self, name, prompt, max_new_tokens=None, eos_id=None,
-                 deadline_ms=None, timeout=60.0):
+                 deadline_ms=None, timeout=60.0, tenant=None):
         """Synchronous convenience: :meth:`submit` + ``result()``."""
         return self.submit(name, prompt, max_new_tokens=max_new_tokens,
-                           eos_id=eos_id,
-                           deadline_ms=deadline_ms).result(timeout=timeout)
+                           eos_id=eos_id, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout=timeout)
 
     # -- the generation loop ------------------------------------------
 
@@ -592,11 +664,10 @@ class GenerationScheduler(object):
             self._retire_stale_backend(name, lane, backend)
             self._retire(lane, backend)
             capacity = entry.buckets[-1] - len(lane.active)
-            admitted = []
             with self._cond:
-                while lane.queue and capacity > 0:
-                    admitted.append(lane.queue.popleft())
-                    capacity -= 1
+                # DRR admission into the decode batch: freed slots are
+                # shared by tenant weight, not arrival order
+                admitted = lane.queue.take(capacity)
                 if _metrics.metrics_enabled():
                     lane.m_depth.set(len(lane.queue))
             for req in admitted:
@@ -648,6 +719,8 @@ class GenerationScheduler(object):
                             >= req.max_new_tokens else "stop")
                 if _metrics.metrics_enabled():
                     lane.m_requests.inc()
+                    if req._h_tenant is not None:
+                        req._h_tenant.inc()
                     lane.m_req.observe(req.latency_s, req.trace)
                 _emit_event("generation.complete", model=req.model,
                             tokens=seq.new_tokens,
@@ -671,7 +744,7 @@ class GenerationScheduler(object):
             req._finish("cancelled")
             return
         if _admission.AdmissionController.expired(req.deadline, now):
-            self.admission.account(name, "deadline")
+            self.admission.account(name, "deadline", req.tenant)
             req._fail(_admission.DeadlineExceededError(
                 "model %r: deadline expired while queued (waited %.3fs)"
                 % (name, now - req.t_admit)))
@@ -679,7 +752,7 @@ class GenerationScheduler(object):
         try:
             self._start_sequence(name, lane, backend, req, resume=resume)
         except CacheExhaustedError as exc:
-            self.admission.account(name, "cache_exhausted")
+            self.admission.account(name, "cache_exhausted", req.tenant)
             if _tracing.tracing_enabled():
                 _tracing.record_span(
                     "serving.shed", cat="serving", model=name,
@@ -842,6 +915,8 @@ class GenerationScheduler(object):
             lane.tokens += 1
             if _metrics.metrics_enabled():
                 lane.m_tokens.inc()
+                if seq.req._h_tokens is not None:
+                    seq.req._h_tokens.inc()
                 lane.m_itl.observe(now - seq.t_last_token, seq.req.trace)
             seq.t_last_token = now
 
@@ -849,7 +924,7 @@ class GenerationScheduler(object):
 
     @property
     def alive(self):
-        return not self._killed
+        return not self._killed and self._fenced_epoch is None
 
     def ready(self):
         return self.alive and not self.admission.draining \
@@ -859,6 +934,13 @@ class GenerationScheduler(object):
         with self._cond:
             lane = self._lanes.get(name)
             return len(lane.queue) if lane else 0
+
+    def load(self):
+        """Waiting + live sequences across lanes — the affinity
+        router's imbalance/spill signal (:mod:`~.routing`)."""
+        with self._cond:
+            return sum(len(l.queue) + len(l.active)
+                       for l in self._lanes.values())
 
     def stats(self, name):
         """Decode-step evidence for bench/tests: steps run, tokens
@@ -895,15 +977,21 @@ class GenerationScheduler(object):
 
     def kill(self):
         """Crash simulation: fail queued and live generations with the
-        typed replica-dead error.  Idempotent."""
+        typed replica-dead error so a router can finish them on a peer
+        (full re-prefill there — this replica's KV pages die with it).
+        Idempotent."""
         with self._cond:
             if self._killed:
                 return
             self._killed = True
             orphans = []
             for lane in self._lanes.values():
-                while lane.queue:
-                    orphans.append(lane.queue.popleft())
+                orphans.extend(lane.queue.drain())
+                # live sequences die with their KV pages; _fail is
+                # idempotent, so a decode step racing this kill cannot
+                # double-resolve
+                orphans.extend(s.req for s in lane.active
+                               if not s.req.done)
                 if _metrics.metrics_enabled():
                     lane.m_depth.set(0)
             self._cond.notify_all()
@@ -911,3 +999,12 @@ class GenerationScheduler(object):
             "replica %r was killed with the request queued" % self.name)
         for req in orphans:
             req._fail(err)
+
+    def fence(self, epoch):
+        """Epoch fence (PR-3 semantics, same contract as
+        :meth:`~.scheduler.Scheduler.fence`): refuse new work at the
+        lost epoch and fail queued/live generations like
+        :meth:`kill` so the new epoch's replicas take them over."""
+        with self._cond:
+            self._fenced_epoch = epoch
+        self.kill()

@@ -171,13 +171,18 @@ class _Entry(object):
     the hot-reload atomicity boundary."""
 
     __slots__ = ("name", "backend", "buckets", "max_queue",
-                 "dispatch_lock")
+                 "tenant_weights", "dispatch_lock")
 
-    def __init__(self, name, backend, buckets, max_queue):
+    def __init__(self, name, backend, buckets, max_queue,
+                 tenant_weights=None):
         self.name = name
         self.backend = backend
         self.buckets = buckets
         self.max_queue = max_queue
+        # per-model WFQ overrides; tenants not listed fall back to the
+        # scheduler's TenantPolicy weights (serving/tenancy.py)
+        self.tenant_weights = dict(tenant_weights) if tenant_weights \
+            else {}
         self.dispatch_lock = threading.Lock()
 
     def pick_bucket(self, n):
@@ -210,11 +215,13 @@ class ModelRegistry(object):
         self._lock = threading.Lock()
         self._entries = {}
 
-    def register(self, name, backend, buckets=None, max_queue=None):
+    def register(self, name, backend, buckets=None, max_queue=None,
+                 tenant_weights=None):
         """Register ``backend`` (coerced via :func:`as_backend`) under
         ``name``.  ``buckets`` defaults to the backend's own bucket list
         or ``MXNET_TPU_SERVING_BUCKETS``; ``max_queue`` to
-        ``MXNET_TPU_SERVING_MAX_QUEUE``."""
+        ``MXNET_TPU_SERVING_MAX_QUEUE``.  ``tenant_weights`` overrides
+        the scheduler's per-tenant WFQ weights for this model only."""
         backend = as_backend(backend)
         if buckets is None:
             buckets = backend.buckets or default_buckets()
@@ -229,7 +236,8 @@ class ModelRegistry(object):
             if name in self._entries:
                 raise MXNetError("model %r already registered (use swap "
                                  "for hot reload)" % name)
-            entry = _Entry(name, backend, buckets, int(max_queue))
+            entry = _Entry(name, backend, buckets, int(max_queue),
+                           tenant_weights=tenant_weights)
             self._entries[name] = entry
         return entry
 
@@ -272,5 +280,8 @@ class ModelRegistry(object):
         with self._lock:
             entries = sorted(self._entries.items())
         return [{"name": name, "buckets": list(e.buckets),
-                 "max_queue": e.max_queue, **e.backend.describe()}
+                 "max_queue": e.max_queue,
+                 **({"tenant_weights": dict(e.tenant_weights)}
+                    if e.tenant_weights else {}),
+                 **e.backend.describe()}
                 for name, e in entries]

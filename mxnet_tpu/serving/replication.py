@@ -52,6 +52,7 @@ import time
 from ..observability.events import emit as _emit_event
 from ..observability import metrics as _metrics
 from . import admission as _admission
+from . import tenancy as _tenancy
 from .scheduler import Scheduler
 
 __all__ = ["ReplicaGroup", "ServingRouter"]
@@ -79,7 +80,8 @@ class ReplicaGroup(object):
     """
 
     def __init__(self, replicas=2, group="serving",
-                 isolated_metrics=False):
+                 isolated_metrics=False, scheduler_cls=None,
+                 tenant_policy=None):
         from .. import kvstore_async as _kv
 
         self.group = group
@@ -87,14 +89,23 @@ class ReplicaGroup(object):
         self._lock = threading.Lock()
         self._fenced = set()
         self._isolated = bool(isolated_metrics)
+        # one scheduler class for the whole group: classifier lanes by
+        # default, GenerationScheduler for a generation group (its
+        # register() accepts the classifier-shaped signature)
+        self._scheduler_cls = scheduler_cls or Scheduler
+        # ONE tenant policy shared by every replica (grown ones too):
+        # a tenant's quota bounds the tenant, not tenant × replicas
+        self.tenant_policy = (tenant_policy if tenant_policy is not None
+                              else _tenancy.TenantPolicy())
         self._models = {}    # name -> (factory|None, buckets, max_queue)
         self.registries = []
         self.schedulers = []
         for i in range(int(replicas)):
             reg = _metrics.Registry() if isolated_metrics else None
             self.registries.append(reg)
-            sched = Scheduler(metrics_registry=reg,
-                              name="%s/%d" % (group, i))
+            sched = self._scheduler_cls(
+                metrics_registry=reg, name="%s/%d" % (group, i),
+                tenant_policy=self.tenant_policy)
             self.schedulers.append(sched)
             _M_UP.labels(sched.name).set(1)
         _kv._membership_publish(
@@ -104,7 +115,8 @@ class ReplicaGroup(object):
 
     # -- models -------------------------------------------------------
 
-    def register(self, name, backends, buckets=None, max_queue=None):
+    def register(self, name, backends, buckets=None, max_queue=None,
+                 tenant_weights=None):
         """Register ``name`` on every replica.  ``backends`` is either
         a list (one backend per replica — each replica needs its OWN
         Predictor/ExportedModel, executors are not shared) or a
@@ -122,10 +134,12 @@ class ReplicaGroup(object):
                 "group %r has %d replicas, got %d backends"
                 % (self.group, len(targets), len(backends)))
         with self._lock:
-            self._models[name] = (factory, buckets, max_queue)
+            self._models[name] = (factory, buckets, max_queue,
+                                  tenant_weights)
         for sched, backend in zip(targets, backends):
             sched.register(name, backend, buckets=buckets,
-                           max_queue=max_queue)
+                           max_queue=max_queue,
+                           tenant_weights=tenant_weights)
 
     def warmup(self, name):
         """Pre-bind every bucket on every live replica."""
@@ -265,7 +279,7 @@ class ReplicaGroup(object):
         _chaos.visit("serving.scale", name="grow:%s" % self.group)
         with self._lock:
             models = dict(self._models)
-        pinned = sorted(name for name, (fac, _, _) in models.items()
+        pinned = sorted(name for name, (fac, _, _, _) in models.items()
                         if fac is None)
         if pinned:
             raise MXNetError(
@@ -277,13 +291,17 @@ class ReplicaGroup(object):
             with self._lock:
                 idx = len(self.schedulers)
                 reg = _metrics.Registry() if self._isolated else None
-                sched = Scheduler(metrics_registry=reg,
-                                  name="%s/%d" % (self.group, idx))
+                sched = self._scheduler_cls(
+                    metrics_registry=reg,
+                    name="%s/%d" % (self.group, idx),
+                    tenant_policy=self.tenant_policy)
                 self.registries.append(reg)
                 self.schedulers.append(sched)
-            for name, (factory, buckets, max_queue) in models.items():
+            for name, (factory, buckets, max_queue,
+                       tenant_weights) in models.items():
                 sched.register(name, factory(), buckets=buckets,
-                               max_queue=max_queue)
+                               max_queue=max_queue,
+                               tenant_weights=tenant_weights)
             _M_UP.labels(sched.name).set(1)
             added.append(idx)
         epoch = self._advance_epoch()
@@ -376,11 +394,13 @@ class ServingRouter(object):
             return 0  # deadline_from_ms(0) -> no deadline
         return max((req.deadline - time.monotonic()) * 1e3, 0.001)
 
-    def request(self, model, inputs, deadline_ms=None, timeout=30.0):
+    def request(self, model, inputs, deadline_ms=None, timeout=30.0,
+                tenant=None):
         shed = None
         for index, sched in self._rotation():
             try:
-                req = sched.submit(model, inputs, deadline_ms=deadline_ms)
+                req = sched.submit(model, inputs, deadline_ms=deadline_ms,
+                                   tenant=tenant)
             except _admission.ReplicaDeadError:
                 self._group.fence(index)
                 continue
@@ -405,7 +425,7 @@ class ServingRouter(object):
             try:
                 peer = sched.submit(model, req.inputs,
                                     deadline_ms=self._remaining_ms(req),
-                                    force=True)
+                                    force=True, tenant=req.tenant)
                 return peer.result(timeout=timeout)
             except _admission.ReplicaDeadError:
                 self._group.fence(index)

@@ -1,0 +1,279 @@
+"""KV-affinity routing: keep a generation session on its cache.
+
+Round-robin (:class:`~.replication.ServingRouter`) is the right call
+for stateless classification, but a *generation session* leaves state
+behind: its :class:`~.paged_kv.PagedKVCache` blocks live on whichever
+replica ran the prefill.  Routing the session's next request anywhere
+else forfeits that work — the peer must re-prefill the whole prompt.
+:class:`KVAffinityRouter` therefore pins each session to a **home
+replica** and keeps sending it there, spilling only when staying home
+is worse than re-prefilling:
+
+- **hit** — the session routes to its home replica; decode resumes on
+  warm KV blocks.
+- **spill** — the home replica's load exceeds
+  ``MXNET_TPU_ROUTE_SPILL_FACTOR`` × the least-loaded peer's, so the
+  session moves and re-prefills there.  A spilled generation is
+  bitwise-equivalent to a cold session (deterministic prefill+decode);
+  only latency is paid.
+- **dead** — the home replica is fenced or unroutable; the session
+  re-homes on a live peer (re-prefill, nothing dropped).
+- **miss** — first request of a session (or affinity disabled): pick
+  the least-loaded routable replica, round-robin among ties.
+- **failover** — a replica died *holding* an accepted generation; the
+  group fences it and the request is re-admitted on a peer with
+  ``force=True`` and the remaining deadline — the PR-8 brownout
+  contract (accepted work is never dropped) extended to affinity
+  misses.
+
+Every candidate replica passes the ``serving.route`` chaos site first
+(name ``<model>:<replica index>``): a fired ``raise``/``drop`` rule
+makes that replica unroutable for the attempt — the deterministic way
+to drill spills and re-homes — while ``delay`` stretches routing.
+
+Outcomes are accounted in ``serving_route_total{group, outcome}``;
+``kv_affinity_hit_ratio{group}`` is hits over lookups, where a lookup
+is counted **only when the session already had a placement** — a fresh
+session's unavoidable miss never dilutes the ratio.
+
+Scale events need no router surgery: :meth:`~.replication.
+ReplicaGroup.grow` replicas join the candidate set on the next route,
+and a shrink's drain refuses new admits, which reads as *dead* here
+and re-homes the session.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import chaos as _chaos
+from ..observability import metrics as _metrics
+from ..observability.events import emit as _emit_event
+from . import admission as _admission
+
+__all__ = ["KVAffinityRouter", "default_affinity", "default_spill_factor"]
+
+_M_ROUTE = _metrics.counter(
+    "serving_route_total",
+    "Affinity-router decisions, by outcome "
+    "(hit | miss | spill | dead | failover)",
+    ["group", "outcome"])
+_M_HIT_RATIO = _metrics.gauge(
+    "kv_affinity_hit_ratio",
+    "Sessions routed onto their existing KV blocks, over routed "
+    "sessions that had any prior placement", ["group"])
+
+
+def default_affinity():
+    """``MXNET_TPU_ROUTE_AFFINITY`` — set 0 to fall back to pure
+    least-loaded routing (every request re-prefills)."""
+    raw = os.environ.get("MXNET_TPU_ROUTE_AFFINITY", "1")
+    return raw.strip().lower() not in ("0", "false", "off")
+
+
+def default_spill_factor():
+    """``MXNET_TPU_ROUTE_SPILL_FACTOR`` — spill a session off its home
+    replica when home load exceeds this factor × the least-loaded
+    peer's (default 4: staying on warm KV is worth a 4× queue)."""
+    try:
+        factor = float(os.environ.get("MXNET_TPU_ROUTE_SPILL_FACTOR", "4"))
+    except ValueError:
+        factor = 4.0
+    return factor if factor > 0 else 4.0
+
+
+class KVAffinityRouter(object):
+    """Session-sticky router over a :class:`~.replication.ReplicaGroup`
+    of :class:`~.generation.GenerationScheduler` replicas (built with
+    ``scheduler_cls=GenerationScheduler``).
+
+    ``session`` is the caller's opaque session id (a conversation, a
+    user stream); requests without one are routed least-loaded like any
+    stateless call.  The router never *drops* on a routing fault — the
+    worst case is a re-prefill somewhere alive.
+    """
+
+    def __init__(self, group, affinity=None, spill_factor=None):
+        self._group = group
+        self._affinity = (default_affinity() if affinity is None
+                          else bool(affinity))
+        self._spill = (default_spill_factor() if spill_factor is None
+                       else float(spill_factor))
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._sessions = {}      # session id -> home replica index
+        self._hits = 0
+        self._lookups = 0
+
+    # -- placement ----------------------------------------------------
+
+    def _routable(self, model):
+        """Live replicas that survive the ``serving.route`` chaos gate
+        this attempt.  A fired rule only skips the one candidate — the
+        route falls through to peers, never to a drop."""
+        out = []
+        for index, sched in self._group.live():
+            try:
+                _chaos.visit("serving.route",
+                             name="%s:%d" % (model, index))
+            except _chaos.ChaosError:
+                continue
+            out.append((index, sched))
+        return out
+
+    def _account(self, outcome, lookup=False, hit=False):
+        with self._lock:
+            if lookup:
+                self._lookups += 1
+                if hit:
+                    self._hits += 1
+            hits, lookups = self._hits, self._lookups
+        if _metrics.metrics_enabled():
+            _M_ROUTE.labels(self._group.group, outcome).inc()
+            if lookups:
+                _M_HIT_RATIO.labels(self._group.group).set(hits / lookups)
+
+    def _forget_replica(self, index):
+        """Drop every session homed on a fenced replica — its KV blocks
+        died with it, so the next request is an honest miss."""
+        with self._lock:
+            dead = [s for s, i in self._sessions.items() if i == index]
+            for s in dead:
+                del self._sessions[s]
+
+    def route(self, model, session=None):
+        """Pick ``(index, scheduler)`` for one request, accounting the
+        outcome.  Raises :class:`~.admission.ReplicaDeadError` when no
+        replica is routable at all."""
+        cands = self._routable(model)
+        attempts = 0
+        while not cands:
+            # every candidate got chaos-blocked this pass; while live
+            # replicas exist that is transient unroutability, not
+            # death — re-roll the gate (bounded, so a prob=1 rule
+            # still surfaces as dead instead of spinning)
+            if not self._group.live() or attempts >= 16:
+                raise _admission.ReplicaDeadError(
+                    "group %r has no routable serving replica"
+                    % self._group.group)
+            attempts += 1
+            cands = self._routable(model)
+        by_index = dict(cands)
+        home = None
+        if session is not None and self._affinity:
+            with self._lock:
+                home = self._sessions.get(session)
+        if home is not None:
+            if home in by_index:
+                loads = {i: s.load() for i, s in cands}
+                peer_min = min((l for i, l in loads.items() if i != home),
+                               default=None)
+                # +1 keeps an idle group from thrashing: a home queue of
+                # 1 vs empty peers is not worth forfeiting warm KV
+                if (peer_min is not None
+                        and loads[home] > self._spill * (peer_min + 1)):
+                    choice = min((i for i in loads if i != home),
+                                 key=loads.get)
+                    self._place(session, choice)
+                    self._account("spill", lookup=True)
+                    _emit_event("serving.route", group=self._group.group,
+                                 model=model, outcome="spill",
+                                 session=str(session), replica=choice)
+                    return choice, by_index[choice]
+                self._account("hit", lookup=True, hit=True)
+                return home, by_index[home]
+            # home fenced or chaos-blocked: re-home (the mapping is
+            # only forgotten when the replica is actually gone —
+            # _forget_replica on fence — so a chaos blip re-homes
+            # without poisoning a healthy map)
+            choice = self._least_loaded(cands)
+            self._place(session, choice)
+            self._account("dead", lookup=True)
+            _emit_event("serving.route", group=self._group.group,
+                         model=model, outcome="dead",
+                         session=str(session), replica=choice)
+            return choice, by_index[choice]
+        choice = self._least_loaded(cands)
+        if session is not None and self._affinity:
+            self._place(session, choice)
+        self._account("miss")
+        return choice, by_index[choice]
+
+    def _least_loaded(self, cands):
+        with self._lock:
+            start = self._rr
+            self._rr += 1
+        order = (cands[start % len(cands):] + cands[:start % len(cands)])
+        return min(order, key=lambda t: t[1].load())[0]
+
+    def _place(self, session, index):
+        if session is not None:
+            with self._lock:
+                self._sessions[session] = index
+
+    def placement(self, session):
+        """The session's current home replica index, or None."""
+        with self._lock:
+            return self._sessions.get(session)
+
+    def end_session(self, session):
+        """Forget a finished session's placement."""
+        with self._lock:
+            self._sessions.pop(session, None)
+
+    # -- request paths ------------------------------------------------
+
+    @staticmethod
+    def _remaining_ms(req):
+        if req.deadline is None:
+            return 0  # deadline_from_ms(0) -> no deadline
+        return max((req.deadline - time.monotonic()) * 1e3, 0.001)
+
+    def submit(self, model, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, tenant=None, session=None, force=False):
+        """Route + admit one generation; returns ``(request, index)``.
+        A replica found dead at the door is fenced and the route
+        retried; sheds (overload / drain / quota) surface to the caller
+        untouched — peers would only multiply a tenant's quota."""
+        while True:
+            index, sched = self.route(model, session=session)
+            try:
+                req = sched.submit(model, prompt,
+                                   max_new_tokens=max_new_tokens,
+                                   eos_id=eos_id, deadline_ms=deadline_ms,
+                                   tenant=tenant, force=force)
+            except _admission.ReplicaDeadError:
+                self._group.fence(index)
+                self._forget_replica(index)
+                continue
+            return req, index
+
+    def generate(self, model, prompt, max_new_tokens=None, eos_id=None,
+                 deadline_ms=None, timeout=60.0, tenant=None,
+                 session=None):
+        """Synchronous generation with failover: a replica that dies
+        *holding* the accepted request is fenced and the generation
+        re-admitted on a peer — ``force=True``, remaining deadline,
+        full re-prefill — so accepted work is never dropped."""
+        req, index = self.submit(model, prompt,
+                                 max_new_tokens=max_new_tokens,
+                                 eos_id=eos_id, deadline_ms=deadline_ms,
+                                 tenant=tenant, session=session)
+        try:
+            return req.result(timeout=timeout)
+        except _admission.ReplicaDeadError:
+            self._group.fence(index)
+            self._forget_replica(index)
+            self._account("failover")
+            _emit_event("serving.route", group=self._group.group,
+                         model=model, outcome="failover",
+                         session=str(session), replica=index)
+            retry, _ = self.submit(model, prompt,
+                                   max_new_tokens=max_new_tokens,
+                                   eos_id=eos_id,
+                                   deadline_ms=self._remaining_ms(req),
+                                   tenant=req.tenant, session=session,
+                                   force=True)
+            return retry.result(timeout=timeout)

@@ -26,6 +26,20 @@ Lifecycle verbs map to production events:
 - :meth:`Scheduler.fence` — the PR-3 epoch fence: a zombie replica
   that lost its membership epoch refuses new work.
 
+**Multi-tenant fairness** (PR-16): every lane's queue is a
+:class:`~.tenancy.FairQueue` — deficit round-robin over per-tenant
+FIFO queues, weights from ``MXNET_TPU_TENANT_WEIGHTS`` (or the model's
+``tenant_weights`` registration override).  Under contention a
+tenant's share of every dispatch window converges to its weight; a
+single-tenant lane short-circuits to the plain FIFO it always was.
+Admission additionally charges the tenant's token buckets
+(:class:`~.tenancy.TenantPolicy`): an exhausted budget sheds with the
+typed 429 :class:`~.admission.QuotaExceededError` naming the budget
+and carrying the bucket's refill time.  Successful answers are booked
+per tenant in ``serving_tenant_requests_total{model,tenant}`` — the
+good-counter behind per-tenant SLO error budgets
+(``observability/slo.py``).
+
 Chaos sites ``serving.admit`` (in :meth:`submit`, before the queue
 lock) and ``serving.dispatch`` (inside the dispatch window, before the
 device call) let seeded drills inject shed/delay/crash at both doors.
@@ -35,7 +49,6 @@ same replica before the failure lands on the request futures.
 
 from __future__ import annotations
 
-import collections
 import os
 import threading
 import time
@@ -47,6 +60,7 @@ from ..base import MXNetError
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from . import admission as _admission
+from . import tenancy as _tenancy
 from .registry import ModelRegistry
 
 __all__ = ["InferenceRequest", "Scheduler", "default_retries"]
@@ -72,19 +86,26 @@ class InferenceRequest(object):
     under it and lists it in the batch span's fan-in links.
     """
 
-    __slots__ = ("model", "inputs", "deadline", "t_admit", "_event",
-                 "outputs", "error", "latency_s", "trace")
+    __slots__ = ("model", "inputs", "deadline", "tenant", "t_admit",
+                 "_event", "outputs", "error", "latency_s", "trace",
+                 "_h_tenant")
 
-    def __init__(self, model, inputs, deadline):
+    def __init__(self, model, inputs, deadline,
+                 tenant=_tenancy.DEFAULT_TENANT):
         self.model = model
         self.inputs = inputs
         self.deadline = deadline
+        self.tenant = tenant
         self.t_admit = time.monotonic()
         self._event = threading.Event()
         self.outputs = None
         self.error = None
         self.latency_s = None
         self.trace = None
+        # pre-resolved serving_tenant_requests_total{model,tenant}
+        # handle (attached at submit, None with metrics disabled) so
+        # the dispatch loop never resolves labels
+        self._h_tenant = None
 
     @property
     def done(self):
@@ -117,13 +138,17 @@ class _Lane(object):
     handles (label resolution off the hot path)."""
 
     __slots__ = ("entry", "queue", "thread", "batches", "rows", "slots",
+                 "tenant_handles",
                  "m_req", "m_wait", "m_depth", "m_sat", "m_occ",
                  "m_requests", "m_batches", "m_compiles", "m_errors")
 
-    def __init__(self, entry):
+    def __init__(self, entry, weight_fn=None):
         self.entry = entry
-        self.queue = collections.deque()
+        self.queue = _tenancy.FairQueue(weight_fn)
         self.thread = None
+        # per-tenant success-counter handles, resolved lazily at submit
+        # (never in the dispatch loop)
+        self.tenant_handles = {}
         # running totals for bench occupancy (rows served / slots run)
         self.batches = 0
         self.rows = 0
@@ -144,19 +169,27 @@ class Scheduler(object):
         ``{shard, role, epoch}`` identity.
     name : str
         Replica name (membership + error messages).
+    tenant_policy : tenancy.TenantPolicy, optional
+        Per-tenant WFQ weights + quota buckets.  A replica group passes
+        ONE policy to every replica so a tenant's budget bounds the
+        tenant, not tenant × replicas; defaults to a private policy
+        built from the ``MXNET_TPU_TENANT_*`` env rows.
     """
 
     def __init__(self, registry=None, metrics_registry=None,
-                 name="serving0"):
+                 name="serving0", tenant_policy=None):
         self.name = name
         self.registry = registry if registry is not None else ModelRegistry()
         self._reg = (metrics_registry if metrics_registry is not None
                      else _metrics.REGISTRY)
+        # shared across a replica group so quotas bound the TENANT, not
+        # tenant-times-replicas; a private policy otherwise
+        self.tenants = (tenant_policy if tenant_policy is not None
+                        else _tenancy.TenantPolicy())
         self.admission = _admission.AdmissionController(
             reject_counter=self._reg.counter(
-                "serving_rejected_total",
-                "Serving requests shed, by model and reason "
-                "(overload | deadline | draining)", ["model", "reason"]))
+                "serving_rejected_total", _admission.REJECTED_HELP,
+                _admission.REJECTED_LABELS))
         self._fam = self._families(self._reg)
         self._cond = threading.Condition()
         self._lanes = {}
@@ -204,16 +237,35 @@ class Scheduler(object):
                 "serving_dispatch_errors_total",
                 "Dispatch attempts that raised (chaos or backend fault)",
                 ["model"]),
+            "tenant_req": reg.counter(
+                "serving_tenant_requests_total",
+                "Requests answered successfully per model and tenant "
+                "(the per-tenant SLO good-counter)",
+                ["model", "tenant"]),
         }
 
     # -- registration -------------------------------------------------
 
-    def register(self, name, backend, buckets=None, max_queue=None):
+    def _weight_fn(self, entry):
+        """The lane's DRR weight lookup: per-model registration
+        overrides first, then the shared tenant policy."""
+        overrides = entry.tenant_weights
+        policy = self.tenants
+
+        def weight(tenant):
+            w = overrides.get(tenant)
+            return policy.weight(tenant) if w is None else float(w)
+        return weight
+
+    def register(self, name, backend, buckets=None, max_queue=None,
+                 tenant_weights=None):
         """Register a model and start its dispatch thread.  Accepts
-        anything :func:`~.registry.as_backend` does."""
+        anything :func:`~.registry.as_backend` does.  ``tenant_weights``
+        optionally overrides the policy's WFQ weights for this model."""
         entry = self.registry.register(name, backend, buckets=buckets,
-                                       max_queue=max_queue)
-        lane = _Lane(entry)
+                                       max_queue=max_queue,
+                                       tenant_weights=tenant_weights)
+        lane = _Lane(entry, weight_fn=self._weight_fn(entry))
         for key, attr in (("req", "m_req"), ("wait", "m_wait"),
                           ("depth", "m_depth"), ("sat", "m_sat"),
                           ("occ", "m_occ"), ("requests", "m_requests"),
@@ -283,27 +335,30 @@ class Scheduler(object):
                              % (sorted(extra), sorted(want)))
         return rows
 
-    def submit(self, name, inputs, deadline_ms=None, force=False):
+    def submit(self, name, inputs, deadline_ms=None, force=False,
+               tenant=None):
         """Admit one request; returns its :class:`InferenceRequest`
-        future.  ``force=True`` bypasses overload/drain shedding — used
-        by the router to re-admit a request that a DEAD peer had
+        future.  ``force=True`` bypasses overload/drain/quota shedding —
+        used by the router to re-admit a request that a DEAD peer had
         already accepted (accepted work is never shed twice); kill and
-        fencing still refuse.
+        fencing still refuse.  ``tenant`` labels the request for WFQ,
+        quotas and per-tenant accounting (None = ``default``).
 
         A typed rejection closes a terminal ``serving.shed`` span tagged
         with the reject reason, parented under the submitter's current
         span (the frontend's ``serving.request`` root)."""
+        tenant = _tenancy.clean_tenant(tenant)
         try:
-            return self._submit(name, inputs, deadline_ms, force)
+            return self._submit(name, inputs, deadline_ms, force, tenant)
         except _admission.ServingError as exc:
             if _tracing.tracing_enabled():
                 _tracing.record_span(
                     "serving.shed", cat="serving", model=name,
                     reason=_admission.reject_reason(exc) or "error",
-                    error=type(exc).__name__)
+                    tenant=tenant, error=type(exc).__name__)
             raise
 
-    def _submit(self, name, inputs, deadline_ms, force):
+    def _submit(self, name, inputs, deadline_ms, force, tenant):
         if self._killed or self._fenced_epoch is not None:
             raise _admission.ReplicaDeadError(
                 "replica %r is %s" % (self.name,
@@ -313,33 +368,47 @@ class Scheduler(object):
         lane = self._lane(name)
         rows = self._check_inputs(lane.entry, inputs)
         deadline = _admission.deadline_from_ms(deadline_ms)
-        req = InferenceRequest(name, rows, deadline)
+        req = InferenceRequest(name, rows, deadline, tenant)
         # the submitter's context (e.g. the frontend root span) is this
         # request's identity in the trace: queue-wait spans parent under
         # it and the batch span lists it as a fan-in link
         req.trace = _tracing.capture_wire_context()
-        with _tracing.span("serving.admit", cat="serving", model=name):
+        with _tracing.span("serving.admit", cat="serving", model=name,
+                           tenant=tenant):
             # chaos fires OUTSIDE the queue lock: an injected delay
             # stalls this caller, not every lane's dispatch loop
             chaos.visit("serving.admit", name=name)
             with self._cond:
                 if self._stopping and not force:
-                    self.admission.reject(name, "draining")
+                    self.admission.reject(name, "draining", tenant=tenant)
                 if not force:
                     self.admission.admit(name, len(lane.queue),
-                                         lane.entry.max_queue, deadline)
-                lane.queue.append(req)
+                                         lane.entry.max_queue, deadline,
+                                         tenant=tenant)
+                    # token-bucket quota AFTER the door checks, so a
+                    # request the lane would shed anyway never burns
+                    # budget; unlimited tenants short-circuit inside
+                    over = self.tenants.charge(tenant)
+                    if over is not None:
+                        self.admission.quota_reject(name, tenant, *over)
+                lane.queue.push(tenant, req)
                 if _metrics.metrics_enabled():
                     depth = len(lane.queue)
                     lane.m_depth.set(depth)
                     lane.m_sat.set(depth / float(lane.entry.max_queue))
+                    h = lane.tenant_handles.get(tenant)
+                    if h is None:
+                        h = lane.tenant_handles[tenant] = \
+                            self._fam["tenant_req"].labels(name, tenant)
+                    req._h_tenant = h
                 self._cond.notify_all()
         return req
 
-    def request(self, name, inputs, deadline_ms=None, timeout=30.0):
+    def request(self, name, inputs, deadline_ms=None, timeout=30.0,
+                tenant=None):
         """Synchronous convenience: :meth:`submit` + ``result()``."""
-        return self.submit(name, inputs, deadline_ms=deadline_ms).result(
-            timeout=timeout)
+        return self.submit(name, inputs, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout=timeout)
 
     # -- dispatch loop ------------------------------------------------
 
@@ -358,8 +427,9 @@ class Scheduler(object):
                 if not lane.queue:
                     # stopping with an empty queue: done
                     return
-                take = min(len(lane.queue), lane.entry.buckets[-1])
-                window = [lane.queue.popleft() for _ in range(take)]
+                # DRR window: each tenant's share of the pack converges
+                # to its weight under contention (tenancy.FairQueue)
+                window = lane.queue.take(lane.entry.buckets[-1])
                 if _metrics.metrics_enabled():
                     depth = len(lane.queue)
                     lane.m_depth.set(depth)
@@ -374,7 +444,7 @@ class Scheduler(object):
             # second deadline check: expired while queued -> shed
             # BEFORE costing device time
             if _admission.AdmissionController.expired(req.deadline, now):
-                self.admission.account(name, "deadline")
+                self.admission.account(name, "deadline", req.tenant)
                 if traced:
                     _tracing.record_span(
                         "serving.shed", cat="serving",
@@ -451,6 +521,8 @@ class Scheduler(object):
             req._resolve([o[i] for o in outs])
             if _metrics.metrics_enabled():
                 lane.m_requests.inc()
+                if req._h_tenant is not None:
+                    req._h_tenant.inc()
                 lane.m_wait.observe(now - req.t_admit)
                 # the request's trace token rides as the bucket's
                 # exemplar: a p99 blip links to a concrete trace
@@ -471,6 +543,12 @@ class Scheduler(object):
         with self._cond:
             lane = self._lanes.get(name)
             return len(lane.queue) if lane else 0
+
+    def load(self):
+        """Total queued requests across lanes — the routing tier's
+        least-loaded signal (:mod:`~.routing`)."""
+        with self._cond:
+            return sum(len(l.queue) for l in self._lanes.values())
 
     def stats(self, name):
         """Running totals for bench: batches, rows served, bucket slots
@@ -511,8 +589,7 @@ class Scheduler(object):
             self._killed = True
             orphans = []
             for lane in self._lanes.values():
-                while lane.queue:
-                    orphans.append(lane.queue.popleft())
+                orphans.extend(lane.queue.drain())
                 if _metrics.metrics_enabled():
                     lane.m_depth.set(0)
                     lane.m_sat.set(0.0)

@@ -102,6 +102,20 @@ def test_bench_wire_keys():
     assert rec["codec_reconciles"] is True
 
 
+def test_bench_fairness_keys():
+    """BENCH_FAIRNESS=1: the schema-12 multi-tenant keys — isolation
+    ratio, quota shed rate, KV-affinity hit ratio — all live and
+    bounded on the CPU smoke."""
+    rec = _run_bench({"BENCH_FAIRNESS": "1", "BENCH_FAIR_REQUESTS": "32"})
+    assert rec["schema_version"] >= 12
+    assert rec["metric"] == "fairness_cpu_smoke_throughput"
+    assert rec["unit"] == "req/s"
+    assert rec["value"] > 0
+    assert rec["fairness_p99_ratio"] > 0
+    assert 0.0 <= rec["quota_shed_rate"] <= 1.0
+    assert rec["kv_affinity_hit_ratio"] > 0
+
+
 def test_bench_git_sha_override():
     rec = _run_bench({"BENCH_GIT_SHA": "cafef00d"})
     assert rec["git_sha"] == "cafef00d"

@@ -280,10 +280,26 @@ _SERVING_TEXT = (
     "last dispatched batch\n"
     "# TYPE serving_batch_occupancy gauge\n"
     'serving_batch_occupancy{model="mlp"} 0.75\n'
-    "# HELP serving_rejected_total Serving requests shed, by model and "
-    "reason (overload | deadline | draining)\n"
+    "# HELP serving_rejected_total Serving requests shed, by model, "
+    "reason (overload | deadline | draining | quota | ...) and tenant\n"
     "# TYPE serving_rejected_total counter\n"
-    'serving_rejected_total{model="mlp",reason="overload"} 2\n'
+    'serving_rejected_total{model="mlp",reason="overload",'
+    'tenant="default"} 2\n'
+    'serving_rejected_total{model="mlp",reason="quota",tenant="spam"} '
+    "7\n"
+    "# HELP serving_tenant_requests_total Requests admitted per model "
+    "and tenant\n"
+    "# TYPE serving_tenant_requests_total counter\n"
+    'serving_tenant_requests_total{model="mlp",tenant="default"} 5\n'
+    'serving_tenant_requests_total{model="mlp",tenant="spam"} 1\n'
+    "# HELP slo_error_budget_remaining Fraction of the SLO's error "
+    "budget left\n"
+    "# TYPE slo_error_budget_remaining gauge\n"
+    'slo_error_budget_remaining{slo="availability",tenant="all"} 0.4\n'
+    'slo_error_budget_remaining{slo="availability",tenant="default"} '
+    "1\n"
+    'slo_error_budget_remaining{slo="availability",tenant="spam"} '
+    "-874\n"
 )
 
 

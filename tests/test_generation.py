@@ -218,7 +218,7 @@ def test_exhaustion_sheds_through_admission(lm):
     # 6 blocks of 4 = 24 token slots; each request reserves
     # prompt + max_new_tokens up front
     sched, be = _scheduler(lm, num_blocks=6)
-    rejected = sched.admission._rejected.labels("lm", "cache_exhausted")
+    rejected = sched.admission._rejected.labels("lm", "cache_exhausted", "default")
     before = rejected.value
     # slow decode keeps r1's 4 blocks held while r2 tries to allocate
     with chaos.inject("serving.decode", "delay", prob=1.0, seed=1,

@@ -287,7 +287,7 @@ def test_slo_report_tracks_the_availability_budget():
     assert avail["bad"] == 4 and avail["exhausted"]
     # the budget federates as a gauge
     gauge = omet.REGISTRY.get("slo_error_budget_remaining")
-    assert gauge.labels("availability").value <= 0
+    assert gauge.labels("availability", "all").value <= 0
 
 
 def test_slo_latency_counts_split_on_the_threshold_bucket():
@@ -591,7 +591,7 @@ def test_chaos_load_yields_one_linked_trace_and_one_bundle(
                                                  0.999)]))
     assert wd.evaluate(now=5000.0) == []        # baseline over registry
     rejected = omet.REGISTRY.get("serving_rejected_total")
-    rejected.labels("m", "overload").inc(50)    # synthetic breach
+    rejected.labels("m", "overload", "default").inc(50)    # synthetic breach
     active = [a.name for a in wd.evaluate(now=5010.0)]
     assert "slo_availability_fast_burn" in active
     wd.evaluate(now=5020.0)                     # staying red adds none

@@ -128,7 +128,7 @@ def test_deadline_rejected_at_admission(ckpt):
         sched.submit("mlp", {"data": np.zeros(FEAT, np.float32)},
                      deadline_ms=1e-6)
     assert ei.value.http_status == 504
-    assert sched.admission._rejected.labels("mlp", "deadline").value == 1
+    assert sched.admission._rejected.labels("mlp", "deadline", "default").value == 1
     sched.close()
 
 
@@ -151,7 +151,7 @@ def test_deadline_expires_while_queued(ckpt):
     assert blocker.result(timeout=10)
     with pytest.raises(serving.DeadlineExceededError):
         victim.result(timeout=10)
-    assert sched.admission._rejected.labels("mlp", "deadline").value == 1
+    assert sched.admission._rejected.labels("mlp", "deadline", "default").value == 1
     sched.close()
 
 
@@ -173,7 +173,7 @@ def test_overload_sheds_429(ckpt):
     # shedding never drops accepted work: everything admitted completes
     for req in [first] + accepted:
         assert req.result(timeout=10)
-    assert sched.admission._rejected.labels("mlp", "overload").value == 1
+    assert sched.admission._rejected.labels("mlp", "overload", "default").value == 1
     sched.close()
 
 
@@ -504,7 +504,7 @@ def test_metrics_disabled_serving_still_works(ckpt, monkeypatch):
     # shedding still raises typed errors, just unrecorded
     with pytest.raises(serving.DeadlineExceededError):
         sched.submit("mlp", row, deadline_ms=1e-6)
-    assert sched.admission._rejected.labels("mlp", "deadline").value == 0
+    assert sched.admission._rejected.labels("mlp", "deadline", "default").value == 0
     sched.close()
 
 

@@ -63,7 +63,15 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               "kv_bytes_per_step": False,
               "kv_header_overhead_pct": False,
               "kv_codec_ms_share": False,
-              "kv_rpcs_per_flush_p50": False}
+              "kv_rpcs_per_flush_p50": False,
+              # schema-12 fairness keys (BENCH_FAIRNESS=1 rounds):
+              # isolation ratio is down-is-good (1.0 = the saturating
+              # tenant cost the innocent one nothing); shed rate and
+              # affinity hits are up-is-good — the quota biting and
+              # sessions landing on their KV blocks
+              "fairness_p99_ratio": False,
+              "quota_shed_rate": True,
+              "kv_affinity_hit_ratio": True}
 TREND_TOLERANCE = 0.10
 
 
