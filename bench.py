@@ -95,7 +95,11 @@ def _obs_counters():
 # quota_shed_rate (quota 429s over the saturating tenant's offered
 # load) / kv_affinity_hit_ratio (sessions landing on their KV blocks)
 # from the BENCH_FAIRNESS=1 multi-tenant robustness lane (PR-16)
-_SCHEMA_VERSION = 12
+# v13: kv_compress_ratio (dense gradient bytes in / compressed bytes
+# out under MXNET_TPU_KV_COMPRESS) / kv_coalesce_rpcs_saved (RPCs the
+# fused push_pull path avoided) on the BENCH_WIRE=1 lane, which now
+# runs the PR-17 binary wire by default
+_SCHEMA_VERSION = 13
 
 
 def _bench_peak():
@@ -669,9 +673,11 @@ def wire_main():
     live state transfer) with the PR-15 byte books on.  Emits the
     schema-11 additive keys — ``kv_bytes_per_step``,
     ``kv_header_overhead_pct``, ``kv_codec_ms_share``,
-    ``kv_rpcs_per_flush_p50`` — plus ``wire_reconciles``: whether the
-    per-op byte books matched the socket-level truth within 1% (the
-    same falsifiability gate ``make wire`` exits nonzero on)."""
+    ``kv_rpcs_per_flush_p50`` — the schema-13 additions —
+    ``kv_compress_ratio``, ``kv_coalesce_rpcs_saved`` — plus
+    ``wire_reconciles``: whether the per-op byte books matched the
+    socket-level truth within 1% (the same falsifiability gate
+    ``make wire`` exits nonzero on)."""
     import jax
     from jax.sharding import Mesh
 
@@ -683,6 +689,9 @@ def wire_main():
 
     os.environ["MXNET_TPU_KV_REPL_SYNC"] = "1"
     os.environ.setdefault("MXNET_TPU_PS_SECRET", "bench")
+    # the lane measures the full PR-17 stack by default (binary wire +
+    # int8 push compression + coalescing); export the knobs to compare
+    os.environ.setdefault("MXNET_TPU_KV_COMPRESS", "int8")
     secret = os.environ["MXNET_TPU_PS_SECRET"]
     servers, addrs = [], []
     for shard in range(2):
@@ -732,6 +741,8 @@ def wire_main():
         "kv_codec_ms_share": round(
             100.0 * rep["codec_share_of_step"], 4),
         "kv_rpcs_per_flush_p50": round(rep["rpcs_per_flush_p50"], 1),
+        "kv_compress_ratio": round(rep["compress_ratio"], 2),
+        "kv_coalesce_rpcs_saved": int(rep["coalesce_rpcs_saved"]),
         "wire_reconciles": bool(ok),
         "codec_reconciles": bool(codec_ok),
         "elapsed_s": round(dt, 3),

@@ -303,6 +303,53 @@ class KVStore(object):
         if pairs:
             self._async.push(pairs)
 
+    def push_pull(self, key, value, out, priority=0):
+        """Fused ``push`` + ``pull`` for the training step's kv phase.
+
+        On ``dist_async`` with RPC coalescing on (the default,
+        ``MXNET_TPU_KV_COALESCE=0`` disables), the gradients and the
+        fresh-weight fetch ride ONE wire RPC per shard instead of two —
+        the server applies the update, then answers with the weights.
+        Every other mode (and coalescing-off) degrades to the classic
+        ``push(); pull()`` pair, so callers can use this unconditionally.
+        """
+        import numpy as _np
+
+        from . import kvstore_async as ka
+
+        if self._async is None or not ka._coalesce_enabled():
+            self.push(key, value, priority)
+            return self.pull(key, out=out, priority=priority)
+        import jax.numpy as jnp
+
+        if self._updater is not None:
+            raise MXNetError(
+                "dist_async applies the optimizer on the server: "
+                "use set_optimizer(), not set_updater()")
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        outs = _val_list(out, len(keys))
+        pairs = []
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            merged = vlist[0]
+            if len(vlist) > 1:
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + v._data
+                merged = NDArray(acc, vlist[0].context)
+            pairs.append((_updater_key(k), _np.asarray(merged._data)))
+        fresh = self._async.push_pull(
+            pairs, [_updater_key(k) for k in keys],
+            shapes=[tuple(olist[0].shape) for olist in outs])
+        for k, v, olist in zip(keys, fresh, outs):
+            if v is None:
+                raise MXNetError("key %s has not been initialized" % k)
+            arr = jnp.asarray(v)
+            for o in olist:
+                o._set_data(arr.astype(o.dtype))
+
     def pull(self, key, out=None, priority=0):
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))

@@ -113,9 +113,11 @@ import zlib
 import numpy as _np
 
 from . import chaos as _chaos
+from . import kvstore_wire as _wire
 from .base import (CorruptMessageError, MXNetError, ServerDeadError,
                    ShardFailedError, StaleEpochError,
                    TruncatedMessageError)
+from .kvstore_wire import _unwire_key, _wire_key
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 from .observability import flight_recorder as _flight
@@ -197,9 +199,15 @@ _M_WIRE_FRAME = _metrics.histogram(
     ["op", "dir"], buckets=_WIRE_FRAME_BUCKETS)
 _M_WIRE_RPCS = _metrics.histogram(
     "kv_wire_rpcs_per_flush",
-    "Per-server RPCs one logical ServerGroup push/pull fans out to — "
-    "the small-RPC coalescing opportunity a batched binary wire would "
-    "collapse", buckets=_RPCS_FLUSH_BUCKETS)
+    "Wire RPCs one logical ServerGroup flush (a push or a pull) costs. "
+    "Uncoalesced, that is the per-server fan-out width; a coalesced "
+    "push_pull amortizes its fused RPCs across both logical flushes, "
+    "so the p50 falling is the measured coalescing win",
+    buckets=_RPCS_FLUSH_BUCKETS)
+_M_COALESCE_SAVED = _metrics.counter(
+    "kv_coalesce_rpcs_saved_total",
+    "Wire RPCs avoided by fusing a step's push+pull flushes into one "
+    "push_pull per shard (baseline fan-out minus fused fan-out)")
 _M_WIRE_CODEC = _metrics.histogram(
     "kv_wire_codec_seconds",
     "Wall seconds serializing (stage=encode) or deserializing "
@@ -241,6 +249,13 @@ def _max_msg_bytes():
     return int(os.environ.get("MXNET_TPU_PS_MAX_MSG_MB", "1024")) << 20
 
 
+def _coalesce_enabled():
+    """RPC coalescing: fuse each training step's push+pull flushes into
+    one push_pull RPC per shard (``MXNET_TPU_KV_COALESCE=0`` restores
+    the two-round-trip path)."""
+    return os.environ.get("MXNET_TPU_KV_COALESCE", "1") != "0"
+
+
 def _call_timeout_s():
     """Per-attempt socket timeout for one RPC round trip."""
     return float(os.environ.get("MXNET_TPU_PS_CALL_TIMEOUT", "60"))
@@ -277,23 +292,26 @@ def _repl_timeout_s():
 # freezes+exports it on the old owner (leaving a ``StaleEpochError``
 # tombstone), discard rolls a staged copy back — all three replicate so a
 # follower promoted mid-resize holds the same tombstones and staged keys.
-_MUTATING_OPS = frozenset({"init", "push", "set_optimizer", "command",
-                           "resize_install", "resize_retire",
+_MUTATING_OPS = frozenset({"init", "push", "push_pull", "set_optimizer",
+                           "command", "resize_install", "resize_retire",
                            "resize_discard", "resize_seal"})
 # the same ops are what a primary appends to its replication log
 _REPLICATED_OPS = _MUTATING_OPS
 
 
-# -- wire codec: JSON header + raw buffers, nothing executable -----------
-
-def _wire_key(k):
-    """Keys on the wire are JSON values; tuple stripe keys ride as lists."""
-    return list(k) if isinstance(k, tuple) else k
-
-
-def _unwire_key(k):
-    return tuple(k) if isinstance(k, list) else k
-
+# -- wire codecs ----------------------------------------------------------
+#
+# Two frame formats share the 8-byte outer length prefix:
+#
+# * the PR-17 BINARY frame (kvstore_wire.py): fixed 54-byte header +
+#   key table + tensor descriptors + zero-copy raw payload — the
+#   default (``MXNET_TPU_KV_WIRE=binary``);
+# * the PR-15 JSON frame below (``MXNET_TPU_KV_WIRE=json``), kept one
+#   release for interop.
+#
+# Decode auto-detects by magic, and a server answers in the format the
+# request arrived in, so an old-format peer on either end of the socket
+# keeps working without negotiation.
 
 def _encode_msg(msg):
     """Serialize a message dict.  Tensors (under ``pairs``/``vals``) and
@@ -411,21 +429,33 @@ def _recv_exact(sock, n, what):
 def _record_wire(op, dirn, stage, codec_s, payload):
     """Book one frame into the wire families.  ``payload`` is the framed
     body WITHOUT the 8-byte outer length prefix; the prefix is charged to
-    the header part so header+payload equals the socket bytes exactly."""
-    (hdr_len,) = struct.unpack_from("<I", payload, 0)
+    the header part so header+payload equals the socket bytes exactly.
+    The header/payload split is format-aware: binary frames carry their
+    own header length in a fixed slot (O(1)), JSON frames derive it from
+    the u32 header-length prefix."""
     frame = 8 + len(payload)
-    header_b = min(8 + 4 + hdr_len, frame)
+    if _wire.is_binary_frame(payload):
+        hdr_len = _wire.header_len(payload)
+        header_b = min(8 + hdr_len, frame)
+    else:
+        (hdr_len,) = struct.unpack_from("<I", payload, 0)
+        header_b = min(8 + 4 + hdr_len, frame)
     _M_WIRE_BYTES.labels(op, dirn, "header").inc(float(header_b))
     _M_WIRE_BYTES.labels(op, dirn, "payload").inc(float(frame - header_b))
     _M_WIRE_FRAME.labels(op, dirn).observe(float(frame))
     _M_WIRE_CODEC.labels(op, stage).observe(codec_s)
 
 
-def _send_msg(sock, obj, *, op=None, wire_dir="send"):
+def _send_msg(sock, obj, *, op=None, wire_dir="send", fmt=None):
+    """``fmt`` pins the frame format (a server answers in the format the
+    request arrived in); None defers to ``MXNET_TPU_KV_WIRE``."""
     rec = _metrics.metrics_enabled()
     trace = _tracing.tracing_enabled()
+    if fmt is None:
+        fmt = _wire.wire_format()
     t0 = time.monotonic() if (rec or trace) else 0.0
-    payload = _encode_msg(obj)
+    payload = (_wire.encode_frame(obj) if fmt == "binary"
+               else _encode_msg(obj))
     codec_s = (time.monotonic() - t0) if (rec or trace) else 0.0
     cap = _max_msg_bytes()
     if len(payload) > cap:
@@ -467,7 +497,14 @@ def _recv_msg(sock, *, op=None, wire_dir="recv"):
     buf = _chaos.visit("kvstore.recv", buf)
     t0 = time.monotonic() if (rec or trace) else 0.0
     try:
-        msg = _decode_msg(bytes(buf))
+        # magic-based auto-detect: binary frames (incl. any future
+        # version byte, rejected typed) vs the one-release JSON frame
+        if _wire.is_binary_frame(buf):
+            msg = _wire.decode_frame(bytes(buf))
+            _WIRE_TLS.rx_fmt = "binary"
+        else:
+            msg = _decode_msg(bytes(buf))
+            _WIRE_TLS.rx_fmt = "json"
     except Exception:
         if rec:
             # the frame WAS consumed off the socket; book the prefix+body
@@ -497,15 +534,18 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 msg = _recv_msg(self.request)
+                # answer in the format the request arrived in: an
+                # old-format (JSON) client never sees a binary frame
+                fmt = getattr(_WIRE_TLS, "rx_fmt", None)
                 resp = srv.dispatch(msg)
                 op = msg.get("op")
                 try:
-                    _send_msg(self.request, resp, op=op)
+                    _send_msg(self.request, resp, op=op, fmt=fmt)
                 except _MessageTooBig as exc:
                     # tell the client WHY instead of dying mid-frame (a
                     # bare cut would read as 'peer closed' after retries)
                     _send_msg(self.request, {"ok": False, "err": str(exc)},
-                              op=op)
+                              op=op, fmt=fmt)
         except (EOFError, ConnectionError, ValueError, OSError):
             return
         finally:
@@ -1189,7 +1229,33 @@ class AsyncServer:
             if dedup:
                 last = self._last_seq.get(rank)
                 if last is not None and last[0] == seq:
+                    if op == "push_pull" and last[1].get("ok"):
+                        # the cached entry is the bounded push-ack (a
+                        # cached copy of the pulled weights per worker
+                        # would defeat pull's no-retained-response
+                        # design); the pull half is idempotent — re-run
+                        # it fresh
+                        return self._pull_locked({"keys": msg["keys"]}), \
+                            None
                     return last[1], None  # duplicate of a completed request
+            if op == "push_pull":
+                # fused step RPC: apply the push half (same validation
+                # and seqno bumps as a plain push), replicate it as a
+                # plain push entry, then serve the pull half from the
+                # just-updated store — one wire round trip per shard per
+                # step instead of two
+                rej = self._moved_reject_locked(
+                    [k for k, _ in msg["pairs"]] + list(msg["keys"]))
+                if rej is not None:
+                    return rej, None
+                resp = self._dispatch_locked("push", rank, msg)
+                if dedup:
+                    self._last_seq[rank] = (seq, resp)
+                if not resp.get("ok"):
+                    return resp, None
+                latch = self._append_entry_locked("push", rank, seq, msg,
+                                                  resp)
+                return self._pull_locked({"keys": msg["keys"]}), latch
             if op in ("init", "push"):
                 # AFTER dedup: a push applied before its key moved must
                 # still answer its retry from cache (the applied update
@@ -1724,6 +1790,12 @@ class AsyncClient:
     def pull(self, keys):
         return self._call({"op": "pull", "keys": keys})["vals"]
 
+    def push_pull(self, pairs, keys):
+        """Fused push+pull: one round trip applies the gradients and
+        returns the fresh weights (RPC coalescing, PR 17)."""
+        return self._call({"op": "push_pull", "pairs": pairs,
+                           "keys": keys})["vals"]
+
     def set_optimizer(self, pickled):
         if not self._secret:
             raise MXNetError(
@@ -2008,6 +2080,13 @@ class ReplicatedClient:
             return resp["vals"], resp.get("seqnos")
         return resp["vals"]
 
+    def push_pull(self, pairs, keys):
+        """Fused push+pull through the current primary; a failover
+        retry keeps the seq, and the replicated dedup answers the push
+        half from cache while re-running the idempotent pull half."""
+        return self._call({"op": "push_pull", "pairs": pairs,
+                           "keys": keys})["vals"]
+
     def set_optimizer(self, pickled):
         if not self._secret:
             raise MXNetError(
@@ -2068,6 +2147,9 @@ class ServerGroup:
                                               "1000000"))
         self._striped = {}  # base key -> (shape, n_chunks)
         self._pool = None  # lazy persistent fan-out pool (hot path)
+        # opt-in gradient compression (MXNET_TPU_KV_COMPRESS): per-key
+        # eligibility is negotiated at init time via negotiate()
+        self._compressor = _wire.GradCompressor.from_env()
 
     @staticmethod
     def _normalize_spec(a):
@@ -2303,6 +2385,16 @@ class ServerGroup:
         rank 0 never initializes times out with a clear error rather
         than committing another rank's value.
         """
+        comp = self._compressor
+        if comp is not None:
+            # negotiation point: every rank admits the same wire keys
+            # (striping is a pure function of shape + the job-wide
+            # bound), so a compressed push from any worker is one the
+            # server knows how to decompress — self-describing frames
+            # make that a local decision, not a handshake
+            for key, value in pairs:
+                for _s, wk, chunk in self._split(key, value):
+                    comp.negotiate(wk, chunk)
         if self._rank != 0:
             self.wait_for_init([(k, _np.asarray(v).shape)
                                 for k, v in pairs])
@@ -2339,9 +2431,18 @@ class ServerGroup:
             time.sleep(delay)
             delay = min(delay * 2, 0.5)
 
+    def _maybe_compress(self, per_server):
+        """Run push gradients through the negotiated compressor (binary
+        wire only — the JSON frame has no compressed-tensor form)."""
+        comp = self._compressor
+        if comp is None or _wire.wire_format() != "binary":
+            return per_server
+        return {s: [(k, comp.compress(k, v)) for k, v in p]
+                for s, p in per_server.items()}
+
     def push(self, pairs):
         def go():
-            per = self._scatter(pairs)
+            per = self._maybe_compress(self._scatter(pairs))
             # one logical flush → len(per) wire RPCs (re-observed on a
             # topology-refresh retry, which really does fan out again)
             _M_WIRE_RPCS.observe(float(len(per)))
@@ -2353,13 +2454,49 @@ class ServerGroup:
     def pull(self, keys, shapes=None):
         return self._routed(lambda: self._pull_impl(keys, shapes))
 
-    def _pull_impl(self, keys, shapes=None):
-        """``shapes`` (per-key tuples, e.g. the out buffers' shapes) makes
-        routing deterministic for keys this worker never initialized
-        itself: striping is a pure function of element count and the
-        job-wide bound, so a pull-only worker computes the same layout
-        the initializing worker did."""
-        # plan: striped keys fan out to all servers; plain keys to one
+    def push_pull(self, pairs, keys, shapes=None):
+        """Fused flush: push ``pairs`` and pull ``keys`` in ONE wire RPC
+        per shard (the server applies the update, then answers with the
+        fresh weights).  With coalescing off the two logical flushes run
+        as the classic two round trips."""
+        if not _coalesce_enabled():
+            self.push(pairs)
+            return self.pull(keys, shapes)
+        return self._routed(
+            lambda: self._push_pull_impl(pairs, keys, shapes))
+
+    def _push_pull_impl(self, pairs, keys, shapes):
+        per = self._maybe_compress(self._scatter(pairs))
+        requests, slots = self._pull_plan(keys, shapes)
+        servers = sorted(set(per) | set(requests))
+        # two logical flushes share len(servers) wire RPCs: book the
+        # amortized width once per flush, and the fan-out the fusion
+        # avoided into the savings counter
+        _M_WIRE_RPCS.observe(len(servers) / 2.0)
+        _M_WIRE_RPCS.observe(len(servers) / 2.0)
+        _M_COALESCE_SAVED.inc(
+            float(len(per) + len(requests) - len(servers)))
+
+        def job(s):
+            if s in per and s in requests:
+                return self._clients[s].push_pull(per[s], requests[s])
+            if s in per:
+                return self._clients[s].push(per[s])
+            return self._clients[s].pull(requests[s])
+
+        resp_list = self._fanout(
+            [(s, lambda s=s: job(s)) for s in servers])
+        responses = {s: r for s, r in zip(servers, resp_list)
+                     if s in requests}
+        return self._pull_gather(slots, responses)
+
+    def _pull_plan(self, keys, shapes):
+        """Route a pull: striped keys fan out to all servers; plain keys
+        to one.  ``shapes`` (per-key tuples, e.g. the out buffers'
+        shapes) makes routing deterministic for keys this worker never
+        initialized itself: striping is a pure function of element count
+        and the job-wide bound, so a pull-only worker computes the same
+        layout the initializing worker did."""
         requests = {}  # server -> [wire keys]
         slots = []     # per key: ("plain", server, idx) | ("striped", [...])
         for pos, key in enumerate(keys):
@@ -2384,12 +2521,9 @@ class ServerGroup:
                 requests.setdefault(server, [])
                 slots.append(("plain", server, len(requests[server])))
                 requests[server].append(key)
-        ordered = sorted(requests)
-        _M_WIRE_RPCS.observe(float(len(ordered)))
-        resp_list = self._fanout(
-            [(s, lambda s=s: self._clients[s].pull(requests[s]))
-             for s in ordered])
-        responses = dict(zip(ordered, resp_list))
+        return requests, slots
+
+    def _pull_gather(self, slots, responses):
         out = []
         for slot in slots:
             if slot[0] == "plain":
@@ -2404,6 +2538,16 @@ class ServerGroup:
                     shape = self._striped[key][0]
                     out.append(_np.concatenate(chunks).reshape(shape))
         return out
+
+    def _pull_impl(self, keys, shapes=None):
+        requests, slots = self._pull_plan(keys, shapes)
+        ordered = sorted(requests)
+        _M_WIRE_RPCS.observe(float(len(ordered)))
+        resp_list = self._fanout(
+            [(s, lambda s=s: self._clients[s].pull(requests[s]))
+             for s in ordered])
+        responses = dict(zip(ordered, resp_list))
+        return self._pull_gather(slots, responses)
 
     def set_optimizer(self, pickled):
         self._routed(lambda: self._fanout(

@@ -1,0 +1,639 @@
+"""Binary zero-copy wire codec for the async parameter server.
+
+PR 15 booked every JSON-framed byte against socket ground truth and
+printed a PROJECTED savings line for the binary wire that would replace
+it; this module is that wire.  One frame is::
+
+    [8B outer length prefix — written by _send_msg, not here]
+    fixed header   "<4sBBHiqqiIIIHII"  (54 bytes)
+        4s magic            b"MXTB"
+        B  version          1 (unknown versions -> CorruptMessageError)
+        B  opcode           op-string table below; 0 = no/uncommon op
+        H  flags            field-presence bits (_F_* below)
+        i  rank             worker rank            (flags & _F_RANK)
+        q  seq              per-worker RPC seqno   (flags & _F_SEQ)
+        q  rseq             replication log seqno  (flags & _F_RSEQ)
+        i  epoch            membership epoch       (flags & _F_EPOCH)
+        I  n_pairs          (key, tensor) pairs
+        I  n_keys           extra keys beyond the pairs (e.g. pull)
+        I  n_vals           extra tensors beyond the pairs (e.g. vals)
+        H  trace_len        PR-5 trace-token bytes
+        I  meta_len         JSON escape-hatch bytes
+        I  hdr_len          offset where raw tensor payload begins
+    trace token    trace_len bytes, utf-8
+    key table      (n_pairs + n_keys) x [u16 klen][klen JSON bytes]
+    descriptors    (n_pairs + n_vals [+1 optimizer]) x tensor descriptor
+    meta JSON      meta_len bytes — every field with no fixed slot
+    payloads       raw tensor bytes, one slice per descriptor, decoded
+                   ZERO-COPY (np.frombuffer on the exact slice)
+
+Tensor descriptors carry a kind byte: 0 none, 1 raw, 2 int8-quantized
+(symmetric max-abs grid from ``contrib/quantization.py`` + f32 scale),
+3 top-k sparse (u32 indices + values), 4 opaque bytes (the HMAC-gated
+optimizer pickle).  Kinds 2/3 are the opt-in gradient compression
+(``MXNET_TPU_KV_COMPRESS``): the client quantizes/sparsifies eligible
+push gradients with per-key error feedback (:class:`GradCompressor`),
+the server decompresses at decode time — frames are self-describing,
+so decompression needs no server-side negotiation state.
+
+Everything malformed — truncated, bit-flipped, oversize counts, wrong
+magic/version — raises typed :class:`CorruptMessageError` (never
+``struct.error``): the ledger books the consumed prefix once under
+op='corrupt' and the client retry ladder classifies it.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import struct
+import threading
+
+import numpy as _np
+
+from .base import CorruptMessageError, MXNetError
+from .observability import metrics as _metrics
+
+__all__ = ["MAGIC", "VERSION", "encode_frame", "decode_frame",
+           "is_binary_frame", "header_len", "wire_format",
+           "CompressedTensor", "GradCompressor", "parse_compress_spec"]
+
+MAGIC = b"MXTB"
+VERSION = 1
+
+_FIXED = struct.Struct("<4sBBHiqqiIIIHII")
+_FIXED_LEN = _FIXED.size  # 54
+_HDRLEN_OFF = _FIXED_LEN - 4  # the trailing u32 hdr_len slot
+
+_F_RANK = 0x01
+_F_SEQ = 0x02
+_F_EPOCH = 0x04
+_F_RSEQ = 0x08
+_F_OPT = 0x10
+_F_PAIRS = 0x20
+_F_KEYS = 0x40
+_F_VALS = 0x80
+_F_TRACE = 0x100
+
+# ops with a fixed code; anything else rides the meta JSON under "op"
+_OPCODES = {"init": 1, "push": 2, "pull": 3, "push_pull": 4,
+            "set_optimizer": 5, "command": 6, "heartbeat": 7, "stats": 8,
+            "shutdown": 9, "replicate": 10, "promote": 11,
+            "sync_follower": 12, "resize_install": 13, "resize_retire": 14,
+            "resize_discard": 15, "resize_seal": 16, "resize_export": 17}
+_OPNAMES = {v: k for k, v in _OPCODES.items()}
+
+_K_NONE, _K_RAW, _K_INT8, _K_TOPK, _K_OPAQUE = 0, 1, 2, 3, 4
+
+_DTYPE_CODES = {"float32": 1, "float64": 2, "float16": 3, "int8": 4,
+                "uint8": 5, "int16": 6, "uint16": 7, "int32": 8,
+                "uint32": 9, "int64": 10, "uint64": 11, "bool": 12}
+_DTYPE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
+
+_NDIM_CAP = 32  # forged ndim bytes must not drive unbounded loops
+
+# compression byte books: 'in' is the dense gradient bytes handed to the
+# compressor, 'out' the bytes its wire form occupies — the bench's
+# kv_compress_ratio is in/out
+_M_COMPRESS_BYTES = _metrics.counter(
+    "kv_compress_bytes_total",
+    "Gradient-compression byte flow: dir='in' dense bytes entering the "
+    "compressor, dir='out' compressed bytes leaving for the wire",
+    ["dir"])
+_H_COMP_IN = _M_COMPRESS_BYTES.labels("in")
+_H_COMP_OUT = _M_COMPRESS_BYTES.labels("out")
+
+
+def wire_format():
+    """Frame format for OUTGOING messages (lazy env read, like every
+    kvstore tunable): ``MXNET_TPU_KV_WIRE`` = ``binary`` (default) |
+    ``json`` (the PR-15 frame, kept one release for interop — decode
+    auto-detects by magic, so mixed fleets work either way)."""
+    fmt = os.environ.get("MXNET_TPU_KV_WIRE", "binary").strip().lower()
+    if fmt not in ("binary", "json"):
+        raise MXNetError(
+            "MXNET_TPU_KV_WIRE=%r — expected 'binary' or 'json'" % fmt)
+    return fmt
+
+
+def is_binary_frame(payload):
+    """True when the frame body starts with the binary magic (old JSON
+    frames start with a u32 header length whose bytes can never spell
+    b'MXTB' followed by '{' — JSON headers are tiny and begin with
+    '{')."""
+    return len(payload) >= _FIXED_LEN and payload[:4] == MAGIC
+
+
+def header_len(payload):
+    """Framing-overhead bytes of a binary frame body (everything before
+    the raw tensor payload section) — O(1) via the hdr_len slot, for
+    the wire ledger's header/payload split."""
+    (n,) = struct.unpack_from("<I", payload, _HDRLEN_OFF)
+    return n
+
+
+def _wire_key(k):
+    """Keys on the wire are JSON values; tuple stripe keys ride as
+    lists (shared with the JSON codec — kvstore_async imports these)."""
+    return list(k) if isinstance(k, tuple) else k
+
+
+def _unwire_key(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+# -- encode ---------------------------------------------------------------
+#
+# The same dtypes, shapes and keys cross the wire every step, so their
+# encodings are memoized — the per-tensor Python overhead is what a
+# zero-copy codec has left to pay, and caching it is how the binary
+# frame beats C-optimized pickle on small tensors too.  Caches are
+# size-capped so a peer feeding garbage cannot grow them unboundedly.
+_CACHE_CAP = 4096
+_DT_ENC_CACHE = {}
+_DIMS_CACHE = {}
+_KEY_ENC_CACHE = {}
+_KEY_DEC_CACHE = {}
+
+
+def _encode_dtype(dt):
+    enc = _DT_ENC_CACHE.get(dt)
+    if enc is None:
+        code = _DTYPE_CODES.get(dt.name)
+        if code is not None:
+            enc = struct.pack("<B", code)
+        else:
+            name = dt.name.encode("ascii")
+            enc = struct.pack("<BB", 0, len(name)) + name
+        if len(_DT_ENC_CACHE) < _CACHE_CAP:
+            _DT_ENC_CACHE[dt] = enc
+    return enc
+
+
+def _encode_dims(shape):
+    enc = _DIMS_CACHE.get(shape)
+    if enc is None:
+        if len(shape) > _NDIM_CAP:
+            raise MXNetError("tensor rank %d exceeds the wire cap of %d"
+                             % (len(shape), _NDIM_CAP))
+        enc = struct.pack("<B%dI" % len(shape), len(shape),
+                          *(int(d) for d in shape))
+        if len(_DIMS_CACHE) < _CACHE_CAP:
+            _DIMS_CACHE[shape] = enc
+    return enc
+
+
+def _encode_key(k):
+    try:
+        enc = _KEY_ENC_CACHE.get(k)
+        cacheable = True
+    except TypeError:            # unhashable (e.g. a list-form key)
+        enc, cacheable = None, False
+    if enc is None:
+        kb = _json.dumps(_wire_key(k),
+                         separators=(",", ":")).encode("utf-8")
+        if len(kb) > 0xFFFF:
+            raise MXNetError(
+                "kvstore key too long for the wire (%d bytes)" % len(kb))
+        enc = struct.pack("<H", len(kb)) + kb
+        if cacheable and len(_KEY_ENC_CACHE) < _CACHE_CAP:
+            _KEY_ENC_CACHE[k] = enc
+    return enc
+
+
+def _decode_key(kb):
+    k = _KEY_DEC_CACHE.get(kb)
+    if k is None:
+        k = _unwire_key(_json.loads(kb.decode("utf-8")))
+        if len(_KEY_DEC_CACHE) < _CACHE_CAP:
+            _KEY_DEC_CACHE[kb] = k
+    return k
+
+
+def _encode_tensor(v, descs, payloads):
+    if v is None:
+        descs.append(b"\x00")
+        return
+    if isinstance(v, CompressedTensor):
+        if v.kind == "int8":
+            descs.append(struct.pack("<B", _K_INT8)
+                         + _encode_dtype(v.dtype) + _encode_dims(v.shape)
+                         + struct.pack("<f", float(v.scale)))
+            payloads.append(v.q.data)
+        else:  # topk
+            descs.append(struct.pack("<B", _K_TOPK)
+                         + _encode_dtype(v.dtype) + _encode_dims(v.shape)
+                         + struct.pack("<I", int(v.indices.size)))
+            payloads.append(v.indices.data)
+            payloads.append(v.values.data)
+        return
+    arr = _np.ascontiguousarray(v)
+    descs.append(struct.pack("<B", _K_RAW) + _encode_dtype(arr.dtype)
+                 + _encode_dims(arr.shape))
+    payloads.append(arr.data)
+
+
+def encode_frame(msg):
+    """Serialize a message dict into one binary frame body (the caller
+    adds the 8-byte outer length prefix).  Tensors under ``pairs`` /
+    ``vals`` (dense ndarrays or :class:`CompressedTensor`) and the
+    opaque ``optimizer`` bytes ride as raw payload slices; every other
+    field must be JSON-safe, same contract as the JSON codec."""
+    flags = 0
+    opcode = rank = seq = rseq = epoch = 0
+    pairs, keys, vals, opt = (), (), (), None
+    trace = b""
+    meta = {}
+    for field, value in msg.items():
+        if field == "op":
+            opcode = _OPCODES.get(value, 0)
+            if opcode == 0:
+                meta[field] = value
+        elif field == "rank" and value is not None:
+            flags |= _F_RANK
+            rank = int(value)
+        elif field == "seq" and value is not None:
+            flags |= _F_SEQ
+            seq = int(value)
+        elif field == "rseq" and value is not None:
+            flags |= _F_RSEQ
+            rseq = int(value)
+        elif field == "epoch" and value is not None:
+            flags |= _F_EPOCH
+            epoch = int(value)
+        elif field == "trace" and value is not None:
+            flags |= _F_TRACE
+            trace = str(value).encode("utf-8")
+        elif field == "pairs":
+            flags |= _F_PAIRS
+            pairs = value
+        elif field == "keys":
+            flags |= _F_KEYS
+            keys = value
+        elif field == "vals":
+            flags |= _F_VALS
+            vals = value
+        elif field == "optimizer":
+            flags |= _F_OPT
+            opt = bytes(value)
+        else:
+            meta[field] = value
+    key_parts = [_encode_key(k)
+                 for k in [k for k, _ in pairs] + list(keys)]
+    descs, payloads = [], []
+    for _, v in pairs:
+        _encode_tensor(v, descs, payloads)
+    for v in vals:
+        _encode_tensor(v, descs, payloads)
+    if opt is not None:
+        descs.append(struct.pack("<BQ", _K_OPAQUE, len(opt)))
+        payloads.append(opt)
+    meta_b = (_json.dumps(meta, separators=(",", ":")).encode("utf-8")
+              if meta else b"")
+    hdr_len = (_FIXED_LEN + len(trace) + sum(len(p) for p in key_parts)
+               + sum(len(d) for d in descs) + len(meta_b))
+    fixed = _FIXED.pack(MAGIC, VERSION, opcode, flags, rank, seq, rseq,
+                        epoch, len(pairs), len(keys), len(vals),
+                        len(trace), len(meta_b), hdr_len)
+    return b"".join([fixed, trace] + key_parts + descs + [meta_b]
+                    + payloads)
+
+
+# -- decode ---------------------------------------------------------------
+
+def _decode_dtype(buf, cur):
+    code = buf[cur]
+    cur += 1
+    if code == 0:
+        n = buf[cur]
+        cur += 1
+        name = bytes(buf[cur:cur + n]).decode("ascii")
+        cur += n
+        return _np.dtype(name), cur
+    name = _DTYPE_NAMES.get(code)
+    if name is None:
+        raise CorruptMessageError("unknown wire dtype code %d" % code)
+    return _np.dtype(name), cur
+
+
+def _decode_dims(buf, cur, limit):
+    ndim = buf[cur]
+    cur += 1
+    if ndim > _NDIM_CAP or cur + 4 * ndim > limit:
+        raise CorruptMessageError("corrupt tensor rank %d" % ndim)
+    dims = struct.unpack_from("<%dI" % ndim, buf, cur)
+    count = 1
+    for d in dims:
+        count *= d
+    return tuple(int(d) for d in dims), count, cur + 4 * ndim
+
+
+def _decode_frame_impl(payload, decompress):
+    total = len(payload)
+    if total < _FIXED_LEN:
+        raise CorruptMessageError(
+            "binary frame shorter than its fixed header")
+    (magic, version, opcode, flags, rank, seq, rseq, epoch, n_pairs,
+     n_keys, n_vals, trace_len, meta_len, hdr_len) = \
+        _FIXED.unpack_from(payload, 0)
+    if magic != MAGIC:
+        raise CorruptMessageError("bad binary frame magic %r" % magic)
+    if version != VERSION:
+        raise CorruptMessageError(
+            "unsupported binary wire version %d (this release speaks "
+            "version %d)" % (version, VERSION))
+    if hdr_len < _FIXED_LEN or hdr_len > total:
+        raise CorruptMessageError("corrupt hdr_len %d in a %d-byte frame"
+                                  % (hdr_len, total))
+    # a forged count must die before it drives a loop: every key costs
+    # >= 2 header bytes, every descriptor >= 1
+    if 2 * (n_pairs + n_keys) + (n_pairs + n_vals) > hdr_len:
+        raise CorruptMessageError("corrupt section counts (%d/%d/%d)"
+                                  % (n_pairs, n_keys, n_vals))
+    cur = _FIXED_LEN
+
+    def need(n, what):
+        if cur + n > hdr_len:
+            raise CorruptMessageError("truncated %s section" % what)
+
+    need(trace_len, "trace")
+    trace = (bytes(payload[cur:cur + trace_len]).decode("utf-8")
+             if trace_len else None)
+    cur += trace_len
+    all_keys = []
+    for _ in range(n_pairs + n_keys):
+        need(2, "key table")
+        (klen,) = struct.unpack_from("<H", payload, cur)
+        cur += 2
+        need(klen, "key table")
+        all_keys.append(_decode_key(bytes(payload[cur:cur + klen])))
+        cur += klen
+    # descriptor walk: payload slices are consumed in order starting at
+    # hdr_len; every length is validated against the frame end BEFORE
+    # the slice (np.frombuffer never over-reads)
+    poff = hdr_len
+    tensors = []
+    n_opt = 1 if flags & _F_OPT else 0
+    opt_raw = None
+    for ti in range(n_pairs + n_vals + n_opt):
+        need(1, "descriptor")
+        kind = payload[cur]
+        cur += 1
+        if kind == _K_NONE:
+            tensors.append(None)
+            continue
+        if kind == _K_OPAQUE:
+            need(8, "descriptor")
+            (blen,) = struct.unpack_from("<Q", payload, cur)
+            cur += 8
+            if poff + blen > total:
+                raise CorruptMessageError("opaque payload overruns frame")
+            blob = bytes(payload[poff:poff + blen])
+            poff += blen
+            tensors.append(blob)
+            continue
+        if kind not in (_K_RAW, _K_INT8, _K_TOPK):
+            raise CorruptMessageError("unknown tensor kind %d" % kind)
+        dt, cur = _decode_dtype(payload, cur)
+        shape, count, cur = _decode_dims(payload, cur, hdr_len)
+        if kind == _K_RAW:
+            nbytes = count * dt.itemsize
+            if poff + nbytes > total:
+                raise CorruptMessageError("tensor payload overruns frame")
+            arr = _np.frombuffer(payload, dtype=dt, count=count,
+                                 offset=poff).reshape(shape)
+            poff += nbytes
+            tensors.append(arr)
+        elif kind == _K_INT8:
+            need(4, "descriptor")
+            (scale,) = struct.unpack_from("<f", payload, cur)
+            cur += 4
+            if poff + count > total:
+                raise CorruptMessageError("int8 payload overruns frame")
+            q = _np.frombuffer(payload, dtype=_np.int8, count=count,
+                               offset=poff)
+            poff += count
+            ct = CompressedTensor.int8(q.reshape(shape), scale, dt, shape)
+            tensors.append(ct.decompress() if decompress else ct)
+        else:  # _K_TOPK
+            need(4, "descriptor")
+            (k,) = struct.unpack_from("<I", payload, cur)
+            cur += 4
+            if k > count:
+                raise CorruptMessageError("top-k k=%d exceeds size %d"
+                                          % (k, count))
+            nbytes = k * (4 + dt.itemsize)
+            if poff + nbytes > total:
+                raise CorruptMessageError("top-k payload overruns frame")
+            idx = _np.frombuffer(payload, dtype=_np.uint32, count=k,
+                                 offset=poff)
+            values = _np.frombuffer(payload, dtype=dt, count=k,
+                                    offset=poff + 4 * k)
+            poff += nbytes
+            if k and int(idx.max()) >= count:
+                raise CorruptMessageError("top-k index out of range")
+            ct = CompressedTensor.topk(idx, values, dt, shape)
+            tensors.append(ct.decompress() if decompress else ct)
+    if meta_len:
+        need(meta_len, "meta")
+        meta = _json.loads(bytes(payload[cur:cur + meta_len])
+                           .decode("utf-8"))
+        if not isinstance(meta, dict):
+            raise CorruptMessageError("binary frame meta is not an object")
+        cur += meta_len
+    else:
+        meta = {}
+    if cur != hdr_len or poff != total:
+        raise CorruptMessageError(
+            "frame length mismatch (header %d/%d, payload %d/%d)"
+            % (cur, hdr_len, poff, total))
+    msg = dict(meta)
+    if opcode:
+        name = _OPNAMES.get(opcode)
+        if name is None:
+            raise CorruptMessageError("unknown opcode %d" % opcode)
+        msg["op"] = name
+    if flags & _F_RANK:
+        msg["rank"] = rank
+    if flags & _F_SEQ:
+        msg["seq"] = seq
+    if flags & _F_RSEQ:
+        msg["rseq"] = rseq
+    if flags & _F_EPOCH:
+        msg["epoch"] = epoch
+    if trace is not None:
+        msg["trace"] = trace
+    if flags & _F_OPT:
+        opt_raw = tensors.pop()
+        if not isinstance(opt_raw, (bytes, bytearray)):
+            raise CorruptMessageError(
+                "optimizer slot holds a non-opaque descriptor")
+        msg["optimizer"] = bytes(opt_raw)
+    if flags & _F_PAIRS:
+        msg["pairs"] = list(zip(all_keys[:n_pairs], tensors[:n_pairs]))
+    if flags & _F_KEYS:
+        msg["keys"] = all_keys[n_pairs:]
+    if flags & _F_VALS:
+        msg["vals"] = tensors[n_pairs:n_pairs + n_vals]
+    return msg
+
+
+def decode_frame(payload, decompress=True):
+    """Inverse of :func:`encode_frame`.  Dense tensors come back as
+    ZERO-COPY read-only views over ``payload`` (``np.frombuffer`` on
+    the exact slice); compressed tensors are decompressed to dense
+    unless ``decompress=False`` (tests inspect the wire form).  Any
+    malformed input raises :class:`CorruptMessageError` — never
+    ``struct.error`` — at the consumed-prefix point, so the wire
+    ledger's corrupt booking stays exact."""
+    try:
+        return _decode_frame_impl(payload, decompress)
+    except CorruptMessageError:
+        raise
+    except (struct.error, ValueError, KeyError, IndexError, TypeError,
+            UnicodeDecodeError, OverflowError) as exc:
+        raise CorruptMessageError(
+            "malformed binary frame: %r" % (exc,)) from exc
+
+
+# -- gradient compression -------------------------------------------------
+
+class CompressedTensor:
+    """Wire form of one compressed gradient: ``int8`` (symmetric
+    max-abs grid, payload = int8 codes + f32 scale) or ``topk``
+    (payload = u32 flat indices + values).  Self-describing: carries
+    the original dtype+shape so the decoder rebuilds a dense array."""
+
+    __slots__ = ("kind", "dtype", "shape", "scale", "q", "indices",
+                 "values")
+
+    def __init__(self, kind, dtype, shape):
+        self.kind = kind
+        self.dtype = _np.dtype(dtype)
+        self.shape = tuple(int(d) for d in shape)
+        self.scale = 0.0
+        self.q = self.indices = self.values = None
+
+    @classmethod
+    def int8(cls, q, scale, dtype, shape):
+        ct = cls("int8", dtype, shape)
+        ct.q = _np.ascontiguousarray(q, dtype=_np.int8)
+        ct.scale = float(scale)
+        return ct
+
+    @classmethod
+    def topk(cls, indices, values, dtype, shape):
+        ct = cls("topk", dtype, shape)
+        ct.indices = _np.ascontiguousarray(indices, dtype=_np.uint32)
+        ct.values = _np.ascontiguousarray(values, dtype=dtype)
+        return ct
+
+    @property
+    def wire_nbytes(self):
+        """Payload bytes this tensor occupies on the wire."""
+        if self.kind == "int8":
+            return self.q.size  # int8: one byte per element
+        return self.indices.nbytes + self.values.nbytes
+
+    def decompress(self):
+        if self.kind == "int8":
+            return (self.q.astype(self.dtype) * self.dtype.type(self.scale)
+                    ).reshape(self.shape)
+        count = 1
+        for d in self.shape:
+            count *= d
+        dense = _np.zeros(count, dtype=self.dtype)
+        dense[self.indices] = self.values
+        return dense.reshape(self.shape)
+
+
+def parse_compress_spec(value=None):
+    """``MXNET_TPU_KV_COMPRESS`` = ``int8`` | ``topk:<k>`` | ``0``
+    (off, the default) -> ("int8", 0) | ("topk", k) | None."""
+    spec = (value if value is not None
+            else os.environ.get("MXNET_TPU_KV_COMPRESS", "0"))
+    spec = spec.strip().lower()
+    if spec in ("", "0", "off", "none"):
+        return None
+    if spec == "int8":
+        return ("int8", 0)
+    if spec.startswith("topk:"):
+        try:
+            k = int(spec.split(":", 1)[1])
+        except ValueError:
+            k = 0
+        if k > 0:
+            return ("topk", k)
+    raise MXNetError(
+        "MXNET_TPU_KV_COMPRESS=%r — expected 'int8', 'topk:<k>' or '0'"
+        % spec)
+
+
+def _compress_min_elems():
+    """Below this element count a key is never compressed — header +
+    scale overhead would eat the savings on tiny tensors."""
+    return int(os.environ.get("MXNET_TPU_KV_COMPRESS_MIN", "16"))
+
+
+class GradCompressor:
+    """Client-side push-gradient compressor with per-key error
+    feedback (the 1-bit-SGD recipe): the quantization/sparsification
+    residual of step *t* is added back to the gradient of step *t+1*,
+    so the compression error averages out instead of biasing the
+    trajectory.
+
+    Eligibility is negotiated per key at init time (the ISSUE's
+    negotiation point): :meth:`negotiate` sees every wire key with its
+    initial value and admits float32/float64 keys of at least
+    ``MXNET_TPU_KV_COMPRESS_MIN`` elements; everything else (tiny
+    biases, int tensors) is passed through dense.  Only pushes are ever
+    compressed — init values and pulls stay exact."""
+
+    def __init__(self, spec):
+        self.kind, self.k = spec
+        self._eligible = set()
+        self._residual = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls):
+        spec = parse_compress_spec()
+        return None if spec is None else cls(spec)
+
+    def negotiate(self, wire_key, value):
+        arr = _np.asarray(value)
+        if arr.dtype in (_np.float32, _np.float64) \
+                and arr.size >= _compress_min_elems():
+            with self._lock:
+                self._eligible.add(wire_key)
+
+    def compress(self, wire_key, arr):
+        """Dense gradient in, :class:`CompressedTensor` out (or the
+        array unchanged when the key was not admitted at init)."""
+        with self._lock:
+            if wire_key not in self._eligible:
+                return arr
+            arr = _np.asarray(arr)
+            res = self._residual.get(wire_key)
+            g = arr + res.reshape(arr.shape) if res is not None else arr
+            if self.kind == "int8":
+                from .contrib.quantization import quantize_weight_int8
+
+                q, scale = quantize_weight_int8(g)
+                ct = CompressedTensor.int8(q, scale, arr.dtype, g.shape)
+                self._residual[wire_key] = g - ct.decompress()
+            else:
+                flat = _np.ravel(g)
+                k = min(self.k, flat.size)
+                idx = _np.argpartition(_np.abs(flat),
+                                       flat.size - k)[flat.size - k:]
+                idx = _np.sort(idx).astype(_np.uint32)
+                ct = CompressedTensor.topk(idx, flat[idx], arr.dtype,
+                                           g.shape)
+                residual = _np.array(flat, copy=True)
+                residual[idx] = 0
+                self._residual[wire_key] = residual
+            if _metrics.metrics_enabled():
+                _H_COMP_IN.inc(float(arr.nbytes))
+                _H_COMP_OUT.inc(float(ct.wire_nbytes))
+            return ct

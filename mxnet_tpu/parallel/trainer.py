@@ -1549,9 +1549,17 @@ class ShardedTrainer:
                 with att.phase("kv"):
                     # the push may ride out a shard failover internally
                     # (promote + same-seq retry); only whole-group loss
-                    # escapes, as ShardFailedError
-                    kv.push(diff, [NDArray(grads[n]) for n in diff])
-                    kv.pull(diff, out=bufs)
+                    # escapes, as ShardFailedError.  push_pull fuses the
+                    # step's two flushes into one RPC per shard on
+                    # dist_async (falling back to push();pull() on every
+                    # other mode or with coalescing off)
+                    if hasattr(kv, "push_pull"):
+                        kv.push_pull(diff,
+                                     [NDArray(grads[n]) for n in diff],
+                                     out=bufs)
+                    else:
+                        kv.push(diff, [NDArray(grads[n]) for n in diff])
+                        kv.pull(diff, out=bufs)
                 with att.phase("placement"):
                     # accumulates onto the batch placement above: both
                     # are host->device transfers on the step's critical

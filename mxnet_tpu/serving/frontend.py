@@ -8,12 +8,20 @@ A tiny stdlib ``http.server`` endpoint (same loopback posture as
 ``POST /v1/predict``
     JSON body ``{"model": ..., "inputs": {name: nested lists},
     "deadline_ms": ...}`` → ``{"model": ..., "outputs": [...]}``.
-    Raw-tensor bodies are supported with
-    ``Content-Type: application/octet-stream`` and query parameters
-    ``?model=m&input=data``: the body is one ``.npy``-serialized
-    per-sample array (``numpy.save`` bytes), the response the first
-    output as ``.npy`` bytes (``X-MXTPU-Outputs`` carries the count) —
-    no JSON float round-trip on the hot path.
+    Raw-tensor bodies are supported two ways.  The preferred wire is
+    ``Content-Type: application/x-mxtpu-frame`` with ``?model=m``: the
+    body is one PR-17 binary frame (see ``docs/how_to/wire_format.md``)
+    whose ``pairs`` carry the named inputs as raw tensor bytes; the
+    response is a frame whose ``vals`` carry every output zero-copy —
+    the same codec the async-PS wire uses, so header overhead is the
+    fixed 54-byte struct instead of an ``.npy`` header per tensor.
+    Corrupt frames answer 400 (typed ``CorruptMessageError``).  The
+    older ``Content-Type: application/octet-stream`` path with query
+    parameters ``?model=m&input=data`` is kept for one release: the
+    body is one ``.npy``-serialized per-sample array (``numpy.save``
+    bytes), the response the first output as ``.npy`` bytes
+    (``X-MXTPU-Outputs`` carries the count) — no JSON float round-trip
+    on either hot path.
 ``POST /v1/generate``
     JSON body ``{"model": ..., "prompt": [token ids],
     "max_new_tokens": ..., "eos_id": ..., "deadline_ms": ...}`` →
@@ -76,6 +84,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError
+from .. import kvstore_wire as _wire
 # the submodule path matters: the package exports an ``events()``
 # accessor FUNCTION under the same name as the submodule
 from ..observability.events import emit as _emit_event
@@ -86,14 +95,17 @@ from . import tenancy as _tenancy
 
 __all__ = ["ServingFrontend", "start_frontend", "trace_header_enabled"]
 
-# raw-npy wire books: the serving analogue of kv_wire_bytes_total —
-# bytes of .npy request/response bodies on the octet-stream hot path
-# (JSON predict bodies are excluded; their float round-trip is the
-# thing this path exists to avoid).  Handles pre-resolved at import.
+# raw-tensor wire books: the serving analogue of kv_wire_bytes_total —
+# bytes of binary-frame and .npy request/response bodies on the
+# raw-tensor hot paths (JSON predict bodies are excluded; their float
+# round-trip is the thing these paths exist to avoid).  Both content
+# types share the counter, so the frame path's header savings show up
+# directly as fewer bytes per request.  Handles pre-resolved at import.
 _M_SERVING_WIRE = _metrics.counter(
     "serving_wire_bytes_total",
-    "Raw-tensor (.npy) bytes crossing the serving frontend by "
-    "direction (recv = request body, send = response body)", ["dir"])
+    "Raw-tensor (binary-frame or .npy) bytes crossing the serving "
+    "frontend by direction (recv = request body, send = response "
+    "body)", ["dir"])
 _H_SWIRE_RECV = _M_SERVING_WIRE.labels("recv")
 _H_SWIRE_SEND = _M_SERVING_WIRE.labels("send")
 
@@ -260,6 +272,9 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                         if path == "/v1/generate":
                             self._generate(body)
                         elif ctype.startswith(
+                                "application/x-mxtpu-frame"):
+                            self._predict_frame(body, query)
+                        elif ctype.startswith(
                                 "application/octet-stream"):
                             self._predict_raw(body, query)
                         else:
@@ -353,6 +368,33 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                 self._shed = "disconnect"
                 self._status = 499
                 self.close_connection = True
+
+        def _predict_frame(self, body, query):
+            # PR-17 binary-frame path: inputs ride the frame's pairs
+            # zero-copy, outputs ride the response frame's vals.  A
+            # corrupt body raises CorruptMessageError (an MXNetError)
+            # out of decode_frame, which _reply_error maps to a 400.
+            q = urllib.parse.parse_qs(query)
+            model = self._model = q["model"][0]
+            deadline = q.get("deadline_ms", [None])[0]
+            _H_SWIRE_RECV.inc(float(len(body)))
+            msg = _wire.decode_frame(bytes(body))
+            pairs = msg.get("pairs") or []
+            if not pairs:
+                raise MXNetError(
+                    "binary predict frame carries no input pairs")
+            inputs = {str(n): _np.asarray(v) for n, v in pairs}
+            outs = _target_request(
+                target, model, inputs,
+                float(deadline) if deadline is not None else None,
+                timeout, tenant=self._tenant)
+            out_bytes = _wire.encode_frame({
+                "model": model,
+                "vals": [_np.ascontiguousarray(_np.asarray(o))
+                         for o in outs]})
+            _H_SWIRE_SEND.inc(float(len(out_bytes)))
+            self._reply(200, out_bytes, "application/x-mxtpu-frame",
+                        extra=(("X-MXTPU-Outputs", str(len(outs))),))
 
         def _predict_raw(self, body, query):
             q = urllib.parse.parse_qs(query)

@@ -88,16 +88,22 @@ def test_bench_generate_keys():
 
 def test_bench_wire_keys():
     """BENCH_WIRE=1: the schema-11 wire keys are present and >0 on the
-    CPU smoke, and the byte books reconcile with the socket truth (the
-    lane's falsifiability gate rides in the JSON row)."""
+    CPU smoke, the schema-13 additions (compression ratio, coalesced
+    RPC savings) are live under the lane's default PR-17 stack, and
+    the byte books reconcile with the socket truth (the lane's
+    falsifiability gate rides in the JSON row)."""
     rec = _run_bench({"BENCH_WIRE": "1"})
-    assert rec["schema_version"] >= 11
+    assert rec["schema_version"] >= 13
     assert rec["metric"] == "kv_wire_bytes_per_step"
     assert rec["unit"] == "B/step"
     assert rec["kv_bytes_per_step"] > 0
     assert rec["kv_header_overhead_pct"] > 0
     assert rec["kv_codec_ms_share"] > 0
     assert rec["kv_rpcs_per_flush_p50"] > 0
+    # the lane defaults to int8 push compression + coalescing, so both
+    # schema-13 keys must show real wins, not placeholders
+    assert rec["kv_compress_ratio"] > 1.0
+    assert rec["kv_coalesce_rpcs_saved"] > 0
     assert rec["wire_reconciles"] is True
     assert rec["codec_reconciles"] is True
 

@@ -297,7 +297,8 @@ class _StubTarget(object):
     """Minimal Scheduler stand-in for the frontend: request() echoes the
     row doubled (the raw path only needs the shared signature)."""
 
-    def request(self, model, inputs, deadline_ms=None, timeout=None):
+    def request(self, model, inputs, deadline_ms=None, timeout=None,
+                tenant=None):
         ((_, row),) = inputs.items()
         return [np.asarray(row) * 2.0]
 

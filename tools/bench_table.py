@@ -71,7 +71,13 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               # sessions landing on their KV blocks
               "fairness_p99_ratio": False,
               "quota_shed_rate": True,
-              "kv_affinity_hit_ratio": True}
+              "kv_affinity_hit_ratio": True,
+              # schema-13 wire keys (BENCH_WIRE=1 rounds): compression
+              # ratio is up-is-good (dense bytes in / wire bytes out),
+              # coalesce savings count the RPCs the fused push_pull
+              # never sent — also up-is-good
+              "kv_compress_ratio": True,
+              "kv_coalesce_rpcs_saved": True}
 TREND_TOLERANCE = 0.10
 
 
