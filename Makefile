@@ -11,6 +11,7 @@ test: native check
 	python -m pytest tests/ -q
 	python tools/wire_report.py
 	python tools/loadgen.py
+	python tools/dr_drill.py
 
 test-fast: check
 	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
@@ -48,6 +49,9 @@ watchdog:
 elastic:
 	python tools/elastic_fit.py
 
+dr:
+	python tools/dr_drill.py
+
 continuous:
 	python tools/continuous_fit.py
 
@@ -67,5 +71,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	wire dryrun dist-test chaos trace watchdog elastic continuous serve \
+	wire dryrun dist-test chaos trace watchdog elastic dr continuous serve \
 	generate slo fairness clean

@@ -99,7 +99,12 @@ def _obs_counters():
 # out under MXNET_TPU_KV_COMPRESS) / kv_coalesce_rpcs_saved (RPCs the
 # fused push_pull path avoided) on the BENCH_WIRE=1 lane, which now
 # runs the PR-17 binary wire by default
-_SCHEMA_VERSION = 13
+# v14: snapshot_save_ms / snapshot_restore_ms / snapshot_frozen_ms from
+# the BENCH_SNAPSHOT=1 durability lane (PR-18): a consistent cut of a
+# live 2-shard PS under push load, then a cold restore onto a 3-shard
+# fleet — frozen_ms is the only window where pushes block, so it is the
+# number the trend gate must keep flat
+_SCHEMA_VERSION = 14
 
 
 def _bench_peak():
@@ -667,6 +672,88 @@ def elastic_main():
     }))
 
 
+def snapshot_main():
+    """Durability lane (BENCH_SNAPSHOT=1, PR-18): time a coordinated
+    snapshot of a live 2-shard striped PS while a pusher thread keeps
+    updates flowing, then a cold restore onto a DIFFERENT (3-shard)
+    fleet.  Emits the schema-14 additive keys: ``snapshot_save_ms``
+    (end-to-end commit including fsync discipline),
+    ``snapshot_frozen_ms`` (the routing-frozen delta cut — the only
+    window where training blocks) and ``snapshot_restore_ms``
+    (verify + reassemble + re-stripe + install)."""
+    import pickle
+    import shutil
+    import tempfile
+    import threading
+
+    import mxnet_tpu  # noqa: F401 — env bootstrap
+    from mxnet_tpu import kvstore_async as ka
+    from mxnet_tpu import optimizer as mx_opt
+    from mxnet_tpu import snapshot
+
+    n_keys = int(os.environ.get("BENCH_SNAPSHOT_KEYS", "24"))
+    n_push = int(os.environ.get("BENCH_SNAPSHOT_PUSHES", "400"))
+    servers = [ka.AsyncServer(secret="bench", server_id=i).start()
+               for i in range(5)]
+    group = ka.ServerGroup([servers[0].address, servers[1].address],
+                           rank=0, heartbeat=False, secret="bench")
+    group._bound = 1 << 10  # stripe the big keys across the fleet
+    rs = np.random.RandomState(0)
+    keys = [("k%02d" % i,
+             (4096,) if i % 4 == 0 else (64,)) for i in range(n_keys)]
+    group.init([(k, rs.randn(*s).astype(np.float32)) for k, s in keys])
+    group.set_optimizer(pickle.dumps(mx_opt.SGD(learning_rate=0.01)))
+
+    pushed = [0]
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set() and pushed[0] < n_push:
+            k, s = keys[pushed[0] % n_keys]
+            group.push([(k, np.ones(s, np.float32))])
+            pushed[0] += 1
+
+    snap_dir = tempfile.mkdtemp(prefix="mxtpu_bench_snap_")
+    t0 = time.perf_counter()
+    pusher = threading.Thread(target=pound)
+    pusher.start()
+    while pushed[0] < 8 and time.perf_counter() - t0 < 5:
+        time.sleep(0.002)               # cut under real push load
+    saved = snapshot.save(group, snap_dir, keys, step=1, secret="bench")
+    stop.set()
+    pusher.join()
+    group.shutdown()
+
+    # cold restore onto a different topology: 3 fresh shards
+    group2 = ka.ServerGroup([s.address for s in servers[2:]], rank=0,
+                            heartbeat=False, secret="bench")
+    group2._bound = 1 << 10
+    restored = snapshot.restore_latest(snap_dir, group2, secret="bench")
+    out = group2.pull([k for k, _ in keys])
+    survived = all(v.shape == tuple(s) for v, (_, s) in zip(out, keys))
+    group2.shutdown()
+    for s in servers:
+        s.stop()
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "snapshot_save",
+        "value": round(saved["save_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": 0.0,  # the 2017 reference has no live PS snapshot
+        "snapshot_save_ms": round(saved["save_ms"], 3),
+        "snapshot_frozen_ms": round(saved["frozen_ms"], 3),
+        "snapshot_restore_ms": round(restored["restore_ms"], 3),
+        "snapshot_restripe_ok": bool(
+            survived and restored["restored_shards"] == 3),
+        "pushes_during_save": pushed[0],
+        "elapsed_s": round(dt, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"keys": n_keys, "pushes": n_push},
+    }))
+
+
 def wire_main():
     """Wire-bandwidth lane (BENCH_WIRE=1): a 2-shard replicated
     in-process kvstore fit (sync replication, followers attached via
@@ -1000,6 +1087,9 @@ def main():
     if os.environ.get("BENCH_WIRE") == "1":
         wire_main()
         return
+    if os.environ.get("BENCH_SNAPSHOT") == "1":
+        snapshot_main()
+        return
     if os.environ.get("BENCH_GENERATE") == "1":
         generate_main()
         return
@@ -1221,6 +1311,8 @@ def _metric_names():
     if os.environ.get("BENCH_WIRE") == "1":
         return ("kv_wire_bytes_per_step",
                 "kv_wire_cpu_smoke_bytes_per_step", "B/step")
+    if os.environ.get("BENCH_SNAPSHOT") == "1":
+        return ("snapshot_save", "snapshot_save", "ms")
     if os.environ.get("BENCH_GENERATE") == "1":
         return ("generation_throughput",
                 "generation_cpu_smoke_throughput", "tokens/s")

@@ -18,6 +18,7 @@ __all__ = [
     "ResizeAbortedError",
     "TruncatedMessageError",
     "CorruptMessageError",
+    "CheckpointCorruptError",
     "StreamStallError",
     "string_types",
     "numeric_types",
@@ -98,6 +99,26 @@ class CorruptMessageError(MXNetError, ValueError):
     a data-plane frame failing validation is the same failure class as
     a wire frame failing it, and a typed error is what lets the
     streaming loader's skip-and-count mode exist at all."""
+
+
+class CheckpointCorruptError(MXNetError, ValueError):
+    """Durable training state failed integrity verification on read: a
+    snapshot shard / manifest / fit-meta sidecar whose recorded checksum
+    no longer matches its bytes, a manifest naming a file that does not
+    exist, or a snapshot directory with no committed manifest at all —
+    the on-disk counterpart of a wire frame failing validation.  Raised
+    by ``snapshot.load``/``verify``, ``parallel.checkpoint.
+    verify_checkpoint`` and the strict fit-meta reader *before* any
+    state is handed to a trainer or serving backend, so a torn write or
+    a bit flip is quarantined at the verify step instead of surfacing
+    as an opaque load error mid-restore.  Subclasses ``ValueError`` the
+    way ``CorruptMessageError`` does, so generic corrupt-payload
+    handlers classify it without importing the framework."""
+
+    def __init__(self, msg, path=None, file=None):
+        super().__init__(msg)
+        self.path = path
+        self.file = file
 
 
 class StreamStallError(MXNetError, TimeoutError):

@@ -58,6 +58,16 @@ site                      planted at
                           sequence id; ``raise``/``drop`` surface as the
                           typed 429 ``CacheExhaustedError`` path, ``delay``
                           stretches the admission window)
+``storage.write``         durable-state file write (``durable.
+                          atomic_write_bytes`` — snapshot shards,
+                          manifests, fit-meta sidecars; ``name`` is the
+                          destination path).  ``corrupt`` is a torn
+                          write / bit flip in the payload about to hit
+                          disk, ``drop`` is a full disk
+                          (``OSError(ENOSPC)``), ``raise`` a failed
+                          write, ``delay`` a slow fsync —
+                          ``chaos.corrupt_file`` with this site is the
+                          post-commit bit-rot counterpart
 ``data.read``             RecordIO record read (``MXRecordIO.read``;
                           ``name`` is the stream's uri).  ``corrupt``
                           garbles the record header so the magic check
@@ -114,9 +124,9 @@ _M_FIRED = _metrics.counter(
 SITES = frozenset({
     "engine.op", "kvstore.send", "kvstore.recv", "kvstore.call",
     "kvstore.server_kill", "kvstore.repl_drop", "kvstore.repl_delay",
-    "kvstore.resize_drop", "checkpoint.write", "serving.admit",
-    "serving.dispatch", "serving.scale", "serving.decode",
-    "serving.kv_alloc", "serving.route", "data.read",
+    "kvstore.resize_drop", "checkpoint.write", "storage.write",
+    "serving.admit", "serving.dispatch", "serving.scale",
+    "serving.decode", "serving.kv_alloc", "serving.route", "data.read",
 })
 
 
@@ -149,6 +159,10 @@ def _drop_exc(site):
         from . import base
 
         return base.CorruptMessageError("chaos: record dropped mid-read")
+    if site == "storage.write":
+        import errno
+
+        return OSError(errno.ENOSPC, "chaos: no space left on device")
     return ChaosDrop("chaos: dropped at %s" % site)
 
 

@@ -55,7 +55,7 @@ import time as _time
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import CheckpointCorruptError, MXNetError
 from .observability import flight_recorder as _flight
 from .observability import metrics as _metrics
 from .observability import watchdog as _watchdog
@@ -206,6 +206,18 @@ class DeployDaemon(object):
     def _gate(self, step):
         """Run the candidate through the gate; returns the validated
         backend or None (rejection already recorded)."""
+        try:
+            # integrity first: a checkpoint whose manifest checksums no
+            # longer match its bytes must never reach a build attempt —
+            # a corrupt weight file can load "successfully" into wrong
+            # numbers that only the golden gate might catch (and serving
+            # configs without one would promote silently)
+            _ckpt.verify_checkpoint(self.checkpoint_dir, step)
+        except CheckpointCorruptError as exc:
+            self._reject(step, "checksum", exc)
+            return None
+        except OSError:
+            pass  # absence is the loader's failure to classify, not ours
         try:
             backend = self._loader(self.checkpoint_dir, step)
         except Exception as exc:  # noqa: BLE001 — any load failure rejects

@@ -1,0 +1,167 @@
+"""Atomic, checksummed durable-state writes — the one way training
+state reaches disk.
+
+Every file that outlives the process (snapshot shards, snapshot
+manifests, checkpoint manifests, fit-meta sidecars) goes through
+:func:`atomic_write_bytes`: write to a ``.tmp`` sibling, ``fsync`` the
+data, ``os.replace`` into place, then ``fsync`` the parent directory so
+the rename itself is durable.  A crash at any instant leaves either the
+old file or the new one — never a truncated half-write that a later
+``resume="auto"`` or restore trips over.  The graftcheck ``atomic-write``
+rule enforces that durable-state paths use these helpers instead of a
+bare ``open(path, "w")``.
+
+The write path is also the ``storage.write`` chaos site: ``corrupt`` is
+a torn write / bit flip in the payload about to hit disk, ``drop`` is a
+full disk (``OSError(ENOSPC)`` — the native loss exception, so the
+production abort path is what gets exercised), ``raise`` a failed
+write, ``delay`` a slow fsync.
+
+Integrity rides with the bytes: :func:`checksummed_json_bytes` embeds a
+``sha256`` over the canonical JSON of the rest of the object, and
+:func:`verify_checksummed_json` raises the typed
+``CheckpointCorruptError`` — never a bare ``ValueError`` — when the
+recorded digest no longer matches, so every reader up the stack
+(snapshot restore, deployd's promotion gate, the trainer resume ladder)
+classifies disk rot the same way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json as _json
+import os
+
+from . import chaos as _chaos
+from .base import CheckpointCorruptError
+from .observability import flight_recorder as _flight
+from .observability import metrics as _metrics
+from .observability.events import emit as _emit_event
+
+__all__ = ["atomic_write_bytes", "atomic_write_json", "file_sha256",
+           "checksummed_json_bytes", "verify_checksummed_json",
+           "load_checksummed_json", "quarantine"]
+
+_M_QUARANTINED = _metrics.counter(
+    "snapshot_quarantined_total",
+    "Durable state (snapshot / checkpoint) that failed integrity "
+    "verification and was quarantined, by kind", ["kind"])
+
+
+def _fsync_default():
+    """``MXNET_TPU_SNAPSHOT_FSYNC=0`` trades crash durability for speed
+    (tests, tmpfs scratch); the default is the durable path."""
+    return os.environ.get("MXNET_TPU_SNAPSHOT_FSYNC", "1") != "0"
+
+
+def _fsync_dir(path):
+    """fsync a directory so a just-committed rename survives power loss.
+    Best-effort: not every filesystem supports directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data, fsync=None):
+    """Write ``data`` to ``path`` via tmp + fsync + atomic rename.
+
+    The payload passes through the ``storage.write`` chaos site first
+    (``name`` is the destination path, so ``match=`` can target one
+    file class), then lands as an all-or-nothing replace: a kill at any
+    point leaves either the previous content or the full new content.
+    """
+    data = _chaos.visit("storage.write", bytes(data), name=path)
+    if fsync is None:
+        fsync = _fsync_default()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return path
+
+
+def atomic_write_json(path, obj, fsync=None):
+    """``atomic_write_bytes`` of the canonical (sorted-key) JSON."""
+    return atomic_write_bytes(
+        path, _json.dumps(obj, sort_keys=True).encode("utf-8"),
+        fsync=fsync)
+
+
+def file_sha256(path):
+    """Streaming sha256 hex digest of a file's bytes."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def checksummed_json_bytes(obj):
+    """Canonical JSON bytes of ``obj`` with an embedded ``sha256`` field
+    covering everything else — a self-verifying sidecar."""
+    if "sha256" in obj:
+        raise ValueError("object already carries a sha256 field")
+    body = _json.dumps(obj, sort_keys=True).encode("utf-8")
+    stamped = dict(obj)
+    stamped["sha256"] = hashlib.sha256(body).hexdigest()
+    return _json.dumps(stamped, sort_keys=True).encode("utf-8")
+
+
+def verify_checksummed_json(data, path=None):
+    """Decode bytes produced by :func:`checksummed_json_bytes`, raising
+    the typed ``CheckpointCorruptError`` on any mismatch or malformation
+    (a torn sidecar and a bit-flipped one are the same failure class)."""
+    try:
+        obj = _json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise CheckpointCorruptError(
+            "checksummed sidecar is not valid JSON%s: %s"
+            % (" (%s)" % path if path else "", exc), path=path) from exc
+    if not isinstance(obj, dict) or "sha256" not in obj:
+        raise CheckpointCorruptError(
+            "checksummed sidecar carries no sha256 field%s"
+            % (" (%s)" % path if path else ""), path=path)
+    recorded = obj.pop("sha256")
+    body = _json.dumps(obj, sort_keys=True).encode("utf-8")
+    actual = hashlib.sha256(body).hexdigest()
+    if actual != recorded:
+        raise CheckpointCorruptError(
+            "checksum mismatch%s: recorded %s != actual %s"
+            % (" (%s)" % path if path else "", recorded[:12], actual[:12]),
+            path=path)
+    return obj
+
+
+def quarantine(kind, exc, **fields):
+    """Book a quarantine in every ops channel at once: the
+    ``snapshot_quarantined_total{kind}`` counter (watchdog-ruled), a
+    structured ``snapshot.quarantined`` event, and a flight bundle whose
+    manifest carries ``fields`` (the bad file, the snapshot name, the
+    step) — a 3am fallback-ladder hop is attributable to the exact
+    corrupt byte range that caused it."""
+    _M_QUARANTINED.labels(kind).inc()
+    _emit_event("snapshot.quarantined", what=kind, error=str(exc),
+                **fields)
+    _flight.record_failure("snapshot_quarantined", exc=exc, what=kind,
+                           **fields)
+
+
+def load_checksummed_json(path):
+    """Read + verify a checksummed sidecar file.  ``OSError`` (missing
+    file) passes through untouched — absence and corruption are
+    different failure classes and callers ladder them differently."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return verify_checksummed_json(data, path=path)

@@ -583,6 +583,26 @@ class KVStore(object):
         return {"epoch": self._async.topology_epoch,
                 "cutover_ms": plan.cutover_ms}
 
+    def snapshot(self, directory, step=None):
+        """Durable cluster snapshot: a consistent seqno-barrier cut of
+        every PS shard — values, optimizer slots, seqnos, membership
+        epoch — committed all-or-nothing under ``directory`` as a
+        ``snap-<step>`` record (see :mod:`mxnet_tpu.snapshot` for the
+        cut protocol, checksum manifest, and the restore ladder).  Like
+        :meth:`resize`, only ``dist_async`` with a live PS data plane
+        has shard state to capture.  Returns ``{"step", "path",
+        "save_ms", "frozen_ms", "epoch", "shards"}``."""
+        if self._async is None:
+            raise MXNetError(
+                "snapshot: kvstore type %r has no parameter-server "
+                "shards to capture (dist_async with a PS data plane "
+                "only)" % self._kind)
+        from . import snapshot as _snapshot
+
+        keys = [(_updater_key(k), tuple(self._store[k].shape))
+                for k in self._store]
+        return _snapshot.save(self._async, directory, keys, step=step)
+
     def num_dead_node(self, node_id):
         """Liveness probe (parity: ``kvstore.h:242`` /
         ``ps::Postoffice::get_num_dead_node``).
@@ -603,18 +623,19 @@ class KVStore(object):
                 raise MXNetError(
                     "dist_tpu has no optimizer state to save: call "
                     "set_optimizer first")
-            with open(fname, "wb") as fout:
-                fout.write(self._tpu.get_states())
+            from . import durable as _durable
+
+            _durable.atomic_write_bytes(fname, self._tpu.get_states())
             return
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
+        from . import durable as _durable
         from . import engine
 
         for v in self._key_vars.values():  # drain in-flight updates
             engine.wait_for_var(v)
         self._check_comm_error()
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states())
+        _durable.atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         if self._tpu is not None:

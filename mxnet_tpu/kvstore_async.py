@@ -1211,6 +1211,18 @@ class AsyncServer:
                                    "exports come from the primary"
                                    % (self.server_id, self.role)}, None
                 return self._resize_export_locked(msg), None
+            if op == "snapshot_export":
+                # read-only side of the consistent-cut protocol: same
+                # contract as resize_export — primary-only (followers
+                # may lag the seqno marks a cut is diffed against), and
+                # deliberately NOT dedup'd or replicated
+                if self.role != "primary":
+                    return {"ok": False, "not_primary": True,
+                            "epoch": self.epoch,
+                            "err": "snapshot_export: server s%d is %s — "
+                                   "snapshots cut from the primary"
+                                   % (self.server_id, self.role)}, None
+                return self._snapshot_export_locked(msg), None
             if op not in _REPLICATED_OPS:
                 return {"ok": False, "err": "unknown op %r" % op}, None
             # mutating client ops: primary-only, epoch-fenced
@@ -1338,6 +1350,35 @@ class AsyncServer:
                 "seqlist": [[_wire_key(k), int(self._seqnos.get(k, 0))]
                             for k in keys]}
         states = self._opt_states_locked(keys)
+        if states or self._opt_raw is not None:
+            raw = pickle.dumps({"states": states,
+                                "opt_raw": self._opt_raw})
+            resp["optimizer"] = raw
+            resp["mac"] = _optimizer_mac(self.secret, raw)
+        return resp
+
+    def _snapshot_export_locked(self, msg):
+        """Consistent-cut source: a full (warm pass) or dirty-delta (cut
+        pass, taken inside the group's frozen routing window) export of
+        every key this primary owns.  ``since`` carries the warm pass's
+        seqno marks as ``[[wire_key, seqno], ...]``: only keys whose
+        seqno advanced past their mark ship values again, so the frozen
+        window pays for the delta — never the full transfer, which
+        happened warm.  ``seqlist`` always covers every live key (the
+        cut's final marks, recorded into the snapshot).  Optimizer slots
+        ride the same HMAC-gated pickle as every executable payload."""
+        since = {_unwire_key(k): int(n)
+                 for k, n in (msg.get("since") or [])}
+        keys = sorted(self._store, key=repr)
+        seqlist = [[_wire_key(k), int(self._seqnos.get(k, 0))]
+                   for k in keys]
+        dirty = [k for k in keys
+                 if k not in since
+                 or int(self._seqnos.get(k, 0)) > since[k]]
+        resp = {"ok": True, "epoch": self.epoch,
+                "server_id": self.server_id, "seqlist": seqlist,
+                "pairs": [(k, _np.array(self._store[k])) for k in dirty]}
+        states = self._opt_states_locked(dirty)
         if states or self._opt_raw is not None:
             raw = pickle.dumps({"states": states,
                                 "opt_raw": self._opt_raw})

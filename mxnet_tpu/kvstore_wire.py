@@ -80,7 +80,8 @@ _OPCODES = {"init": 1, "push": 2, "pull": 3, "push_pull": 4,
             "set_optimizer": 5, "command": 6, "heartbeat": 7, "stats": 8,
             "shutdown": 9, "replicate": 10, "promote": 11,
             "sync_follower": 12, "resize_install": 13, "resize_retire": 14,
-            "resize_discard": 15, "resize_seal": 16, "resize_export": 17}
+            "resize_discard": 15, "resize_seal": 16, "resize_export": 17,
+            "snapshot_export": 18}
 _OPNAMES = {v: k for k, v in _OPCODES.items()}
 
 _K_NONE, _K_RAW, _K_INT8, _K_TOPK, _K_OPAQUE = 0, 1, 2, 3, 4

@@ -133,7 +133,9 @@ def _write_bundle(kind, exc, extra):
             v, (str, int, float, bool, type(None))) else v
             for k, v in extra.items()},
     }
-    with open(os.path.join(tmp, "manifest.json"), "w",
+    # atomicity lives at the bundle level: every file lands in the .tmp
+    # staging dir and one os.rename below commits the whole bundle
+    with open(os.path.join(tmp, "manifest.json"), "w",  # graftcheck: disable=atomic-write
               encoding="utf-8") as f:
         json.dump(manifest, f, indent=2)
     with open(os.path.join(tmp, "spans.json"), "w",

@@ -459,7 +459,9 @@ def default_rules():
     regression at terminal severity + codec-share threshold), and the
     error-budget burn-rate rules
     (:func:`~.slo.burn_rules`: fast-burn terminal, slow-burn warning,
-    for each default SLO).  Thresholds come from the
+    for each default SLO), and the durable-state quarantine rule (any
+    ``snapshot_quarantined_total`` increase is corrupt training state
+    on disk).  Thresholds come from the
     ``MXNET_TPU_WATCHDOG_*`` / ``MXNET_TPU_SLO_*`` env rows
     (docs/env_vars.md)."""
     from . import slo as _slo   # function-level: slo imports this module
@@ -504,6 +506,16 @@ def default_rules():
              description="model FLOPs utilization fell below its own "
                          "rolling baseline / MXNET_TPU_WATCHDOG_MFU_"
                          "FACTOR (hardware efficiency regressed)"),
+        Rule("snapshot_quarantine", "snapshot_quarantined_total",
+             kind="increase",
+             threshold=_env_float(
+                 "MXNET_TPU_WATCHDOG_QUARANTINE_MAX", 0.0),
+             window_s=3600.0, severity="critical",
+             description="durable state (a snapshot or checkpoint) "
+                         "failed integrity verification and was "
+                         "quarantined — the restore ladder is burning "
+                         "through history; the snapshot_quarantined "
+                         "flight bundle names the corrupt file"),
         Rule("goodput_floor", "goodput_ratio", op="<", skip_zero=True,
              threshold=_env_float("MXNET_TPU_WATCHDOG_GOODPUT_FLOOR",
                                   0.5),

@@ -22,10 +22,13 @@ import os
 import jax
 
 from .. import chaos as _chaos
+from .. import durable as _durable
+from ..base import CheckpointCorruptError
 from ..observability.events import emit as _emit_event
 
 __all__ = ["save_sharded", "restore_sharded", "latest_step", "all_steps",
-           "save_fit_meta", "load_fit_meta", "close_all"]
+           "save_fit_meta", "load_fit_meta", "verify_checkpoint",
+           "close_all"]
 
 # one live CheckpointManager per directory: retention (max_to_keep) applies,
 # async saves overlap training, and manager startup is amortized
@@ -82,6 +85,10 @@ def save_sharded(directory, step, params, moms=None, aux=None, wait=True,
                  wait=bool(wait))
     if wait:
         mgr.wait_until_finished()
+        # integrity manifest over the finished step directory, written
+        # atomically BEFORE the bit-rot chaos below so injected rot is
+        # always detectable by verify_checkpoint
+        _write_ckpt_manifest(directory, step)
         # corrupt-mode counterpart (bit-rot / torn write): garble the
         # written step's largest shard so restore-time validation and the
         # previous-checkpoint fallback are testable
@@ -109,27 +116,99 @@ def _meta_path(directory, step):
     return os.path.join(directory, "fit-meta-%d.json" % int(step))
 
 
+def _manifest_path(directory, step):
+    return os.path.join(directory, "ckpt-manifest-%d.json" % int(step))
+
+
 def save_fit_meta(directory, step, meta):
-    """Write the fit-loop position for ``step`` as a JSON sidecar next to
-    the orbax step directory (kept OUT of the orbax tree so old
-    checkpoints without it still restore).  Atomic rename so a crash
-    mid-write leaves no torn sidecar."""
+    """Write the fit-loop position for ``step`` as a checksummed JSON
+    sidecar next to the orbax step directory (kept OUT of the orbax tree
+    so old checkpoints without it still restore).  tmp + fsync + atomic
+    rename so a mid-write kill leaves either the previous sidecar or the
+    full new one, and the embedded sha256 makes a later bit flip a typed
+    ``CheckpointCorruptError`` instead of silently-wrong loop state."""
     os.makedirs(directory, exist_ok=True)
-    path = _meta_path(directory, step)
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        _json.dump(meta, f)
-    os.replace(tmp, path)
+    _durable.atomic_write_bytes(_meta_path(directory, step),
+                                _durable.checksummed_json_bytes(meta))
 
 
 def load_fit_meta(directory, step):
-    """The fit-loop position saved for ``step``, or None (pre-sidecar
-    checkpoint / torn file)."""
+    """The fit-loop position saved for ``step``; None for a pre-sidecar
+    checkpoint (no sidecar file at all).  A sidecar that EXISTS but fails
+    its checksum — or does not parse — raises the typed
+    ``CheckpointCorruptError``: silently treating a rotted sidecar as
+    "pre-sidecar" would resume at the wrong batch with the wrong RNG
+    stream, which is exactly the corruption class this layer exists to
+    catch.  Pre-Round-18 sidecars (valid JSON, no ``sha256`` field)
+    still load — nothing to verify."""
+    path = _meta_path(directory, step)
     try:
-        with open(_meta_path(directory, step), encoding="utf-8") as f:
-            return _json.load(f)
-    except (OSError, ValueError):
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
         return None
+    try:
+        obj = _json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise CheckpointCorruptError(
+            "fit-meta sidecar for step %d is torn or garbled (%s)"
+            % (int(step), path), path=path, file=path) from exc
+    if isinstance(obj, dict) and "sha256" in obj:
+        return _durable.verify_checksummed_json(data, path=path)
+    return obj
+
+
+def _write_ckpt_manifest(directory, step):
+    """Record every file of the finished orbax step directory (relative
+    path, size, sha256) in an atomically-written, self-checksummed
+    manifest — the restore gate's ground truth."""
+    step_dir = os.path.join(directory, str(int(step)))
+    if not os.path.isdir(step_dir):
+        return None
+    files = []
+    for root, _dirs, names in os.walk(step_dir):
+        for fn in sorted(names):
+            p = os.path.join(root, fn)
+            files.append({"path": os.path.relpath(p, step_dir),
+                          "bytes": os.path.getsize(p),
+                          "sha256": _durable.file_sha256(p)})
+    manifest = {"format": "mxnet-tpu-ckpt-manifest-v1", "step": int(step),
+                "files": files}
+    return _durable.atomic_write_bytes(
+        _manifest_path(directory, step),
+        _durable.checksummed_json_bytes(manifest))
+
+
+def verify_checkpoint(directory, step):
+    """Verify a saved step against its integrity manifest.
+
+    Returns True when every recorded file matches its sha256, False for
+    a legacy step with no manifest (nothing to verify — callers decide
+    whether unverified is acceptable), and raises
+    ``CheckpointCorruptError`` naming the first bad file on any
+    mismatch, truncation, or manifest rot."""
+    path = _manifest_path(directory, step)
+    try:
+        manifest = _durable.load_checksummed_json(path)
+    except OSError:
+        return False
+    step_dir = os.path.join(directory, str(int(step)))
+    for entry in manifest.get("files", []):
+        p = os.path.join(step_dir, entry["path"])
+        try:
+            size = os.path.getsize(p)
+        except OSError as exc:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: manifest names %r but it is missing"
+                % (int(step), entry["path"]),
+                path=step_dir, file=entry["path"]) from exc
+        if size != entry["bytes"] or \
+                _durable.file_sha256(p) != entry["sha256"]:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: %r fails its manifest checksum "
+                "(torn write or bit rot)" % (int(step), entry["path"]),
+                path=step_dir, file=entry["path"])
+    return True
 
 
 def _ckpt_tree(mgr, step):

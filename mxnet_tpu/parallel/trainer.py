@@ -1022,17 +1022,46 @@ class ShardedTrainer:
             raise MXNetError("resume must be None or 'auto', got %r"
                              % (resume,))
         if resume == "auto" and checkpoint_dir is not None:
+            from .. import durable as _durable
+            from ..base import CheckpointCorruptError as _CkptCorrupt
+
+            _state_in = state  # restored on every fallback hop
             for ckpt_step in reversed(_ckpt.all_steps(checkpoint_dir)):
                 try:
+                    verified = _ckpt.verify_checkpoint(checkpoint_dir,
+                                                       ckpt_step)
                     state = _ckpt.restore_sharded(checkpoint_dir, ckpt_step,
                                                   trainer=self)
+                    resume_meta = _ckpt.load_fit_meta(checkpoint_dir,
+                                                      ckpt_step)
+                except _CkptCorrupt as exc:
+                    state = _state_in
+                    _durable.quarantine(
+                        "checkpoint", exc, step=int(ckpt_step),
+                        directory=str(checkpoint_dir),
+                        file=getattr(exc, "file", None))
+                    log.warning(
+                        "resume: checkpoint step %d failed integrity "
+                        "verification (%s); falling back to the previous "
+                        "checkpoint", ckpt_step, exc)
+                    continue
                 except Exception as exc:  # noqa: BLE001 — fall back a step
                     log.warning(
                         "resume: checkpoint step %d failed validation "
                         "(%r); falling back to the previous checkpoint",
                         ckpt_step, exc)
                     continue
-                resume_meta = _ckpt.load_fit_meta(checkpoint_dir, ckpt_step)
+                if resume_meta is None and verified:
+                    # manifest-era checkpoint with its sidecar missing:
+                    # the save was killed between the shard write and the
+                    # meta write — its loop position is unknowable, so
+                    # fall back to the previous intact step
+                    state = _state_in
+                    log.warning(
+                        "resume: checkpoint step %d has a manifest but no "
+                        "fit-meta sidecar (save killed mid-write); falling "
+                        "back to the previous checkpoint", ckpt_step)
+                    continue
                 if resume_meta is None:
                     # pre-sidecar checkpoint: its step number is an epoch
                     # boundary (the historical epoch+1 numbering) and the
@@ -1724,18 +1753,44 @@ class ShardedTrainer:
             raise MXNetError("resume must be None or 'auto', got %r"
                              % (resume,))
         if resume == "auto" and checkpoint_dir is not None:
+            from .. import durable as _durable
+            from ..base import CheckpointCorruptError as _CkptCorrupt
+
+            _state_in = state  # restored on every fallback hop
             for ckpt_step in reversed(_ckpt.all_steps(checkpoint_dir)):
                 try:
+                    verified = _ckpt.verify_checkpoint(checkpoint_dir,
+                                                       ckpt_step)
                     state = _ckpt.restore_sharded(checkpoint_dir,
                                                   ckpt_step, trainer=self)
+                    resume_meta = _ckpt.load_fit_meta(checkpoint_dir,
+                                                      ckpt_step)
+                except _CkptCorrupt as exc:
+                    state = _state_in
+                    _durable.quarantine(
+                        "checkpoint", exc, step=int(ckpt_step),
+                        directory=str(checkpoint_dir),
+                        file=getattr(exc, "file", None))
+                    log.warning(
+                        "resume: checkpoint step %d failed integrity "
+                        "verification (%s); falling back to the previous "
+                        "checkpoint", ckpt_step, exc)
+                    continue
                 except Exception as exc:  # noqa: BLE001 — fall back a step
                     log.warning(
                         "resume: checkpoint step %d failed validation "
                         "(%r); falling back to the previous checkpoint",
                         ckpt_step, exc)
                     continue
-                resume_meta = _ckpt.load_fit_meta(checkpoint_dir,
-                                                  ckpt_step)
+                if resume_meta is None and verified:
+                    # manifest-era step with no sidecar: the save was
+                    # killed between shard and meta writes — fall back
+                    state = _state_in
+                    log.warning(
+                        "resume: checkpoint step %d has a manifest but no "
+                        "fit-meta sidecar (save killed mid-write); falling "
+                        "back to the previous checkpoint", ckpt_step)
+                    continue
                 log.info("resume: restored checkpoint step %d", ckpt_step)
                 break
             else:

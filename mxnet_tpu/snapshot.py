@@ -1,0 +1,686 @@
+"""Durable cluster snapshots: consistent cuts of a live async PS,
+all-or-nothing commits, checksum-verified restore onto any topology.
+
+The trainer-side sharded checkpoints (``parallel/checkpoint.py``) cover
+the model replica; this module covers the OTHER half of PAPER.md §1
+layer 8's durable responsibility — the parameter server, whose primaries
+hold the authoritative weights, per-key seqnos, server-side optimizer
+slots and membership epoch.  A whole-cluster loss without this layer
+loses everything since the last trainer save.
+
+**Consistent cut.**  :class:`SnapshotPlan` reuses the two-phase shape of
+``elastic.ResizePlan``:
+
+1. *prepare* (warm): every shard primary answers a ``snapshot_export``
+   RPC with its full state — values, seqnos, HMAC-gated optimizer
+   slots — while training keeps pushing.  The returned seqnos are the
+   warm marks.
+2. *cut* (frozen): inside the group's routing lock, each shard exports
+   again with ``since=<warm marks>`` and returns only the keys whose
+   seqno advanced — the dirty delta — plus the final seqno list.  The
+   frozen window pays for the delta, never the transfer; its wall time
+   is ``plan.frozen_ms`` (the bench's ``snapshot_frozen_ms``).
+
+The merged cut is a seqno-barrier-consistent image of the whole group:
+for every key, the value at its recorded seqno, with matching optimizer
+state and the membership epoch.
+
+**All-or-nothing commit.**  Shard files are the PR-17 ``kvstore_wire``
+binary record format, staged in a ``snap-<step>.tmp`` directory, every
+file written through ``durable.atomic_write_bytes`` (tmp + fsync +
+atomic rename; the ``storage.write`` chaos site drills torn writes, bit
+flips, ENOSPC and slow fsync here).  A self-checksummed manifest
+recording each file's sha256 is written LAST, then one directory rename
+makes the snapshot visible.  Readers only ever see ``snap-<N>``
+directories with a complete manifest — never a half-snapshot.
+
+**Verified restore, quarantine, fallback ladder.**  ``restore_latest``
+walks snapshots newest-first; each candidate is checksum-verified
+end-to-end before a single byte reaches a server.  A mismatch raises
+the typed ``CheckpointCorruptError``, renames the snapshot to
+``*.quarantined`` and books it (``snapshot_quarantined_total``, a
+``snapshot.quarantined`` ops event, a flight bundle naming the bad
+file), then the ladder falls back to the next-newest intact snapshot.
+
+**Topology-change restore.**  A snapshot saved at S shards restores
+into S′: values (and, slot-wise, optimizer state) are reassembled from
+the saved striping and re-cut with ``elastic._placement`` under the
+live group's shard count, installed via the idempotent
+``resize_install`` op, and the group's stripe routing table is seeded
+to match — ``tools/dr_drill.py`` proves the continuation is bitwise
+equal to an uninterrupted run.
+
+Note the snapshot carries pickled optimizer payloads (like the live
+``set_optimizer`` wire op); snapshot directories are trusted state, the
+same trust class as checkpoint files.
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import hashlib
+import os
+import pickle
+import shutil
+import time
+
+import numpy as _np
+
+from . import chaos as _chaos
+from . import durable as _durable
+from . import elastic as _elastic
+from . import kvstore_async as _ka
+from . import kvstore_wire as _wire
+from .base import CheckpointCorruptError, MXNetError
+from .observability import metrics as _metrics
+from .observability.events import emit as _emit_event
+
+__all__ = ["SnapshotPlan", "save", "restore_latest", "restore_path",
+           "list_snapshots", "verify", "quarantine_snapshot", "gc"]
+
+_M_SAVE = _metrics.histogram(
+    "snapshot_save_seconds",
+    "End-to-end wall time of a PS snapshot save (warm export + cut + "
+    "committed write)")
+_M_FROZEN = _metrics.histogram(
+    "snapshot_frozen_seconds",
+    "Routing-frozen cut window of a PS snapshot — the dirty-delta pass "
+    "only; training pushes proceed outside it")
+_M_RESTORE = _metrics.histogram(
+    "snapshot_restore_seconds",
+    "End-to-end wall time of a verified PS snapshot restore (checksum "
+    "walk + re-stripe + install)")
+
+_FORMAT = "mxnet-tpu-snapshot-v1"
+_MANIFEST = "manifest.json"
+
+
+def _keep():
+    return max(1, int(os.environ.get("MXNET_TPU_SNAPSHOT_KEEP", "3")))
+
+
+def _verify_on_save():
+    return os.environ.get("MXNET_TPU_SNAPSHOT_VERIFY", "1") != "0"
+
+
+def _snap_name(step):
+    return "snap-%d" % int(step)
+
+
+def _shard_name(i):
+    return "shard-%05d.bin" % int(i)
+
+
+def _state_key(wk):
+    return _elastic._state_key(wk)
+
+
+# -- the two-phase consistent cut ----------------------------------------
+
+
+class SnapshotPlan:
+    """Coordinated snapshot of a live :class:`~mxnet_tpu.kvstore_async.
+    ServerGroup` into ``directory``.
+
+    ``keys`` is the full ``[(key, shape), ...]`` inventory of the store
+    (``KVStore.snapshot`` derives it from its local mirror) — recorded
+    in the manifest so a restore can re-stripe onto any shard count.
+    Typical use::
+
+        plan = SnapshotPlan(group, directory, keys, step=global_step)
+        plan.run()         # prepare + cut + write + retention GC
+        plan.frozen_ms     # the number to keep small
+
+    ``prepare``/``cut``/``write`` are also public so callers (and the
+    DR drill) can overlap training with the warm pass exactly.
+    """
+
+    def __init__(self, group, directory, keys, step=None, secret=None):
+        self._group = group
+        self._directory = str(directory)
+        self._keys = [(k, tuple(int(d) for d in s)) for k, s in keys]
+        self._secret = secret or group._secret \
+            or os.environ.get("MXNET_TPU_PS_SECRET")
+        self._clients = {}
+        if step is None:
+            steps = [s for s, _ in list_snapshots(self._directory)]
+            step = (max(steps) + 1) if steps else 1
+        self.step = int(step)
+        # per-shard cut state: spec -> {"seqlist": {wk: seq},
+        # "pairs": {wk: np.ndarray}, "states": {state_key: slot}}
+        self._shards = {}
+        self._opt_raw = None
+        self._epoch = 0
+        self.state = "new"
+        self.frozen_ms = None
+        self.save_ms = None
+        self.path = None
+
+    # -- side-channel RPC plumbing (same shape as ResizePlan) -----------
+
+    def _client(self, spec):
+        cli = self._clients.get(spec)
+        if cli is None:
+            reps = spec.split("|")
+            rank = -next(_ka._rejoin_ranks)
+            if len(reps) > 1:
+                cli = _ka.ReplicatedClient(reps, rank, heartbeat=False,
+                                           secret=self._secret)
+            else:
+                cli = _ka.AsyncClient(reps[0], rank, heartbeat=False,
+                                      secret=self._secret)
+            self._clients[spec] = cli
+        return cli
+
+    def close(self):
+        for cli in self._clients.values():
+            cli.close()
+        self._clients = {}
+
+    def _take_export(self, spec, resp):
+        """Merge one ``snapshot_export`` response (full or delta) into
+        the shard's staged cut."""
+        shard = self._shards.setdefault(
+            spec, {"seqlist": {}, "pairs": {}, "states": {}})
+        shard["seqlist"] = {_ka._unwire_key(k): int(n)
+                            for k, n in resp.get("seqlist", [])}
+        for wk, val in resp.get("pairs", []):
+            shard["pairs"][wk] = _np.array(val, copy=True)
+        raw = resp.get("optimizer")
+        if raw is not None:
+            import hmac as _hmaclib
+
+            mac = _ka._optimizer_mac(self._secret or "", raw)
+            if not _hmaclib.compare_digest(resp.get("mac", ""), mac):
+                raise MXNetError(
+                    "snapshot export rejected: bad or missing HMAC on "
+                    "the optimizer-state payload (shards must share the "
+                    "per-job secret)")
+            payload = pickle.loads(raw)
+            shard["states"].update(payload.get("states", {}))
+            if payload.get("opt_raw") is not None:
+                self._opt_raw = payload["opt_raw"]
+        self._epoch = max(self._epoch, int(resp.get("epoch", 0)))
+
+    # -- phase 1: warm pass ---------------------------------------------
+
+    def prepare(self):
+        """Full export from every shard primary while training keeps
+        pushing; the returned seqnos become the cut's warm marks."""
+        if self.state != "new":
+            raise MXNetError("SnapshotPlan.prepare: plan is %s"
+                             % self.state)
+        self._t0 = time.monotonic()
+        try:
+            for spec in list(self._group._specs):
+                resp = self._client(spec)._call({"op": "snapshot_export"})
+                self._take_export(spec, resp)
+        except Exception:
+            self.state = "failed"
+            raise
+        self.state = "prepared"
+        _emit_event("snapshot", phase="prepared", step=self.step,
+                    group=",".join(self._group.group_id),
+                    shards=len(self._shards))
+        return self
+
+    # -- phase 2: the frozen cut ----------------------------------------
+
+    def cut(self):
+        """Dirty-delta export inside the routing lock: every key whose
+        seqno advanced past its warm mark ships again, everything else
+        is already staged — the frozen window is the delta, not the
+        transfer."""
+        if self.state != "prepared":
+            raise MXNetError("SnapshotPlan.cut: plan is %s" % self.state)
+        t0 = time.monotonic()
+        try:
+            with self._group.routing_frozen():
+                for spec in list(self._group._specs):
+                    marks = self._shards.get(spec, {}).get("seqlist", {})
+                    since = [[_ka._wire_key(k), int(n)]
+                             for k, n in marks.items()]
+                    resp = self._client(spec)._call(
+                        {"op": "snapshot_export", "since": since})
+                    self._take_export(spec, resp)
+        except Exception:
+            self.state = "failed"
+            raise
+        dt = time.monotonic() - t0
+        self.frozen_ms = dt * 1000.0
+        _M_FROZEN.observe(dt)
+        self.state = "cut"
+        _emit_event("snapshot", phase="cut", step=self.step,
+                    group=",".join(self._group.group_id),
+                    frozen_ms=round(self.frozen_ms, 3), epoch=self._epoch)
+        return self
+
+    # -- commit ----------------------------------------------------------
+
+    def write(self):
+        """Serialize the cut to disk: binary shard records + a
+        self-checksummed manifest, staged in a ``.tmp`` directory and
+        made visible by one atomic rename.  Any failure (a seeded
+        ``storage.write`` ENOSPC included) removes the staging directory
+        and re-raises — the previous snapshot is untouched."""
+        if self.state != "cut":
+            raise MXNetError("SnapshotPlan.write: plan is %s" % self.state)
+        final = os.path.join(self._directory, _snap_name(self.step))
+        staging = final + ".tmp"
+        os.makedirs(self._directory, exist_ok=True)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        try:
+            files = []
+            specs = list(self._group._specs)
+            for i, spec in enumerate(specs):
+                shard = self._shards.get(
+                    spec, {"seqlist": {}, "pairs": {}, "states": {}})
+                frame = _wire.encode_frame({
+                    "op": "snapshot_shard", "shard": i, "spec": spec,
+                    "epoch": self._epoch,
+                    "seqlist": [[_ka._wire_key(k), int(n)]
+                                for k, n in sorted(
+                                    shard["seqlist"].items(), key=repr)],
+                    "pairs": sorted(shard["pairs"].items(),
+                                    key=lambda kv: repr(kv[0])),
+                    "optimizer": pickle.dumps(
+                        {"states": shard["states"]}),
+                })
+                name = _shard_name(i)
+                # checksum the in-memory bytes BEFORE the write: a bit
+                # flip on the way to disk (the storage.write corrupt
+                # fault, real torn writes) must MISmatch the manifest,
+                # not be checksummed into legitimacy
+                digest = hashlib.sha256(frame).hexdigest()
+                _durable.atomic_write_bytes(
+                    os.path.join(staging, name), frame)
+                files.append({"path": name, "bytes": len(frame),
+                              "sha256": digest})
+            manifest = {
+                "format": _FORMAT, "step": self.step,
+                "epoch": self._epoch, "shards": len(specs),
+                "specs": specs, "bound": int(self._group._bound),
+                "keys": [[_ka._wire_key(k), list(s)]
+                         for k, s in self._keys],
+                "opt_raw_b64": (_b64.b64encode(self._opt_raw).decode()
+                                if self._opt_raw is not None else None),
+                "created": time.time(), "files": files,
+            }
+            _durable.atomic_write_bytes(
+                os.path.join(staging, _MANIFEST),
+                _durable.checksummed_json_bytes(manifest))
+            # the commit point: one rename makes the snapshot visible
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.rename(staging, final)
+            _durable._fsync_dir(self._directory)
+        except Exception:
+            shutil.rmtree(staging, ignore_errors=True)
+            self.state = "failed"
+            raise
+        self.path = final
+        self.save_ms = (time.monotonic() - self._t0) * 1000.0
+        _M_SAVE.observe(self.save_ms / 1000.0)
+        self.state = "committed"
+        _emit_event("snapshot", phase="committed", step=self.step,
+                    path=final, shards=len(specs), epoch=self._epoch,
+                    save_ms=round(self.save_ms, 3),
+                    frozen_ms=round(self.frozen_ms or 0.0, 3))
+        if _verify_on_save():
+            try:
+                verify(final)
+            except CheckpointCorruptError as exc:
+                # the bytes on disk are not the bytes we cut: fail the
+                # save loudly NOW and pull the corpse out of the ladder
+                self.state = "failed"
+                quarantine_snapshot(final, exc)
+                raise
+        # post-commit bit-rot drill: a seeded corrupt rule on the
+        # storage site garbles the committed snapshot (the restore
+        # ladder's quarantine path is what it exercises)
+        _chaos.corrupt_file("storage.write", final)
+        return self
+
+    def run(self):
+        """prepare + cut + write + retention GC; closes the side-channel
+        clients in every outcome."""
+        try:
+            self.prepare()
+            self.cut()
+            self.write()
+        finally:
+            self.close()
+        gc(self._directory)
+        return self
+
+
+def save(group, directory, keys, step=None, secret=None):
+    """One-call snapshot: returns ``{"step", "path", "save_ms",
+    "frozen_ms", "epoch", "shards"}``."""
+    plan = SnapshotPlan(group, directory, keys, step=step, secret=secret)
+    plan.run()
+    return {"step": plan.step, "path": plan.path,
+            "save_ms": plan.save_ms, "frozen_ms": plan.frozen_ms,
+            "epoch": plan._epoch, "shards": len(plan._shards)}
+
+
+# -- on-disk inventory, verification, quarantine, GC ---------------------
+
+
+def list_snapshots(directory):
+    """Committed snapshots under ``directory`` as ascending
+    ``[(step, path)]`` — only ``snap-<N>`` directories containing a
+    manifest count (a mid-rename kill leaves a ``.tmp`` staging dir,
+    which is invisible here)."""
+    out = []
+    if not os.path.isdir(directory):
+        return out
+    for name in os.listdir(directory):
+        if not name.startswith("snap-") or name.endswith(".tmp") \
+                or name.endswith(".quarantined"):
+            continue
+        try:
+            step = int(name[len("snap-"):])
+        except ValueError:
+            continue
+        path = os.path.join(directory, name)
+        if os.path.isfile(os.path.join(path, _MANIFEST)):
+            out.append((step, path))
+    return sorted(out)
+
+
+def verify(path):
+    """End-to-end integrity check of one snapshot directory: the
+    manifest's self-checksum, then every shard file's recorded size and
+    sha256.  Returns the manifest dict; raises
+    ``CheckpointCorruptError`` naming the first bad file."""
+    manifest = _durable.load_checksummed_json(
+        os.path.join(path, _MANIFEST))
+    if manifest.get("format") != _FORMAT:
+        raise CheckpointCorruptError(
+            "snapshot %s: unknown manifest format %r"
+            % (path, manifest.get("format")), path=path, file=_MANIFEST)
+    for entry in manifest.get("files", []):
+        p = os.path.join(path, entry["path"])
+        try:
+            size = os.path.getsize(p)
+        except OSError as exc:
+            raise CheckpointCorruptError(
+                "snapshot %s: manifest names %r but it is missing"
+                % (path, entry["path"]), path=path,
+                file=entry["path"]) from exc
+        if size != entry["bytes"] \
+                or _durable.file_sha256(p) != entry["sha256"]:
+            raise CheckpointCorruptError(
+                "snapshot %s: %r fails its manifest checksum (torn "
+                "write or bit rot)" % (path, entry["path"]),
+                path=path, file=entry["path"])
+    return manifest
+
+
+def quarantine_snapshot(path, exc):
+    """Move a corrupt snapshot out of the restore ladder's sight
+    (rename to ``*.quarantined``) and book the event in every ops
+    channel.  Returns the quarantined path."""
+    dest = path + ".quarantined"
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+    os.rename(path, dest)
+    _durable.quarantine("snapshot", exc, snapshot=os.path.basename(path),
+                        path=dest, file=getattr(exc, "file", None))
+    return dest
+
+
+def gc(directory, keep=None):
+    """Retention: delete the oldest committed snapshots beyond ``keep``
+    (``MXNET_TPU_SNAPSHOT_KEEP``, default 3), plus any leftover ``.tmp``
+    staging and surplus ``.quarantined`` directories.  Returns the
+    number of directories removed."""
+    keep = _keep() if keep is None else max(1, int(keep))
+    removed = 0
+    snaps = list_snapshots(directory)
+    for _step, path in snaps[:-keep] if len(snaps) > keep else []:
+        shutil.rmtree(path, ignore_errors=True)
+        removed += 1
+    if os.path.isdir(directory):
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("snap-"))
+        stale_tmp = [n for n in names if n.endswith(".tmp")]
+        quarantined = [n for n in names if n.endswith(".quarantined")]
+        for name in stale_tmp + quarantined[:-keep]:
+            shutil.rmtree(os.path.join(directory, name),
+                          ignore_errors=True)
+            removed += 1
+    return removed
+
+
+# -- restore: verify, reassemble, re-stripe, install ---------------------
+
+
+def _assemble(manifest, path):
+    """Read every shard record and reassemble per-base-key flat values,
+    seqnos and optimizer slots under the SAVED topology."""
+    keys = [(_ka._unwire_key(k), tuple(int(d) for d in s))
+            for k, s in manifest["keys"]]
+    saved_specs = list(manifest["specs"])
+    bound = int(manifest["bound"])
+    values, seqmap, states_old = {}, {}, {}
+    part_seq = {}
+    for i in range(int(manifest["shards"])):
+        with open(os.path.join(path, _shard_name(i)), "rb") as f:
+            frame = _wire.decode_frame(f.read())
+        for k, n in frame.get("seqlist", []):
+            part_seq[_ka._unwire_key(k)] = int(n)
+        for wk, val in frame.get("pairs", []):
+            values[wk] = _np.array(val, copy=True)
+        raw = frame.get("optimizer")
+        if raw is not None:
+            states_old.update(pickle.loads(raw).get("states", {}))
+    assembled = {}
+    old_place = {}
+    for key, shape in keys:
+        parts = _elastic._placement(saved_specs, key, shape, bound)
+        old_place[key] = parts
+        size = _elastic._prod(shape)
+        flat, seq = None, 0
+        for _idx, wk, sl in parts:
+            val = values.get(wk)
+            if val is None:
+                raise CheckpointCorruptError(
+                    "snapshot %s: part %r of key %r absent from its "
+                    "shard record" % (path, wk, key), path=path)
+            v = _np.asarray(val).ravel()
+            if flat is None:
+                flat = _np.zeros(size, dtype=v.dtype)
+            if sl is None:
+                flat[:] = v
+            else:
+                flat[sl[0]:sl[1]] = v
+            seq = max(seq, part_seq.get(wk, 0))
+        assembled[key] = (shape, flat, seq)
+    return assembled, old_place, states_old
+
+
+def _as_np(x):
+    """Optimizer slots are framework arrays (``NDArray`` wrappers around
+    jax buffers) — unwrap to numpy for the re-cut math."""
+    if hasattr(x, "asnumpy"):
+        return _np.asarray(x.asnumpy())
+    return _np.asarray(x)
+
+
+def _wrap_like(orig, arr):
+    """Re-wrap a re-cut numpy slot in the original's array type, so the
+    server-side updater gets back exactly what the optimizer created."""
+    if hasattr(orig, "asnumpy"):
+        import jax.numpy as _jnp
+
+        from .ndarray import NDArray as _NDArray
+
+        return _NDArray(_jnp.asarray(arr))
+    return arr
+
+
+def _restripe_state(key, shape, old_parts, new_parts, states_old):
+    """Optimizer slots for ``key`` re-cut from the saved striping to the
+    live one.  Slot arrays the same shape as their weight part (the
+    ``_NumpyUpdater`` contract) are reassembled flat and re-sliced —
+    momentum survives a shard-count change exactly.  Anything else
+    (scalar schedules, mismatched layouts) passes through only when the
+    geometry is unchanged.  Returns {state_key: slot} for the new parts.
+    """
+    same = [(wk, sl) for _i, wk, sl in old_parts] == \
+        [(wk, sl) for _i, wk, sl in new_parts]
+    olds = [states_old.get(_state_key(wk)) for _i, wk, _sl in old_parts]
+    if same:
+        return {_state_key(wk): st
+                for (_i, wk, _sl), st in zip(new_parts, olds)
+                if st is not None}
+    if any(st is None for st in olds):
+        return {}
+
+    def slots(st):
+        return tuple(st) if isinstance(st, (tuple, list)) else (st,)
+
+    was_tuple = isinstance(olds[0], (tuple, list))
+    nslots = {len(slots(st)) for st in olds}
+    if len(nslots) != 1:
+        return {}
+    nslots = nslots.pop()
+    size = _elastic._prod(shape)
+    exemplar = slots(olds[0])
+    flats = []
+    for j in range(nslots):
+        flat = None
+        for (_i, _wk, sl), st in zip(old_parts, olds):
+            a = _as_np(slots(st)[j])
+            want = size if sl is None else sl[1] - sl[0]
+            if a.size != want:
+                return {}  # not a per-element slot — can't re-cut
+            if flat is None:
+                flat = _np.zeros(size, dtype=a.dtype)
+            if sl is None:
+                flat[:] = a.ravel()
+            else:
+                flat[sl[0]:sl[1]] = a.ravel()
+        flats.append(flat)
+    out = {}
+    for _i, wk, sl in new_parts:
+        pieces = [_wrap_like(exemplar[j],
+                             f.reshape(shape) if sl is None
+                             else f[sl[0]:sl[1]])
+                  for j, f in enumerate(flats)]
+        out[_state_key(wk)] = tuple(pieces) if was_tuple else pieces[0]
+    return out
+
+
+def restore_path(path, group, secret=None, manifest=None):
+    """Install one VERIFIED snapshot into a live (possibly freshly
+    cold-started) ``ServerGroup`` whose shard count may differ from the
+    saved one.  Values, seqnos and optimizer slots are re-striped with
+    the same placement math routing uses; the group's stripe table and
+    topology epoch adopt the restored image."""
+    t0 = time.monotonic()
+    if manifest is None:
+        manifest = verify(path)
+    assembled, old_place, states_old = _assemble(manifest, path)
+    new_specs = list(group._specs)
+    bound = int(group._bound)
+    secret = secret or group._secret \
+        or os.environ.get("MXNET_TPU_PS_SECRET")
+    opt_raw = manifest.get("opt_raw_b64")
+    opt_raw = _b64.b64decode(opt_raw) if opt_raw else None
+
+    per_shard = {}   # shard idx -> [(wk, value, seqno)]
+    states_new = {}
+    striped = {}
+    for key, (shape, flat, seq) in assembled.items():
+        new_parts = _elastic._placement(new_specs, key, shape, bound)
+        if len(new_parts) > 1:
+            striped[key] = (shape, len(new_specs))
+        states_new.update(_restripe_state(
+            key, shape, old_place[key], new_parts, states_old))
+        for idx, wk, sl in new_parts:
+            val = (flat.reshape(shape) if sl is None
+                   else flat[sl[0]:sl[1]])
+            per_shard.setdefault(idx, []).append((wk, val, seq))
+
+    clients = {}
+
+    def client(spec):
+        if spec not in clients:
+            reps = spec.split("|")
+            rank = -next(_ka._rejoin_ranks)
+            clients[spec] = (
+                _ka.ReplicatedClient(reps, rank, heartbeat=False,
+                                     secret=secret)
+                if len(reps) > 1 else
+                _ka.AsyncClient(reps[0], rank, heartbeat=False,
+                                secret=secret))
+        return clients[spec]
+
+    try:
+        if opt_raw is not None:
+            for spec in new_specs:
+                client(spec).set_optimizer(opt_raw)
+        batch_n = _elastic._batch_keys()
+        for idx in sorted(per_shard):
+            spec = new_specs[idx]
+            for batch in _elastic._batched(per_shard[idx], batch_n):
+                msg = {"op": "resize_install",
+                       "pairs": [(wk, v) for wk, v, _ in batch],
+                       "seqlist": [[_ka._wire_key(wk), int(sq)]
+                                   for wk, _, sq in batch]}
+                states = {sk: states_new[sk]
+                          for sk in (_state_key(wk) for wk, _, _ in batch)
+                          if sk in states_new}
+                if states:
+                    raw = pickle.dumps({"states": states})
+                    msg["optimizer"] = raw
+                    msg["mac"] = _ka._optimizer_mac(secret or "", raw)
+                client(spec)._call(msg)
+    finally:
+        for cli in clients.values():
+            cli.close()
+
+    with group.routing_frozen():
+        group._striped.update(striped)
+        epoch = max(int(manifest.get("epoch", 0)), group.topology_epoch)
+        _elastic.publish_topology(group.group_id, new_specs, epoch)
+        group.adopt_topology(new_specs, epoch)
+    dt = time.monotonic() - t0
+    _M_RESTORE.observe(dt)
+    _emit_event("snapshot", phase="restored", step=int(manifest["step"]),
+                path=path, saved_shards=int(manifest["shards"]),
+                restored_shards=len(new_specs),
+                restore_ms=round(dt * 1000.0, 3))
+    return {"step": int(manifest["step"]), "path": path,
+            "epoch": int(manifest.get("epoch", 0)),
+            "saved_shards": int(manifest["shards"]),
+            "restored_shards": len(new_specs), "keys": len(assembled),
+            "restore_ms": dt * 1000.0}
+
+
+def restore_latest(directory, group, secret=None):
+    """The disaster-recovery ladder: walk committed snapshots newest
+    first, verify each end-to-end, quarantine every corrupt one, and
+    install the newest intact image.  Raises ``CheckpointCorruptError``
+    when NO intact snapshot remains (every candidate quarantined) and
+    ``MXNetError`` when the directory holds none at all."""
+    snaps = list_snapshots(directory)
+    if not snaps:
+        raise MXNetError("restore_latest: no committed snapshot under %r"
+                         % (directory,))
+    for step, path in reversed(snaps):
+        try:
+            manifest = verify(path)
+        except CheckpointCorruptError as exc:
+            quarantine_snapshot(path, exc)
+            continue
+        return restore_path(path, group, secret=secret,
+                            manifest=manifest)
+    raise CheckpointCorruptError(
+        "restore_latest: every snapshot under %r failed verification "
+        "and was quarantined" % (directory,), path=str(directory))

@@ -108,6 +108,24 @@ def test_bench_wire_keys():
     assert rec["codec_reconciles"] is True
 
 
+def test_bench_snapshot_keys():
+    """BENCH_SNAPSHOT=1: the schema-14 durability keys — save, frozen
+    window, and cold-restore-onto-3-shards latencies — all live on the
+    CPU smoke, with the re-stripe round-trip asserted inside the lane."""
+    rec = _run_bench({"BENCH_SNAPSHOT": "1", "BENCH_SNAPSHOT_KEYS": "8",
+                      "BENCH_SNAPSHOT_PUSHES": "64"})
+    assert rec["schema_version"] >= 14
+    assert rec["metric"] == "snapshot_save"
+    assert rec["unit"] == "ms"
+    assert rec["snapshot_save_ms"] > 0
+    assert rec["snapshot_restore_ms"] > 0
+    # the frozen window is the delta cut only — it must be a fraction
+    # of the full save, or the two-phase design has regressed into a
+    # stop-the-world snapshot
+    assert 0 < rec["snapshot_frozen_ms"] < rec["snapshot_save_ms"]
+    assert rec["snapshot_restripe_ok"] is True
+
+
 def test_bench_fairness_keys():
     """BENCH_FAIRNESS=1: the schema-12 multi-tenant keys — isolation
     ratio, quota shed rate, KV-affinity hit ratio — all live and

@@ -83,11 +83,14 @@ def test_gate_rejects_corrupt_checkpoint(tmp_path):
     reg = _registry(NpBackend(_params(9), "baseline"))
     dd = deployd.DeployDaemon(ckdir, reg, "m", _loader, probation_s=30.0)
     dec = dd.poll_once(now=100.0)
-    assert dec["action"] == "reject" and dec["reason"] == "restore"
+    # the integrity gate catches the garbled step BEFORE the loader: the
+    # committed manifest promises item dirs that are gone, so the reject
+    # reason is the typed "checksum", not an opaque restore failure
+    assert dec["action"] == "reject" and dec["reason"] == "checksum"
     # the candidate never touched traffic
     assert reg.get("m").backend.tag == "baseline"
     ev = obs.events(kind="deploy.reject")
-    assert ev and ev[-1].fields["reason"] == "restore"
+    assert ev and ev[-1].fields["reason"] == "checksum"
     rej = obs.REGISTRY.get("deployd_rejections_total")
     assert rej.total() == 1
     # rejected steps are not re-scanned

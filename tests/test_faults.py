@@ -465,6 +465,42 @@ def test_resume_falls_back_past_corrupt_checkpoint(tmp_path):
         assert np.isfinite(np.asarray(p[n])).all()
 
 
+def test_resume_skips_step_killed_between_shard_and_meta_writes(tmp_path):
+    """A kill between the shard write and the fit-meta sidecar write
+    leaves a checkpoint with a manifest but no sidecar.  resume='auto'
+    must treat that step as mid-save debris and fall back to the prior
+    intact step — byte-for-byte the same resume as if the torn step had
+    never been written."""
+    import shutil
+
+    X, Y = _data()
+    d_torn = str(tmp_path / "torn")
+    _trainer().fit(_iter(X, Y), num_epoch=2, seed=5, checkpoint_dir=d_torn,
+                   checkpoint_every=4, log_every=0)
+    steps = ckpt.all_steps(d_torn)
+    assert len(steps) >= 2
+    ckpt.close_all()
+    d_ref = str(tmp_path / "ref")
+    shutil.copytree(d_torn, d_ref)
+    # torn dir: the newest step kept its shards + manifest, lost its
+    # sidecar (the kill window).  ref dir: that step never happened.
+    os.remove(os.path.join(d_torn, "fit-meta-%d.json" % steps[-1]))
+    shutil.rmtree(os.path.join(d_ref, str(steps[-1])))
+    os.remove(os.path.join(d_ref, "fit-meta-%d.json" % steps[-1]))
+    os.remove(os.path.join(d_ref, "ckpt-manifest-%d.json" % steps[-1]))
+
+    (p_torn, _, _), _ = _trainer().fit(
+        _iter(X, Y), num_epoch=2, seed=5, checkpoint_dir=d_torn,
+        checkpoint_every=4, resume="auto", log_every=0)
+    ckpt.close_all()
+    (p_ref, _, _), _ = _trainer().fit(
+        _iter(X, Y), num_epoch=2, seed=5, checkpoint_dir=d_ref,
+        checkpoint_every=4, resume="auto", log_every=0)
+    for n in p_ref:
+        np.testing.assert_array_equal(np.asarray(p_torn[n]),
+                                      np.asarray(p_ref[n]))
+
+
 def test_nonfinite_guard_skips_and_aborts():
     X, Y = _data()
     Xbad = X.copy()

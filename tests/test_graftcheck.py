@@ -662,6 +662,55 @@ def test_cli_json_output(tmp_path, capsys):
     assert doc["rules"] == ["chaos-site"] and doc["findings"] == []
 
 
+# -- atomic-write -----------------------------------------------------------
+
+def test_atomic_write_durable_module_flagged(tmp_path):
+    """ANY write-mode open in a durable-state module is flagged; reads
+    and the atomic helpers' own tmp writes are exempt."""
+    root = _mini(tmp_path, {"mxnet_tpu/snapshot.py": """\
+        def save(path, data):
+            with open(path, "wb") as f:
+                f.write(data)
+
+        def load(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        def atomic_write_bytes(path, data):
+            with open(path + ".tmp", "wb") as f:
+                f.write(data)
+        """})
+    findings = _run(root, "atomic-write")
+    assert [(f.path, f.line) for f in findings] == [
+        (os.path.join("mxnet_tpu", "snapshot.py"), 2)]
+    assert "durable-state module" in findings[0].message
+
+
+def test_atomic_write_token_path_flagged_elsewhere(tmp_path):
+    """Outside the durable modules, only writes whose path expression
+    names durable-state tokens are flagged."""
+    root = _mini(tmp_path, {"mxnet_tpu/other.py": """\
+        def dump(d, log_path):
+            with open(d + "/manifest.json", "w") as f:
+                f.write("{}")
+            with open(log_path, "w") as f:
+                f.write("scratch log, not durable state")
+        """})
+    findings = _run(root, "atomic-write")
+    assert [(f.path, f.line) for f in findings] == [
+        (os.path.join("mxnet_tpu", "other.py"), 2)]
+
+
+def test_atomic_write_pragma_suppresses(tmp_path):
+    root = _mini(tmp_path, {"mxnet_tpu/other.py": """\
+        def dump(d):
+            # staged into a .tmp dir; one rename commits the bundle
+            with open(d + "/manifest.json", "w") as f:  # graftcheck: disable=atomic-write
+                f.write("{}")
+        """})
+    assert _run(root, "atomic-write") == []
+
+
 # -- the tier-1 gate: this repo stays clean ---------------------------------
 
 def test_whole_repo_zero_unbaselined(capsys):

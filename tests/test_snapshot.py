@@ -1,0 +1,394 @@
+"""Durable cluster snapshots: the consistent-cut plan lifecycle,
+all-or-nothing commits under seeded ``storage.write`` faults, the
+corruption matrix (one byte flipped in every durable file class must
+produce a typed error + quarantine + fallback), topology-change
+restores, retention GC, and the checkpoint-side kill-between-writes
+regression.
+
+Server fixtures mirror ``test_elastic.py``: real ``AsyncServer``
+threads on loopback, a tiny stripe bound so 'big' actually stripes.
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, snapshot
+from mxnet_tpu import durable
+from mxnet_tpu.base import CheckpointCorruptError, MXNetError
+from mxnet_tpu.kvstore_async import AsyncServer, ServerGroup
+from mxnet_tpu import observability as obs
+from mxnet_tpu.parallel import checkpoint as ckpt
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _fast_fsync(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SNAPSHOT_FSYNC", "0")
+
+
+def _servers(n, base=0):
+    return [AsyncServer(secret="sn", server_id=base + i).start()
+            for i in range(n)]
+
+
+def _group(servers, bound=1 << 6):
+    group = ServerGroup([s.address for s in servers], rank=0,
+                        heartbeat=False, secret="sn")
+    group._bound = bound
+    return group
+
+
+def _seed_group(group):
+    rs = np.random.RandomState(0)
+    w0 = np.arange(8).astype(np.float32)
+    big0 = rs.standard_normal((32, 8)).astype(np.float32)
+    group.init([("w", w0), ("big", big0)])
+    keys = [("w", (8,)), ("big", (32, 8))]
+    return keys, w0, big0
+
+
+def _pull_check(group, w0, big0):
+    out = group.pull(["w", "big"])
+    np.testing.assert_array_equal(np.asarray(out[0]).reshape(8), w0)
+    np.testing.assert_array_equal(
+        np.asarray(out[1]).reshape(32, 8), big0)
+
+
+def _flip_byte(path, offset=-8):
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x5A]))
+
+
+# ---------------------------------------------------------------------
+# plan lifecycle + commit protocol
+# ---------------------------------------------------------------------
+
+
+def test_snapshot_plan_lifecycle(tmp_path):
+    """Phase ordering is enforced, the committed snapshot verifies
+    end-to-end, steps auto-increment, the frozen window is measured
+    over the cut only."""
+    servers = _servers(2)
+    group = _group(servers)
+    keys, _w0, _big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    try:
+        plan = snapshot.SnapshotPlan(group, d, keys, step=3)
+        with pytest.raises(MXNetError, match="plan is new"):
+            plan.cut()
+        with pytest.raises(MXNetError, match="plan is new"):
+            plan.write()
+        plan.run()
+        assert plan.state == "committed"
+        assert plan.frozen_ms is not None and plan.frozen_ms >= 0.0
+        assert plan.save_ms >= plan.frozen_ms
+        assert snapshot.list_snapshots(d) == [
+            (3, os.path.join(d, "snap-3"))]
+        manifest = snapshot.verify(os.path.join(d, "snap-3"))
+        assert manifest["shards"] == 2 and manifest["step"] == 3
+        assert len(manifest["files"]) == 2
+        # a second save without an explicit step lands after the newest
+        res = snapshot.save(group, d, keys, secret="sn")
+        assert res["step"] == 4 and res["shards"] == 2
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+def test_restore_onto_different_shard_counts(tmp_path):
+    """A snapshot saved at S=2 restores bitwise-equal onto S'=3 and
+    S'=1 — striped keys are reassembled and re-cut with the live
+    group's placement."""
+    servers = _servers(2)
+    group = _group(servers)
+    keys, w0, big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    snapshot.save(group, d, keys, step=1, secret="sn")
+    group.shutdown()
+    for s in servers:
+        s.stop()
+    for n_new, base in ((3, 10), (1, 20)):
+        servers2 = _servers(n_new, base=base)
+        group2 = _group(servers2)
+        try:
+            out = snapshot.restore_latest(d, group2, secret="sn")
+            assert out["saved_shards"] == 2
+            assert out["restored_shards"] == n_new
+            _pull_check(group2, w0, big0)
+        finally:
+            group2.shutdown()
+            for s in servers2:
+                s.stop()
+
+
+def test_momentum_survives_topology_change(tmp_path):
+    """Server-side optimizer slots re-stripe with their weights: after
+    a S=2 → S'=3 restore, pushing the same gradient on the restored
+    group and on the uninterrupted original yields bitwise-equal
+    weights (momentum included)."""
+    servers = _servers(2)
+    group = _group(servers)
+    keys, _w0, _big0 = _seed_group(group)
+    opt = pickle.dumps(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                        rescale_grad=1.0, wd=0.0))
+    group.set_optimizer(opt)
+    rs = np.random.RandomState(7)
+    g1 = {"w": rs.standard_normal(8).astype(np.float32),
+          "big": rs.standard_normal((32, 8)).astype(np.float32)}
+    g2 = {"w": rs.standard_normal(8).astype(np.float32),
+          "big": rs.standard_normal((32, 8)).astype(np.float32)}
+    group.push(list(g1.items()))   # momentum now non-zero everywhere
+    group.pull(["w", "big"])       # barrier: updates applied
+    d = str(tmp_path / "snaps")
+    snapshot.save(group, d, keys, step=1, secret="sn")
+
+    # uninterrupted reference: one more identical push
+    group.push(list(g2.items()))
+    ref = group.pull(["w", "big"])
+
+    servers2 = _servers(3, base=10)
+    group2 = _group(servers2)
+    try:
+        snapshot.restore_latest(d, group2, secret="sn")
+        group2.push(list(g2.items()))
+        got = group2.pull(["w", "big"])
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        group.shutdown()
+        group2.shutdown()
+        for s in servers + servers2:
+            s.stop()
+
+
+# ---------------------------------------------------------------------
+# the corruption matrix
+# ---------------------------------------------------------------------
+
+
+def _two_snapshots(tmp_path):
+    servers = _servers(2)
+    group = _group(servers)
+    keys, w0, big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    snapshot.save(group, d, keys, step=1, secret="sn")
+    snapshot.save(group, d, keys, step=2, secret="sn")
+    group.shutdown()
+    for s in servers:
+        s.stop()
+    return d, keys, w0, big0
+
+
+@pytest.mark.parametrize("victim", ["shard-00000.bin", "manifest.json"])
+def test_corrupt_newest_falls_back_with_quarantine(tmp_path, monkeypatch,
+                                                   victim):
+    """One flipped byte in the newest snapshot (shard payload or
+    manifest): the typed error is raised internally, the snapshot is
+    quarantined through every ops channel — counter, event, flight
+    bundle naming the bad file — and the ladder restores the previous
+    intact snapshot."""
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(flight_dir))
+    d, _keys, w0, big0 = _two_snapshots(tmp_path)
+    _flip_byte(os.path.join(d, "snap-2", victim))
+    obs.clear_events()
+
+    servers = _servers(2, base=30)
+    group = _group(servers)
+    try:
+        out = snapshot.restore_latest(d, group, secret="sn")
+        assert out["step"] == 1
+        _pull_check(group, w0, big0)
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+    # exactly one quarantine: the corrupt dir moved out of the ladder
+    assert not os.path.isdir(os.path.join(d, "snap-2"))
+    assert os.path.isdir(os.path.join(d, "snap-2.quarantined"))
+    evs = obs.events(kind="snapshot.quarantined")
+    assert len(evs) == 1 and evs[0].fields["what"] == "snapshot"
+    assert 'snapshot_quarantined_total{kind="snapshot"} 1' \
+        in obs.metrics.dump_metrics()
+    bundles = [b for b in os.listdir(str(flight_dir))
+               if b.startswith("flight_snapshot_quarantined")]
+    assert len(bundles) == 1
+    with open(os.path.join(str(flight_dir), bundles[0],
+                           "manifest.json")) as f:
+        extra = json.load(f)["extra"]
+    assert extra["snapshot"] == "snap-2"
+    if victim != "manifest.json":   # manifest corruption can't name one
+        assert extra["file"] == victim
+
+
+def test_all_snapshots_corrupt_raises_typed(tmp_path):
+    """When every candidate fails verification the ladder exhausts with
+    the typed error (and everything is quarantined) — callers can
+    distinguish 'no snapshot' from 'only corrupt snapshots'."""
+    d, _keys, _w0, _big0 = _two_snapshots(tmp_path)
+    _flip_byte(os.path.join(d, "snap-1", "shard-00001.bin"))
+    _flip_byte(os.path.join(d, "snap-2", "shard-00000.bin"))
+    servers = _servers(2, base=40)
+    group = _group(servers)
+    try:
+        with pytest.raises(CheckpointCorruptError, match="every snapshot"):
+            snapshot.restore_latest(d, group, secret="sn")
+        with pytest.raises(MXNetError, match="no committed snapshot"):
+            snapshot.restore_latest(str(tmp_path / "empty"), group,
+                                    secret="sn")
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+    assert snapshot.list_snapshots(d) == []
+    assert os.path.isdir(os.path.join(d, "snap-1.quarantined"))
+    assert os.path.isdir(os.path.join(d, "snap-2.quarantined"))
+
+
+@pytest.mark.chaos
+def test_enospc_mid_save_aborts_clean(tmp_path):
+    """A seeded ``storage.write`` ENOSPC mid-snapshot aborts the save
+    with the native OSError, removes the staging directory, and leaves
+    the previous snapshot exactly as it was."""
+    servers = _servers(2)
+    group = _group(servers)
+    keys, w0, big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    try:
+        snapshot.save(group, d, keys, step=1, secret="sn")
+        before = snapshot.verify(os.path.join(d, "snap-1"))
+        with chaos.inject("storage.write", "drop", limit=1) as inj:
+            with pytest.raises(OSError) as ei:
+                snapshot.save(group, d, keys, step=2, secret="sn")
+            assert inj.fires == 1
+        import errno
+
+        assert ei.value.errno == errno.ENOSPC
+        # all-or-nothing: no snap-2, no staging litter, snap-1 intact
+        assert snapshot.list_snapshots(d) == [
+            (1, os.path.join(d, "snap-1"))]
+        assert not any(n.endswith(".tmp") for n in os.listdir(d))
+        assert snapshot.verify(os.path.join(d, "snap-1")) == before
+        # the same save succeeds once the fault clears
+        snapshot.save(group, d, keys, step=2, secret="sn")
+        assert [s for s, _ in snapshot.list_snapshots(d)] == [1, 2]
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
+def test_torn_write_fails_save_loudly(tmp_path):
+    """A seeded bit flip on the way to disk (corrupt mode at
+    ``storage.write``): the post-commit read-back verification catches
+    the mismatch AT SAVE TIME, quarantines the corpse, and raises the
+    typed error — silent rot never becomes the newest snapshot."""
+    servers = _servers(2)
+    group = _group(servers)
+    keys, _w0, _big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    try:
+        snapshot.save(group, d, keys, step=1, secret="sn")
+        with chaos.inject("storage.write", "corrupt", limit=1) as inj:
+            with pytest.raises(CheckpointCorruptError):
+                snapshot.save(group, d, keys, step=2, secret="sn")
+            assert inj.fires == 1
+        assert snapshot.list_snapshots(d) == [
+            (1, os.path.join(d, "snap-1"))]
+        assert os.path.isdir(os.path.join(d, "snap-2.quarantined"))
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
+def test_silent_bitrot_caught_by_restore_ladder(tmp_path, monkeypatch):
+    """With save-time verification off, the same torn write commits
+    silently corrupt — the restore ladder must still catch it by
+    checksum, quarantine, and fall back to the intact snapshot."""
+    monkeypatch.setenv("MXNET_TPU_SNAPSHOT_VERIFY", "0")
+    servers = _servers(2)
+    group = _group(servers)
+    keys, w0, big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    try:
+        snapshot.save(group, d, keys, step=1, secret="sn")
+        with chaos.inject("storage.write", "corrupt", limit=1) as inj:
+            snapshot.save(group, d, keys, step=2, secret="sn")
+            assert inj.fires == 1
+        assert [s for s, _ in snapshot.list_snapshots(d)] == [1, 2]
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+    servers2 = _servers(2, base=50)
+    group2 = _group(servers2)
+    try:
+        out = snapshot.restore_latest(d, group2, secret="sn")
+        assert out["step"] == 1
+        _pull_check(group2, w0, big0)
+    finally:
+        group2.shutdown()
+        for s in servers2:
+            s.stop()
+
+
+def test_gc_retention(tmp_path, monkeypatch):
+    """GC keeps MXNET_TPU_SNAPSHOT_KEEP newest snapshots and sweeps
+    stale staging dirs."""
+    monkeypatch.setenv("MXNET_TPU_SNAPSHOT_KEEP", "2")
+    servers = _servers(1)
+    group = _group(servers)
+    keys, _w0, _big0 = _seed_group(group)
+    d = str(tmp_path / "snaps")
+    try:
+        os.makedirs(os.path.join(d, "snap-9.tmp"))  # a dead staging dir
+        for step in (1, 2, 3, 4):
+            snapshot.save(group, d, keys, step=step, secret="sn")
+        assert [s for s, _ in snapshot.list_snapshots(d)] == [3, 4]
+        assert not os.path.isdir(os.path.join(d, "snap-9.tmp"))
+    finally:
+        group.shutdown()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------
+# checkpoint-side integrity (fit-meta sidecars, the kill regression)
+# ---------------------------------------------------------------------
+
+
+def test_fit_meta_corruption_is_typed(tmp_path):
+    """A flipped byte in a checksummed fit-meta sidecar raises the
+    typed error; a missing sidecar stays None (absence != corruption)."""
+    d = str(tmp_path)
+    ckpt.save_fit_meta(d, 3, {"epoch": 1, "nbatch": 7})
+    meta = ckpt.load_fit_meta(d, 3)
+    assert meta["epoch"] == 1 and meta["nbatch"] == 7
+    _flip_byte(os.path.join(d, "fit-meta-3.json"), offset=10)
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.load_fit_meta(d, 3)
+    assert ckpt.load_fit_meta(d, 99) is None
+
+
+def test_legacy_plain_json_fit_meta_still_loads(tmp_path):
+    """Pre-sidecar checkpoints carry plain-JSON fit metas with no
+    checksum; they must keep loading (upgrade compatibility)."""
+    d = str(tmp_path)
+    with open(os.path.join(d, "fit-meta-5.json"), "w") as f:
+        json.dump({"epoch": 2, "nbatch": 0}, f)
+    meta = ckpt.load_fit_meta(d, 5)
+    assert meta["epoch"] == 2

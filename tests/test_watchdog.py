@@ -302,10 +302,11 @@ def test_default_rules_clean_registry_fires_nothing():
     names = [r.name for r in wd.rules]
     assert names == ["spans_dropped", "heartbeat_stale",
                      "replication_lag", "step_p99_regression",
-                     "straggler", "mfu_regression", "goodput_floor",
+                     "straggler", "mfu_regression",
+                     "snapshot_quarantine", "goodput_floor",
                      "stream_stall",
                      "request_p99_slo", "inter_token_p99",
-                     "queue_saturation",
+                     "queue_saturation", "quota_shed_surge",
                      "wire_bytes_regression", "wire_codec_share",
                      "slo_availability_fast_burn",
                      "slo_availability_slow_burn",

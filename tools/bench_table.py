@@ -77,7 +77,14 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               # coalesce savings count the RPCs the fused push_pull
               # never sent — also up-is-good
               "kv_compress_ratio": True,
-              "kv_coalesce_rpcs_saved": True}
+              "kv_coalesce_rpcs_saved": True,
+              # schema-14 durability keys (BENCH_SNAPSHOT=1 rounds):
+              # all three are down-is-good latencies; frozen_ms is the
+              # one that blocks training, so a regression there is a
+              # direct goodput loss
+              "snapshot_save_ms": False,
+              "snapshot_restore_ms": False,
+              "snapshot_frozen_ms": False}
 TREND_TOLERANCE = 0.10
 
 

@@ -36,6 +36,11 @@ rule                  invariant enforced
                       is a registered family (or a federation-derived
                       exposition name), so golden files cannot drift from
                       the registry
+``atomic-write``      durable training state (snapshots, checkpoint
+                      manifests, fit-meta sidecars, optimizer dumps) is
+                      written through ``mxnet_tpu.durable``'s tmp +
+                      fsync + atomic-rename helpers, never a bare
+                      write-mode ``open`` that a crash can tear
 ====================  ====================================================
 
 Findings print as ``file:line rule message``; ``--json`` emits a machine

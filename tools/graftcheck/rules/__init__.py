@@ -6,6 +6,7 @@ adding a module here and one entry below (see
 docs/how_to/static_analysis.md "Adding a rule").
 """
 
+from .atomic_write import check_atomic_write
 from .envvars import check_env_var_registry
 from .chaos_sites import check_chaos_sites
 from .metrics_discipline import check_metrics_hot_path
@@ -22,6 +23,7 @@ ALL_RULES = {
     "lock-discipline": check_lock_discipline,
     "jit-purity": check_jit_purity,
     "golden-metrics": check_golden_metrics,
+    "atomic-write": check_atomic_write,
 }
 
 __all__ = ["ALL_RULES"]

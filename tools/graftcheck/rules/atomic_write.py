@@ -1,0 +1,114 @@
+"""atomic-write: durable training state reaches disk only through the
+``mxnet_tpu.durable`` tmp + fsync + atomic-rename helpers, never a bare
+``open(path, "w")``.
+
+A bare write-mode ``open`` is a torn-write generator: a crash (or a
+seeded ``storage.write`` chaos fault) between ``open`` and ``close``
+leaves a truncated file that a later ``resume="auto"``, snapshot
+restore, or deployd promotion gate trips over — exactly the corruption
+class PR 18's quarantine machinery exists to catch, so the write side
+must not manufacture it.  ``durable.atomic_write_bytes`` makes every
+durable write all-or-nothing; this rule closes the discipline
+statically.
+
+Two detection tiers:
+
+* **durable modules** (``mxnet_tpu/durable.py``, ``mxnet_tpu/
+  snapshot.py``, ``mxnet_tpu/parallel/checkpoint.py``,
+  ``mxnet_tpu/deployd.py``, ``mxnet_tpu/kvstore.py``): EVERY write-mode
+  ``open`` is flagged — these files exist to manage durable state.
+* **everywhere else under ``mxnet_tpu/``**: a write-mode ``open`` whose
+  path expression mentions a durable-state token (``manifest``,
+  ``snapshot``, ``fit_meta``/``fit-meta``, ``ckpt``, ``checkpoint``).
+
+Exemptions: code inside a function whose name contains ``atomic`` (the
+helpers' own tmp-file writes), read/append-less modes, and the usual
+``# graftcheck: disable=atomic-write`` pragma for writes that are
+genuinely scratch (document why at the pragma).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding
+
+RULE = "atomic-write"
+
+_DURABLE_MODULES = {
+    os.path.join("mxnet_tpu", "durable.py"),
+    os.path.join("mxnet_tpu", "snapshot.py"),
+    os.path.join("mxnet_tpu", "deployd.py"),
+    os.path.join("mxnet_tpu", "kvstore.py"),
+    os.path.join("mxnet_tpu", "parallel", "checkpoint.py"),
+}
+_TOKEN_RE = re.compile(
+    r"manifest|snapshot|fit[_-]meta|\bckpt\b|checkpoint", re.IGNORECASE)
+
+
+def _write_mode(call):
+    """The mode string of an ``open`` call when it writes, else None."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or \
+            not isinstance(mode.value, str):
+        return None
+    return mode.value if any(c in mode.value for c in "wax+") else None
+
+
+def _walk_with_funcs(tree):
+    """(node, enclosing function-name chain) pairs, depth first."""
+    stack = []
+
+    def visit(node):
+        yield node, tuple(stack)
+        is_func = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_func:
+            stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        if is_func:
+            stack.pop()
+
+    yield from visit(tree)
+
+
+def check_atomic_write(project):
+    for sf in project.py_files:
+        if sf.tree is None or not sf.path.startswith("mxnet_tpu"):
+            continue
+        durable_module = sf.path in _DURABLE_MODULES
+        for node, funcs in _walk_with_funcs(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                continue
+            mode = _write_mode(node)
+            if mode is None or not node.args:
+                continue
+            if any("atomic" in f for f in funcs):
+                continue  # the durable helpers' own tmp writes
+            path_src = ast.get_source_segment(
+                sf.text, node.args[0]) or ""
+            if durable_module:
+                yield Finding(
+                    sf.path, node.lineno, RULE,
+                    "bare open(..., %r) in a durable-state module — "
+                    "write through mxnet_tpu.durable.atomic_write_bytes "
+                    "(tmp + fsync + atomic rename) so a crash can't "
+                    "leave a torn file" % mode)
+            elif _TOKEN_RE.search(path_src):
+                yield Finding(
+                    sf.path, node.lineno, RULE,
+                    "bare open(%s, %r) writes what looks like durable "
+                    "training state — use mxnet_tpu.durable."
+                    "atomic_write_bytes (or pragma with a why if this "
+                    "is scratch)" % (path_src[:60], mode))
