@@ -12,6 +12,7 @@ test: native check
 	python tools/wire_report.py
 	python tools/loadgen.py
 	python tools/dr_drill.py
+	$(MAKE) kernels
 
 test-fast: check
 	python -m pytest tests/ -q -x --ignore=tests/test_dist.py
@@ -67,9 +68,16 @@ slo:
 fairness:
 	python tools/loadgen.py
 
+# fused-kernel tier (PR-19): full parity grid (exit nonzero on any
+# mismatch), then the BENCH_KERNELS=1 lane (which re-gates on the quick
+# grid and measures the optimizer-tree CPU win)
+kernels:
+	python -m mxnet_tpu.ops.fused.parity
+	BENCH_KERNELS=1 python bench.py
+
 clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
 	wire dryrun dist-test chaos trace watchdog elastic dr continuous serve \
-	generate slo fairness clean
+	generate slo fairness kernels clean

@@ -104,7 +104,14 @@ def _obs_counters():
 # live 2-shard PS under push load, then a cold restore onto a 3-shard
 # fleet — frozen_ms is the only window where pushes block, so it is the
 # number the trend gate must keep flat
-_SCHEMA_VERSION = 14
+# v15: fused_parity_ok / attn_prefill_ms / paged_decode_tokens_per_sec /
+# fused_opt_step_ms / stock_opt_step_ms / variant_compile_flops from
+# the BENCH_KERNELS=1 fused-kernel lane (PR-19): the quick parity grid
+# is the gate; attention numbers ride the public dispatch seam (stock
+# on CPU — Pallas wins are asserted only on TPU); the optimizer pair is
+# the one measured CPU claim (one jitted fused tree step vs the eager
+# per-param updater dispatch)
+_SCHEMA_VERSION = 15
 
 
 def _bench_peak():
@@ -754,6 +761,143 @@ def snapshot_main():
     }))
 
 
+def kernels_main():
+    """Fused-kernel lane (BENCH_KERNELS=1, PR-19): the parity gate plus
+    kernel-level timings on the operator-variant seam.
+
+    Emits the schema-15 additive keys.  ``fused_parity_ok`` is the gate
+    everything else rides on: the quick parity grid (2 cases per
+    variant) must be green or the lane's headline value is 0 and
+    ``make kernels`` exits nonzero.  ``attn_prefill_ms`` and
+    ``paged_decode_tokens_per_sec`` time the PUBLIC dispatch seam —
+    whatever variant the backend selects, which on CPU is stock, so off
+    TPU they are a stock baseline and never a fused claim (the Pallas
+    variants gate on parity + their ``trainer_compile_flops`` rows).
+    ``fused_opt_step_ms`` vs ``stock_opt_step_ms`` is the one measured
+    CPU claim: one jitted fused optimizer tree step against the eager
+    per-param updater dispatch (the imperative ``model._update_params``
+    shape the fused tree replaces)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu  # noqa: F401 — env bootstrap
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import efficiency as eff
+    from mxnet_tpu.ops import attention as oatt
+    from mxnet_tpu.ops.fused import attention_kernels as fak
+    from mxnet_tpu.ops.fused import parity as fpar
+    from mxnet_tpu.parallel import trainer as ptr
+
+    t_start = time.perf_counter()
+    reps = int(os.environ.get("BENCH_KERNEL_REPS", "15"))
+    parity_rows = fpar.run_parity(quick=True)
+    parity_ok = bool(parity_rows) and all(r["ok"] for r in parity_rows)
+
+    def _med_ms(fn, *args):
+        jax.block_until_ready(fn(*args))       # warmup / compile
+        lat = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(np.asarray(lat)))
+
+    rs = np.random.RandomState(0)
+
+    # prefill attention through the seam (jitted, like every call site)
+    b, h, t, d = 2, 4, 128, 32
+    q = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32))
+    attn_prefill_ms = _med_ms(jax.jit(oatt.stable_causal_attention),
+                              q, k, v)
+
+    # paged decode through the seam: one token per live sequence
+    bsz, heads, dim, blk, max_blocks = 4, 4, 32, 16, 4
+    n_pages = bsz * max_blocks + 1
+    k_pages = jnp.asarray(
+        rs.randn(n_pages, blk, heads, dim).astype(np.float32))
+    v_pages = jnp.asarray(
+        rs.randn(n_pages, blk, heads, dim).astype(np.float32))
+    ctx = [37, 12, 64, 5][:bsz]
+    bt = np.zeros((bsz, max_blocks), np.int32)
+    nxt = 1
+    for i, c in enumerate(ctx):
+        for jj in range(-(-c // blk)):
+            bt[i, jj] = nxt
+            nxt += 1
+    dq = jnp.asarray(rs.randn(bsz, heads, dim).astype(np.float32))
+    k_step = jnp.asarray(rs.randn(bsz, heads, dim).astype(np.float32))
+    v_step = jnp.asarray(rs.randn(bsz, heads, dim).astype(np.float32))
+    dargs = (dq, k_step, v_step, k_pages, v_pages, jnp.asarray(bt),
+             jnp.asarray(ctx, dtype=jnp.int32))
+    decode_ms = _med_ms(jax.jit(oatt.paged_decode_attention), *dargs)
+    paged_decode_tokens_per_sec = bsz / (decode_ms / 1e3)
+
+    # the optimizer-tree fusion's measured CPU win: eager per-param
+    # dispatch (stock updater shape) vs ONE jitted fused tree step
+    attrs = {"lr": 0.05, "wd": 1e-4, "momentum": 0.9,
+             "rescale_grad": 1.0, "clip_gradient": -1.0}
+    shapes = [(256, 64), (64,), (128, 128), (128,), (512, 32), (32,)]
+    shapes = shapes * 4                         # 24 params, mixed sizes
+    params = {"p%02d" % i: jnp.asarray(rs.randn(*s).astype(np.float32))
+              for i, s in enumerate(shapes)}
+    grads = {n: jnp.asarray(rs.randn(*w.shape).astype(np.float32))
+             for n, w in params.items()}
+    moms = {n: jnp.zeros_like(w) for n, w in params.items()}
+    stock_opt_step_ms = _med_ms(
+        lambda: ptr.sgd_mom_tree_stock(attrs, params, grads, moms))
+    fused_tree = jax.jit(
+        lambda p, g, m: ptr.fused_sgd_mom_tree(attrs, p, g, m))
+    fused_opt_step_ms = _med_ms(fused_tree, params, grads, moms)
+
+    # per-variant compile cost: the trainer_compile_flops{cache} rows
+    # the attention variants gate on (analysis only, nothing executes)
+    eff.record_variant_compile("stable_causal_attention", "stock",
+                               oatt._stable_causal_attention_stock,
+                               q, k, v)
+    eff.record_variant_compile("stable_causal_attention", "fused",
+                               fak.fused_prefill_attention, q, k, v)
+    eff.record_variant_compile("paged_decode_attention", "stock",
+                               oatt._paged_decode_attention_stock,
+                               *dargs)
+    eff.record_variant_compile("paged_decode_attention", "fused",
+                               fak.fused_paged_decode_attention, *dargs)
+    flops_fam = obs.REGISTRY.get("trainer_compile_flops")
+    variant_flops = {}
+    if flops_fam is not None:
+        for op_name in ("stable_causal_attention",
+                        "paged_decode_attention"):
+            for var in ("stock", "fused"):
+                cache = "variant:%s:%s" % (op_name, var)
+                val = flops_fam.labels(cache).value
+                if val:
+                    variant_flops[cache] = float(val)
+
+    dt = time.perf_counter() - t_start
+    print(json.dumps({
+        "metric": "kernels_parity",
+        "value": 1.0 if parity_ok else 0.0,
+        "unit": "ok",
+        "vs_baseline": 0.0,  # the gate is parity, not a 2017 number
+        "fused_parity_ok": parity_ok,
+        "fused_parity_cases": len(parity_rows),
+        "attn_prefill_ms": round(attn_prefill_ms, 3),
+        "paged_decode_tokens_per_sec": round(
+            paged_decode_tokens_per_sec, 2),
+        "fused_opt_step_ms": round(fused_opt_step_ms, 3),
+        "stock_opt_step_ms": round(stock_opt_step_ms, 3),
+        "variant_compile_flops": variant_flops,
+        "elapsed_s": round(dt, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"reps": reps, "opt_params": len(shapes),
+                   "platform": jax.devices()[0].platform},
+    }))
+    if not parity_ok:
+        raise SystemExit(1)
+
+
 def wire_main():
     """Wire-bandwidth lane (BENCH_WIRE=1): a 2-shard replicated
     in-process kvstore fit (sync replication, followers attached via
@@ -1081,6 +1225,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_KERNELS") == "1":
+        kernels_main()
+        return
     if os.environ.get("BENCH_FAIRNESS") == "1":
         fairness_main()
         return
@@ -1305,6 +1452,8 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_KERNELS") == "1":
+        return ("kernels_parity", "kernels_parity", "ok")
     if os.environ.get("BENCH_FAIRNESS") == "1":
         return ("fairness_throughput",
                 "fairness_cpu_smoke_throughput", "req/s")

@@ -137,6 +137,7 @@ import jax.numpy as jnp
 from jax import nn as jnn
 
 from ..ops.attention import paged_decode_attention, stable_causal_attention
+from ..ops.registry import dispatch_variant
 
 _LN_EPS = 1e-5
 
@@ -183,6 +184,13 @@ def init_lm_params(cfg, seed=0, scale=0.02):
 
 
 def _lm_ln(x, gamma, beta):
+    # fused-tier seam: the Pallas epilogue kernel is bitwise-equal to
+    # _lm_ln_stock, so the prefill/decode parity gate holds either way
+    return dispatch_variant("lm_layer_norm", _lm_ln_stock, x, gamma,
+                            beta)
+
+
+def _lm_ln_stock(x, gamma, beta):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     y = (x - mean) / jnp.sqrt(var + _LN_EPS)
@@ -199,9 +207,14 @@ def _lm_qkv(x, qkv_weight, cfg):
     return qkv[0], qkv[1], qkv[2]
 
 
+def _lm_gelu_bias_stock(h, bias):
+    return jnn.gelu(h + bias)
+
+
 def _lm_ffn(x, i, params):
     h = jnp.einsum("btc,fc->btf", x, params["l%d_ffn1_weight" % i])
-    h = jnn.gelu(h + params["l%d_ffn1_bias" % i])
+    h = dispatch_variant("lm_gelu_bias", _lm_gelu_bias_stock, h,
+                         params["l%d_ffn1_bias" % i])
     h = jnp.einsum("btc,fc->btf", h, params["l%d_ffn2_weight" % i])
     return h + params["l%d_ffn2_bias" % i]
 

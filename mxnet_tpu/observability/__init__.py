@@ -93,6 +93,7 @@ from .events import (Event, emit, events, clear_events, render_jsonl,
                      default_buffer)
 from .autoscaler import Autoscaler, ScaleAction, WATCHED_RULES
 from .efficiency import (peak_flops, record_compile, record_step_rate,
+                         record_variant_compile,
                          model_flops_per_step, GoodputLedger, ledger,
                          BADPUT_CAUSES, efficiency_table,
                          format_efficiency, goodput_table, format_goodput,
@@ -119,6 +120,7 @@ __all__ = [
     "default_buffer",
     "Autoscaler", "ScaleAction", "WATCHED_RULES",
     "peak_flops", "record_compile", "record_step_rate",
+    "record_variant_compile",
     "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",
     "efficiency_table", "format_efficiency", "goodput_table",
     "format_goodput", "goodput_reconciles", "capture_profile",

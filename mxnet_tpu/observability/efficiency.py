@@ -57,7 +57,8 @@ import time as _time
 from . import metrics as _metrics
 
 __all__ = [
-    "peak_flops", "record_compile", "record_step_rate",
+    "peak_flops", "record_compile", "record_variant_compile",
+    "record_step_rate",
     "model_flops_per_step", "GoodputLedger", "ledger", "BADPUT_CAUSES",
     "efficiency_table", "format_efficiency", "goodput_table",
     "format_goodput", "goodput_reconciles", "capture_profile",
@@ -293,6 +294,23 @@ def record_compile(cache, lower, steps=1):
             fams["mem"].labels(cache).set(footprint)
     if steps and flops > 0:
         fams["step_flops"].set(flops / float(steps))
+
+
+def record_variant_compile(op_name, variant, fn, *args, **kwargs):
+    """Record one fused-tier variant's compile cost under the cache key
+    ``variant:<op>:<variant>``.
+
+    The per-variant ``trainer_compile_flops{cache}`` row is how MFU
+    attribution credits a kernel-level win to the variant that earned
+    it (ISSUE 19) — attention/paged-decode variants gate on parity plus
+    this row, never on a quoted CPU timing.  ``fn(*args, **kwargs)`` is
+    jit-lowered for analysis only; nothing executes.  Never raises
+    (:func:`record_compile`'s fallback chain applies).
+    """
+    import jax
+
+    record_compile("variant:%s:%s" % (op_name, variant),
+                   lambda: jax.jit(fn).lower(*args, **kwargs), steps=0)
 
 
 def model_flops_per_step(registry=None):

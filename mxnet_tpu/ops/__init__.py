@@ -11,3 +11,7 @@ from . import contrib_op  # noqa: F401
 
 # not an op: the generation lane's paged KV-cache allocator
 from . import kv_cache  # noqa: F401
+
+# fused-kernel variant tier: registers Pallas/fused variants of the
+# stock ops above (plus their parity twins), so it imports last
+from . import fused  # noqa: F401

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import ParamSpec as P, register
+from .registry import ParamSpec as P, dispatch_variant, register
 
 __all__ = ["flash_attention", "ring_attention", "paged_decode_attention",
            "stable_causal_attention"]
@@ -107,7 +107,18 @@ def stable_causal_attention(q, k, v, sm_scale=None):
     :func:`flash_attention` (materialises the score matrix) but its
     output bits do not depend on the query length — the property the
     paged-decode parity gate relies on.
+
+    Dispatches through the fused tier (``ops/fused``): on eligible
+    backends (or under ``MXNET_TPU_OPS_FUSED_OVERRIDE``) the
+    tolerance-class flash variant runs instead; ``MXNET_TPU_OPS_FUSED=0``
+    pins the stock body below.
     """
+    return dispatch_variant("stable_causal_attention",
+                            _stable_causal_attention_stock,
+                            q, k, v, sm_scale=sm_scale)
+
+
+def _stable_causal_attention_stock(q, k, v, sm_scale=None):
     if sm_scale is None:
         sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
     s = _stable_scores(q, k) * sm_scale
@@ -138,7 +149,21 @@ def paged_decode_attention(q, k_step, v_step, k_pages, v_pages,
     the same contiguous prefix a full-sequence forward sees — identical
     reduction order, and the padded-key masking keeps garbage in
     unwritten page tails away from the output bits.
+
+    Dispatches through the fused tier: the Pallas block-table kernel
+    (``ops/fused/attention_kernels.py``) is bitwise-equal to the stock
+    body below, so the decode parity contract survives either way.
     """
+    return dispatch_variant("paged_decode_attention",
+                            _paged_decode_attention_stock,
+                            q, k_step, v_step, k_pages, v_pages,
+                            block_tables, context_lens,
+                            sm_scale=sm_scale)
+
+
+def _paged_decode_attention_stock(q, k_step, v_step, k_pages, v_pages,
+                                  block_tables, context_lens,
+                                  sm_scale=None):
     if sm_scale is None:
         sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
     bsz, max_blocks = block_tables.shape

@@ -1,0 +1,199 @@
+"""Fused layernorm / activation epilogues (transformer hot path c).
+
+Three small Pallas kernels that fold the elementwise epilogues XLA
+would otherwise schedule as separate HLOs:
+
+* ``LayerNorm``/``fused`` — the registry op (op convention): fp32
+  mean/var + ``lax.rsqrt`` + affine in one VMEM pass.  Minor-axis norm
+  only; other ``axis`` values delegate to stock inside the variant.
+* ``lm_layer_norm``/``fused`` — the LM's ``_lm_ln`` twin
+  (``models/transformer.py``): same math spelled with ``jnp.sqrt`` on
+  already-fp32 activations, because the generation lane's bitwise gate
+  pins that exact spelling.
+* ``lm_gelu_bias``/``fused`` — the FFN epilogue ``gelu(h + bias)``.
+
+All three replay stock's op sequence exactly, so they are ``bitwise``
+class; the parity harness holds them to byte equality on the CPU
+interpret path.  Whole-array single-program kernels: the epilogue
+tensors the LM dispatches fit VMEM; a blocked row grid is the TPU-scale
+follow-up and changes nothing about the contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import nn as jnn
+
+from ..registry import register_variant
+from .parity import register_parity
+
+__all__ = ["fused_layer_norm_op", "fused_lm_layer_norm",
+           "fused_lm_gelu_bias"]
+
+_LN_EPS = 1e-5   # transformer.py's _LN_EPS; asserted equal in parity
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _ln_op_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    # stock spelling: ops/attention.py _layer_norm (fp32 + lax.rsqrt)
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    o_ref[...] = (y * g_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_layer_norm_op(attrs, data, gamma, beta):
+    """Op-convention variant of the ``LayerNorm`` registry op."""
+    import jax.experimental.pallas as pl
+
+    axis = attrs["axis"]
+    if axis not in (-1, data.ndim - 1):
+        # non-minor axis: the registry op's generality, stock's job
+        from .. import attention as _att
+
+        return _att._layer_norm(attrs, data, gamma, beta)
+    kernel = functools.partial(_ln_op_kernel, eps=attrs["eps"])
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(data.shape, data.dtype),
+        interpret=_interpret(),
+    )(data, gamma, beta)
+
+
+register_variant("LayerNorm", "fused", fused_layer_norm_op,
+                 backends=("tpu",), parity="bitwise")
+
+
+def _lm_ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    # stock spelling: models/transformer.py _lm_ln (fp32 in, jnp.sqrt)
+    x = x_ref[...]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    o_ref[...] = y * g_ref[...] + b_ref[...]
+
+
+def fused_lm_layer_norm(x, gamma, beta):
+    """Plain-convention twin of ``transformer._lm_ln`` (fp32 LM path)."""
+    import jax.experimental.pallas as pl
+
+    kernel = functools.partial(_lm_ln_kernel, eps=_LN_EPS)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x, gamma, beta)
+
+
+register_variant("lm_layer_norm", "fused", fused_lm_layer_norm,
+                 backends=("tpu",), parity="bitwise")
+
+
+def _gelu_bias_kernel(h_ref, b_ref, o_ref):
+    o_ref[...] = jnn.gelu(h_ref[...] + b_ref[...])
+
+
+def fused_lm_gelu_bias(h, bias):
+    """FFN epilogue ``gelu(h + bias)`` in one pass (``_lm_ffn``)."""
+    import jax.experimental.pallas as pl
+
+    return pl.pallas_call(
+        _gelu_bias_kernel,
+        out_shape=jax.ShapeDtypeStruct(h.shape, h.dtype),
+        interpret=_interpret(),
+    )(h, bias)
+
+
+register_variant("lm_gelu_bias", "fused", fused_lm_gelu_bias,
+                 backends=("tpu",), parity="bitwise")
+
+
+# ----------------------------------------------------------------------
+# parity grids
+# ----------------------------------------------------------------------
+
+
+def _seed(case):
+    import zlib
+
+    return zlib.adler32(repr(case).encode())
+
+
+def _ln_op_case(case):
+    import numpy as np
+
+    from .. import attention as _att
+
+    dtype, shape = case
+    rng = np.random.default_rng(_seed(case))
+    c = shape[-1]
+    data = jnp.asarray(rng.standard_normal(shape), jnp.float32) \
+        .astype(dtype)
+    gamma = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    attrs = {"axis": -1, "eps": 1e-5}
+    stock = functools.partial(_att._layer_norm, attrs)
+    fused = functools.partial(fused_layer_norm_op, attrs)
+    return stock, fused, (data, gamma, beta)
+
+
+register_parity(
+    "LayerNorm", "fused", _ln_op_case,
+    grid=(
+        ("float32", (4, 7, 33)),         # ragged minor dim
+        ("float32", (2, 128)),
+        ("bfloat16", (3, 5, 64)),
+        ("float16", (2, 9, 17)),
+    ))
+
+
+def _lm_ln_case(case):
+    import numpy as np
+
+    def stock(x, gamma, beta):
+        from ...models import transformer as _t
+
+        return _t._lm_ln_stock(x, gamma, beta)
+
+    shape = case
+    rng = np.random.default_rng(_seed(case))
+    c = shape[-1]
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    gamma = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal((c,)), jnp.float32)
+    return stock, fused_lm_layer_norm, (x, gamma, beta)
+
+
+register_parity(
+    "lm_layer_norm", "fused", _lm_ln_case,
+    grid=((2, 16, 32), (1, 1, 32), (3, 21, 33)))
+
+
+def _gelu_case(case):
+    import numpy as np
+
+    def stock(h, bias):
+        from ...models import transformer as _t
+
+        return _t._lm_gelu_bias_stock(h, bias)
+
+    shape = case
+    rng = np.random.default_rng(_seed(case))
+    f = shape[-1]
+    h = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((f,)), jnp.float32)
+    return stock, fused_lm_gelu_bias, (h, bias)
+
+
+register_parity(
+    "lm_gelu_bias", "fused", _gelu_case,
+    grid=((2, 16, 128), (1, 1, 64), (3, 17, 65)))

@@ -126,6 +126,30 @@ def test_bench_snapshot_keys():
     assert rec["snapshot_restripe_ok"] is True
 
 
+def test_bench_kernels_keys():
+    """BENCH_KERNELS=1: the schema-15 fused-kernel keys.  Parity is the
+    gate (the lane exits nonzero without it, so returncode==0 already
+    proves the quick grid is green); the optimizer pair must show the
+    fused tree's measured CPU win over the eager per-param dispatch —
+    the one kernel claim this lane is allowed to make off-TPU."""
+    rec = _run_bench({"BENCH_KERNELS": "1", "BENCH_KERNEL_REPS": "5"})
+    assert rec["schema_version"] >= 15
+    assert rec["metric"] == "kernels_parity"
+    assert rec["unit"] == "ok"
+    assert rec["fused_parity_ok"] is True
+    assert rec["fused_parity_cases"] > 0
+    assert rec["attn_prefill_ms"] > 0
+    assert rec["paged_decode_tokens_per_sec"] > 0
+    assert rec["fused_opt_step_ms"] > 0
+    assert rec["stock_opt_step_ms"] > 0
+    # the measured CPU claim: one jitted fused tree step beats O(n)
+    # eager per-param updates
+    assert rec["fused_opt_step_ms"] < rec["stock_opt_step_ms"]
+    # per-variant compile-FLOPs rows (attention variants gate on these,
+    # not on CPU wall time)
+    assert isinstance(rec["variant_compile_flops"], dict)
+
+
 def test_bench_fairness_keys():
     """BENCH_FAIRNESS=1: the schema-12 multi-tenant keys — isolation
     ratio, quota shed rate, KV-affinity hit ratio — all live and

@@ -319,7 +319,7 @@ def test_worker_serves_metrics_alerts_and_profile(monkeypatch):
             srv.url.replace("/metrics", "/alerts"), timeout=10)
             .read().decode())
         assert isinstance(alerts["alerts"], list)
-        assert alerts["rules"] == 19  # incl. efficiency, SLO burn, wire + quarantine rules
+        assert alerts["rules"] == 20  # incl. efficiency, SLO burn, wire, quarantine + fused rules
         prof = json.loads(urllib.request.urlopen(
             srv.url.replace("/metrics", "/profile?ms=5"), timeout=60)
             .read().decode())

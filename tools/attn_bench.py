@@ -66,6 +66,12 @@ def bench_config(B, H, T, D, steps, dtype=jnp.bfloat16):
             q, k, v, causal=True, sm_scale=sm),
         "jax_ref": lambda q, k, v: jfa.flash_attention(
             q, k, v, causal=True, sm_scale=sm),
+        # the production path: the PR-19 dispatch seam picks the variant
+        # for this backend (on TPU with MXNET_TPU_OPS_FUSED=1 that is
+        # the flash kernel behind the stable-attention contract, fp32
+        # out — the cast is part of the cost serving actually pays)
+        "seam": lambda q, k, v: ours.stable_causal_attention(
+            q, k, v, sm_scale=sm),
     }
     rows = []
     for name, fn in cands.items():

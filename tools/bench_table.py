@@ -84,7 +84,16 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               # direct goodput loss
               "snapshot_save_ms": False,
               "snapshot_restore_ms": False,
-              "snapshot_frozen_ms": False}
+              "snapshot_frozen_ms": False,
+              # schema-15 fused-kernel keys (BENCH_KERNELS=1 rounds):
+              # kernel latencies are down-is-good; decode tokens/sec is
+              # up-is-good.  fused_opt_step_ms is the lane's measured
+              # CPU claim, so a regression there un-earns the fusion;
+              # stock_opt_step_ms is the eager comparator and is NOT
+              # trended (it measures dispatch overhead, not our code)
+              "attn_prefill_ms": False,
+              "paged_decode_tokens_per_sec": True,
+              "fused_opt_step_ms": False}
 TREND_TOLERANCE = 0.10
 
 

@@ -1,11 +1,21 @@
-"""Measure the image input pipeline's decode throughput (native C++
-decode workers vs the Python/PIL path).
+"""Measure decode throughput: the image input pipeline by default
+(native C++ decode workers vs the Python/PIL path), or — with
+``--paged`` — the generation lane's paged-attention decode step through
+the PR-19 operator-variant seam.
 
-Writes a synthetic JPEG RecordIO file and times full epochs through
-ImageIter at 224x224 with the standard train augs.  The native path's
-workers are set by MXTPU_DECODE_WORKERS (default: cores-1).
+Image mode writes a synthetic JPEG RecordIO file and times full epochs
+through ImageIter at 224x224 with the standard train augs.  The native
+path's workers are set by MXTPU_DECODE_WORKERS (default: cores-1).
+
+Paged mode times ``ops.attention.paged_decode_attention`` (jitted, the
+production dispatch — whatever variant the backend selects; export
+``MXNET_TPU_OPS_FUSED_OVERRIDE=paged_decode_attention=stock|fused`` to
+pin a side) and prints tokens/sec per config.  Off-TPU the fused Pallas
+kernel runs only under interpret, so CPU numbers are a stock baseline,
+not a kernel claim.
 
     python tools/decode_bench.py [--n 1024] [--workers 1 2 4]
+    python tools/decode_bench.py --paged [--steps 30]
 """
 
 import argparse
@@ -45,14 +55,62 @@ def run_epoch(rec, batch=128):
     return mode, total, dt
 
 
+def run_paged(steps):
+    """Tokens/sec of the paged decode step through the dispatch seam."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import attention as oatt
+    from mxnet_tpu.ops.registry import select_variant
+
+    rs = np.random.RandomState(0)
+    step = jax.jit(oatt.paged_decode_attention)
+    for bsz, heads, dim, blk, max_blocks in (
+            (4, 4, 32, 16, 4), (8, 8, 64, 16, 8)):
+        n_pages = bsz * max_blocks + 1
+        k_pages = jnp.asarray(
+            rs.randn(n_pages, blk, heads, dim).astype(np.float32))
+        v_pages = jnp.asarray(
+            rs.randn(n_pages, blk, heads, dim).astype(np.float32))
+        ctx = [(i * 13) % (blk * max_blocks - 1) + 1 for i in range(bsz)]
+        bt = np.zeros((bsz, max_blocks), np.int32)
+        nxt = 1
+        for i, c in enumerate(ctx):
+            for j in range(-(-c // blk)):
+                bt[i, j] = nxt
+                nxt += 1
+        q = jnp.asarray(rs.randn(bsz, heads, dim).astype(np.float32))
+        args = (q, q, q, k_pages, v_pages, jnp.asarray(bt),
+                jnp.asarray(ctx, dtype=jnp.int32))
+        jax.block_until_ready(step(*args))          # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / steps
+        var = select_variant("paged_decode_attention")
+        variant = var.name if var is not None else "stock"
+        print("paged B=%d H=%d D=%d blk=%d pages=%d [%s]: %.3f ms/step"
+              " = %.0f tokens/s" % (bsz, heads, dim, blk, max_blocks,
+                                    variant, dt * 1e3, bsz / dt))
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--paged", action="store_true",
+                    help="bench the LLM paged decode step instead of "
+                         "image decode")
+    ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--hw", type=int, nargs=2, default=[480, 360],
                     help="source image size (ImageNet-ish)")
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--workers", type=int, nargs="*", default=None)
     args = ap.parse_args()
+
+    if args.paged:
+        run_paged(args.steps)
+        return
 
     tmp = tempfile.mkdtemp(prefix="mxtpu_decode_bench_")
     rec = os.path.join(tmp, "bench.rec")

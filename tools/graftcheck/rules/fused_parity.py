@@ -1,0 +1,73 @@
+"""fused-parity: every fused-tier variant has a parity twin.
+
+The fused-kernel tier's contract (ISSUE 19): a variant registered via
+``ops.registry.register_variant`` ships only with a matching
+``ops.fused.parity.register_parity`` registration — a kernel nobody can
+falsify is a kernel nobody can trust.  The parity harness enforces the
+same pairing at runtime, but only when it *runs*; this rule flags the
+orphan at the registration site so review sees it on the diff.
+
+Checked forms: ``register_variant("<op>", "<variant>", ...)`` against
+``register_parity("<op>", "<variant>", ...)`` (any attribute path whose
+last segment matches, so ``registry.register_variant(...)`` and
+decorator usage both count).  Both names must be string literals — a
+computed name defeats static pairing and is flagged as such.  Scope is
+runtime files: test fixtures may register deliberately broken variants
+for the harness to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, dotted_name
+
+RULE = "fused-parity"
+
+
+def _literal_pair(node):
+    """(op, variant) from the call's first two args, or None."""
+    if len(node.args) < 2:
+        return None
+    a, b = node.args[0], node.args[1]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+            and isinstance(b, ast.Constant) and isinstance(b.value, str):
+        return (a.value, b.value)
+    return None
+
+
+def check_fused_parity(project):
+    variants = []       # (path, line, (op, variant))
+    parity = set()      # (op, variant)
+    non_literal = []    # (path, line, func name)
+    for sf in project.runtime_files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if not dn:
+                continue
+            leaf = dn.rsplit(".", 1)[-1]
+            if leaf not in ("register_variant", "register_parity"):
+                continue
+            pair = _literal_pair(node)
+            if pair is None:
+                non_literal.append((sf.path, node.lineno, leaf))
+            elif leaf == "register_variant":
+                variants.append((sf.path, node.lineno, pair))
+            else:
+                parity.add(pair)
+    for path, line, leaf in non_literal:
+        yield Finding(
+            path, line, RULE,
+            "%s() without literal op/variant names — the fused tier "
+            "requires statically pairable registrations" % leaf)
+    for path, line, (op, variant) in variants:
+        if (op, variant) not in parity:
+            yield Finding(
+                path, line, RULE,
+                "fused variant %s:%s has no register_parity "
+                "registration (ops/fused/parity.py) — unfalsifiable "
+                "kernel" % (op, variant))
