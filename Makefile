@@ -10,6 +10,7 @@ test: native check
 	$(MAKE) -C native test
 	python -m pytest tests/ -q
 	python tools/wire_report.py
+	python tools/memory_report.py
 	python tools/loadgen.py
 	python tools/dr_drill.py
 	$(MAKE) kernels
@@ -31,6 +32,11 @@ efficiency:
 
 wire:
 	python tools/wire_report.py
+
+# PR-20 capacity ledger: reconciled pool books on a checkpointed fit
+# AND a generation-lane serving run, then the synthetic OOM squeeze
+memory:
+	python tools/memory_report.py
 
 dryrun:
 	python __graft_entry__.py
@@ -79,5 +85,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-fast check bench bench-trend efficiency \
-	wire dryrun dist-test chaos trace watchdog elastic dr continuous serve \
-	generate slo fairness kernels clean
+	wire memory dryrun dist-test chaos trace watchdog elastic dr continuous \
+	serve generate slo fairness kernels clean

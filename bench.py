@@ -111,7 +111,14 @@ def _obs_counters():
 # on CPU — Pallas wins are asserted only on TPU); the optimizer pair is
 # the one measured CPU claim (one jitted fused tree step vs the eager
 # per-param updater dispatch)
-_SCHEMA_VERSION = 15
+# v16: kv_cache_occupancy_pct / memory_headroom_ratio /
+# memory_ledger_reconciles from the BENCH_MEMORY=1 capacity lane
+# (PR-20): the pool ledger must reconcile against jax.live_arrays()
+# truth on a live generation workload (the gate — an empty ledger
+# fails), occupancy is read with sessions still resident, and the
+# headroom ratio rides the synthetic MXNET_TPU_MEMORY_BUDGET_BYTES
+# device budget on CPU (real memory_stats() limits on TPU)
+_SCHEMA_VERSION = 16
 
 
 def _bench_peak():
@@ -898,6 +905,95 @@ def kernels_main():
         raise SystemExit(1)
 
 
+def memory_main():
+    """Memory/capacity lane (BENCH_MEMORY=1, PR-20): the reconciled
+    pool ledger measured on a live generation workload.
+
+    Emits the schema-16 additive keys.  ``memory_ledger_reconciles``
+    is the gate everything rides on: the named pool books must explain
+    the ``jax.live_arrays()`` truth within the ledger tolerance or the
+    lane exits nonzero — and an empty ledger fails by contract, the
+    same falsifiability shape as the wire lane's reconcile.
+    ``kv_cache_occupancy_pct`` is read with sessions still resident
+    (peak hold, not the drained pool), and ``memory_headroom_ratio``
+    is computed against the synthetic ``MXNET_TPU_MEMORY_BUDGET_BYTES``
+    device budget on CPU (real ``memory_stats()`` limits on TPU)."""
+    import jax
+
+    import mxnet_tpu  # noqa: F401 — env bootstrap
+    from mxnet_tpu import serving
+    from mxnet_tpu.models import transformer as tfm
+    from mxnet_tpu.observability import memory as omem
+    from mxnet_tpu.observability import metrics as om
+
+    t_start = time.perf_counter()
+    om.reset_metrics()
+    cfg = tfm.lm_config(num_classes=128, seq_len=64, num_embed=64,
+                        num_heads=4, num_layers=2)
+    # commit the weight tree to the device: the ledger books jax.Array
+    # leaves only, and host-numpy weights would leave both the books
+    # and the live-array truth empty (a vacuous, failing gate)
+    params = jax.device_put(tfm.init_lm_params(cfg, seed=0))
+    sched = serving.GenerationScheduler()
+    be = serving.LMBackend(params, cfg, block_size=8, num_blocks=32)
+    sched.register("lm", be, decode_buckets=[1, 2],
+                   prefill_buckets=[8, 16])
+    sched.warmup("lm")
+    for seed in range(3):
+        toks = sched.generate("lm", list(range(1 + seed, 9 + seed)),
+                              max_new_tokens=8)
+        assert toks, "generation produced no tokens"
+    # hold a few sessions resident so occupancy is read at peak — the
+    # generate() free path would otherwise drain the pool back to zero
+    held = ("bench-a", "bench-b", "bench-c")
+    for sid in held:
+        be.cache.allocate(sid, 24)
+    occ_fam = om.REGISTRY.get("serving_kv_cache_occupancy")
+    occupancy = float(occ_fam.labels("lm").value) if occ_fam else 0.0
+    budget_preset = os.environ.get("MXNET_TPU_MEMORY_BUDGET_BYTES")
+    try:
+        if not budget_preset:
+            # CPU memory_stats() carries no bytes_limit: pin the
+            # synthetic budget at 2x the live total so the headroom
+            # ratio is deterministic (~0.5) instead of absent
+            live = omem.sample() or 0
+            os.environ["MXNET_TPU_MEMORY_BUDGET_BYTES"] = str(
+                int(max(live, 1) * 2))
+        omem.sample()
+        ok, booked, truth = omem.memory_reconciles()
+        head_fam = om.REGISTRY.get("memory_headroom_ratio")
+        headroom = (float(head_fam.labels("all").value)
+                    if head_fam else 0.0)
+        rep = omem.memory_report()
+    finally:
+        for sid in held:
+            be.cache.free(sid)
+        if not budget_preset:
+            del os.environ["MXNET_TPU_MEMORY_BUDGET_BYTES"]
+    sched.close()
+    dt = time.perf_counter() - t_start
+    print(json.dumps({
+        "metric": "memory_ledger",
+        "value": 1.0 if ok else 0.0,
+        "unit": "ok",
+        "vs_baseline": 0.0,  # the gate is the reconcile, not a 2017 number
+        "memory_ledger_reconciles": bool(ok),
+        "memory_booked_bytes": int(booked),
+        "memory_live_bytes": int(truth),
+        "memory_other_bytes": int(rep["other_bytes"]),
+        "kv_cache_occupancy_pct": round(occupancy * 100.0, 2),
+        "memory_headroom_ratio": round(headroom, 4),
+        "elapsed_s": round(dt, 3),
+        **_obs_counters(),
+        **_provenance(),
+        "config": {"num_blocks": 32, "block_size": 8,
+                   "held_sessions": len(held),
+                   "platform": jax.devices()[0].platform},
+    }))
+    if not ok:
+        raise SystemExit(1)
+
+
 def wire_main():
     """Wire-bandwidth lane (BENCH_WIRE=1): a 2-shard replicated
     in-process kvstore fit (sync replication, followers attached via
@@ -1225,6 +1321,9 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
+    if os.environ.get("BENCH_MEMORY") == "1":
+        memory_main()
+        return
     if os.environ.get("BENCH_KERNELS") == "1":
         kernels_main()
         return
@@ -1452,6 +1551,8 @@ def _probe_accelerator(timeout_s):
 
 def _metric_names():
     """(tpu metric, cpu-smoke metric, unit) for the selected BENCH_MODEL."""
+    if os.environ.get("BENCH_MEMORY") == "1":
+        return ("memory_ledger", "memory_ledger", "ok")
     if os.environ.get("BENCH_KERNELS") == "1":
         return ("kernels_parity", "kernels_parity", "ok")
     if os.environ.get("BENCH_FAIRNESS") == "1":

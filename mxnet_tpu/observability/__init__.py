@@ -62,6 +62,15 @@ The runtime's telemetry layer (the subsystem the paper's
   RPCs per flush, reconciliation against socket-level truth and the
   attribution ``kv`` phase, and the explicitly-labeled projected
   binary-wire savings line (the baseline ROADMAP item 3 must beat).
+- :mod:`~mxnet_tpu.observability.memory` — the capacity analogue of
+  the wire ledger: every live device byte booked into named pools
+  (``params`` / ``optimizer`` / ``kv_cache`` / ``prefetch`` /
+  ``compile`` / derived ``other``) via tagging seams in the trainer,
+  prefetcher, and paged KV cache; ``memory_pool_bytes{pool,device}``
+  with watermarks and alloc/free counters; ``memory_reconciles``
+  gating the books against ``jax.live_arrays()`` ground truth;
+  ``memory_headroom_ratio{device}`` driving the ``oom_proximity`` /
+  ``kv_cache_pressure`` watchdog rules; the ``/memory`` JSON endpoint.
 
 Instrumented out of the box: engine push/run/poison per lane, prefetch
 occupancy + stall time, trainer step latency + tokens/sec, kvstore RPC
@@ -100,6 +109,11 @@ from .efficiency import (peak_flops, record_compile, record_step_rate,
                          goodput_reconciles, capture_profile)
 from .wire import (wire_table, wire_report, format_wire_report,
                    wire_reconciles, codec_reconciles)
+from .memory import (POOLS as MEMORY_POOLS, tag as memory_tag,
+                     tag_tree as memory_tag_tree,
+                     untag as memory_untag, sample as memory_sample,
+                     top_buffers, memory_report, format_memory_report,
+                     memory_reconciles)
 
 __all__ = [
     "Registry", "REGISTRY", "counter", "gauge", "histogram",
@@ -126,4 +140,7 @@ __all__ = [
     "format_goodput", "goodput_reconciles", "capture_profile",
     "wire_table", "wire_report", "format_wire_report",
     "wire_reconciles", "codec_reconciles",
+    "MEMORY_POOLS", "memory_tag", "memory_tag_tree", "memory_untag",
+    "memory_sample", "top_buffers", "memory_report",
+    "format_memory_report", "memory_reconciles",
 ]

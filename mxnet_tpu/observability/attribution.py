@@ -61,23 +61,6 @@ _M_PHASE = _metrics.histogram(
 _H_PHASE = {p: _M_PHASE.labels(p) for p in PHASES}
 _H_RESIDUAL = _M_PHASE.labels("unattributed")
 
-_M_LIVE = _metrics.gauge(
-    "memory_live_buffer_bytes",
-    "Bytes held by live device buffers at the last sample point "
-    "(device='all' sums jax.live_arrays(); per-device series come from "
-    "the backend allocator's bytes_in_use when it reports one)",
-    ["device"])
-_M_PEAK = _metrics.gauge(
-    "memory_peak_bytes",
-    "Backend allocator peak bytes in use, per device (HBM watermark; "
-    "absent on backends whose memory_stats() reports nothing)",
-    ["device"])
-_M_LIVE_WM = _metrics.gauge(
-    "memory_live_buffer_watermark_bytes",
-    "High-water mark of memory_live_buffer_bytes{device='all'} across "
-    "sample points since the last registry reset")
-
-
 class _PhaseTimer(object):
     """Times one ``with`` block into its attribution accumulator."""
 
@@ -168,37 +151,18 @@ def attributor():
 
 
 def sample_memory():
-    """Sample live-buffer and allocator memory gauges (see module doc).
-    Constant-time guard when metrics are disabled; any backend that
-    can't report simply contributes nothing."""
+    """Sample live-buffer and allocator memory gauges.  Since Round 20
+    the ground-truth probe lives in :mod:`.memory` (one reader, not
+    two) — this delegates to :func:`memory.sample`, which keeps the
+    ``memory_live_buffer_bytes`` / ``memory_peak_bytes`` / watermark
+    family names unchanged and additionally books the ``other``
+    residual and headroom for the pool ledger.  Constant-time guard
+    when metrics are disabled."""
     if not _metrics.metrics_enabled():
         return
-    import jax
+    from . import memory as _memory
 
-    total = 0
-    try:
-        for a in jax.live_arrays():
-            try:
-                total += int(a.nbytes)
-            except (AttributeError, TypeError):
-                pass
-    except Exception:
-        return
-    _M_LIVE.labels("all").set(float(total))
-    if total > (_M_LIVE_WM.value or 0.0):
-        _M_LIVE_WM.set(float(total))
-    for d in jax.devices():
-        try:
-            stats = d.memory_stats()
-        except Exception:
-            stats = None
-        if not stats:
-            continue
-        if "bytes_in_use" in stats:
-            _M_LIVE.labels("dev%d" % d.id).set(float(stats["bytes_in_use"]))
-        if "peak_bytes_in_use" in stats:
-            _M_PEAK.labels("dev%d" % d.id).set(
-                float(stats["peak_bytes_in_use"]))
+    _memory.sample()
 
 
 def attribution_table(registry=None):

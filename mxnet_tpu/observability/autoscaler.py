@@ -44,10 +44,13 @@ __all__ = ["Autoscaler", "ScaleAction", "WATCHED_RULES"]
 # the alert names that mean "capacity is short": the PR-6 stock rule
 # set, the generation lane's inter-token-latency SLO (a slow decode
 # step stalls every live sequence — that is a capacity signal for a
-# generation replica group), plus the SLO fast-burn rules — an error
-# budget dying fast is a capacity signal, not just a page
+# generation replica group), sustained KV-cache block pressure (a
+# nearly-full pool means CacheExhaustedError sheds are imminent and
+# more replicas mean more block pools), plus the SLO fast-burn rules —
+# an error budget dying fast is a capacity signal, not just a page
 WATCHED_RULES = ("queue_saturation", "request_p99_slo", "straggler",
-                 "inter_token_p99") + _slo.FAST_BURN_RULES
+                 "inter_token_p99",
+                 "kv_cache_pressure") + _slo.FAST_BURN_RULES
 
 _M_ACTIONS = _metrics.counter(
     "cluster_autoscale_actions_total",

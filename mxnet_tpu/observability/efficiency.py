@@ -292,6 +292,10 @@ def record_compile(cache, lower, steps=1):
             footprint = 0.0
         if footprint > 0:
             fams["mem"].labels(cache).set(footprint)
+            # book the XLA footprint into the memory ledger (allocator-
+            # side bytes, outside the live-array truth → device="xla")
+            from . import memory as _memory
+            _memory.tag("compile", cache, int(footprint), device="xla")
     if steps and flops > 0:
         fams["step_flops"].set(flops / float(steps))
 

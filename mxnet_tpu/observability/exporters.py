@@ -126,6 +126,12 @@ def start_metrics_server(port=None, addr="127.0.0.1", registry=None,
 
                 body = json.dumps(_slo.report(reg)).encode("utf-8")
                 ctype = "application/json; charset=utf-8"
+            elif path == "/memory":
+                from . import memory as _memory
+
+                body = json.dumps(_memory.memory_report(reg),
+                                  sort_keys=True).encode("utf-8")
+                ctype = "application/json; charset=utf-8"
             elif path == "/events":
                 from .events import render_jsonl as _render_jsonl
 

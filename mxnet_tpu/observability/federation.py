@@ -207,6 +207,8 @@ class FederatedCollector(object):
         wsteps = {}          # member name -> [sum_s, count] (worker steps)
         mfu = {}             # member name -> model_flops_utilization
         wire = {}            # (member, dir) -> kv wire bytes
+        mempool = {}         # (member, pool) -> memory_pool_bytes
+        headroom = {}        # member name -> min memory_headroom_ratio
         for t in self.targets:
             key = _source_key(t)
             if key in seen:
@@ -260,6 +262,18 @@ class FederatedCollector(object):
                         ld = _label_dict(labels or "")
                         k = (member, ld.get("dir", "?"))
                         wire[k] = wire.get(k, 0.0) + fval
+                    elif name == "memory_pool_bytes":
+                        # capacity books per member+pool (device rows
+                        # collapse — the federation view answers 'how
+                        # much', the local one 'where')
+                        ld = _label_dict(labels or "")
+                        k = (member, ld.get("pool", "?"))
+                        mempool[k] = mempool.get(k, 0.0) + fval
+                    elif name == "memory_headroom_ratio" and fval > 0:
+                        # zero = a reset placeholder that never sampled;
+                        # it must not drag cluster_memory_headroom_min
+                        headroom[member] = min(
+                            headroom.get(member, float("inf")), fval)
 
         # families sorted by name; series keep scrape order (histogram
         # buckets must stay in ascending-le order, which lexical
@@ -351,6 +365,25 @@ class FederatedCollector(object):
             w("# TYPE cluster_mfu_min gauge\n")
             w("cluster_mfu_min %s\n"
               % _metrics._fmt_value(min(mfu.values())))
+
+        # -- memory capacity: per-member pool books plus the fleet's
+        # headroom floor (the member the next OOM hunts start from) ----
+        if mempool:
+            w("# HELP cluster_memory_pool_bytes Memory-ledger pool bytes "
+              "per federation member (device rows summed from "
+              "memory_pool_bytes)\n")
+            w("# TYPE cluster_memory_pool_bytes gauge\n")
+            for member, pool in sorted(mempool):
+                w('cluster_memory_pool_bytes{member="%s",pool="%s"} %s\n'
+                  % (_metrics._fmt_label(member), _metrics._fmt_label(pool),
+                     _metrics._fmt_value(mempool[(member, pool)])))
+        if headroom:
+            w("# HELP cluster_memory_headroom_min The tightest device "
+              "memory headroom ratio across all members — the fleet's "
+              "OOM-proximity floor\n")
+            w("# TYPE cluster_memory_headroom_min gauge\n")
+            w("cluster_memory_headroom_min %s\n"
+              % _metrics._fmt_value(min(headroom.values())))
 
         # -- wire bandwidth: per-member byte books plus a cluster-wide
         # MB/s rate from the delta against the previous render pass ----

@@ -444,5 +444,10 @@ def dump_metrics(exemplars=False):
 
 def reset_metrics():
     """Zero the global registry (handles stay live — see
-    :meth:`Registry.reset`)."""
+    :meth:`Registry.reset`) and drop the memory ledger's bookings —
+    a booking that outlived its zeroed gauges would resurrect at the
+    next sample and poison the reconcile gate."""
     REGISTRY.reset()
+    from . import memory as _memory
+
+    _memory._reset_ledger()

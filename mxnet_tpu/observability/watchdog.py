@@ -221,6 +221,10 @@ class Rule(object):
         # its own raw quantity from the parsed exposition instead of
         # the stock _stat_of(metric, stat, selector) lookup
         self.value_fn = None
+        # bundle_extra_fn seam: a terminal rule may attach extra
+        # diagnosis payload to its rising-edge flight bundle (the
+        # oom_proximity rule ships the pool ledger + top-K buffers)
+        self.bundle_extra_fn = None
         # evaluation state
         self.firing = False
         self.value = None          # the quantity last compared
@@ -354,9 +358,17 @@ class Watchdog(object):
                     if rule.severity == "terminal":
                         # one bundle per firing episode: the edge, not
                         # every evaluation while it stays red
+                        extra = {}
+                        if rule.bundle_extra_fn is not None:
+                            try:
+                                extra = dict(rule.bundle_extra_fn())
+                            except Exception:
+                                # diagnosis payload must never block
+                                # the bundle itself
+                                extra = {}
                         _flight.record_failure(
                             "watchdog.%s" % rule.name, None,
-                            alert=alert.as_dict())
+                            alert=alert.as_dict(), **extra)
                 elif firing:
                     self._active[rule.name].value = rule.value
                 elif was:
@@ -450,13 +462,23 @@ def _wire_codec_share(fams):
     return codec / wall
 
 
+def _memory_bundle_extras():
+    """Diagnosis payload for the ``oom_proximity`` flight bundle: the
+    pool ledger snapshot and top-K largest live buffers."""
+    from . import memory as _memory
+
+    return _memory.oom_bundle_extras()
+
+
 def default_rules():
     """The stock SLO rule set: trace-buffer pressure, heartbeat age,
     replication lag, step-p99 self-regression, (when evaluated over a
     federated source) straggler skew, MFU self-regression, the goodput
     floor, the serving tier's request-p99 SLO + queue-saturation
     rules, the wire-bandwidth pair (bytes/step rolling-baseline
-    regression at terminal severity + codec-share threshold), and the
+    regression at terminal severity + codec-share threshold), the
+    memory/capacity pair (``oom_proximity`` terminal on headroom,
+    ``kv_cache_pressure`` warning on block-pool occupancy), and the
     error-budget burn-rate rules
     (:func:`~.slo.burn_rules`: fast-burn terminal, slow-burn warning,
     for each default SLO), and the durable-state quarantine rule (any
@@ -614,5 +636,31 @@ def default_rules():
                     "budget (the binary-wire lane's trigger condition)")
     codec_share.value_fn = _wire_codec_share
     rules.extend([wire_regress, codec_share])
+    # memory/capacity rules (observability/memory.py books).  Headroom
+    # is clamped to a 1e-6 floor by memory.sample(), so skip_zero can
+    # keep a registry-reset zero placeholder from false-firing while a
+    # genuinely exhausted device (headroom ~0) still trips the rule.
+    oom = Rule(
+        "oom_proximity", "memory_headroom_ratio", stat="min", op="<",
+        skip_zero=True,
+        threshold=_env_float("MXNET_TPU_WATCHDOG_HEADROOM_MIN", 0.05),
+        for_s=_env_float("MXNET_TPU_WATCHDOG_HEADROOM_FOR_S", 0.0),
+        severity="terminal",
+        description="a device's memory headroom fell below MXNET_TPU_"
+                    "WATCHDOG_HEADROOM_MIN — the next allocation spike "
+                    "is an OOM; the flight bundle carries the pool "
+                    "ledger snapshot and the top-K largest live buffers")
+    oom.bundle_extra_fn = _memory_bundle_extras
+    rules.append(oom)
+    rules.append(Rule(
+        "kv_cache_pressure", "serving_kv_cache_occupancy", stat="max",
+        op=">", skip_zero=True,
+        threshold=_env_float("MXNET_TPU_WATCHDOG_KV_PRESSURE", 0.9),
+        for_s=_env_float("MXNET_TPU_WATCHDOG_KV_PRESSURE_FOR_S", 0.0),
+        severity="warning",
+        description="a model's KV-cache block pool is nearly full — "
+                    "CacheExhaustedError 429s are imminent; the rule "
+                    "rides the autoscaler's WATCHED_RULES so sustained "
+                    "pressure grows the replica group"))
     rules.extend(_slo.burn_rules())
     return rules

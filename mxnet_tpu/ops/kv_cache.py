@@ -34,11 +34,13 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 
 import numpy as np
 
 from .. import chaos
 from ..base import MXNetError
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 
 __all__ = ["CacheExhaustedError", "PagedKVCache", "default_block_size",
@@ -76,6 +78,25 @@ _M_EXHAUSTED = _metrics.counter(
     "serving_kv_cache_exhausted_total",
     "Allocations rejected because the block pool was empty, by model",
     ["model"])
+_M_HEADROOM = _metrics.gauge(
+    "serving_kv_cache_headroom",
+    "Fraction of KV-cache blocks still free (1 - occupancy), by model",
+    ["model"])
+_M_FRAG = _metrics.gauge(
+    "serving_kv_cache_fragmentation",
+    "Internal fragmentation of allocated blocks: 1 - tokens_written / "
+    "(used_blocks * block_size); 0 when nothing is allocated, by model",
+    ["model"])
+_M_ALLOCS = _metrics.counter(
+    "serving_kv_cache_alloc_blocks_total",
+    "Blocks handed out by the free list, by model", ["model"])
+_M_FREES = _metrics.counter(
+    "serving_kv_cache_free_blocks_total",
+    "Blocks returned to the free list, by model", ["model"])
+_M_SESS_BLOCKS = _metrics.histogram(
+    "serving_kv_blocks_per_session",
+    "Blocks one sequence held when it was freed, by model", ["model"],
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
 
 
 class PagedKVCache(object):
@@ -111,6 +132,20 @@ class PagedKVCache(object):
         self._occ = _M_OCC.labels(model)
         self._used = _M_BLOCKS.labels(model)
         self._exhausted = _M_EXHAUSTED.labels(model)
+        self._headroom = _M_HEADROOM.labels(model)
+        self._frag = _M_FRAG.labels(model)
+        self._allocs = _M_ALLOCS.labels(model)
+        self._frees = _M_FREES.labels(model)
+        self._sess_blocks = _M_SESS_BLOCKS.labels(model)
+        # book the host-resident page pools into the memory ledger;
+        # the finalizer releases the row when the cache (hot-swap,
+        # backend teardown) is collected
+        self._ledger_key = id(self)
+        _memory.tag("kv_cache", self._ledger_key,
+                    self.k_pages.nbytes + self.v_pages.nbytes,
+                    device="host")
+        weakref.finalize(self, _memory.untag, "kv_cache",
+                         self._ledger_key)
 
     # -- allocation --------------------------------------------------
 
@@ -132,14 +167,22 @@ class PagedKVCache(object):
             grow = need_total - len(table)
             if grow > len(self._free):
                 self._exhausted.inc()
-                raise CacheExhaustedError(
+                used = self.num_blocks - len(self._free)
+                err = CacheExhaustedError(
                     "kv cache exhausted: seq %r needs %d more block(s), "
                     "%d free of %d" % (seq_id, grow, len(self._free),
                                        self.num_blocks))
+                # occupancy hints the serving front-end forwards in the
+                # 429 error body so clients can back off proportionally
+                err.kv_cache_occupancy = used / float(self.num_blocks)
+                err.kv_cache_blocks_free = len(self._free)
+                err.kv_cache_blocks_total = self.num_blocks
+                raise err
             if grow > 0:
                 fresh = [self._free.pop() for _ in range(grow)]
                 self._tables[seq_id] = table + fresh
                 self._lengths.setdefault(seq_id, 0)
+                self._allocs.inc(grow)
             self._set_gauges_locked()
 
     def free(self, seq_id):
@@ -151,6 +194,8 @@ class PagedKVCache(object):
             self._lengths.pop(seq_id, None)
             if table:
                 self._free.extend(reversed(table))
+                self._frees.inc(len(table))
+                self._sess_blocks.observe(len(table))
             self._set_gauges_locked()
             return list(table)
 
@@ -158,6 +203,12 @@ class PagedKVCache(object):
         used = self.num_blocks - len(self._free)
         self._used.set(used)
         self._occ.set(used / float(self.num_blocks))
+        self._headroom.set(len(self._free) / float(self.num_blocks))
+        if used:
+            written = sum(self._lengths.values())
+            self._frag.set(1.0 - written / float(used * self.block_size))
+        else:
+            self._frag.set(0.0)
 
     # -- reads -------------------------------------------------------
 
@@ -229,8 +280,15 @@ class PagedKVCache(object):
     def stats(self):
         with self._lock:
             used = self.num_blocks - len(self._free)
+            written = sum(self._lengths.values())
             return {"blocks": self.num_blocks, "used": used,
                     "free": len(self._free),
                     "occupancy": used / float(self.num_blocks),
+                    "headroom": len(self._free) / float(self.num_blocks),
+                    "fragmentation": (1.0 - written
+                                      / float(used * self.block_size))
+                                     if used else 0.0,
                     "sequences": len(self._tables),
-                    "block_size": self.block_size}
+                    "block_size": self.block_size,
+                    "pool_bytes": self.k_pages.nbytes
+                                  + self.v_pages.nbytes}

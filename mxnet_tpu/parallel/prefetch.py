@@ -33,6 +33,7 @@ from collections import namedtuple
 
 from .. import engine as _engine
 from ..base import StreamStallError
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 
@@ -146,8 +147,12 @@ class PrefetchFeeder(object):
                 self._slots[i] = _END
                 return
             t_place = _time.monotonic()
-            self._slots[i] = Chunk(self._place(host), host, len(host))
+            chunk = Chunk(self._place(host), host, len(host))
+            self._slots[i] = chunk
             _M_PLACE.inc(_time.monotonic() - t_place)
+            # book the staged superbatch into the memory ledger; the
+            # consume side releases the row when the chunk leaves
+            _memory.tag_tree("prefetch", (id(self), i), chunk.placed)
             self._ready += 1
             _M_OCCUPANCY.set(self._ready)
 
@@ -214,6 +219,7 @@ class PrefetchFeeder(object):
         self._ready = max(self._ready - 1, 0)
         _M_OCCUPANCY.set(self._ready)
         _M_CHUNKS.inc()
+        _memory.untag("prefetch", (id(self), i))
         self._push(i)
         return chunk
 
@@ -241,6 +247,8 @@ class PrefetchFeeder(object):
         self._drain()
         for v in self._vars + [self._order]:
             _engine.clear_poison(v)
+        for i in range(self._depth):
+            _memory.untag("prefetch", (id(self), i))
         self._exhausted = False
         self._done = False
         self._broken = None
@@ -256,6 +264,8 @@ class PrefetchFeeder(object):
             return
         self._closed = True
         self._drain()
+        for i in range(self._depth):
+            _memory.untag("prefetch", (id(self), i))
         for v in self._vars + [self._order]:
             _engine.delete_variable(v)
 

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..observability import attribution as _attr
 from ..observability import efficiency as _eff
+from ..observability import memory as _mem
 from ..observability import metrics as _metrics
 
 __all__ = ["ShardedTrainer", "auto_tp_specs", "zero_extend_spec"]
@@ -1159,6 +1160,10 @@ class ShardedTrainer:
         params, moms, aux = (state if state is not None
                              else self.init(initializer=initializer,
                                             seed=seed))
+        # memory-ledger seams: the state trees are the pool baseline the
+        # reconcile gate checks against jax.live_arrays() at sample points
+        _mem.tag_tree("params", id(self), (params, aux))
+        _mem.tag_tree("optimizer", id(self), moms)
         K = self.pipeline_steps
         step = self.step_fn() if K == 1 else None
         fwd = self.forward_fn()
@@ -1594,6 +1599,8 @@ class ShardedTrainer:
             params[n] = jax.device_put(
                 jnp.asarray(b._data).astype(self._param_dtype(n)),
                 pshard[n])
+        _mem.tag_tree("params", id(self), (params, aux))
+        _mem.tag_tree("optimizer", id(self), moms)
         gradf = self.grad_fn()
         fwd = self.forward_fn()
 
@@ -1877,6 +1884,8 @@ class ShardedTrainer:
         params, moms, aux = (state if state is not None
                              else self.init(initializer=initializer,
                                             seed=seed))
+        _mem.tag_tree("params", id(self), (params, aux))
+        _mem.tag_tree("optimizer", id(self), moms)
         if resume_meta is not None:
             global_step = int(resume_meta.get("global_step", 0))
             rng_seed = int(resume_meta.get("seed", seed))

@@ -113,6 +113,25 @@ _H_SWIRE_SEND = _M_SERVING_WIRE.labels("send")
 # "pid:rN" — still unique, just not resolvable in a trace)
 _req_ids = itertools.count(1)
 
+#: sentinel: the generation stream ended before its first token
+_NO_TOKEN = object()
+
+
+def _kv_hints(exc):
+    """Occupancy hint fields for a :class:`~mxnet_tpu.ops.kv_cache.
+    CacheExhaustedError` response body (empty for other errors): how
+    full the block pool was when the allocation was rejected, so a
+    client can back off proportionally instead of blind-retrying."""
+    occ = getattr(exc, "kv_cache_occupancy", None)
+    if occ is None:
+        return {}
+    return {"kv_cache_occupancy": round(float(occ), 4),
+            "kv_cache_blocks_free": getattr(exc, "kv_cache_blocks_free",
+                                            None),
+            "kv_cache_blocks_total": getattr(exc,
+                                             "kv_cache_blocks_total",
+                                             None)}
+
 
 def trace_header_enabled():
     """``MXNET_TPU_SERVING_TRACE_HEADER``: accept the caller's
@@ -219,12 +238,13 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             if status == 429:
                 # quota sheds carry the bucket's actual refill time,
                 # overload sheds the env default — either way a 429 is
-                # never headerless (tested contract)
+                # never headerless (tested contract; since PR 20 the
+                # cache-exhaustion path rides it too)
                 extra = (("Retry-After",
                           str(_admission.retry_after_s(exc))),)
-            self._reply_json(status, {"error": str(exc),
-                                      "type": type(exc).__name__},
-                             extra=extra)
+            payload = {"error": str(exc), "type": type(exc).__name__}
+            payload.update(_kv_hints(exc))
+            self._reply_json(status, payload, extra=extra)
 
         def do_GET(self):
             self._rid = None     # keep-alive: no id leak from a POST
@@ -332,6 +352,18 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                 eos_id=payload.get("eos_id"),
                 deadline_ms=payload.get("deadline_ms"),
                 tenant=self._tenant)
+            # first-outcome gating: pull the first token BEFORE
+            # committing the status line, so a prefill-time failure
+            # (cache exhaustion in the generation loop) maps onto its
+            # typed HTTP status — a CacheExhaustedError 429 with
+            # Retry-After and occupancy hints — instead of riding an
+            # already-committed 200's error tail
+            it = req.tokens(timeout=timeout)
+            first = _NO_TOKEN
+            try:
+                first = next(it)
+            except StopIteration:
+                pass
             self._status = 200
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
@@ -341,9 +373,14 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
             self.end_headers()
             try:
                 try:
-                    for tok in req.tokens(timeout=timeout):
+                    if first is not _NO_TOKEN:
                         self._chunk(json.dumps(
-                            {"token": int(tok)}).encode("utf-8") + b"\n")
+                            {"token": int(first)}).encode("utf-8")
+                            + b"\n")
+                        for tok in it:
+                            self._chunk(json.dumps(
+                                {"token": int(tok)}).encode("utf-8")
+                                + b"\n")
                     tail = {"done": True, "model": model,
                             "finish_reason": req.finish_reason,
                             "tokens": list(req.generated)}
@@ -357,6 +394,7 @@ def start_frontend(target, port=None, addr="127.0.0.1", timeout=30.0,
                             "finish_reason": "error",
                             "error": str(exc),
                             "type": type(exc).__name__}
+                    tail.update(_kv_hints(exc))
                 self._chunk(json.dumps(tail).encode("utf-8") + b"\n")
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()

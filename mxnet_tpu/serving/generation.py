@@ -56,12 +56,14 @@ import os
 import queue as _queue
 import threading
 import time
+import weakref as _weakref
 
 import numpy as _np
 
 from .. import chaos
 from ..base import MXNetError
 from ..models import transformer as _tfm
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from ..observability.events import emit as _emit_event
@@ -245,6 +247,11 @@ class LMBackend(Backend):
                                     // self.cache.block_size)
         self._jits = {}
         self._jit_lock = threading.Lock()
+        # book the weight tree into the memory ledger (serving-lane
+        # analogue of the trainer's params seam); keyed by backend so a
+        # hot-swap replaces the old backend's row when it is collected
+        _memory.tag_tree("params", id(self), self.params)
+        _weakref.finalize(self, _memory.untag, "params", id(self))
 
     def _jit(self, key, build):
         """Shape-keyed jit cache; returns (fn, cold)."""

@@ -251,6 +251,15 @@ _SHARD0_TEXT = (
     'kv_wire_bytes_total{op="push",dir="send",part="header"} 120\n'
     'kv_wire_bytes_total{op="push",dir="send",part="payload"} 4096\n'
     'kv_wire_bytes_total{op="push",dir="replicate",part="payload"} 4096\n'
+    "# HELP memory_pool_bytes Live device bytes booked per pool\n"
+    "# TYPE memory_pool_bytes gauge\n"
+    'memory_pool_bytes{pool="params",device="all"} 8192\n'
+    'memory_pool_bytes{pool="optimizer",device="all"} 4096\n'
+    'memory_pool_bytes{pool="kv_cache",device="host"} 2048\n'
+    "# HELP memory_headroom_ratio Fraction of the device memory "
+    "budget still free\n"
+    "# TYPE memory_headroom_ratio gauge\n"
+    'memory_headroom_ratio{device="all"} 0.35\n'
 )
 _SHARD1_TEXT = (
     "# HELP kv_fenced_total Primaries fenced by a higher epoch\n"

@@ -237,6 +237,37 @@ def test_exhaustion_sheds_through_admission(lm):
     sched.close()
 
 
+def test_frontend_cache_exhaustion_429_round_trip(lm):
+    """A prefill-time ``CacheExhaustedError`` maps to a REAL 429 on
+    ``/v1/generate`` — not an error tail riding a committed 200 — and
+    the reply carries ``Retry-After`` plus the pool's occupancy hints
+    in the JSON body so clients can back off proportionally."""
+    sched, _be = _scheduler(lm, num_blocks=4)
+    fe = serving.start_frontend(sched)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        # 8 prompt + 24 new = 32 slots -> 8 blocks, pool holds 4
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"model": "lm",
+                                 "prompt": list(range(1, 9)),
+                                 "max_new_tokens": 24}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert int(resp.getheader("Retry-After")) >= 1
+        body = json.loads(resp.read().decode())
+        assert body["type"] == "CacheExhaustedError"
+        assert 0.0 <= body["kv_cache_occupancy"] <= 1.0
+        assert body["kv_cache_blocks_total"] == 4
+        assert isinstance(body["kv_cache_blocks_free"], int)
+        # the shed took nothing: the lane still serves
+        assert sched.generate("lm", [5, 6], max_new_tokens=4)
+    finally:
+        fe.close()
+        sched.close()
+
+
 def test_kv_alloc_chaos_site(lm):
     sched, _ = _scheduler(lm)
     with chaos.inject("serving.kv_alloc", "raise", prob=1.0, seed=7,

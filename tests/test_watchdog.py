@@ -309,6 +309,7 @@ def test_default_rules_clean_registry_fires_nothing():
                      "queue_saturation", "quota_shed_surge",
                      "fused_fallback_surge",
                      "wire_bytes_regression", "wire_codec_share",
+                     "oom_proximity", "kv_cache_pressure",
                      "slo_availability_fast_burn",
                      "slo_availability_slow_burn",
                      "slo_latency_fast_burn", "slo_latency_slow_burn"]

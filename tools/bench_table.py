@@ -93,7 +93,14 @@ TREND_KEYS = {"value": True, "tokens_per_sec": True, "mfu": True,
               # trended (it measures dispatch overhead, not our code)
               "attn_prefill_ms": False,
               "paged_decode_tokens_per_sec": True,
-              "fused_opt_step_ms": False}
+              "fused_opt_step_ms": False,
+              # schema-16 memory keys (BENCH_MEMORY=1 rounds): the
+              # ledger reconcile is the gate (1.0 = books explain the
+              # live-array truth), occupancy at peak hold and device
+              # headroom are both up-is-good capacity signals
+              "memory_ledger_reconciles": True,
+              "kv_cache_occupancy_pct": True,
+              "memory_headroom_ratio": True}
 TREND_TOLERANCE = 0.10
 
 
